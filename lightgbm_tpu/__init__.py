@@ -1,0 +1,45 @@
+"""lightgbm_tpu: a TPU-native gradient-boosted decision tree framework.
+
+A from-scratch reimplementation of the capabilities of LightGBM v2.3.2
+(reference: jpkoponen/LightGBM) designed for TPUs: the compute core
+(histogram construction, split search, partitioning) runs as JAX/XLA
+programs over fixed-shape tensors, and distribution uses `jax.sharding`
+meshes with XLA collectives instead of socket/MPI allreduce.
+
+Public API mirrors the reference Python package
+(reference python-package/lightgbm/__init__.py):
+  Dataset, Booster, train, cv, and sklearn-style wrappers.
+"""
+
+from .version import __version__
+from .config import Config
+from .basic import Dataset, Booster
+from .engine import train, cv, CVBooster
+from .callback import (
+    early_stopping,
+    log_evaluation,
+    record_evaluation,
+    reset_parameter,
+    EarlyStopException,
+)
+
+__all__ = [
+    "__version__",
+    "Config",
+    "Dataset",
+    "Booster",
+    "train",
+    "cv",
+    "CVBooster",
+    "early_stopping",
+    "log_evaluation",
+    "record_evaluation",
+    "reset_parameter",
+    "EarlyStopException",
+]
+
+try:  # sklearn wrappers are optional (scikit-learn may be absent)
+    from .sklearn import LGBMModel, LGBMClassifier, LGBMRegressor, LGBMRanker
+    __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,203 @@
+"""User-facing Dataset / Booster (reference python-package/lightgbm/basic.py).
+
+`Dataset` wraps lazy binned-data construction; `Booster` wraps the boosting
+driver.  Unlike the reference there is no ctypes boundary — the "C API" level
+is `lightgbm_tpu.models` directly — but the surface mirrors basic.py so user
+code ports over unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import TrainingData, Metadata
+
+
+class Dataset:
+    """Lazily-constructed binned dataset (reference basic.py:712-1040)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, silent: bool = False):
+        self.data = data
+        self.label = label
+        self.reference = reference
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params) if params else {}
+        self.free_raw_data = free_raw_data
+        self._inner: Optional[TrainingData] = None
+        self.used_indices: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._inner is not None:
+            return self
+        cfg = Config(self.params)
+        ref_inner = self.reference._inner if self.reference is not None else None
+        if self.reference is not None and ref_inner is None:
+            self.reference.construct()
+            ref_inner = self.reference._inner
+
+        if isinstance(self.data, str):
+            if ref_inner is not None:
+                self._inner = TrainingData.from_file(self.data, cfg, reference=ref_inner)
+            else:
+                self._inner = TrainingData.from_file(self.data, cfg)
+            if self.label is not None:
+                self._inner.metadata.set_field("label", self.label)
+        else:
+            X = _to_2d_array(self.data)
+            feature_names = None if self.feature_name == "auto" else list(self.feature_name)
+            cat: Sequence[int] = []
+            if isinstance(self.categorical_feature, (list, tuple)):
+                if all(isinstance(c, (int, np.integer)) for c in self.categorical_feature):
+                    cat = [int(c) for c in self.categorical_feature]
+                elif feature_names:
+                    cat = [feature_names.index(c) for c in self.categorical_feature]
+            self._inner = TrainingData.from_matrix(
+                X, None if self.label is None else np.asarray(self.label),
+                cfg, weight=self.weight, group_sizes=self.group,
+                init_score=self.init_score, reference=ref_inner,
+                feature_names=feature_names, categorical_features=cat)
+        if self.group is not None and self._inner.metadata.query_boundaries is None:
+            self._inner.metadata.set_field("group", np.asarray(self.group))
+        if self.weight is not None and self._inner.metadata.weight is None:
+            self._inner.metadata.set_field("weight", np.asarray(self.weight))
+        return self
+
+    # ------------------------------------------------------------------
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       params=params if params is not None else self.params)
+
+    def set_field(self, name: str, data) -> "Dataset":
+        self.construct()
+        self._inner.metadata.set_field(name, data)
+        return self
+
+    def get_field(self, name: str):
+        self.construct()
+        return self._inner.metadata.get_field(name)
+
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._inner is not None:
+            self._inner.metadata.set_field("label", label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._inner is not None:
+            self._inner.metadata.set_field("weight", weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._inner is not None:
+            self._inner.metadata.set_field("group", group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._inner is not None:
+            self._inner.metadata.set_field("init_score", init_score)
+        return self
+
+    def get_label(self):
+        return self.get_field("label")
+
+    def get_weight(self):
+        return self.get_field("weight")
+
+    def get_group(self):
+        b = self.get_field("group")
+        return None if b is None else np.diff(b)
+
+    def get_init_score(self):
+        return self.get_field("init_score")
+
+    def num_data(self) -> int:
+        self.construct()
+        return self._inner.num_data
+
+    def num_feature(self) -> int:
+        self.construct()
+        return self._inner.num_total_features
+
+    def subset(self, used_indices, params=None) -> "Dataset":
+        """Row subset sharing this dataset's bin mappers (for cv / bagging)."""
+        self.construct()
+        idx = np.asarray(used_indices)
+        sub = Dataset.__new__(Dataset)
+        sub.data = None
+        sub.label = None
+        sub.reference = self
+        sub.weight = None
+        sub.group = None
+        sub.init_score = None
+        sub.feature_name = self.feature_name
+        sub.categorical_feature = self.categorical_feature
+        sub.params = dict(params) if params else dict(self.params)
+        sub.free_raw_data = True
+        sub.used_indices = idx
+        inner = TrainingData()
+        src = self._inner
+        inner.num_data = len(idx)
+        inner.num_total_features = src.num_total_features
+        inner.used_feature_idx = list(src.used_feature_idx)
+        inner.mappers = src.mappers
+        inner.bins = src.bins[idx]
+        inner.feature_names = src.feature_names
+        inner.config = src.config
+        inner.monotone_constraints = src.monotone_constraints
+        inner.feature_penalty = src.feature_penalty
+        md = src.metadata
+        group_sizes = None
+        if md.query_boundaries is not None:
+            # rows of one query must be taken together (cv folds do this);
+            # recover per-query sizes by run-length over query ids
+            qid = np.searchsorted(md.query_boundaries, idx, side="right") - 1
+            if np.any(np.diff(qid) < 0):
+                raise ValueError("subset indices must be sorted for grouped data")
+            change = np.flatnonzero(np.diff(qid)) + 1
+            starts = np.concatenate([[0], change, [len(idx)]])
+            group_sizes = np.diff(starts)
+        inner.metadata = Metadata(
+            len(idx), md.label[idx],
+            None if md.weight is None else md.weight[idx],
+            group_sizes,
+            None if md.init_score is None else _subset_init_score(md, idx))
+        sub._inner = inner
+        return sub
+
+
+def _subset_init_score(md: Metadata, idx: np.ndarray):
+    s = md.init_score
+    if s is None:
+        return None
+    if s.ndim == 1 and len(s) == md.num_data:
+        return s[idx]
+    return s.reshape(md.num_data, -1)[idx].reshape(-1)
+
+
+def _to_2d_array(data) -> np.ndarray:
+    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas
+        return data.values.astype(np.float64)
+    if hasattr(data, "toarray"):  # scipy sparse
+        return np.asarray(data.toarray(), dtype=np.float64)
+    return np.asarray(data, dtype=np.float64)
+
+
+from .booster import Booster  # noqa: E402  (re-export; keeps basic.py the facade)

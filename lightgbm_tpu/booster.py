@@ -1,0 +1,169 @@
+"""Booster: the training/prediction handle (reference basic.py Booster class).
+
+Wraps the boosting driver in `lightgbm_tpu.models` the way the reference
+Booster wraps the C API handle (reference python-package/lightgbm/basic.py,
+src/c_api.cpp:98-320).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .config import Config
+from .io.dataset import TrainingData
+
+
+class Booster:
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional["Dataset"] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None, silent: bool = False):
+        from .basic import Dataset
+        from .models import create_boosting
+        from .models.gbdt import GBDT
+
+        self.params = dict(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict[str, Dict[str, float]] = {}
+        self._valid_names: List[str] = []
+        self._train_set: Optional[Dataset] = None
+        self._driver = None
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError("train_set must be a Dataset")
+            train_set.construct()
+            self._train_set = train_set
+            cfg = Config(self.params)
+            self._driver = create_boosting(cfg)
+            self._driver.init(cfg, train_set._inner)
+        elif model_file is not None:
+            with open(model_file) as f:
+                text = f.read()
+            self._driver = GBDT.from_model_string(text)
+            self.params = dict(self._driver.loaded_params)
+        elif model_str is not None:
+            self._driver = GBDT.from_model_string(model_str)
+            self.params = dict(self._driver.loaded_params)
+        else:
+            raise ValueError("need train_set, model_file or model_str")
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data, name: str) -> "Booster":
+        data.construct()
+        self._driver.add_valid(data._inner, name)
+        self._valid_names.append(name)
+        return self
+
+    def update(self, train_set=None, fobj=None) -> bool:
+        """One boosting iteration; returns True if no further splits occurred."""
+        if fobj is None:
+            return self._driver.train_one_iter()
+        grad, hess = fobj(self._driver.current_score_for_fobj(), self._train_set)
+        return self._driver.train_one_iter_custom(np.asarray(grad, np.float32),
+                                                  np.asarray(hess, np.float32))
+
+    def rollback_one_iter(self) -> "Booster":
+        self._driver.rollback_one_iter()
+        return self
+
+    @property
+    def current_iteration(self) -> int:
+        return self._driver.current_iteration()
+
+    def num_trees(self) -> int:
+        return self._driver.num_total_model()
+
+    def num_model_per_iteration(self) -> int:
+        return self._driver.num_model_per_iteration()
+
+    def eval_train(self, feval=None) -> List[Tuple]:
+        return self._driver.eval("training", -1, feval=feval,
+                                 booster=self)
+
+    def eval_valid(self, feval=None) -> List[Tuple]:
+        out: List[Tuple] = []
+        for i, name in enumerate(self._valid_names):
+            out.extend(self._driver.eval(name, i, feval=feval, booster=self))
+        return out
+
+    def eval(self, data, name: str, feval=None) -> List[Tuple]:
+        data.construct()
+        return self._driver.eval_for_data(data._inner, name, feval=feval)
+
+    def predict(self, data, num_iteration: Optional[int] = None,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        from .basic import _to_2d_array
+        if isinstance(data, str):
+            from .io.parser import load_text_file
+            cfg = Config(self.params)
+            X = load_text_file(data, label_column=cfg.label_column,
+                               header=True if cfg.header else None)[0]
+            # file without a label column: reload keeping all columns
+            if X.shape[1] == self.num_feature() - 1:
+                X = load_text_file(data, label_column="", header=None)[0]
+        else:
+            X = _to_2d_array(data)
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
+        return self._driver.predict(X, num_iteration=num_iteration,
+                                    raw_score=raw_score, pred_leaf=pred_leaf,
+                                    pred_contrib=pred_contrib)
+
+    def refit(self, data, label, decay_rate: float = 0.9):
+        from .basic import _to_2d_array
+        X = _to_2d_array(data)
+        new_driver = self._driver.refit(X, np.asarray(label), decay_rate)
+        out = Booster(model_str=new_driver.save_model_to_string())
+        return out
+
+    # -- model IO ------------------------------------------------------
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> "Booster":
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
+        with open(filename, "w") as f:
+            f.write(self._driver.save_model_to_string(
+                num_iteration=num_iteration, start_iteration=start_iteration))
+        return self
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0) -> str:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
+        return self._driver.save_model_to_string(
+            num_iteration=num_iteration, start_iteration=start_iteration)
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0) -> Dict:
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
+        return self._driver.dump_model(num_iteration=num_iteration,
+                                       start_iteration=start_iteration)
+
+    # -- introspection -------------------------------------------------
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        return self._driver.feature_importance(importance_type)
+
+    def feature_name(self) -> List[str]:
+        return list(self._driver.feature_names)
+
+    def num_feature(self) -> int:
+        return self._driver.max_feature_idx + 1
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self._driver.reset_config(Config(self.params))
+        return self
+
+    def set_train_data_name(self, name: str) -> "Booster":
+        self._train_data_name = name
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0, end_iteration: int = -1):
+        self._driver.shuffle_models(start_iteration, end_iteration)
+        return self

@@ -1,0 +1,139 @@
+"""Training callbacks (reference python-package/lightgbm/callback.py:55-219)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Dict, List
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+class EarlyStopException(Exception):
+    def __init__(self, best_iteration: int, best_score: List):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list and \
+                (env.iteration + 1) % period == 0:
+            result = "\t".join(
+                _format_eval_result(x, show_stdv) for x in env.evaluation_result_list)
+            print(f"[{env.iteration + 1}]\t{result}")
+    _callback.order = 10
+    return _callback
+
+
+# back-compat alias matching the reference's print_evaluation
+print_evaluation = log_evaluation
+
+
+def _format_eval_result(value, show_stdv: bool = True) -> str:
+    if len(value) == 4:
+        return f"{value[0]}'s {value[1]}: {value[2]:g}"
+    if len(value) == 5:
+        if show_stdv:
+            return f"{value[0]}'s {value[1]}: {value[2]:g} + {value[4]:g}"
+        return f"{value[0]}'s {value[1]}: {value[2]:g}"
+    raise ValueError("Wrong metric value")
+
+
+def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result should be a dict")
+    eval_result.clear()
+
+    def _callback(env: CallbackEnv) -> None:
+        for item in env.evaluation_result_list:
+            data_name, eval_name = item[0], item[1]
+            eval_result.setdefault(data_name, collections.OrderedDict())
+            eval_result[data_name].setdefault(eval_name, [])
+            eval_result[data_name][eval_name].append(item[2])
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs: Any) -> Callable:
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(f"length of list {key!r} must equal num_boost_round")
+                new_params[key] = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_params[key] = value(env.iteration - env.begin_iteration)
+        if new_params:
+            env.model.reset_parameter(new_params)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True) -> Callable:
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List[List] = []
+    cmp_op: List[Callable] = []
+    enabled: List[bool] = [True]
+    first_metric: List[str] = [""]
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = not any(
+            env.params.get(alias, "") == "dart"
+            for alias in ("boosting", "boosting_type", "boost"))
+        if not enabled[0]:
+            if verbose:
+                print("Early stopping is not available in dart mode")
+            return
+        if not env.evaluation_result_list:
+            raise ValueError("For early stopping, at least one validation set is required")
+        if verbose:
+            print(f"Training until validation scores don't improve for "
+                  f"{stopping_rounds} rounds")
+        first_metric[0] = env.evaluation_result_list[0][1]
+        for item in env.evaluation_result_list:
+            best_iter.append(0)
+            best_score_list.append(None)
+            if item[3]:  # higher is better
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda x, y: x > y)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda x, y: x < y)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not cmp_op:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, item in enumerate(env.evaluation_result_list):
+            score = item[2]
+            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
+                best_score[i] = score
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            if first_metric_only and first_metric[0] != item[1]:
+                continue
+            if item[0] == "training" and len(env.evaluation_result_list) > 1:
+                continue  # train metric doesn't trigger early stop when valids exist
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    print(f"Early stopping, best iteration is:\n"
+                          f"[{best_iter[i] + 1}]\t"
+                          + "\t".join(_format_eval_result(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    print(f"Did not meet early stopping. Best iteration is:\n"
+                          f"[{best_iter[i] + 1}]\t"
+                          + "\t".join(_format_eval_result(x) for x in best_score_list[i]))
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+    _callback.order = 30
+    return _callback

@@ -1,0 +1,339 @@
+"""Typed training configuration with LightGBM-compatible parameter names/aliases.
+
+The reference keeps a ~180-field `Config` struct whose alias table and setters are
+code-generated from doc comments (reference include/LightGBM/config.h:41-79 and
+src/config_auto.cpp:10).  Here the registry is a plain Python table: each entry is
+(canonical name, type, default, aliases).  Parameters flow as `key=value` strings
+through every layer, as in the reference (`Config::Str2Map`, config.h:41).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Parameter registry: canonical -> (type, default, aliases)
+# Types: "int", "float", "bool", "str", "int_list", "float_list", "str_list"
+# Mirrors reference include/LightGBM/config.h fields + config_auto.cpp alias table.
+# ---------------------------------------------------------------------------
+
+_P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
+    # --- core ---
+    "config": ("str", "", ("config_file",)),
+    "task": ("str", "train", ("task_type",)),
+    "objective": ("str", "regression", ("objective_type", "app", "application")),
+    "boosting": ("str", "gbdt", ("boosting_type", "boost")),
+    "data": ("str", "", ("train", "train_data", "train_data_file", "data_filename")),
+    "valid": ("str_list", [], ("test", "valid_data", "valid_data_file", "test_data",
+                               "test_data_file", "valid_filenames")),
+    "num_iterations": ("int", 100, ("num_iteration", "n_iter", "num_tree", "num_trees",
+                                    "num_round", "num_rounds", "num_boost_round",
+                                    "n_estimators")),
+    "learning_rate": ("float", 0.1, ("shrinkage_rate", "eta")),
+    "num_leaves": ("int", 31, ("num_leaf", "max_leaves", "max_leaf")),
+    "tree_learner": ("str", "serial", ("tree", "tree_type", "tree_learner_type")),
+    "num_threads": ("int", 0, ("num_thread", "nthread", "nthreads", "n_jobs")),
+    "device_type": ("str", "tpu", ("device",)),
+    "seed": ("int", 0, ("random_seed", "random_state")),
+    # --- learning control ---
+    "max_depth": ("int", -1, ()),
+    "min_data_in_leaf": ("int", 20, ("min_data_per_leaf", "min_data", "min_child_samples")),
+    "min_sum_hessian_in_leaf": ("float", 1e-3, ("min_sum_hessian_per_leaf", "min_sum_hessian",
+                                                "min_hessian", "min_child_weight")),
+    "bagging_fraction": ("float", 1.0, ("sub_row", "subsample", "bagging")),
+    "pos_bagging_fraction": ("float", 1.0, ("pos_sub_row", "pos_subsample", "pos_bagging")),
+    "neg_bagging_fraction": ("float", 1.0, ("neg_sub_row", "neg_subsample", "neg_bagging")),
+    "bagging_freq": ("int", 0, ("subsample_freq",)),
+    "bagging_seed": ("int", 3, ("bagging_fraction_seed",)),
+    "feature_fraction": ("float", 1.0, ("sub_feature", "colsample_bytree")),
+    "feature_fraction_bynode": ("float", 1.0, ("sub_feature_bynode", "colsample_bynode")),
+    "feature_fraction_seed": ("int", 2, ()),
+    "early_stopping_round": ("int", 0, ("early_stopping_rounds", "early_stopping",
+                                        "n_iter_no_change")),
+    "first_metric_only": ("bool", False, ()),
+    "max_delta_step": ("float", 0.0, ("max_tree_output", "max_leaf_output")),
+    "lambda_l1": ("float", 0.0, ("reg_alpha",)),
+    "lambda_l2": ("float", 0.0, ("reg_lambda", "lambda")),
+    "min_gain_to_split": ("float", 0.0, ("min_split_gain",)),
+    "drop_rate": ("float", 0.1, ("rate_drop",)),
+    "max_drop": ("int", 50, ()),
+    "skip_drop": ("float", 0.5, ()),
+    "xgboost_dart_mode": ("bool", False, ()),
+    "uniform_drop": ("bool", False, ()),
+    "drop_seed": ("int", 4, ()),
+    "top_rate": ("float", 0.2, ()),
+    "other_rate": ("float", 0.1, ()),
+    "min_data_per_group": ("int", 100, ()),
+    "max_cat_threshold": ("int", 32, ()),
+    "cat_l2": ("float", 10.0, ()),
+    "cat_smooth": ("float", 10.0, ()),
+    "max_cat_to_onehot": ("int", 4, ()),
+    "top_k": ("int", 20, ("topk",)),
+    "monotone_constraints": ("int_list", [], ("mc", "monotone_constraint")),
+    "feature_contri": ("float_list", [], ("feature_contrib", "fc", "fp", "feature_penalty")),
+    "forcedsplits_filename": ("str", "", ("fs", "forced_splits_filename", "forced_splits_file",
+                                          "forced_splits")),
+    "forcedbins_filename": ("str", "", ()),
+    "refit_decay_rate": ("float", 0.9, ()),
+    "cegb_tradeoff": ("float", 1.0, ()),
+    "cegb_penalty_split": ("float", 0.0, ()),
+    "cegb_penalty_feature_lazy": ("float_list", [], ()),
+    "cegb_penalty_feature_coupled": ("float_list", [], ()),
+    "verbosity": ("int", 1, ("verbose",)),
+    "snapshot_freq": ("int", -1, ("save_period",)),
+    # --- IO / dataset ---
+    "max_bin": ("int", 255, ()),
+    "max_bin_by_feature": ("int_list", [], ()),
+    "min_data_in_bin": ("int", 3, ()),
+    "bin_construct_sample_cnt": ("int", 200000, ("subsample_for_bin",)),
+    "histogram_pool_size": ("float", -1.0, ("hist_pool_size",)),
+    "data_random_seed": ("int", 1, ("data_seed",)),
+    "output_model": ("str", "LightGBM_model.txt", ("model_output", "model_out")),
+    "input_model": ("str", "", ("model_input", "model_in")),
+    "output_result": ("str", "LightGBM_predict_result.txt",
+                      ("predict_result", "prediction_result", "predict_name",
+                       "prediction_name", "pred_name", "name_pred")),
+    "initscore_filename": ("str", "", ("init_score_filename", "init_score_file",
+                                       "init_score", "input_init_score")),
+    "valid_data_initscores": ("str_list", [], ("valid_data_init_scores",
+                                               "valid_init_score_file", "valid_init_score")),
+    "pre_partition": ("bool", False, ("is_pre_partition",)),
+    "enable_bundle": ("bool", True, ("is_enable_bundle", "bundle")),
+    "max_conflict_rate": ("float", 0.0, ()),
+    "is_enable_sparse": ("bool", True, ("is_sparse", "enable_sparse", "sparse")),
+    "sparse_threshold": ("float", 0.8, ()),
+    "use_missing": ("bool", True, ()),
+    "zero_as_missing": ("bool", False, ()),
+    "two_round": ("bool", False, ("two_round_loading", "use_two_round_loading")),
+    "save_binary": ("bool", False, ("is_save_binary", "is_save_binary_file")),
+    "header": ("bool", False, ("has_header",)),
+    "label_column": ("str", "", ("label",)),
+    "weight_column": ("str", "", ("weight",)),
+    "group_column": ("str", "", ("group", "group_id", "query_column", "query", "query_id")),
+    "ignore_column": ("str", "", ("ignore_feature", "blacklist")),
+    "categorical_feature": ("str", "", ("cat_feature", "categorical_column", "cat_column")),
+    # --- predict ---
+    "predict_raw_score": ("bool", False, ("is_predict_raw_score", "predict_rawscore",
+                                          "raw_score")),
+    "predict_leaf_index": ("bool", False, ("is_predict_leaf_index", "leaf_index")),
+    "predict_contrib": ("bool", False, ("is_predict_contrib", "contrib")),
+    "num_iteration_predict": ("int", -1, ()),
+    "pred_early_stop": ("bool", False, ()),
+    "pred_early_stop_freq": ("int", 10, ()),
+    "pred_early_stop_margin": ("float", 10.0, ()),
+    # --- objective ---
+    "num_class": ("int", 1, ("num_classes",)),
+    "is_unbalance": ("bool", False, ("unbalance", "unbalanced_sets")),
+    "scale_pos_weight": ("float", 1.0, ()),
+    "sigmoid": ("float", 1.0, ()),
+    "boost_from_average": ("bool", True, ()),
+    "reg_sqrt": ("bool", False, ()),
+    "alpha": ("float", 0.9, ()),
+    "fair_c": ("float", 1.0, ()),
+    "poisson_max_delta_step": ("float", 0.7, ()),
+    "tweedie_variance_power": ("float", 1.5, ()),
+    "max_position": ("int", 20, ()),
+    "lambdamart_norm": ("bool", True, ()),
+    "label_gain": ("float_list", [], ()),
+    "objective_seed": ("int", 5, ()),
+    # --- metric ---
+    "metric": ("str_list", [], ("metrics", "metric_types")),
+    "metric_freq": ("int", 1, ("output_freq",)),
+    "is_provide_training_metric": ("bool", False, ("training_metric", "is_training_metric",
+                                                   "train_metric")),
+    "eval_at": ("int_list", [1, 2, 3, 4, 5], ("ndcg_eval_at", "ndcg_at", "map_eval_at",
+                                              "map_at")),
+    "multi_error_top_k": ("int", 1, ()),
+    # --- network (mesh) ---
+    "num_machines": ("int", 1, ("num_machine",)),
+    "local_listen_port": ("int", 12400, ("local_port", "port")),
+    "time_out": ("int", 120, ()),
+    "machine_list_filename": ("str", "", ("machine_list_file", "machine_list", "mlist")),
+    "machines": ("str", "", ("workers", "nodes")),
+    # --- device (TPU analog of the reference's GPU block) ---
+    "gpu_platform_id": ("int", -1, ()),
+    "gpu_device_id": ("int", -1, ()),
+    "gpu_use_dp": ("bool", False, ()),
+    # TPU-specific: precision of histogram matmul accumulation.
+    #   "hilo"   - bf16 hi/lo split stats, f32 accumulate (default; ~f32 accurate, MXU speed)
+    #   "bf16"   - single bf16 stats pass (fastest, lossy)
+    #   "f32"    - full f32 dots (XLA 'highest' precision)
+    "tpu_hist_precision": ("str", "hilo", ("hist_precision",)),
+    # rows per histogram scan block (device-side); tuned for VMEM/HBM balance
+    "tpu_block_rows": ("int", 16384, ()),
+}
+
+_ALIAS: Dict[str, str] = {}
+for _name, (_t, _d, _aliases) in _P.items():
+    _ALIAS[_name] = _name
+    for _a in _aliases:
+        _ALIAS[_a] = _name
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "t", "yes", "on", "+"):
+        return True
+    if s in ("false", "0", "f", "no", "off", "-"):
+        return False
+    raise ValueError(f"cannot parse bool from {v!r}")
+
+
+def _coerce(typ: str, v: Any) -> Any:
+    if typ == "int":
+        return int(float(v)) if not isinstance(v, int) else v
+    if typ == "float":
+        return float(v)
+    if typ == "bool":
+        return _parse_bool(v)
+    if typ == "str":
+        return str(v)
+    if typ in ("int_list", "float_list", "str_list"):
+        if isinstance(v, (list, tuple)):
+            items: List[Any] = list(v)
+        else:
+            s = str(v).strip()
+            items = [x for x in s.replace(";", ",").split(",") if x != ""]
+        if typ == "int_list":
+            return [int(float(x)) for x in items]
+        if typ == "float_list":
+            return [float(x) for x in items]
+        return [str(x) for x in items]
+    raise ValueError(f"unknown param type {typ}")
+
+
+OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson",
+    "quantile": "quantile", "mape": "mape",
+    "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank",
+    "rank_xendcg": "rank_xendcg", "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg",
+    "xe_ndcg_mart": "rank_xendcg", "xendcg_mart": "rank_xendcg",
+    "none": "none", "null": "none", "custom": "none", "na": "none",
+}
+
+
+@dataclasses.dataclass
+class Config:
+    """Resolved training configuration.
+
+    Construct with `Config(params_dict)` or `Config.from_string("k1=v1 k2=v2")`.
+    Unknown keys are kept in `extra` (and warned about) so callers can pass
+    through framework-specific knobs.
+    """
+
+    params: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        self.params = {k: (list(v) if isinstance(v, list) else v)
+                       for k, (t, v, _a) in _P.items()}
+        self.extra = {}
+        if params:
+            self.update(params)
+        self._check_conflicts()
+
+    # -- mapping-ish access ------------------------------------------------
+    def __getattr__(self, name: str) -> Any:
+        params = self.__dict__.get("params")
+        if params is not None and name in params:
+            return params[name]
+        raise AttributeError(name)
+
+    def __getitem__(self, name: str) -> Any:
+        return self.params[_ALIAS.get(name, name)]
+
+    def get(self, name: str, default: Any = None) -> Any:
+        return self.params.get(_ALIAS.get(name, name), default)
+
+    def update(self, params: Dict[str, Any]) -> None:
+        for k, v in params.items():
+            canon = _ALIAS.get(str(k).strip())
+            if canon is None:
+                self.extra[str(k)] = v
+                continue
+            typ = _P[canon][0]
+            self.params[canon] = _coerce(typ, v)
+        self._normalize()
+
+    def _normalize(self) -> None:
+        obj = str(self.params["objective"]).strip().lower()
+        self.params["objective"] = OBJECTIVE_ALIASES.get(obj, obj)
+        self.params["boosting"] = str(self.params["boosting"]).strip().lower()
+        self.params["tree_learner"] = str(self.params["tree_learner"]).strip().lower()
+        self.params["device_type"] = str(self.params["device_type"]).strip().lower()
+
+    def _check_conflicts(self) -> None:
+        # mirrors reference Config::CheckParamConflict (config.h:893)
+        p = self.params
+        if p["is_provide_training_metric"] or p["valid"]:
+            if not p["metric"]:
+                # default metric comes from the objective at Booster build time
+                pass
+        if p["boosting"] == "goss":
+            # bagging is managed by GOSS itself
+            p["bagging_freq"] = 0
+        learner = p["tree_learner"]
+        if learner not in ("serial", "feature", "data", "voting",
+                           "feature_parallel", "data_parallel", "voting_parallel"):
+            raise ValueError(f"unknown tree_learner {learner!r}")
+
+    # -- string parsing ----------------------------------------------------
+    @staticmethod
+    def str_to_map(text: str) -> Dict[str, str]:
+        """Parse 'k1=v1 k2=v2' (whitespace/newline separated) into a dict.
+
+        Mirrors reference Config::Str2Map (src/io/config.cpp:41); '#' starts
+        a comment, as in reference .conf files.
+        """
+        out: Dict[str, str] = {}
+        for raw_line in text.replace("\r", "\n").split("\n"):
+            line = raw_line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            for tok in line.split():
+                if "=" in tok:
+                    k, v = tok.split("=", 1)
+                    out[k.strip()] = v.strip()
+        return out
+
+    @staticmethod
+    def load_conf_file(path: str) -> Dict[str, str]:
+        """Parse a reference-style .conf file (one `key = value` per line)."""
+        out: Dict[str, str] = {}
+        with open(path) as f:
+            for raw_line in f:
+                line = raw_line.split("#", 1)[0].strip()
+                if not line or "=" not in line:
+                    continue
+                k, v = line.split("=", 1)
+                out[k.strip()] = v.strip()
+        return out
+
+    @classmethod
+    def from_string(cls, text: str) -> "Config":
+        return cls(cls.str_to_map(text))
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dict(self.params)
+        d.update(self.extra)
+        return d
+
+
+def canonical_name(name: str) -> Optional[str]:
+    return _ALIAS.get(name)

@@ -1,0 +1,245 @@
+"""Training entry points: train() and cv() (reference engine.py:18,373)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from .basic import Dataset
+from .booster import Booster
+from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
+          init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None,
+          early_stopping_rounds: Optional[int] = None,
+          verbose_eval: Union[bool, int] = True,
+          evals_result: Optional[Dict] = None) -> Booster:
+    params = copy.deepcopy(params)
+    if fobj is not None:
+        params["objective"] = "none"
+    for alias in ("num_boost_round", "num_iterations", "num_iteration", "n_iter",
+                  "num_tree", "num_trees", "num_round", "num_rounds",
+                  "n_estimators"):
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+    for alias in ("early_stopping_round", "early_stopping_rounds",
+                  "early_stopping", "n_iter_no_change"):
+        if alias in params and params[alias] is not None:
+            early_stopping_rounds = int(params.pop(alias))
+
+    first_metric_only = bool(params.get("first_metric_only", False))
+
+    if init_model is not None:
+        if isinstance(init_model, str):
+            init_booster = Booster(model_file=init_model)
+        else:
+            init_booster = init_model
+        init_model_str = init_booster.model_to_string()
+    else:
+        init_model_str = None
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model_str is not None:
+        booster._driver.merge_from_model_string(init_model_str)
+    booster.set_train_data_name(params.get("train_data_name", "training"))
+
+    valid_sets = valid_sets or []
+    if valid_names is None:
+        valid_names = [f"valid_{i}" for i in range(len(valid_sets))]
+    is_valid_contain_train = False
+    train_data_name = "training"
+    for vs, name in zip(valid_sets, valid_names):
+        if vs is train_set:
+            is_valid_contain_train = True
+            train_data_name = name
+            continue
+        booster.add_valid(vs, name)
+
+    callbacks = list(callbacks) if callbacks else []
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        callbacks.append(early_stopping(early_stopping_rounds, first_metric_only,
+                                        verbose=bool(verbose_eval)))
+    if verbose_eval is True:
+        callbacks.append(log_evaluation(1))
+    elif isinstance(verbose_eval, int) and verbose_eval >= 1:
+        callbacks.append(log_evaluation(verbose_eval))
+    if evals_result is not None:
+        from .callback import record_evaluation
+        callbacks.append(record_evaluation(evals_result))
+
+    cb_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
+    cb_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
+    cb_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in cb_before:
+            cb(CallbackEnv(model=booster, params=params, iteration=i,
+                           begin_iteration=0, end_iteration=num_boost_round,
+                           evaluation_result_list=None))
+        booster.update(fobj=fobj)
+
+        evaluation_result_list: List = []
+        if valid_sets:
+            if is_valid_contain_train:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in cb_after:
+                cb(CallbackEnv(model=booster, params=params, iteration=i,
+                               begin_iteration=0, end_iteration=num_boost_round,
+                               evaluation_result_list=evaluation_result_list))
+        except EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            evaluation_result_list = e.best_score
+            break
+
+    booster.best_score = {}
+    for item in evaluation_result_list:
+        booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    if booster.best_iteration < 0:
+        booster.best_iteration = -1
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters returned by cv() (reference engine.py:296)."""
+
+    def __init__(self):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def _append(self, booster: Booster) -> None:
+        self.boosters.append(booster)
+
+    def __getattr__(self, name: str):
+        def handler_function(*args: Any, **kwargs: Any) -> List[Any]:
+            return [getattr(booster, name)(*args, **kwargs)
+                    for booster in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params: Dict, seed: int,
+                  stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    group = full_data.get_field("group")
+    rng = np.random.default_rng(seed)
+    if group is not None:
+        # group-aware folds: split whole queries
+        boundaries = group
+        num_queries = len(boundaries) - 1
+        q_idx = np.arange(num_queries)
+        if shuffle:
+            rng.shuffle(q_idx)
+        folds = []
+        flat_group = np.zeros(num_data, dtype=np.int64)
+        for q in range(num_queries):
+            flat_group[boundaries[q]:boundaries[q + 1]] = q
+        for k in range(nfold):
+            test_queries = set(q_idx[k::nfold].tolist())
+            test_mask = np.isin(flat_group, list(test_queries))
+            folds.append((np.where(~test_mask)[0], np.where(test_mask)[0]))
+    elif stratified:
+        label = full_data.get_field("label")
+        folds = []
+        idx_by_class: List[np.ndarray] = []
+        for c in np.unique(label):
+            ci = np.where(label == c)[0]
+            if shuffle:
+                rng.shuffle(ci)
+            idx_by_class.append(ci)
+        for k in range(nfold):
+            test_idx = np.concatenate([ci[k::nfold] for ci in idx_by_class])
+            mask = np.zeros(num_data, dtype=bool)
+            mask[test_idx] = True
+            folds.append((np.where(~mask)[0], np.where(mask)[0]))
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        folds = [(np.setdiff1d(idx, idx[k::nfold], assume_unique=False),
+                  idx[k::nfold]) for k in range(nfold)]
+    return folds
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       early_stopping_rounds: Optional[int] = None,
+       verbose_eval: Union[bool, int, None] = None, show_stdv: bool = True,
+       seed: int = 0, callbacks=None, return_cvbooster: bool = False) -> Dict:
+    params = copy.deepcopy(params)
+    if metrics is not None:
+        params["metric"] = metrics
+    for alias in ("num_boost_round", "num_iterations", "num_iteration", "n_iter",
+                  "num_tree", "num_trees", "num_round", "num_rounds",
+                  "n_estimators"):
+        if alias in params:
+            num_boost_round = int(params.pop(alias))
+
+    if folds is None:
+        stratified = stratified and str(params.get("objective", "")).startswith(
+            ("binary", "multiclass"))
+        folds = _make_n_folds(train_set, nfold, params, seed, stratified, shuffle)
+    elif hasattr(folds, "split"):
+        label = train_set.get_field("label")
+        folds = list(folds.split(np.zeros(train_set.num_data()), label))
+
+    cvbooster = CVBooster()
+    raw_results: List[List] = []
+    for train_idx, test_idx in folds:
+        tr = train_set.subset(np.sort(train_idx))
+        te = train_set.subset(np.sort(test_idx))
+        bst = Booster(params=params, train_set=tr)
+        bst.add_valid(te, "valid")
+        cvbooster._append(bst)
+
+    results: Dict[str, List[float]] = {}
+    for i in range(num_boost_round):
+        all_evals: List[List] = []
+        for bst in cvbooster.boosters:
+            bst.update(fobj=fobj)
+            all_evals.append(bst.eval_valid(feval))
+        # aggregate across folds
+        agg: Dict[str, List[float]] = {}
+        higher: Dict[str, bool] = {}
+        for evals in all_evals:
+            for item in evals:
+                key = f"{item[1]}"
+                agg.setdefault(key, []).append(item[2])
+                higher[key] = item[3]
+        stop = False
+        for key, vals in agg.items():
+            mean, std = float(np.mean(vals)), float(np.std(vals))
+            results.setdefault(f"{key}-mean", []).append(mean)
+            results.setdefault(f"{key}-stdv", []).append(std)
+        if verbose_eval:
+            msgs = [f"{k}: {np.mean(v):g} + {np.std(v):g}" for k, v in agg.items()]
+            print(f"[{i + 1}]\t" + "\t".join(msgs))
+        if early_stopping_rounds and i >= early_stopping_rounds:
+            for key, vals in agg.items():
+                series = results[f"{key}-mean"]
+                best = (np.argmax(series) if higher[key] else np.argmin(series))
+                if i - best >= early_stopping_rounds:
+                    cvbooster.best_iteration = int(best) + 1
+                    stop = True
+                break  # first metric decides
+        if stop:
+            for key in list(results):
+                results[key] = results[key][:cvbooster.best_iteration]
+            break
+
+    out = dict(results)
+    if return_cvbooster:
+        out["cvbooster"] = cvbooster
+    return out

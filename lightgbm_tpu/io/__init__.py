@@ -1,0 +1,3 @@
+from .bin_mapper import BinMapper, MissingType, BinType
+from .dataset import TrainingData, Metadata
+from .parser import load_text_file

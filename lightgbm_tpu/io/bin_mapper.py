@@ -1,0 +1,530 @@
+"""Per-feature value -> bin quantization.
+
+Behavioral re-implementation of the reference BinMapper
+(reference src/io/bin.cpp:78-470, include/LightGBM/bin.h:65-230):
+
+* numerical features: greedy equal-count bin boundary search
+  (`GreedyFindBin`, bin.cpp:78) with the zero-as-one-bin variant
+  (`FindBinWithZeroAsOneBin`, bin.cpp:256) that dedicates one bin to
+  [-1e-35, 1e-35] and splits the budget between negative / positive values;
+* categorical features: categories sorted by count, mapped to bins until 99%
+  coverage, rare categories -> the NaN bin (bin.cpp:410-460);
+* missing handling: None / Zero / NaN (bin.h:26-30) — with MissingType.NaN the
+  last bin is reserved for NaN values;
+* forced bin bounds (`forcedbins_filename`, bin.cpp:157-255).
+
+Bin semantics: numerical bin `i` holds values v with
+`bin_upper_bound[i-1] < v <= bin_upper_bound[i]`; the last real upper bound is
++inf.  `value_to_bin` therefore is a searchsorted over the upper bounds
+(reference `BinMapper::ValueToBin`, bin.h:472-508).
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+K_ZERO_THRESHOLD = 1e-35  # reference include/LightGBM/meta.h:53
+_F32_INF = float("inf")
+
+
+class MissingType(enum.IntEnum):
+    NONE = 0
+    ZERO = 1
+    NAN = 2
+
+
+class BinType(enum.IntEnum):
+    NUMERICAL = 0
+    CATEGORICAL = 1
+
+
+def _upper_bound(a: float) -> float:
+    """Smallest double strictly greater than a (reference Common::GetDoubleUpperBound)."""
+    return float(np.nextafter(a, np.inf))
+
+
+def _equal_ordered(a: float, b: float) -> bool:
+    """b <= nextafter(a, inf) (reference Common::CheckDoubleEqualOrdered)."""
+    return b <= np.nextafter(a, np.inf)
+
+
+def greedy_find_bin(distinct_values: Sequence[float], counts: Sequence[int],
+                    max_bin: int, total_cnt: int, min_data_in_bin: int) -> List[float]:
+    """Greedy equal-count boundary search (reference src/io/bin.cpp:78-155).
+
+    Returns bin upper bounds; the last is +inf.
+    """
+    assert max_bin > 0
+    num_distinct = len(distinct_values)
+    bounds: List[float] = []
+    if num_distinct <= max_bin:
+        cur_cnt_inbin = 0
+        for i in range(num_distinct - 1):
+            cur_cnt_inbin += counts[i]
+            if cur_cnt_inbin >= min_data_in_bin:
+                val = _upper_bound((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                if not bounds or not _equal_ordered(bounds[-1], val):
+                    bounds.append(val)
+                    cur_cnt_inbin = 0
+        bounds.append(_F32_INF)
+        return bounds
+
+    if min_data_in_bin > 0:
+        max_bin = max(1, min(max_bin, total_cnt // min_data_in_bin))
+    mean_bin_size = total_cnt / max_bin
+
+    # values with count >= mean size get their own bin
+    rest_bin_cnt = max_bin
+    rest_sample_cnt = total_cnt
+    is_big = [False] * num_distinct
+    for i in range(num_distinct):
+        if counts[i] >= mean_bin_size:
+            is_big[i] = True
+            rest_bin_cnt -= 1
+            rest_sample_cnt -= counts[i]
+    mean_bin_size = rest_sample_cnt / rest_bin_cnt
+
+    uppers = [_F32_INF] * max_bin
+    lowers = [_F32_INF] * max_bin
+    bin_cnt = 0
+    lowers[0] = distinct_values[0]
+    cur_cnt_inbin = 0
+    # 0.5f: the reference multiplies by a float literal (bin.cpp:131)
+    half = np.float32(0.5)
+    for i in range(num_distinct - 1):
+        if not is_big[i]:
+            rest_sample_cnt -= counts[i]
+        cur_cnt_inbin += counts[i]
+        if (is_big[i] or cur_cnt_inbin >= mean_bin_size or
+                (is_big[i + 1] and cur_cnt_inbin >= max(1.0, mean_bin_size * half))):
+            uppers[bin_cnt] = distinct_values[i]
+            bin_cnt += 1
+            lowers[bin_cnt] = distinct_values[i + 1]
+            if bin_cnt >= max_bin - 1:
+                break
+            cur_cnt_inbin = 0
+            if not is_big[i]:
+                rest_bin_cnt -= 1
+                mean_bin_size = rest_sample_cnt / rest_bin_cnt
+    bin_cnt += 1
+    for i in range(bin_cnt - 1):
+        val = _upper_bound((uppers[i] + lowers[i + 1]) / 2.0)
+        if not bounds or not _equal_ordered(bounds[-1], val):
+            bounds.append(val)
+    bounds.append(_F32_INF)
+    return bounds
+
+
+def _find_bin_zero_as_one(distinct_values: Sequence[float], counts: Sequence[int],
+                          max_bin: int, total_cnt: int,
+                          min_data_in_bin: int) -> List[float]:
+    """Zero-as-one-bin boundary search (reference src/io/bin.cpp:256-313)."""
+    num_distinct = len(distinct_values)
+    left_cnt_data = cnt_zero = right_cnt_data = 0
+    for v, c in zip(distinct_values, counts):
+        if v <= -K_ZERO_THRESHOLD:
+            left_cnt_data += c
+        elif v > K_ZERO_THRESHOLD:
+            right_cnt_data += c
+        else:
+            cnt_zero += c
+
+    left_cnt = num_distinct
+    for i, v in enumerate(distinct_values):
+        if v > -K_ZERO_THRESHOLD:
+            left_cnt = i
+            break
+
+    bounds: List[float] = []
+    if left_cnt > 0 and max_bin > 1:
+        left_max_bin = max(
+            1, int(left_cnt_data / max(1, total_cnt - cnt_zero) * (max_bin - 1)))
+        bounds = greedy_find_bin(distinct_values[:left_cnt], counts[:left_cnt],
+                                 left_max_bin, left_cnt_data, min_data_in_bin)
+        if bounds:
+            bounds[-1] = -K_ZERO_THRESHOLD
+
+    right_start = -1
+    for i in range(left_cnt, num_distinct):
+        if distinct_values[i] > K_ZERO_THRESHOLD:
+            right_start = i
+            break
+
+    right_max_bin = max_bin - 1 - len(bounds)
+    if right_start >= 0 and right_max_bin > 0:
+        right_bounds = greedy_find_bin(distinct_values[right_start:],
+                                       counts[right_start:], right_max_bin,
+                                       right_cnt_data, min_data_in_bin)
+        bounds.append(K_ZERO_THRESHOLD)
+        bounds.extend(right_bounds)
+    else:
+        bounds.append(_F32_INF)
+    assert len(bounds) <= max_bin
+    return bounds
+
+
+def _find_bin_with_forced(distinct_values: Sequence[float], counts: Sequence[int],
+                          max_bin: int, total_cnt: int, min_data_in_bin: int,
+                          forced_bounds: Sequence[float]) -> List[float]:
+    """Forced-boundary variant (reference src/io/bin.cpp:157-255)."""
+    num_distinct = len(distinct_values)
+    left_cnt = num_distinct
+    for i, v in enumerate(distinct_values):
+        if v > -K_ZERO_THRESHOLD:
+            left_cnt = i
+            break
+    right_start = -1
+    for i in range(left_cnt, num_distinct):
+        if distinct_values[i] > K_ZERO_THRESHOLD:
+            right_start = i
+            break
+
+    bounds: List[float] = []
+    if max_bin == 2:
+        bounds.append(K_ZERO_THRESHOLD if left_cnt == 0 else -K_ZERO_THRESHOLD)
+    elif max_bin >= 3:
+        if left_cnt > 0:
+            bounds.append(-K_ZERO_THRESHOLD)
+        if right_start >= 0:
+            bounds.append(K_ZERO_THRESHOLD)
+    bounds.append(_F32_INF)
+
+    max_to_insert = max_bin - len(bounds)
+    num_inserted = 0
+    for b in forced_bounds:
+        if num_inserted >= max_to_insert:
+            break
+        if abs(b) > K_ZERO_THRESHOLD:
+            bounds.append(float(b))
+            num_inserted += 1
+    bounds.sort()
+
+    free_bins = max_bin - len(bounds)
+    bounds_to_add: List[float] = []
+    value_ind = 0
+    n_bounds = len(bounds)
+    for i in range(n_bounds):
+        cnt_in_bin = 0
+        distinct_cnt_in_bin = 0
+        bin_start = value_ind
+        while value_ind < num_distinct and distinct_values[value_ind] < bounds[i]:
+            cnt_in_bin += counts[value_ind]
+            distinct_cnt_in_bin += 1
+            value_ind += 1
+        bins_remaining = max_bin - n_bounds - len(bounds_to_add)
+        num_sub_bins = int(round(cnt_in_bin * free_bins / max(1, total_cnt)))
+        num_sub_bins = min(num_sub_bins, bins_remaining) + 1
+        if i == n_bounds - 1:
+            num_sub_bins = bins_remaining + 1
+        new_bounds = greedy_find_bin(distinct_values[bin_start:value_ind],
+                                     counts[bin_start:value_ind],
+                                     num_sub_bins, cnt_in_bin, min_data_in_bin)
+        bounds_to_add.extend(new_bounds[:-1])  # last is +inf
+    bounds.extend(bounds_to_add)
+    bounds.sort()
+    assert len(bounds) <= max_bin
+    return bounds
+
+
+class BinMapper:
+    """Quantizer for one feature (reference include/LightGBM/bin.h:65-230)."""
+
+    def __init__(self) -> None:
+        self.num_bin: int = 1
+        self.is_trivial: bool = True
+        self.bin_type: BinType = BinType.NUMERICAL
+        self.missing_type: MissingType = MissingType.NONE
+        self.bin_upper_bound: np.ndarray = np.array([_F32_INF])
+        self.bin_2_categorical: List[int] = []
+        self.categorical_2_bin: Dict[int, int] = {}
+        self.min_val: float = 0.0
+        self.max_val: float = 0.0
+        self.default_bin: int = 0      # bin of value 0.0
+        self.most_freq_bin: int = 0
+        self.sparse_rate: float = 0.0
+
+    # ------------------------------------------------------------------
+    def find_bin(self, sample_values: np.ndarray, total_sample_cnt: int,
+                 max_bin: int, min_data_in_bin: int = 3, min_split_data: int = 0,
+                 bin_type: BinType = BinType.NUMERICAL, use_missing: bool = True,
+                 zero_as_missing: bool = False,
+                 forced_bounds: Optional[Sequence[float]] = None) -> None:
+        """Compute bin boundaries from sampled non-zero values.
+
+        `sample_values` excludes (near-)zero values; zeros are implied by
+        `total_sample_cnt - len(sample_values)` as in the reference
+        (src/io/bin.cpp:325-390).  NaNs may be present and are counted as
+        missing.
+        """
+        values = np.asarray(sample_values, dtype=np.float64)
+        na_cnt = int(np.isnan(values).sum())
+        values = values[~np.isnan(values)]
+
+        if not use_missing:
+            self.missing_type = MissingType.NONE
+        elif zero_as_missing:
+            self.missing_type = MissingType.ZERO
+        else:
+            self.missing_type = MissingType.NAN if na_cnt > 0 else MissingType.NONE
+        if self.missing_type != MissingType.NAN:
+            na_cnt = 0
+
+        self.bin_type = bin_type
+        self.default_bin = 0
+        zero_cnt = int(total_sample_cnt - values.size - na_cnt)
+
+        # distinct values with zero spliced in at its sorted position
+        values = np.sort(values, kind="stable")
+        distinct_values: List[float] = []
+        counts: List[int] = []
+        if values.size == 0 or (values[0] > 0.0 and zero_cnt > 0):
+            distinct_values.append(0.0)
+            counts.append(zero_cnt)
+        if values.size:
+            distinct_values.append(float(values[0]))
+            counts.append(1)
+        for i in range(1, values.size):
+            prev, cur = values[i - 1], values[i]
+            if not _equal_ordered(prev, cur):
+                if prev < 0.0 and cur > 0.0:
+                    distinct_values.append(0.0)
+                    counts.append(zero_cnt)
+                distinct_values.append(float(cur))
+                counts.append(1)
+            else:
+                # treat as equal; keep the larger value
+                distinct_values[-1] = float(cur)
+                counts[-1] += 1
+        if values.size and values[-1] < 0.0 and zero_cnt > 0:
+            distinct_values.append(0.0)
+            counts.append(zero_cnt)
+
+        self.min_val = distinct_values[0] if distinct_values else 0.0
+        self.max_val = distinct_values[-1] if distinct_values else 0.0
+        num_distinct = len(distinct_values)
+        forced = list(forced_bounds) if forced_bounds else []
+
+        if bin_type == BinType.NUMERICAL:
+            self._find_bin_numerical(distinct_values, counts, num_distinct, max_bin,
+                                     total_sample_cnt, min_data_in_bin, na_cnt, forced)
+        else:
+            self._find_bin_categorical(distinct_values, counts, max_bin,
+                                       total_sample_cnt, na_cnt, min_data_in_bin)
+
+        # trivial check + most-freq-bin / sparse-rate (reference bin.cpp:500-528)
+        self.is_trivial = self.num_bin <= 1
+        if min_split_data > 0 and not self.is_trivial:
+            if not _splittable(self._cnt_in_bin, total_sample_cnt, min_split_data,
+                               self.bin_type):
+                self.is_trivial = True
+        if not self.is_trivial:
+            self.default_bin = self.value_to_bin(0.0)
+            total = max(1, total_sample_cnt)
+            cnt = self._cnt_in_bin
+            self.most_freq_bin = int(np.argmax(cnt))
+            self.sparse_rate = float(cnt[self.default_bin]) / total
+            max_sparse_rate = float(cnt[self.most_freq_bin]) / total
+            # snap to the zero bin unless another bin dominates (>0.7)
+            if self.most_freq_bin != self.default_bin and max_sparse_rate > np.float32(0.7):
+                self.sparse_rate = max_sparse_rate
+            else:
+                self.most_freq_bin = self.default_bin
+        else:
+            self.sparse_rate = 1.0
+
+    def _find_bin_numerical(self, distinct_values, counts, num_distinct, max_bin,
+                            total_sample_cnt, min_data_in_bin, na_cnt, forced):
+        def run(mb: int, total: int) -> List[float]:
+            if forced:
+                return _find_bin_with_forced(distinct_values, counts, mb, total,
+                                             min_data_in_bin, forced)
+            return _find_bin_zero_as_one(distinct_values, counts,
+                                         mb, total, min_data_in_bin)
+
+        if self.missing_type == MissingType.ZERO:
+            bounds = run(max_bin, total_sample_cnt)
+            if len(bounds) == 2:
+                self.missing_type = MissingType.NONE
+        elif self.missing_type == MissingType.NONE:
+            bounds = run(max_bin, total_sample_cnt)
+        else:  # NaN: reserve the last bin for NaN
+            bounds = run(max_bin - 1, total_sample_cnt - na_cnt)
+            bounds.append(float("nan"))
+        self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+        self.num_bin = len(bounds)
+
+        cnt_in_bin = [0] * self.num_bin
+        i_bin = 0
+        for v, c in zip(distinct_values, counts):
+            while v > self.bin_upper_bound[i_bin]:
+                i_bin += 1
+            cnt_in_bin[i_bin] += c
+        if self.missing_type == MissingType.NAN:
+            cnt_in_bin[self.num_bin - 1] = na_cnt
+        self._cnt_in_bin = cnt_in_bin
+        self.default_bin = self.value_to_bin(0.0)
+
+    def _find_bin_categorical(self, distinct_values, counts, max_bin,
+                              total_sample_cnt, na_cnt, min_data_in_bin=3):
+        """Count-sorted categorical binning (reference bin.cpp:425-497).
+
+        Categories map to bins in descending-count order until 99% coverage;
+        rare categories share the LAST bin (via the unseen->num_bin-1 rule in
+        value_to_bin); a dedicated -1/NaN bin is added only when every
+        category got a bin and NaNs exist.
+        """
+        cat_counts: Dict[int, int] = {}
+        for v, c in zip(distinct_values, counts):
+            iv = int(v)
+            if iv < 0:
+                na_cnt += c
+            else:
+                cat_counts[iv] = cat_counts.get(iv, 0) + c
+        self.num_bin = 0
+        rest_cnt = total_sample_cnt - na_cnt
+        self._cnt_in_bin = []
+        if rest_cnt <= 0:
+            self.missing_type = MissingType.NONE
+            return
+        items = sorted(cat_counts.items(), key=lambda kv: -kv[1])
+        # avoid first bin being category 0 (reference bin.cpp:453-460)
+        if items and items[0][0] == 0:
+            if len(items) == 1:
+                items.append((items[0][0] + 1, 0))
+            items[0], items[1] = items[1], items[0]
+        cut_cnt = int(np.float32((total_sample_cnt - na_cnt)) * np.float32(0.99))
+        self.categorical_2_bin = {}
+        self.bin_2_categorical = []
+        used_cnt = 0
+        mb = min(len(items), max_bin)
+        cnt_in_bin: List[int] = []
+        cur_cat = 0
+        while cur_cat < len(items) and (used_cnt < cut_cnt or self.num_bin < mb):
+            cat, cnt = items[cur_cat]
+            if cnt < min_data_in_bin and cur_cat > 1:
+                break
+            self.bin_2_categorical.append(cat)
+            self.categorical_2_bin[cat] = self.num_bin
+            used_cnt += cnt
+            cnt_in_bin.append(cnt)
+            self.num_bin += 1
+            cur_cat += 1
+        # dedicated NaN bin only when all categories were consumed
+        if cur_cat == len(items) and na_cnt > 0:
+            self.bin_2_categorical.append(-1)
+            self.categorical_2_bin[-1] = self.num_bin
+            cnt_in_bin.append(0)
+            self.num_bin += 1
+        if cur_cat == len(items) and na_cnt == 0:
+            self.missing_type = MissingType.NONE
+        else:
+            self.missing_type = MissingType.NAN
+        if cnt_in_bin:
+            cnt_in_bin[-1] += total_sample_cnt - used_cnt
+        self._cnt_in_bin = cnt_in_bin
+
+    # ------------------------------------------------------------------
+    def value_to_bin(self, value: float) -> int:
+        """Map one raw value to its bin (reference bin.h:472-508)."""
+        if math.isnan(value):
+            if self.missing_type == MissingType.NAN:
+                return self.num_bin - 1
+            value = 0.0
+        if self.bin_type == BinType.NUMERICAL:
+            ub = self.bin_upper_bound
+            hi = self.num_bin - 1
+            if self.missing_type == MissingType.NAN:
+                hi -= 1
+            return int(np.searchsorted(ub[:hi], value, side="left"))
+        iv = int(value)
+        if iv < 0:
+            return self.num_bin - 1
+        return self.categorical_2_bin.get(iv, self.num_bin - 1)
+
+    def values_to_bins(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value->bin for a full column."""
+        values = np.asarray(values, dtype=np.float64)
+        out = np.zeros(values.shape, dtype=np.int32)
+        nan_mask = np.isnan(values)
+        if self.bin_type == BinType.NUMERICAL:
+            vals = np.where(nan_mask, 0.0, values)
+            hi = self.num_bin - 1
+            if self.missing_type == MissingType.NAN:
+                hi -= 1
+            out = np.searchsorted(self.bin_upper_bound[:hi], vals,
+                                  side="left").astype(np.int32)
+            if self.missing_type == MissingType.NAN:
+                out[nan_mask] = self.num_bin - 1
+            return out
+        # NaN: dedicated bin when missing==NaN, else treated as category 0
+        nan_cat = -1 if self.missing_type == MissingType.NAN else 0
+        ivals = np.where(nan_mask, nan_cat,
+                         np.nan_to_num(values, nan=0.0)).astype(np.int64)
+        out = np.full(values.shape, self.num_bin - 1, dtype=np.int32)
+        for cat, b in self.categorical_2_bin.items():
+            if cat >= 0:
+                out[ivals == cat] = b
+        out[ivals < 0] = self.num_bin - 1
+        return out
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Representative raw value for a bin (used for model thresholds)."""
+        if self.bin_type == BinType.NUMERICAL:
+            return float(self.bin_upper_bound[bin_idx])
+        return float(self.bin_2_categorical[bin_idx])
+
+    # -- serialization (for distributed bin-mapper sync & binary cache) ----
+    def to_dict(self) -> dict:
+        return {
+            "num_bin": self.num_bin,
+            "is_trivial": self.is_trivial,
+            "bin_type": int(self.bin_type),
+            "missing_type": int(self.missing_type),
+            "bin_upper_bound": [float(x) for x in self.bin_upper_bound],
+            "bin_2_categorical": list(self.bin_2_categorical),
+            "min_val": self.min_val,
+            "max_val": self.max_val,
+            "default_bin": self.default_bin,
+            "most_freq_bin": self.most_freq_bin,
+            "sparse_rate": self.sparse_rate,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BinMapper":
+        m = cls()
+        m.num_bin = int(d["num_bin"])
+        m.is_trivial = bool(d["is_trivial"])
+        m.bin_type = BinType(d["bin_type"])
+        m.missing_type = MissingType(d["missing_type"])
+        m.bin_upper_bound = np.asarray(d["bin_upper_bound"], dtype=np.float64)
+        m.bin_2_categorical = [int(x) for x in d["bin_2_categorical"]]
+        m.categorical_2_bin = {c: i for i, c in enumerate(m.bin_2_categorical)}
+        m.min_val = float(d["min_val"])
+        m.max_val = float(d["max_val"])
+        m.default_bin = int(d["default_bin"])
+        m.most_freq_bin = int(d["most_freq_bin"])
+        m.sparse_rate = float(d.get("sparse_rate", 0.0))
+        m._cnt_in_bin = []
+        return m
+
+
+def _splittable(cnt_in_bin: List[int], total_cnt: int, filter_cnt: int,
+                bin_type: BinType) -> bool:
+    """Inverse of reference NeedFilter (src/io/bin.cpp:54-76)."""
+    if bin_type == BinType.NUMERICAL:
+        sum_left = 0
+        for c in cnt_in_bin[:-1]:
+            sum_left += c
+            if sum_left >= filter_cnt and total_cnt - sum_left >= filter_cnt:
+                return True
+        return False
+    if len(cnt_in_bin) <= 2:
+        for c in cnt_in_bin[:-1]:
+            if c >= filter_cnt and total_cnt - c >= filter_cnt:
+                return True
+        return False
+    return True

@@ -1,0 +1,298 @@
+"""Binned training data: the TPU-facing data representation.
+
+The reference stores bins in per-group `Bin` columns with EFB bundling and
+sparse/dense specializations (reference src/io/dataset.cpp:265, include/
+LightGBM/feature_group.h:37).  TPU-first, the binned matrix is instead ONE
+fixed-shape `[n_rows, n_features]` integer array resident in HBM — the analog
+of the GPU learner's `Feature4` packing (reference src/treelearner/
+gpu_tree_learner.cpp:354-527) — because the histogram kernel consumes all
+features of a row block at once via one-hot contractions on the MXU.
+
+`TrainingData` owns:
+  * per-feature `BinMapper`s (shared with validation sets, like the reference's
+    `CreateValid` alignment, dataset.h:501),
+  * the host binned matrix (uint8/uint16) and its device copy,
+  * `Metadata` (labels / weights / query boundaries / init scores,
+    reference src/io/metadata.cpp).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..config import Config
+from .bin_mapper import BinMapper, BinType, MissingType, K_ZERO_THRESHOLD
+from .parser import load_text_file
+
+
+class Metadata:
+    """Labels, weights, query boundaries, init scores (reference dataset.h:87)."""
+
+    def __init__(self, num_data: int, label: Optional[np.ndarray] = None,
+                 weight: Optional[np.ndarray] = None,
+                 group_sizes: Optional[np.ndarray] = None,
+                 init_score: Optional[np.ndarray] = None):
+        self.num_data = num_data
+        self.label = (np.zeros(num_data, dtype=np.float32) if label is None
+                      else np.asarray(label, dtype=np.float32))
+        self.weight = None if weight is None else np.asarray(weight, dtype=np.float32)
+        self.init_score = (None if init_score is None
+                           else np.asarray(init_score, dtype=np.float64))
+        if group_sizes is not None:
+            gs = np.asarray(group_sizes, dtype=np.int64)
+            self.query_boundaries = np.concatenate([[0], np.cumsum(gs)]).astype(np.int64)
+            if self.query_boundaries[-1] != num_data:
+                raise ValueError(
+                    f"sum of query sizes ({self.query_boundaries[-1]}) != num_data ({num_data})")
+        else:
+            self.query_boundaries = None
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+    def set_field(self, name: str, data: Optional[np.ndarray]) -> None:
+        if name == "label":
+            self.label = np.asarray(data, dtype=np.float32)
+        elif name == "weight":
+            self.weight = None if data is None else np.asarray(data, dtype=np.float32)
+        elif name in ("group", "query"):
+            if data is None:
+                self.query_boundaries = None
+            else:
+                gs = np.asarray(data, dtype=np.int64)
+                self.query_boundaries = np.concatenate([[0], np.cumsum(gs)]).astype(np.int64)
+        elif name == "init_score":
+            self.init_score = None if data is None else np.asarray(data, dtype=np.float64)
+        else:
+            raise ValueError(f"unknown field {name}")
+
+    def get_field(self, name: str) -> Optional[np.ndarray]:
+        if name == "label":
+            return self.label
+        if name == "weight":
+            return self.weight
+        if name in ("group", "query"):
+            return self.query_boundaries
+        if name == "init_score":
+            return self.init_score
+        raise ValueError(f"unknown field {name}")
+
+
+def _load_forced_bins(config: Config) -> Dict[int, List[float]]:
+    """Load forcedbins_filename JSON: [{"feature": i, "bin_upper_bound": [...]}]
+
+    (reference src/io/dataset_loader.cpp:1246 GetForcedBins).
+    """
+    path = config.forcedbins_filename
+    if not path:
+        return {}
+    import json
+    with open(path) as f:
+        entries = json.load(f)
+    out: Dict[int, List[float]] = {}
+    for e in entries:
+        out[int(e["feature"])] = [float(x) for x in e["bin_upper_bound"]]
+    return out
+
+
+def _parse_column_spec(spec: str, feature_names: List[str]) -> List[int]:
+    """Parse '0,1,2' or 'name:a,b,c' into column indices."""
+    if not spec:
+        return []
+    s = str(spec)
+    if s.startswith("name:"):
+        names = [x.strip() for x in s[5:].split(",") if x.strip()]
+        return [feature_names.index(n) for n in names if n in feature_names]
+    return [int(x) for x in s.replace(";", ",").split(",") if x != ""]
+
+
+class TrainingData:
+    """Binned dataset + metadata. The unit the tree learners consume."""
+
+    def __init__(self) -> None:
+        self.num_data: int = 0
+        self.num_total_features: int = 0
+        self.used_feature_idx: List[int] = []     # used col -> original col
+        self.mappers: List[BinMapper] = []        # one per ORIGINAL column
+        self.bins: Optional[np.ndarray] = None    # [n, num_used] uint8/uint16
+        self.metadata: Optional[Metadata] = None
+        self.feature_names: List[str] = []
+        self.config: Optional[Config] = None
+        self.monotone_constraints: Optional[np.ndarray] = None  # per used feature
+        self.feature_penalty: Optional[np.ndarray] = None       # per used feature
+        self._device_bins = None
+
+    # ------------------------------------------------------------------
+    @property
+    def num_features(self) -> int:
+        return len(self.used_feature_idx)
+
+    @property
+    def max_num_bin(self) -> int:
+        if not self.used_feature_idx:
+            return 1
+        return max(self.mappers[i].num_bin for i in self.used_feature_idx)
+
+    def feature_arrays(self) -> Dict[str, np.ndarray]:
+        """Per-used-feature static arrays consumed by the device grower."""
+        idx = self.used_feature_idx
+        num_bin = np.array([self.mappers[i].num_bin for i in idx], dtype=np.int32)
+        missing = np.array([int(self.mappers[i].missing_type) for i in idx], dtype=np.int32)
+        default_bin = np.array([self.mappers[i].default_bin for i in idx], dtype=np.int32)
+        is_categorical = np.array(
+            [self.mappers[i].bin_type == BinType.CATEGORICAL for i in idx], dtype=bool)
+        mono = (self.monotone_constraints if self.monotone_constraints is not None
+                else np.zeros(len(idx), dtype=np.int32))
+        penalty = (self.feature_penalty if self.feature_penalty is not None
+                   else np.ones(len(idx), dtype=np.float32))
+        return {"num_bin": num_bin, "missing_type": missing,
+                "default_bin": default_bin, "is_categorical": is_categorical,
+                "monotone": mono.astype(np.int32), "penalty": penalty.astype(np.float32)}
+
+    def device_bins(self):
+        """Device copy of the binned matrix (cached)."""
+        import jax.numpy as jnp
+        if self._device_bins is None:
+            self._device_bins = jnp.asarray(self.bins.astype(np.int32))
+        return self._device_bins
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, X: np.ndarray, label: Optional[np.ndarray] = None,
+                    config: Optional[Config] = None,
+                    weight: Optional[np.ndarray] = None,
+                    group_sizes: Optional[np.ndarray] = None,
+                    init_score: Optional[np.ndarray] = None,
+                    reference: Optional["TrainingData"] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_features: Optional[Sequence[int]] = None,
+                    forced_bins: Optional[Dict[int, List[float]]] = None,
+                    ) -> "TrainingData":
+        """Bin a raw float matrix.
+
+        With `reference` given, reuses its BinMappers (validation-set
+        alignment, reference dataset.h:501 CreateValid).
+        """
+        config = config or Config()
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim != 2:
+            raise ValueError("X must be 2-D")
+        n, nf = X.shape
+        self = cls()
+        self.config = config
+        self.num_data = n
+        self.num_total_features = nf
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(nf)])
+
+        if reference is not None:
+            self.mappers = reference.mappers
+            self.used_feature_idx = list(reference.used_feature_idx)
+            self.monotone_constraints = reference.monotone_constraints
+            self.feature_penalty = reference.feature_penalty
+            if reference.num_total_features != nf:
+                raise ValueError("validation data feature count mismatch")
+        else:
+            self._find_mappers(X, config, categorical_features or [], forced_bins or {})
+
+        # bin all used columns
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        bins = np.empty((n, self.num_features), dtype=dtype)
+        for j, col in enumerate(self.used_feature_idx):
+            bins[:, j] = self.mappers[col].values_to_bins(X[:, col]).astype(dtype)
+        self.bins = bins
+
+        self.metadata = Metadata(n, label, weight, group_sizes, init_score)
+        self._set_constraints(config)
+        return self
+
+    @classmethod
+    def from_file(cls, path: str, config: Optional[Config] = None,
+                  reference: Optional["TrainingData"] = None) -> "TrainingData":
+        config = config or Config()
+        X, y, w, group, init, names = load_text_file(
+            path, label_column=config.label_column,
+            header=True if config.header else None)
+        cat = _parse_column_spec(config.categorical_feature, names)
+        data = cls.from_matrix(X, y, config, weight=w, group_sizes=group,
+                               init_score=init, reference=reference,
+                               feature_names=names, categorical_features=cat,
+                               forced_bins=_load_forced_bins(config))
+        return data
+
+    # ------------------------------------------------------------------
+    def _find_mappers(self, X: np.ndarray, config: Config,
+                      categorical_features: Sequence[int],
+                      forced_bins: Dict[int, List[float]]) -> None:
+        n, nf = X.shape
+        sample_cnt = min(n, int(config.bin_construct_sample_cnt))
+        if sample_cnt < n:
+            rng = np.random.default_rng(int(config.data_random_seed))
+            sample_idx = np.sort(rng.choice(n, size=sample_cnt, replace=False))
+            Xs = X[sample_idx]
+        else:
+            Xs = X
+        total = Xs.shape[0]
+
+        ignore = set(_parse_column_spec(config.ignore_column, self.feature_names))
+        cat_set = set(int(c) for c in categorical_features)
+        max_bin_by_feature = list(config.max_bin_by_feature)
+        # near-unsplittable feature filter (reference dataset_loader.cpp:599-600)
+        filter_cnt = int(float(config.min_data_in_leaf) * total / n)
+
+        self.mappers = []
+        self.used_feature_idx = []
+        for col in range(nf):
+            m = BinMapper()
+            if col in ignore:
+                m.num_bin = 1
+                m.is_trivial = True
+                self.mappers.append(m)
+                continue
+            colv = Xs[:, col]
+            # drop (near-)zeros: implied by total_sample_cnt (reference
+            # dataset_loader.cpp sparse-aware sampling)
+            nonzero = colv[~((np.abs(colv) <= K_ZERO_THRESHOLD) & ~np.isnan(colv))]
+            mb = int(config.max_bin)
+            if max_bin_by_feature and col < len(max_bin_by_feature):
+                mb = int(max_bin_by_feature[col])
+            m.find_bin(nonzero, total, mb,
+                       min_data_in_bin=int(config.min_data_in_bin),
+                       min_split_data=filter_cnt,
+                       bin_type=(BinType.CATEGORICAL if col in cat_set
+                                 else BinType.NUMERICAL),
+                       use_missing=bool(config.use_missing),
+                       zero_as_missing=bool(config.zero_as_missing),
+                       forced_bounds=forced_bins.get(col))
+            self.mappers.append(m)
+            if not m.is_trivial:
+                self.used_feature_idx.append(col)
+
+    def _set_constraints(self, config: Config) -> None:
+        mono = list(config.monotone_constraints)
+        if mono:
+            self.monotone_constraints = np.array(
+                [mono[c] if c < len(mono) else 0 for c in self.used_feature_idx],
+                dtype=np.int32)
+        contri = list(config.feature_contri)
+        if contri:
+            self.feature_penalty = np.array(
+                [contri[c] if c < len(contri) else 1.0 for c in self.used_feature_idx],
+                dtype=np.float32)
+
+    # ------------------------------------------------------------------
+    def create_valid(self, X: np.ndarray, label: Optional[np.ndarray] = None,
+                     **kw) -> "TrainingData":
+        return TrainingData.from_matrix(X, label, self.config, reference=self, **kw)
+
+    def real_threshold(self, feature: int, bin_threshold: int) -> float:
+        """Bin threshold -> raw-value threshold for model serialization.
+
+        Numerical split at bin t means `value <= bin_upper_bound[t]` goes left
+        (reference Tree::RealThreshold usage in tree.cpp).
+        """
+        m = self.mappers[self.used_feature_idx[feature]]
+        return m.bin_to_value(bin_threshold)

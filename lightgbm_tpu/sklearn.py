@@ -1,0 +1,7 @@
+"""scikit-learn API wrappers (reference python-package/lightgbm/sklearn.py).
+
+Implemented in the API-surface milestone; importing this module requires
+scikit-learn.
+"""
+
+raise ImportError("sklearn wrappers not yet available")

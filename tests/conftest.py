@@ -1,0 +1,84 @@
+"""Test harness config: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is unavailable in CI; sharding correctness is
+validated on `--xla_force_host_platform_device_count=8` CPU devices instead
+(the driver separately dry-run-compiles the multi-chip path via
+`__graft_entry__.dryrun_multichip`).  Must run before the first jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import pytest
+
+REFERENCE_DIR = "/root/reference"
+ORACLE_BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          ".refbuild", "lightgbm")
+ORACLE_LIB = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                          ".refbuild", "lib_lightgbm.so")
+
+
+def has_oracle() -> bool:
+    return os.path.exists(ORACLE_BIN) and os.path.exists(ORACLE_LIB)
+
+
+@pytest.fixture(scope="session")
+def binary_example():
+    """Load the reference binary_classification example data."""
+    path = os.path.join(REFERENCE_DIR, "examples", "binary_classification")
+    train = np.loadtxt(os.path.join(path, "binary.train"))
+    test = np.loadtxt(os.path.join(path, "binary.test"))
+    return {
+        "X_train": train[:, 1:], "y_train": train[:, 0],
+        "X_test": test[:, 1:], "y_test": test[:, 0],
+        "train_file": os.path.join(path, "binary.train"),
+        "test_file": os.path.join(path, "binary.test"),
+    }
+
+
+@pytest.fixture(scope="session")
+def rank_example():
+    path = os.path.join(REFERENCE_DIR, "examples", "lambdarank")
+    train = np.loadtxt(os.path.join(path, "rank.train"))
+    test = np.loadtxt(os.path.join(path, "rank.test"))
+    qtrain = np.loadtxt(os.path.join(path, "rank.train.query")).astype(np.int64)
+    qtest = np.loadtxt(os.path.join(path, "rank.test.query")).astype(np.int64)
+    return {
+        "X_train": train[:, 1:], "y_train": train[:, 0], "q_train": qtrain,
+        "X_test": test[:, 1:], "y_test": test[:, 0], "q_test": qtest,
+        "train_file": os.path.join(path, "rank.train"),
+    }
+
+
+@pytest.fixture(scope="session")
+def regression_example():
+    path = os.path.join(REFERENCE_DIR, "examples", "regression")
+    train = np.loadtxt(os.path.join(path, "regression.train"))
+    test = np.loadtxt(os.path.join(path, "regression.test"))
+    return {
+        "X_train": train[:, 1:], "y_train": train[:, 0],
+        "X_test": test[:, 1:], "y_test": test[:, 0],
+        "train_file": os.path.join(path, "regression.train"),
+    }
+
+
+@pytest.fixture(scope="session")
+def multiclass_example():
+    path = os.path.join(REFERENCE_DIR, "examples", "multiclass_classification")
+    train = np.loadtxt(os.path.join(path, "multiclass.train"))
+    test = np.loadtxt(os.path.join(path, "multiclass.test"))
+    return {
+        "X_train": train[:, 1:], "y_train": train[:, 0],
+        "X_test": test[:, 1:], "y_test": test[:, 0],
+        "train_file": os.path.join(path, "multiclass.train"),
+    }

@@ -1,0 +1,122 @@
+"""Helpers to drive the compiled reference LightGBM as a parity oracle.
+
+The reference binary/library is built out-of-tree into .refbuild/ by CI setup;
+tests using it skip automatically when it is absent.  We only ever *run* the
+reference — no reference code is copied.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .conftest import ORACLE_BIN, ORACLE_LIB
+
+
+def run_cli(conf: Dict[str, str], cwd: str) -> str:
+    """Run the reference CLI with the given config params; return stdout."""
+    args = [ORACLE_BIN] + [f"{k}={v}" for k, v in conf.items()]
+    out = subprocess.run(args, cwd=cwd, capture_output=True, text=True, timeout=600)
+    if out.returncode != 0:
+        raise RuntimeError(f"oracle failed: {out.stdout}\n{out.stderr}")
+    return out.stdout
+
+
+_LIB = None
+
+
+def _lib():
+    global _LIB
+    if _LIB is None:
+        _LIB = ctypes.CDLL(ORACLE_LIB)
+    return _LIB
+
+
+def dump_dataset_bins(data_file: str, params: str = "") -> Dict:
+    """Bin a data file with the reference loader and parse the bin dump.
+
+    Returns {"num_features", "num_data", "bins": [n, num_total_features] int
+    array with -1 for unused (trivial) features}.
+    """
+    lib = _lib()
+    handle = ctypes.c_void_p()
+    ret = lib.LGBM_DatasetCreateFromFile(
+        data_file.encode(), params.encode(), None, ctypes.byref(handle))
+    if ret != 0:
+        lib.LGBM_GetLastError.restype = ctypes.c_char_p
+        raise RuntimeError(lib.LGBM_GetLastError().decode())
+    with tempfile.NamedTemporaryFile(suffix=".txt", delete=False) as f:
+        dump_path = f.name
+    try:
+        ret = lib.LGBM_DatasetDumpText(handle, dump_path.encode())
+        assert ret == 0
+        with open(dump_path) as f:
+            text = f.read()
+    finally:
+        lib.LGBM_DatasetFree(handle)
+        os.unlink(dump_path)
+
+    lines = text.split("\n")
+    meta = {}
+    row_start = None
+    for i, line in enumerate(lines):
+        if line.startswith("num_features:"):
+            meta["num_features"] = int(line.split(":")[1])
+        elif line.startswith("num_total_features:"):
+            meta["num_total_features"] = int(line.split(":")[1])
+        elif line.startswith("num_data:"):
+            meta["num_data"] = int(line.split(":")[1])
+        elif line.startswith("feature "):
+            row_start = i + 1  # forced_bins section is last before rows
+    # data rows: after the forced_bins block, one comma-separated line per row,
+    # 'NA' for trivial/unused features
+    data_lines = [l for l in lines[row_start:] if l.strip()]
+    rows = []
+    for l in data_lines:
+        toks = [t.strip() for t in l.split(",") if t.strip() != ""]
+        rows.append([-1 if t == "NA" else int(t) for t in toks])
+    bins = np.asarray(rows, dtype=np.int64)
+    meta["bins"] = bins
+    return meta
+
+
+def train_cli_and_read_model(train_file: str, extra_conf: Dict[str, str],
+                             valid_file: Optional[str] = None) -> Dict:
+    """Train with the reference CLI; return parsed stdout metrics + model text."""
+    with tempfile.TemporaryDirectory() as td:
+        model_path = os.path.join(td, "model.txt")
+        conf = {
+            "task": "train",
+            "data": train_file,
+            "output_model": model_path,
+            "verbosity": "1",
+        }
+        if valid_file:
+            conf["valid_data"] = valid_file
+        conf.update(extra_conf)
+        stdout = run_cli(conf, td)
+        with open(model_path) as f:
+            model_text = f.read()
+    return {"stdout": stdout, "model": model_text,
+            "metrics": parse_cli_metrics(stdout)}
+
+
+def parse_cli_metrics(stdout: str) -> Dict[str, List[float]]:
+    """Parse '[LightGBM] [Info] Iteration:N, valid_1 auc : 0.83' lines."""
+    out: Dict[str, List[float]] = {}
+    for line in stdout.split("\n"):
+        if "Iteration:" not in line or " : " not in line:
+            continue
+        try:
+            head, val = line.rsplit(":", 1)
+            value = float(val)
+            key = head.split(",", 1)[1].strip().rsplit(" ", 1)[0].strip()
+            out.setdefault(key, []).append(value)
+        except (ValueError, IndexError):
+            continue
+    return out

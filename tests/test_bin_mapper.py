@@ -1,0 +1,161 @@
+"""Unit + oracle-parity tests for the binning layer (SURVEY.md §2.1 BinMapper)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.bin_mapper import (BinMapper, BinType, MissingType,
+                                        greedy_find_bin)
+from lightgbm_tpu.io.dataset import TrainingData
+
+from .conftest import has_oracle
+
+
+class TestGreedyFindBin:
+    def test_few_distinct_values(self):
+        dv = [1.0, 2.0, 3.0]
+        cnt = [10, 10, 10]
+        bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=30, min_data_in_bin=3)
+        assert bounds[-1] == float("inf")
+        # boundaries must separate the distinct values
+        assert len(bounds) == 3
+        assert 1.0 < bounds[0] < 2.0
+        assert 2.0 < bounds[1] < 3.0
+
+    def test_min_data_in_bin_merges(self):
+        dv = [1.0, 2.0, 3.0, 4.0]
+        cnt = [1, 1, 1, 27]
+        bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=30, min_data_in_bin=3)
+        # 1,2,3 must be merged until >= 3 samples per bin
+        assert len(bounds) == 2
+
+    def test_many_distinct_equal_counts(self):
+        dv = [float(i) for i in range(100)]
+        cnt = [10] * 100
+        bounds = greedy_find_bin(dv, cnt, max_bin=10, total_cnt=1000, min_data_in_bin=3)
+        assert len(bounds) == 10
+        # roughly equal-count bins: each bin spans ~10 values
+        edges = [-np.inf] + bounds
+        per_bin = [sum(c for v, c in zip(dv, cnt) if lo < v <= hi)
+                   for lo, hi in zip(edges[:-1], edges[1:])]
+        assert max(per_bin) <= 2 * min(per_bin)
+
+
+class TestBinMapper:
+    def test_numerical_basic(self):
+        rng = np.random.default_rng(0)
+        vals = rng.normal(size=1000)
+        m = BinMapper()
+        m.find_bin(vals, 1000, max_bin=16)
+        assert m.missing_type == MissingType.NONE
+        assert 2 <= m.num_bin <= 16
+        bins = m.values_to_bins(vals)
+        assert bins.min() >= 0 and bins.max() < m.num_bin
+        # order preserving: larger value -> same or larger bin
+        order = np.argsort(vals)
+        assert np.all(np.diff(bins[order]) >= 0)
+
+    def test_zero_bin_dedicated(self):
+        rng = np.random.default_rng(1)
+        vals = np.concatenate([rng.normal(size=500), np.zeros(500)])
+        m = BinMapper()
+        # sample excludes zeros; total count implies them
+        m.find_bin(vals[np.abs(vals) > 1e-35], 1000, max_bin=32)
+        zb = m.value_to_bin(0.0)
+        assert m.default_bin == zb
+        neg = m.value_to_bin(-0.5)
+        pos = m.value_to_bin(0.5)
+        assert neg < zb <= pos or neg <= zb < pos
+
+    def test_nan_goes_to_last_bin(self):
+        rng = np.random.default_rng(2)
+        vals = np.concatenate([rng.normal(size=900), [np.nan] * 100])
+        m = BinMapper()
+        m.find_bin(vals, 1000, max_bin=16, use_missing=True)
+        assert m.missing_type == MissingType.NAN
+        assert m.value_to_bin(np.nan) == m.num_bin - 1
+        assert m.values_to_bins(np.array([np.nan]))[0] == m.num_bin - 1
+
+    def test_zero_as_missing(self):
+        rng = np.random.default_rng(3)
+        vals = rng.normal(size=1000)
+        m = BinMapper()
+        m.find_bin(vals, 2000, max_bin=16, zero_as_missing=True)
+        assert m.missing_type == MissingType.ZERO
+
+    def test_trivial_constant(self):
+        m = BinMapper()
+        m.find_bin(np.array([]), 1000, max_bin=16)  # all zeros
+        assert m.is_trivial
+
+    def test_categorical(self):
+        rng = np.random.default_rng(4)
+        vals = rng.choice([0, 1, 2, 5, 9], size=1000, p=[0.4, 0.3, 0.2, 0.07, 0.03])
+        m = BinMapper()
+        m.find_bin(vals.astype(float), 1000, max_bin=16,
+                   bin_type=BinType.CATEGORICAL)
+        assert m.bin_type == BinType.CATEGORICAL
+        # most frequent category -> lowest bins, bin 0 is not category 0
+        assert m.bin_2_categorical[0] != 0
+        for cat in [0, 1, 2, 5, 9]:
+            b = m.value_to_bin(float(cat))
+            assert 0 <= b < m.num_bin
+        # unseen category -> last bin
+        assert m.value_to_bin(777.0) == m.num_bin - 1
+
+    def test_roundtrip_serialization(self):
+        rng = np.random.default_rng(5)
+        vals = rng.normal(size=1000)
+        m = BinMapper()
+        m.find_bin(vals, 1000, max_bin=32)
+        m2 = BinMapper.from_dict(m.to_dict())
+        x = rng.normal(size=100)
+        assert np.array_equal(m.values_to_bins(x), m2.values_to_bins(x))
+
+
+class TestTrainingData:
+    def test_from_matrix(self, binary_example):
+        cfg = Config({"max_bin": 255, "min_data_in_bin": 3})
+        d = TrainingData.from_matrix(binary_example["X_train"],
+                                     binary_example["y_train"], cfg)
+        assert d.num_data == 7000
+        assert d.num_features <= 28
+        assert d.bins.shape == (7000, d.num_features)
+        assert d.metadata.label.shape == (7000,)
+
+    def test_valid_alignment(self, binary_example):
+        cfg = Config({"max_bin": 64})
+        d = TrainingData.from_matrix(binary_example["X_train"],
+                                     binary_example["y_train"], cfg)
+        v = d.create_valid(binary_example["X_test"], binary_example["y_test"])
+        assert v.mappers is d.mappers
+        assert v.bins.shape[1] == d.bins.shape[1]
+
+    def test_from_file(self, binary_example):
+        cfg = Config({"max_bin": 255})
+        d = TrainingData.from_file(binary_example["train_file"], cfg)
+        assert d.num_data == 7000
+        assert d.num_total_features == 28
+
+
+@pytest.mark.skipif(not has_oracle(), reason="reference oracle not built")
+class TestOracleParity:
+    """Bit-exact bin parity vs the compiled reference (SURVEY.md §4 test model)."""
+
+    @pytest.mark.parametrize("max_bin", [15, 63, 255])
+    def test_binary_example_bins_match(self, binary_example, max_bin):
+        from .oracle import dump_dataset_bins
+        ref = dump_dataset_bins(binary_example["train_file"],
+                                f"max_bin={max_bin} min_data_in_bin=3")
+        cfg = Config({"max_bin": max_bin, "min_data_in_bin": 3})
+        mine = TrainingData.from_file(binary_example["train_file"], cfg)
+        assert ref["num_data"] == mine.num_data
+        # compare per-original-column bin values
+        ref_bins = ref["bins"]
+        assert ref_bins.shape[0] == mine.num_data
+        mismatched_cols = []
+        for j, col in enumerate(mine.used_feature_idx):
+            if not np.array_equal(ref_bins[:, col], mine.bins[:, j].astype(np.int64)):
+                diff = int((ref_bins[:, col] != mine.bins[:, j]).sum())
+                mismatched_cols.append((col, diff))
+        assert not mismatched_cols, f"bin mismatch in columns {mismatched_cols}"
