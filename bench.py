@@ -1,0 +1,103 @@
+"""Benchmark: Higgs-shaped GBDT training throughput on one TPU chip.
+
+Mirrors the reference's headline benchmark (BASELINE.md: Higgs, 500 trees,
+255 leaves, lr=0.1 — 238.5 s on 2x E5-2670v3, i.e. 2.096 boosting iters/s).
+The real Higgs dataset cannot be fetched here (no egress), so the data is a
+seeded synthetic with Higgs dimensions (1M rows x 28 dense features) and a
+nonlinear separable structure; histogram/split work depends only on shape,
+bins, and leaf count, so iters/sec is comparable.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N_ROWS = int(os.environ.get("BENCH_ROWS", 1_000_000))
+N_FEATURES = 28
+NUM_LEAVES = 255
+MAX_BIN = 255
+WARMUP_ITERS = 3
+BENCH_ITERS = int(os.environ.get("BENCH_ITERS", 25))
+BASELINE_ITERS_PER_SEC = 500.0 / 238.5  # reference Higgs CPU (BASELINE.md)
+
+
+def make_data(n, f, seed=42):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=(f,))
+    logits = (X[:, :8] ** 2 - 1.0).sum(axis=1) * 0.3 + X @ w * 0.5
+    y = (logits + rng.logistic(size=n) > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.booster import Booster
+
+    t_data = time.time()
+    X, y = make_data(N_ROWS, N_FEATURES)
+    data_s = time.time() - t_data
+
+    t_bin = time.time()
+    ds = lgb.Dataset(X, label=y, params={"max_bin": MAX_BIN})
+    ds.construct()
+    bin_s = time.time() - t_bin
+    X_eval = X[:50000].copy()
+    del X
+
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "max_bin": MAX_BIN}
+    bst = Booster(params=params, train_set=ds)
+    t_compile = time.time()
+    for _ in range(WARMUP_ITERS):
+        bst.update()
+    jax.block_until_ready(bst._driver.train_scores.scores)
+    compile_s = time.time() - t_compile
+
+    t0 = time.time()
+    for _ in range(BENCH_ITERS):
+        bst.update()
+    jax.block_until_ready(bst._driver.train_scores.scores)
+    train_s = time.time() - t0
+    iters_per_sec = BENCH_ITERS / train_s
+
+    # sanity: the model must actually learn
+    t_eval = time.time()
+    sample = slice(0, 50000)
+    pred = bst.predict(X_eval)
+    from lightgbm_tpu.models.metrics import AUCMetric
+    from lightgbm_tpu.config import Config
+    m = AUCMetric(Config())
+
+    class _MD:
+        label = y[sample].astype(np.float32)
+        weight = None
+    m.init(_MD, 50000)
+    auc = m.eval(np.log(np.clip(pred, 1e-9, 1 - 1e-9))[None, :]
+                 - np.log(np.clip(1 - pred, 1e-9, 1 - 1e-9))[None, :], None)
+    eval_s = time.time() - t_eval
+
+    print(json.dumps({
+        "metric": "higgs1m_boosting_iters_per_sec",
+        "value": round(iters_per_sec, 3),
+        "unit": "iters/s (1M rows, 28 feats, 255 leaves, 255 bins)",
+        "vs_baseline": round(iters_per_sec / BASELINE_ITERS_PER_SEC, 3),
+        "train_auc_50k": round(float(auc), 4),
+        "bench_iters": BENCH_ITERS,
+        "data_gen_s": round(data_s, 1),
+        "binning_s": round(bin_s, 1),
+        "compile_s": round(compile_s, 1),
+        "platform": jax.devices()[0].platform,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,12 @@ class Booster:
         if train_set is not None:
             if not isinstance(train_set, Dataset):
                 raise TypeError("train_set must be a Dataset")
+            if train_set._inner is None:
+                # merge training params into dataset params before lazy
+                # construction (reference basic.py _update_params): dataset-
+                # affecting keys like max_bin / monotone_constraints may be
+                # given at train() level
+                train_set.params = {**train_set.params, **self.params}
             train_set.construct()
             self._train_set = train_set
             cfg = Config(self.params)

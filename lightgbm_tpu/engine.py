@@ -81,6 +81,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cb_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    evaluation_result_list: List = []
     for i in range(num_boost_round):
         for cb in cb_before:
             cb(CallbackEnv(model=booster, params=params, iteration=i,

@@ -1,0 +1,7 @@
+"""DART boosting (reference src/boosting/dart.hpp) — full logic in M4."""
+
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    pass

@@ -1,0 +1,730 @@
+"""GBDT boosting driver (reference src/boosting/gbdt.cpp:368-449).
+
+Owns the tree models, per-dataset raw-score vectors, the objective/metrics,
+and the TPU tree learner.  One `train_one_iter` =
+boost-from-average -> GetGradients (device) -> bagging mask -> per-class
+grow-tree (device) -> RenewTreeOutput -> Shrinkage -> score update
+(device gather for train, binned traversal for valids) — the same contract
+as the reference driver, with mask-based bagging instead of index-subset
+copies (SURVEY.md §7 M4).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.bin_mapper import BinMapper, MissingType
+from ..io.dataset import TrainingData
+from .learner import TPUTreeLearner
+from .metrics import Metric, create_metrics
+from .objectives import (Objective, create_objective,
+                         create_objective_from_model_string)
+from .tree import Tree
+
+K_EPSILON = 1e-15
+
+
+def _predict_binned(tree: Tree, bins: np.ndarray,
+                    meta: Dict[str, np.ndarray]) -> np.ndarray:
+    """Leaf values via bin-space traversal (NumericalDecisionInner,
+    reference tree.h:252-270) — used for validation-score updates."""
+    n = bins.shape[0]
+    if tree.num_leaves == 1:
+        return np.full(n, tree.leaf_value[0])
+    node = np.zeros(n, dtype=np.int32)
+    num_bin = meta["num_bin"]
+    default_bin = meta["default_bin"]
+    missing = meta["missing_type"]
+    for _ in range(tree.max_depth()):
+        active = node >= 0
+        if not active.any():
+            break
+        nid = node[active]
+        f = tree.split_feature_inner[nid]
+        fbin = bins[active, f].astype(np.int64)
+        mt = missing[f]
+        is_missing = np.where(
+            mt == int(MissingType.NAN), fbin == num_bin[f] - 1,
+            np.where(mt == int(MissingType.ZERO), fbin == default_bin[f], False))
+        dt = tree.decision_type[nid]
+        default_left = (dt & 2) != 0
+        go_left = np.where(is_missing, default_left,
+                           fbin <= tree.threshold_in_bin[nid])
+        node[active] = np.where(go_left, tree.left_child[nid],
+                                tree.right_child[nid]).astype(np.int32)
+    return tree.leaf_value[~node]
+
+
+class _ScoreState:
+    """Per-dataset raw scores [k, n], device-resident for train."""
+
+    def __init__(self, num_class: int, num_data: int,
+                 init_score: Optional[np.ndarray] = None):
+        scores = np.zeros((num_class, num_data), np.float32)
+        self.has_init_score = init_score is not None
+        if init_score is not None:
+            s = np.asarray(init_score, np.float64)
+            if s.size == num_data * num_class:
+                scores += s.reshape(num_class, num_data) if s.ndim == 1 \
+                    else s.T.astype(np.float32)
+            else:
+                scores += s.reshape(1, -1)
+        self.scores = jnp.asarray(scores)
+
+    def add_constant(self, val: float, class_id: int):
+        self.scores = self.scores.at[class_id].add(np.float32(val))
+
+    def add(self, class_id: int, delta):
+        self.scores = self.scores.at[class_id].add(delta)
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(jax.device_get(self.scores), np.float64)
+
+
+class GBDT:
+    """The gradient boosting driver."""
+
+    def __init__(self):
+        self.models: List[Tree] = []
+        self.iter_ = 0
+        self.num_init_iteration = 0
+        self.config: Optional[Config] = None
+        self.objective: Optional[Objective] = None
+        self.train_data: Optional[TrainingData] = None
+        self.learner: Optional[TPUTreeLearner] = None
+        self.metrics: List[Metric] = []
+        self.valid_sets: List[TrainingData] = []
+        self.valid_names: List[str] = []
+        self.valid_metrics: List[List[Metric]] = []
+        self.valid_scores: List[_ScoreState] = []
+        self.train_scores: Optional[_ScoreState] = None
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.shrinkage_rate = 0.1
+        self.feature_names: List[str] = []
+        self.max_feature_idx = 0
+        self.loaded_params: Dict = {}
+        self.label_index = 0
+        self._bag_rng: Optional[np.random.Generator] = None
+        self._pending: List[Tuple] = []
+        self._stopped = False
+        self._train_step = None
+        self._bag_cfg = None
+
+    # ------------------------------------------------------------------
+    def init(self, config: Config, train_data: TrainingData) -> None:
+        self.config = config
+        self.train_data = train_data
+        self.num_class = int(config.num_class)
+        self.shrinkage_rate = float(config.learning_rate)
+        self.objective = create_objective(config)
+        if self.objective is not None:
+            self.objective.init(train_data.metadata, train_data.num_data)
+            self.num_tree_per_iteration = self.objective.num_model_per_iteration()
+        else:
+            self.num_tree_per_iteration = self.num_class
+        self.learner = TPUTreeLearner(config, train_data)
+        self.metrics = create_metrics(
+            config, self.objective.name if self.objective else "")
+        for m in self.metrics:
+            m.init(train_data.metadata, train_data.num_data)
+        self.train_scores = _ScoreState(self.num_tree_per_iteration,
+                                        train_data.num_data,
+                                        train_data.metadata.init_score)
+        self.feature_names = list(train_data.feature_names)
+        self.max_feature_idx = train_data.num_total_features - 1
+        self._bag_rng = np.random.default_rng(int(config.bagging_seed))
+        self._boosted_from_average = [False] * self.num_tree_per_iteration
+        # async fast path: fused device step + lazily materialized trees
+        self._pending: List[Tuple] = []
+        self._stopped = False
+        self._key = jax.random.PRNGKey(int(config.seed))
+        self._bag_key = jax.random.PRNGKey(int(config.bagging_seed))
+        self._train_step = None
+        self._bag_cfg = self._bagging_config()
+        if self.objective is not None and not self.objective.needs_renew:
+            self._train_step = self.learner.make_train_step(
+                self.objective.get_gradients, self.shrinkage_rate,
+                self._bag_cfg)
+
+    def _bagging_config(self) -> Optional[Dict]:
+        cfg = self.config
+        frac = float(cfg.bagging_fraction)
+        freq = int(cfg.bagging_freq)
+        pos_frac = float(cfg.pos_bagging_fraction)
+        neg_frac = float(cfg.neg_bagging_fraction)
+        balanced = (pos_frac < 1.0 or neg_frac < 1.0)
+        if freq <= 0 or (frac >= 1.0 and not balanced):
+            return None
+        out = {"fraction": frac, "pos_fraction": pos_frac,
+               "neg_fraction": neg_frac, "freq": freq}
+        if balanced:
+            label = np.asarray(self.train_data.metadata.label)
+            is_pos = np.zeros(self.learner.n_pad, bool)
+            is_pos[:len(label)] = label > 0
+            out["is_pos"] = is_pos
+        return out
+
+    def add_valid(self, data: TrainingData, name: str) -> None:
+        if data.mappers is not self.train_data.mappers:
+            raise ValueError("validation set must be created with "
+                             "reference=train dataset")
+        self.valid_sets.append(data)
+        self.valid_names.append(name)
+        ms = create_metrics(self.config,
+                            self.objective.name if self.objective else "")
+        for m in ms:
+            m.init(data.metadata, data.num_data)
+        self.valid_metrics.append(ms)
+        self.valid_scores.append(_ScoreState(
+            self.num_tree_per_iteration, data.num_data,
+            data.metadata.init_score))
+        # replay existing model onto the new valid set
+        meta = self.learner.meta_np
+        for i, tree in enumerate(self.models):
+            k = i % self.num_tree_per_iteration
+            self.valid_scores[-1].add(
+                k, jnp.asarray(_predict_binned(tree, data.bins, meta)
+                               .astype(np.float32)))
+
+    # ------------------------------------------------------------------
+    def _boost_from_average(self, class_id: int) -> float:
+        if (self.models or self._boosted_from_average[class_id]
+                or self.objective is None
+                or self.train_scores.has_init_score):
+            return 0.0
+        self._boosted_from_average[class_id] = True
+        if not self.config.boost_from_average:
+            return 0.0
+        init = self.objective.boost_from_score(class_id)
+        if abs(init) > K_EPSILON:
+            self.train_scores.add_constant(init, class_id)
+            for vs in self.valid_scores:
+                vs.add_constant(init, class_id)
+            return init
+        return 0.0
+
+    def bagging_mask(self, it: int) -> Optional[jnp.ndarray]:
+        """Row mask for this iteration (None = all rows). Mask-based analog
+        of reference GBDT::Bagging (gbdt.cpp:210-276)."""
+        cfg = self.config
+        frac = float(cfg.bagging_fraction)
+        freq = int(cfg.bagging_freq)
+        pos_frac = float(cfg.pos_bagging_fraction)
+        neg_frac = float(cfg.neg_bagging_fraction)
+        balanced = (pos_frac < 1.0 or neg_frac < 1.0)
+        if freq <= 0 or (frac >= 1.0 and not balanced):
+            return None
+        if it % freq != 0 and self._cached_bag_mask is not None:
+            return self._cached_bag_mask
+        n = self.train_data.num_data
+        if balanced:
+            label = np.asarray(self.train_data.metadata.label)
+            is_pos = label > 0
+            r = self._bag_rng.random(n)
+            keep = np.where(is_pos, r < pos_frac, r < neg_frac)
+        else:
+            cnt = int(n * frac)
+            idx = self._bag_rng.choice(n, size=cnt, replace=False)
+            keep = np.zeros(n, bool)
+            keep[idx] = True
+        mask = jnp.asarray(keep.astype(np.float32))
+        self._cached_bag_mask = mask
+        return mask
+
+    _cached_bag_mask = None
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad: Optional[jnp.ndarray] = None,
+                       hess: Optional[jnp.ndarray] = None) -> bool:
+        """One boosting iteration; True when training has stalled.
+
+        Fast path: one fused async device dispatch per class and NO
+        host<->device sync; host Tree objects materialize lazily at
+        eval/predict/save time (`_materialize`)."""
+        if self._stopped:
+            return True
+        if (grad is None or hess is None) and self._train_step is not None:
+            bag = self._bag_cfg
+            for k in range(self.num_tree_per_iteration):
+                init = self._boost_from_average(k)
+                refresh = bag is not None and (self.iter_ % bag["freq"] == 0)
+                (records, scores, leaf_ids, leaf_out, self._key,
+                 self._bag_key) = self._train_step(
+                    self.train_scores.scores, self._key, self._bag_key,
+                    k, refresh)
+                self.train_scores.scores = scores
+                self._pending.append((records, k, init))
+            self.iter_ += 1
+            return False
+        return self._train_one_iter_sync(grad, hess)
+
+    def _train_one_iter_sync(self, grad=None, hess=None) -> bool:
+        """Synchronous path: custom fobj gradients or renew objectives."""
+        init_scores = [0.0] * self.num_tree_per_iteration
+        if grad is None or hess is None:
+            for k in range(self.num_tree_per_iteration):
+                init_scores[k] = self._boost_from_average(k)
+            grad, hess = self.objective.get_gradients(self.train_scores.scores)
+            if grad.ndim == 1:
+                grad, hess = grad[None, :], hess[None, :]
+        else:
+            grad = jnp.asarray(grad, jnp.float32).reshape(
+                self.num_tree_per_iteration, -1)
+            hess = jnp.asarray(hess, jnp.float32).reshape(
+                self.num_tree_per_iteration, -1)
+
+        self._materialize()
+        mask = self.bagging_mask(self.iter_)
+        should_continue = False
+        for k in range(self.num_tree_per_iteration):
+            need = (self.objective is None
+                    or self.objective.class_need_train(k))
+            tree = None
+            if need:
+                tree, leaf_ids, out = self.learner.train(grad[k], hess[k], mask)
+            if tree is not None and tree.num_leaves > 1:
+                should_continue = True
+                self._renew_and_update(tree, leaf_ids, k, mask)
+                if abs(init_scores[k]) > K_EPSILON:
+                    tree.add_bias(init_scores[k])
+            else:
+                tree = Tree(2)
+                if len(self.models) < self.num_tree_per_iteration:
+                    if not need and self.objective is not None:
+                        output = self.objective.boost_from_score(k)
+                    else:
+                        output = init_scores[k]
+                    tree.as_constant_tree(output)
+                    self.train_scores.add_constant(output, k)
+                    for vs in self.valid_scores:
+                        vs.add_constant(output, k)
+            self.models.append(tree)
+
+        if not should_continue:
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            self._stopped = True
+            return True
+        self.iter_ += 1
+        return False
+
+    def _materialize(self) -> None:
+        """Fetch pending device records and build host Tree models."""
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        # one batched fetch for all pending trees
+        recs = jax.device_get([p[0] for p in pending])
+        meta = self.learner.meta_np
+        for (_, class_id, init), rec in zip(pending, recs):
+            if self._stopped:
+                break  # drop queued post-stall iterations (reference pops them)
+            tree = self.learner.build_tree_from_records(np.asarray(rec))
+            if tree.num_leaves > 1:
+                tree.apply_shrinkage(self.shrinkage_rate)
+                for vs, vd in zip(self.valid_scores, self.valid_sets):
+                    vs.add(class_id, jnp.asarray(
+                        _predict_binned(tree, vd.bins, meta)
+                        .astype(np.float32)))
+                if abs(init) > K_EPSILON:
+                    tree.add_bias(init)
+                self.models.append(tree)
+            else:
+                # no split happened: device scores were not changed; stop
+                # training like the reference ("no more leaves that meet the
+                # split requirements", gbdt.cpp:434-442). A first-iteration
+                # stall still records the constant boost-from-average tree.
+                self._stopped = True
+                if len(self.models) < self.num_tree_per_iteration:
+                    tree.as_constant_tree(init)
+                    self.models.append(tree)
+        self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def train_one_iter_custom(self, grad: np.ndarray, hess: np.ndarray) -> bool:
+        return self.train_one_iter(jnp.asarray(grad), jnp.asarray(hess))
+
+    def _renew_and_update(self, tree: Tree, leaf_ids, class_id: int, mask):
+        # RenewTreeOutput (objective-specific percentile refits)
+        if self.objective is not None and self.objective.needs_renew:
+            leaf_np = np.asarray(jax.device_get(leaf_ids))
+            score_np = np.asarray(
+                jax.device_get(self.train_scores.scores[class_id]), np.float64)
+            mask_np = (np.ones(len(leaf_np), bool) if mask is None
+                       else np.asarray(jax.device_get(mask)) > 0)
+            self.objective.renew_tree_output(tree, score_np, leaf_np, mask_np)
+        tree.apply_shrinkage(self.shrinkage_rate)
+        # train scores: leaf-partition gather (ScoreUpdater::AddScore train path)
+        leaf_vals = jnp.asarray(tree.leaf_value[:tree.num_leaves]
+                                .astype(np.float32))
+        self.train_scores.add(class_id, leaf_vals[leaf_ids])
+        # valid scores: binned traversal
+        meta = self.learner.meta_np
+        for vs, vd in zip(self.valid_scores, self.valid_sets):
+            vs.add(class_id, jnp.asarray(
+                _predict_binned(tree, vd.bins, meta).astype(np.float32)))
+
+    def rollback_one_iter(self) -> None:
+        self._materialize()
+        if self.iter_ <= 0:
+            return
+        for k in range(self.num_tree_per_iteration):
+            tree = self.models.pop()
+            k_id = self.num_tree_per_iteration - 1 - k
+            delta = _predict_binned(tree, self.train_data.bins,
+                                    self.learner.meta_np).astype(np.float32)
+            self.train_scores.add(k_id, jnp.asarray(-delta))
+            for vs, vd in zip(self.valid_scores, self.valid_sets):
+                vs.add(k_id, jnp.asarray(
+                    -_predict_binned(tree, vd.bins, self.learner.meta_np)
+                    .astype(np.float32)))
+        self.iter_ -= 1
+
+    # ------------------------------------------------------------------
+    def current_iteration(self) -> int:
+        self._materialize()
+        return len(self.models) // max(self.num_tree_per_iteration, 1)
+
+    def num_total_model(self) -> int:
+        self._materialize()
+        return len(self.models)
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+    def current_score_for_fobj(self) -> np.ndarray:
+        return self.train_scores.numpy()
+
+    # ------------------------------------------------------------------
+    def eval(self, name: str, valid_idx: int, feval=None, booster=None
+             ) -> List[Tuple]:
+        self._materialize()
+        out = []
+        if valid_idx < 0:
+            scores = self.train_scores.numpy()
+            metrics = self.metrics
+        else:
+            scores = self.valid_scores[valid_idx].numpy()
+            metrics = self.valid_metrics[valid_idx]
+        for m in metrics:
+            out.append((name, m.name, m.eval(scores, self.objective),
+                        m.higher_is_better))
+        if feval is not None:
+            ds = self.train_data if valid_idx < 0 else self.valid_sets[valid_idx]
+            res = feval(scores.reshape(-1), _FevalData(ds))
+            for item in (res if isinstance(res, list) else [res]):
+                out.append((name, item[0], item[1], item[2]))
+        return out
+
+    def eval_for_data(self, data: TrainingData, name: str, feval=None):
+        raise NotImplementedError("use add_valid before training")
+
+    # ------------------------------------------------------------------
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
+        self._materialize()
+        """[k, n] raw scores from raw feature matrix."""
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        k = self.num_tree_per_iteration
+        total = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            total = min(total, num_iteration * k)
+        out = np.zeros((k, X.shape[0]), np.float64)
+        for i in range(total):
+            out[i % k] += self.models[i].predict(X)
+        return out
+
+    def predict(self, X: np.ndarray, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False) -> np.ndarray:
+        self._materialize()
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        if pred_leaf:
+            k = self.num_tree_per_iteration
+            total = len(self.models)
+            if num_iteration is not None and num_iteration > 0:
+                total = min(total, num_iteration * k)
+            leaves = np.stack([self.models[i].predict_leaf(X)
+                               for i in range(total)], axis=1)
+            return leaves
+        if pred_contrib:
+            raise NotImplementedError("pred_contrib lands with the SHAP milestone")
+        raw = self.predict_raw(X, num_iteration)
+        if not raw_score and self.objective is not None:
+            conv = self.objective.convert_output(raw)
+            raw = conv
+        if raw.shape[0] == 1:
+            return raw[0]
+        return raw.T  # [n, k] multiclass
+
+    # ------------------------------------------------------------------
+    def refit(self, X: np.ndarray, label: np.ndarray, decay_rate: float):
+        raise NotImplementedError("refit lands with the boosting-modes milestone")
+
+    def reset_config(self, config: Config) -> None:
+        self._materialize()
+        self.config = config
+        self.shrinkage_rate = float(config.learning_rate)
+        if self.learner is not None:
+            self.learner = TPUTreeLearner(config, self.train_data)
+            self._bag_cfg = self._bagging_config()
+            if self.objective is not None and not self.objective.needs_renew:
+                self._train_step = self.learner.make_train_step(
+                    self.objective.get_gradients, self.shrinkage_rate,
+                    self._bag_cfg)
+
+    def shuffle_models(self, start: int = 0, end: int = -1) -> None:
+        self._materialize()
+        if end < 0:
+            end = len(self.models)
+        rng = np.random.default_rng(0)
+        seg = self.models[start:end]
+        rng.shuffle(seg)
+        self.models[start:end] = seg
+
+    def feature_importance(self, importance_type: str = "split") -> np.ndarray:
+        self._materialize()
+        imp = np.zeros(self.max_feature_idx + 1, np.float64)
+        for tree in self.models:
+            ni = tree.num_leaves - 1
+            for j in range(ni):
+                f = int(tree.split_feature[j])
+                if importance_type == "split":
+                    imp[f] += 1
+                else:
+                    imp[f] += max(float(tree.split_gain[j]), 0.0)
+        if importance_type == "split":
+            return imp.astype(np.int64).astype(np.float64)
+        return imp
+
+    # ------------------------------------------------------------------
+    # model IO (reference src/boosting/gbdt_model_text.cpp)
+    # ------------------------------------------------------------------
+    def _feature_infos(self) -> List[str]:
+        infos = []
+        td = self.train_data
+        if td is None:
+            return list(self.loaded_params.get("feature_infos", []))
+        used = set(td.used_feature_idx)
+        for i, m in enumerate(td.mappers):
+            if i not in used or m.is_trivial:
+                infos.append("none")
+            elif m.bin_type.name == "CATEGORICAL":
+                cats = sorted(m.bin_2_categorical)
+                infos.append(f"{':'.join(str(c) for c in cats)}")
+            else:
+                infos.append(f"[{m.min_val!r}:{m.max_val!r}]")
+        return infos
+
+    def save_model_to_string(self, num_iteration: int = -1,
+                             start_iteration: int = 0) -> str:
+        self._materialize()
+        buf = io.StringIO()
+        buf.write("tree\n")
+        buf.write("version=v3\n")
+        buf.write(f"num_class={self.num_class}\n")
+        buf.write(f"num_tree_per_iteration={self.num_tree_per_iteration}\n")
+        buf.write(f"label_index={self.label_index}\n")
+        buf.write(f"max_feature_idx={self.max_feature_idx}\n")
+        if self.objective is not None:
+            buf.write(f"objective={self.objective.to_model_string()}\n")
+        buf.write("feature_names=" + " ".join(self.feature_names) + "\n")
+        buf.write("feature_infos=" + " ".join(self._feature_infos()) + "\n")
+
+        total = len(self.models)
+        k = self.num_tree_per_iteration
+        start = start_iteration * k
+        end = total
+        if num_iteration is not None and num_iteration > 0:
+            end = min(total, start + num_iteration * k)
+        tree_strs = []
+        for i in range(start, end):
+            s = f"Tree={i - start}\n" + self.models[i].to_string()
+            tree_strs.append(s)
+        buf.write("tree_sizes=" + " ".join(str(len(s)) for s in tree_strs) + "\n")
+        buf.write("\n")
+        for s in tree_strs:
+            buf.write(s)
+        buf.write("\nend of trees\n")
+        # feature importances (split counts, descending)
+        imp = self.feature_importance("split")
+        pairs = [(int(v), self.feature_names[i]) for i, v in enumerate(imp) if v > 0]
+        pairs.sort(key=lambda t: -t[0])
+        buf.write("\nfeature_importances:\n")
+        for v, name in pairs:
+            buf.write(f"{name}={v}\n")
+        buf.write("\nparameters:\n")
+        if self.config is not None:
+            for key, val in self.config.params.items():
+                if isinstance(val, list):
+                    val = ",".join(str(x) for x in val)
+                if isinstance(val, bool):
+                    val = int(val)
+                buf.write(f"[{key}: {val}]\n")
+        buf.write("\nend of parameters\n")
+        return buf.getvalue()
+
+    @classmethod
+    def from_model_string(cls, text: str) -> "GBDT":
+        self = cls()
+        lines = text.split("\n")
+        kv: Dict[str, str] = {}
+        tree_blocks: List[str] = []
+        i = 0
+        while i < len(lines):
+            line = lines[i]
+            if line.startswith("Tree="):
+                block = [line]
+                i += 1
+                while i < len(lines) and not lines[i].startswith("Tree=") \
+                        and not lines[i].startswith("end of trees"):
+                    block.append(lines[i])
+                    i += 1
+                tree_blocks.append("\n".join(block))
+                continue
+            if line.startswith("end of trees"):
+                break
+            if "=" in line:
+                key, v = line.split("=", 1)
+                kv[key] = v
+            i += 1
+        self.num_class = int(kv.get("num_class", "1"))
+        self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", "1"))
+        self.label_index = int(kv.get("label_index", "0"))
+        self.max_feature_idx = int(kv.get("max_feature_idx", "0"))
+        self.feature_names = kv.get("feature_names", "").split()
+        self.loaded_params = {"feature_infos": kv.get("feature_infos", "").split()}
+        if "objective" in kv:
+            self.objective = create_objective_from_model_string(kv["objective"])
+        for block in tree_blocks:
+            self.models.append(Tree.from_string(
+                block.split("\n", 1)[1] if "\n" in block else ""))
+        self.num_init_iteration = self.current_iteration()
+        self.iter_ = 0
+        return self
+
+    def _rebind_tree(self, tree: Tree) -> None:
+        """Map a loaded tree's real-feature splits back into bin space so the
+        binned traversal (_predict_binned) is valid for score replay."""
+        used_pos = {col: j for j, col in
+                    enumerate(self.train_data.used_feature_idx)}
+        for j in range(tree.num_leaves - 1):
+            real_f = int(tree.split_feature[j])
+            if real_f not in used_pos:
+                raise ValueError(
+                    f"init model splits on feature {real_f} which is trivial/"
+                    "unused in the new training data")
+            if int(tree.decision_type[j]) & 1:
+                raise NotImplementedError(
+                    "categorical splits in init models not yet supported")
+            tree.split_feature_inner[j] = used_pos[real_f]
+            mapper = self.train_data.mappers[real_f]
+            tree.threshold_in_bin[j] = mapper.value_to_bin(
+                float(tree.threshold[j]))
+
+    def merge_from_model_string(self, text: str) -> None:
+        """Continued training: prepend a loaded model (init_model)."""
+        self._materialize()
+        other = GBDT.from_model_string(text)
+        if other.num_tree_per_iteration != self.num_tree_per_iteration:
+            raise ValueError("init model has different num_tree_per_iteration")
+        for tree in other.models:
+            if tree.num_leaves > 1:
+                self._rebind_tree(tree)
+        self.models = other.models + self.models
+        self.num_init_iteration = other.current_iteration()
+        # replay loaded trees onto the score states
+        meta = self.learner.meta_np
+        for i, tree in enumerate(other.models):
+            kk = i % self.num_tree_per_iteration
+            self.train_scores.add(kk, jnp.asarray(
+                _predict_binned(tree, self.train_data.bins, meta)
+                .astype(np.float32)))
+            for vs, vd in zip(self.valid_scores, self.valid_sets):
+                vs.add(kk, jnp.asarray(
+                    _predict_binned(tree, vd.bins, meta).astype(np.float32)))
+
+    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> Dict:
+        self._materialize()
+        k = self.num_tree_per_iteration
+        start = start_iteration * k
+        end = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            end = min(end, start + num_iteration * k)
+        out = {
+            "name": "tree",
+            "version": "v3",
+            "num_class": self.num_class,
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": self.label_index,
+            "max_feature_idx": self.max_feature_idx,
+            "objective": (self.objective.to_model_string()
+                          if self.objective else "none"),
+            "feature_names": list(self.feature_names),
+            "tree_info": [self._tree_to_json(i, self.models[i])
+                          for i in range(start, end)],
+        }
+        return out
+
+    def _tree_to_json(self, idx: int, tree: Tree) -> Dict:
+        def node(i: int) -> Dict:
+            if i < 0:
+                leaf = ~i
+                return {
+                    "leaf_index": int(leaf),
+                    "leaf_value": float(tree.leaf_value[leaf]),
+                    "leaf_weight": float(tree.leaf_weight[leaf]),
+                    "leaf_count": int(tree.leaf_count[leaf]),
+                }
+            dt = int(tree.decision_type[i])
+            d = {
+                "split_index": int(i),
+                "split_feature": int(tree.split_feature[i]),
+                "split_gain": float(tree.split_gain[i]),
+                "threshold": float(tree.threshold[i]),
+                "decision_type": "==" if dt & 1 else "<=",
+                "default_left": bool(dt & 2),
+                "missing_type": ["None", "Zero", "NaN"][(dt >> 2) & 3],
+                "internal_value": float(tree.internal_value[i]),
+                "internal_weight": float(tree.internal_weight[i]),
+                "internal_count": int(tree.internal_count[i]),
+                "left_child": node(int(tree.left_child[i])),
+                "right_child": node(int(tree.right_child[i])),
+            }
+            return d
+        return {
+            "tree_index": idx,
+            "num_leaves": int(tree.num_leaves),
+            "num_cat": int(tree.num_cat),
+            "shrinkage": float(tree.shrinkage),
+            "tree_structure": node(0) if tree.num_leaves > 1 else {
+                "leaf_value": float(tree.leaf_value[0])},
+        }
+
+
+class _FevalData:
+    """Minimal Dataset-like shim passed to custom feval callbacks."""
+
+    def __init__(self, td: TrainingData):
+        self._td = td
+
+    def get_label(self):
+        return np.asarray(self._td.metadata.label)
+
+    def get_weight(self):
+        w = self._td.metadata.weight
+        return None if w is None else np.asarray(w)
+
+    def get_group(self):
+        b = self._td.metadata.query_boundaries
+        return None if b is None else np.diff(b)

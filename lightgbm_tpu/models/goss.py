@@ -1,0 +1,7 @@
+"""GOSS sampling (reference src/boosting/goss.hpp) — full logic in M4."""
+
+from .gbdt import GBDT
+
+
+class GOSS(GBDT):
+    pass

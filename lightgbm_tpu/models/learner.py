@@ -1,0 +1,201 @@
+"""TPU tree learner: wraps the device grower, assembles host Tree models.
+
+The analog of the reference's learner factory slot (reference
+src/treelearner/tree_learner.cpp:13-36): the serial learner here IS the
+device learner (device offload is the default, like `device_type=gpu`
+composing with the serial learner, gpu_tree_learner.cpp:739-750).  Parallel
+variants wrap the same grower with mesh shardings (lightgbm_tpu.parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..config import Config
+from ..io.bin_mapper import MissingType
+from ..io.dataset import TrainingData
+from ..ops.grower import GrowerParams, make_grower, pad_rows
+from .tree import Tree
+
+
+class TPUTreeLearner:
+    def __init__(self, config: Config, train_data: TrainingData):
+        self.config = config
+        self.td = train_data
+        n = train_data.num_data
+        self.num_features = train_data.num_features
+        if self.num_features == 0:
+            raise ValueError("no usable features in training data")
+
+        meta_np = train_data.feature_arrays()
+        self.meta_np = meta_np
+        B = int(meta_np["num_bin"].max())
+        self.num_bins = B
+
+        block = int(config.tpu_block_rows)
+        self.n_pad = pad_rows(n, block)
+        bins = train_data.bins
+        if self.n_pad != n:
+            pad = np.zeros((self.n_pad - n, bins.shape[1]), dtype=bins.dtype)
+            bins = np.concatenate([bins, pad], axis=0)
+        # int32 bins: the one-hot compare needs a signed/iota-compatible dtype
+        self.bins_pad = jnp.asarray(bins.astype(np.int32))
+        self.n = n
+
+        self.meta = {k: jnp.asarray(v.astype(np.int32) if v.dtype != np.float32
+                                    else v)
+                     for k, v in meta_np.items() if k != "is_categorical"}
+        self.meta["penalty"] = jnp.asarray(meta_np["penalty"])
+
+        self.params = GrowerParams(
+            num_leaves=max(int(config.num_leaves), 2),
+            num_bins=B,
+            block_rows=min(block, self.n_pad),
+            precision=str(config.tpu_hist_precision),
+            l1=float(config.lambda_l1),
+            l2=float(config.lambda_l2),
+            max_delta_step=float(config.max_delta_step),
+            min_data_in_leaf=float(config.min_data_in_leaf),
+            min_sum_hessian=float(config.min_sum_hessian_in_leaf),
+            min_gain_to_split=float(config.min_gain_to_split),
+            max_depth=int(config.max_depth),
+        )
+        self.grow = make_grower(self.params, self.num_features)
+        self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
+        self._ones_mask = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
+
+    # ------------------------------------------------------------------
+    def sample_features(self) -> jnp.ndarray:
+        """Per-tree feature_fraction mask (reference GetUsedFeatures,
+        serial_tree_learner.cpp:271-319)."""
+        frac = float(self.config.feature_fraction)
+        F = self.num_features
+        mask = np.ones(F, np.float32)
+        if frac < 1.0:
+            k = max(1, int(np.ceil(F * frac)))
+            used = self._feature_rng.choice(F, size=k, replace=False)
+            mask = np.zeros(F, np.float32)
+            mask[used] = 1.0
+        return jnp.asarray(mask)
+
+    def pad_vector(self, v: jnp.ndarray) -> jnp.ndarray:
+        if v.shape[0] == self.n_pad:
+            return v
+        return jnp.zeros(self.n_pad, v.dtype).at[:v.shape[0]].set(v)
+
+    # ------------------------------------------------------------------
+    def make_train_step(self, grad_fn, learning_rate: float,
+                        bagging: Optional[Dict] = None):
+        """Fuse gradients + tree growth + train-score update into ONE device
+        program per iteration.
+
+        On tunneled TPU attachments every host<->device round trip costs tens
+        of ms, so the driver must dispatch asynchronously and never sync on
+        the hot path: RNG keys thread through device state, bagging and
+        feature-fraction masks are sampled on device, and the only per-tree
+        artifact is the packed [L-1, 15] record array (fetched lazily).
+
+        grad_fn: (scores [k, n]) -> (grad [k, n], hess [k, n]) pure device fn.
+        Returns step(scores, key, class_id_static) ->
+            (records, new_scores, leaf_ids, leaf_output, new_key).
+        """
+        n, n_pad = self.n, self.n_pad
+        frac = 1.0 if bagging is None else bagging.get("fraction", 1.0)
+        pos_frac = 1.0 if bagging is None else bagging.get("pos_fraction", 1.0)
+        neg_frac = 1.0 if bagging is None else bagging.get("neg_fraction", 1.0)
+        is_pos = None
+        if bagging is not None and (pos_frac < 1.0 or neg_frac < 1.0):
+            is_pos = jnp.asarray(bagging["is_pos"])
+        feature_frac = float(self.config.feature_fraction)
+        ones_mask = self._ones_mask
+        F = self.num_features
+        grow = self.grow
+        meta = self.meta
+        bins_pad = self.bins_pad
+
+        def step(scores, key, bag_key, class_id, refresh_bag):
+            grad, hess = grad_fn(scores)
+            g = grad[class_id] if grad.ndim == 2 else grad
+            h = hess[class_id] if hess.ndim == 2 else hess
+            g = jnp.zeros(n_pad, jnp.float32).at[:n].set(g[:n])
+            h = jnp.zeros(n_pad, jnp.float32).at[:n].set(h[:n])
+
+            key, kf = jax.random.split(key)
+            if refresh_bag:  # static: bagging_freq boundary
+                bag_key = jax.random.split(bag_key)[0]
+            mask = ones_mask
+            if is_pos is not None:
+                r = jax.random.uniform(bag_key, (n_pad,))
+                keep = jnp.where(is_pos, r < pos_frac, r < neg_frac)
+                mask = mask * keep.astype(jnp.float32)
+            elif frac < 1.0:
+                r = jax.random.uniform(bag_key, (n_pad,))
+                mask = mask * (r < frac).astype(jnp.float32)
+            fmask = jnp.ones(F, jnp.float32)
+            if feature_frac < 1.0:
+                k_used = max(1, int(np.ceil(F * feature_frac)))
+                perm = jax.random.permutation(kf, F)
+                fmask = jnp.zeros(F, jnp.float32).at[perm[:k_used]].set(1.0)
+
+            out = grow(bins_pad, g, h, mask, fmask, meta)
+            any_split = out["records"][0, 14] > 0.5  # REC_DID_SPLIT
+            delta = out["leaf_output"][out["leaf_ids"]] * learning_rate
+            delta = jnp.where(any_split, delta, 0.0)
+            new_scores = scores.at[class_id, :].add(delta[:n])
+            return (out["records"], new_scores, out["leaf_ids"][:n],
+                    out["leaf_output"], key, bag_key)
+
+        return jax.jit(step, static_argnames=("class_id", "refresh_bag"))
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              row_mask: Optional[jnp.ndarray] = None
+              ) -> Tuple[Tree, jnp.ndarray, Dict]:
+        """Grow one tree. Returns (tree, leaf_ids[n] device, raw grower out)."""
+        mask = self._ones_mask if row_mask is None else \
+            self.pad_vector(row_mask) * self._ones_mask
+        out = self.grow(self.bins_pad, self.pad_vector(grad),
+                        self.pad_vector(hess), mask,
+                        self.sample_features(), self.meta)
+        tree = self.build_tree(out)
+        return tree, out["leaf_ids"][:self.n], out
+
+    def build_tree(self, out: Dict) -> Tree:
+        """Replay device split records into a reference-compatible Tree."""
+        rec = np.asarray(jax.device_get(out["records"]))  # [L-1, 15], one fetch
+        return self.build_tree_from_records(rec)
+
+    def build_tree_from_records(self, rec: np.ndarray) -> Tree:
+        from ..ops import grower as G
+        L = self.params.num_leaves
+        tree = Tree(L)
+        used = self.td.used_feature_idx
+        mappers = self.td.mappers
+        missing = self.meta_np["missing_type"]
+        for s in range(rec.shape[0]):
+            row = rec[s]
+            if row[G.REC_DID_SPLIT] < 0.5:
+                break
+            f = int(row[G.REC_FEATURE])
+            thr_bin = int(row[G.REC_THRESHOLD])
+            real_f = used[f]
+            tree.split(
+                leaf=int(row[G.REC_LEAF]),
+                feature_inner=f,
+                real_feature=real_f,
+                threshold_bin=thr_bin,
+                threshold_double=mappers[real_f].bin_to_value(thr_bin),
+                left_value=float(row[G.REC_LEFT_OUTPUT]),
+                right_value=float(row[G.REC_RIGHT_OUTPUT]),
+                left_cnt=int(round(float(row[G.REC_LEFT_COUNT]))),
+                right_cnt=int(round(float(row[G.REC_RIGHT_COUNT]))),
+                left_weight=float(row[G.REC_LEFT_WEIGHT]),
+                right_weight=float(row[G.REC_RIGHT_WEIGHT]),
+                gain=float(row[G.REC_GAIN]),
+                missing_type=int(missing[f]),
+                default_left=row[G.REC_DEFAULT_LEFT] > 0.5)
+        return tree

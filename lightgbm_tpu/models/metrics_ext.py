@@ -1,0 +1,1 @@
+"""Extended metric zoo (filled out in the objectives/metrics milestone)."""

@@ -1,0 +1,1 @@
+"""Extended objective zoo (filled out in the objectives milestone)."""

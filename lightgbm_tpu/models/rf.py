@@ -1,0 +1,7 @@
+"""Random forest mode (reference src/boosting/rf.hpp) — full logic in M4."""
+
+from .gbdt import GBDT
+
+
+class RF(GBDT):
+    pass

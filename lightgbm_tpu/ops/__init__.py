@@ -1,0 +1,2 @@
+from .histogram import build_histogram, pack_stats
+from .split import find_best_split_all_features

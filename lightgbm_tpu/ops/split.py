@@ -1,0 +1,195 @@
+"""Best-split search over histograms as masked cumulative sums + argmax.
+
+Re-expresses the reference's sequential two-direction threshold scans
+(reference src/treelearner/feature_histogram.hpp:508-644
+FindBestThresholdSequence) as vectorized [F, B] tensor ops:
+
+* direction +1 ("missing right"): left stats = prefix sums over bins in
+  ascending order, excluding the zero bin for MissingType.Zero features and
+  the NaN bin for MissingType.NaN features; right = parent - left, so the
+  excluded (missing) mass falls to the right.  default_left = False.
+* direction -1 ("missing left"): right stats = suffix sums with the same
+  exclusions; left = parent - right, missing mass falls left.
+  default_left = True.
+
+Gain math matches feature_histogram.hpp:444-506: L1 soft-thresholded leaf
+outputs, L2, max_delta_step clamp, optional monotone-constraint veto; the
+reported gain is (left+right gain) - (parent gain + min_gain_to_split),
+scaled by the per-feature penalty (CEGB / feature_contri hook).
+
+Tie-breaking mirrors the reference scan order: dir=-1 is scanned first and
+keeps the LARGEST threshold among equal gains; dir=+1 replaces only on
+strictly greater gain and keeps the smallest threshold.  Across features the
+lowest feature index wins ties (ArrayArgs::ArgMax semantics).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+K_MIN_SCORE = -1e30
+K_EPSILON = 1e-15
+
+MISSING_NONE = 0
+MISSING_ZERO = 1
+MISSING_NAN = 2
+
+
+def _threshold_l1(s, l1):
+    reg = jnp.maximum(0.0, jnp.abs(s) - l1)
+    return jnp.sign(s) * reg
+
+
+def leaf_output(sum_g, sum_h, l1, l2, max_delta_step):
+    """CalculateSplittedLeafOutput (feature_histogram.hpp:449-456)."""
+    out = -_threshold_l1(sum_g, l1) / (sum_h + l2)
+    if_clip = (max_delta_step > 0.0)
+    clipped = jnp.clip(out, -max_delta_step, max_delta_step)
+    return jnp.where(if_clip, clipped, out)
+
+
+def leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step):
+    """GetLeafSplitGain (feature_histogram.hpp:497-506)."""
+    output = leaf_output(sum_g, sum_h, l1, l2, max_delta_step)
+    sg_l1 = _threshold_l1(sum_g, l1)
+    return -(2.0 * sg_l1 * output + (sum_h + l2) * output * output)
+
+
+class SplitResult(NamedTuple):
+    gain: jnp.ndarray          # scalar f32; <=0 means no valid split
+    feature: jnp.ndarray       # i32 index into used features
+    threshold: jnp.ndarray     # i32 bin threshold
+    default_left: jnp.ndarray  # bool
+    left_sum_g: jnp.ndarray
+    left_sum_h: jnp.ndarray
+    left_count: jnp.ndarray    # f32
+    left_output: jnp.ndarray
+    right_output: jnp.ndarray
+
+
+def find_best_split_all_features(
+        hist: jnp.ndarray,        # [F, B, 3] (g, h, cnt)
+        sum_g, sum_h, num_data,   # parent totals (scalars, f32)
+        num_bin: jnp.ndarray,     # [F] i32 bins per feature
+        missing_type: jnp.ndarray,  # [F] i32
+        default_bin: jnp.ndarray,   # [F] i32
+        monotone: jnp.ndarray,      # [F] i32 in {-1,0,1}
+        penalty: jnp.ndarray,       # [F] f32
+        feature_mask: jnp.ndarray,  # [F] f32/bool (feature_fraction)
+        *, l1: float, l2: float, max_delta_step: float,
+        min_data_in_leaf: float, min_sum_hessian: float,
+        min_gain_to_split: float,
+        min_constraint=-1e30, max_constraint=1e30) -> SplitResult:
+    """Best split for one leaf across all features. Fully vectorized.
+
+    min/max_constraint are the leaf's monotone value bounds, propagated down
+    the tree by the grower (reference serial_tree_learner.cpp:840-851)."""
+    F, B, _ = hist.shape
+    bin_iota = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
+    nb = num_bin[:, None]                                        # [F, 1]
+
+    hg, hh, hc = hist[..., 0], hist[..., 1], hist[..., 2]
+
+    is_zero_missing = (missing_type[:, None] == MISSING_ZERO)
+    is_nan_missing = (missing_type[:, None] == MISSING_NAN)
+    skip_bin = is_zero_missing & (bin_iota == default_bin[:, None])
+    na_bin = is_nan_missing & (bin_iota == nb - 1)
+    acc_mask = (~skip_bin) & (~na_bin) & (bin_iota < nb)
+
+    ag = jnp.where(acc_mask, hg, 0.0)
+    ah = jnp.where(acc_mask, hh, 0.0)
+    ac = jnp.where(acc_mask, hc, 0.0)
+
+    cg = jnp.cumsum(ag, axis=1)                                  # [F, B]
+    ch = jnp.cumsum(ah, axis=1)
+    cc = jnp.cumsum(ac, axis=1)
+
+    gain_shift = leaf_split_gain(sum_g, sum_h + 2 * K_EPSILON,
+                                 l1, l2, max_delta_step)
+    min_gain_shift = gain_shift + min_gain_to_split
+
+    def eval_dir(left_g, left_h, left_c, thr_valid):
+        right_g = sum_g - left_g
+        right_h = sum_h - left_h
+        right_c = num_data - left_c
+        ok = (thr_valid
+              & (left_c >= min_data_in_leaf) & (right_c >= min_data_in_leaf)
+              & (left_h >= min_sum_hessian) & (right_h >= min_sum_hessian))
+        lo = jnp.clip(leaf_output(left_g, left_h, l1, l2, max_delta_step),
+                      min_constraint, max_constraint)
+        ro = jnp.clip(leaf_output(right_g, right_h, l1, l2, max_delta_step),
+                      min_constraint, max_constraint)
+        mono = monotone[:, None]
+        mono_bad = ((mono > 0) & (lo > ro)) | ((mono < 0) & (lo < ro))
+        sg_l1_l = _threshold_l1(left_g, l1)
+        sg_l1_r = _threshold_l1(right_g, l1)
+        g_l = -(2.0 * sg_l1_l * lo + (left_h + l2) * lo * lo)
+        g_r = -(2.0 * sg_l1_r * ro + (right_h + l2) * ro * ro)
+        gain = jnp.where(mono_bad, 0.0, g_l + g_r)
+        gain = jnp.where(ok & (gain > min_gain_shift), gain, K_MIN_SCORE)
+        return gain, lo, ro
+
+    # ---- direction +1: left = prefix, missing goes right ----------------
+    thr_ok_p1 = (bin_iota <= nb - 2) & (~skip_bin) & \
+        jnp.where(is_nan_missing, bin_iota <= nb - 2, True)
+    gain_p1, lo_p1, ro_p1 = eval_dir(cg, ch, cc, thr_ok_p1)
+
+    # ---- direction -1: right = suffix, missing goes left ----------------
+    # right stats at threshold t = total_acc - prefix[t]
+    tg, th, tc = cg[:, -1:], ch[:, -1:], cc[:, -1:]
+    left_g_m1 = sum_g - (tg - cg)
+    left_h_m1 = sum_h - (th - ch)
+    left_c_m1 = num_data - (tc - cc)
+    thr_ok_m1 = (bin_iota <= nb - 2 - is_nan_missing.astype(jnp.int32)) & (~skip_bin)
+    gain_m1, lo_m1, ro_m1 = eval_dir(left_g_m1, left_h_m1, left_c_m1, thr_ok_m1)
+
+    # ---- per-feature best with reference tie-breaking -------------------
+    # dir=-1: largest threshold wins ties -> argmax over reversed bins
+    rev = gain_m1[:, ::-1]
+    idx_m1 = (B - 1) - jnp.argmax(rev, axis=1)                   # [F]
+    best_m1 = jnp.take_along_axis(gain_m1, idx_m1[:, None], axis=1)[:, 0]
+    # dir=+1: smallest threshold wins ties -> plain argmax
+    idx_p1 = jnp.argmax(gain_p1, axis=1)
+    best_p1 = jnp.take_along_axis(gain_p1, idx_p1[:, None], axis=1)[:, 0]
+
+    use_p1 = best_p1 > best_m1                                   # strict >
+    feat_gain = jnp.where(use_p1, best_p1, best_m1)
+    feat_thr = jnp.where(use_p1, idx_p1, idx_m1).astype(jnp.int32)
+    feat_dleft = ~use_p1
+
+    # only-2-bin NaN features get default_left=False in the reference
+    # (feature_histogram.hpp:105-108); with a full scan this is cosmetic but
+    # keeps model files identical
+    two_bin_nan = (num_bin <= 2) & (missing_type == MISSING_NAN)
+    feat_dleft = jnp.where(two_bin_nan, False, feat_dleft)
+
+    feat_gain = jnp.where(feature_mask > 0, feat_gain, K_MIN_SCORE)
+    out_gain = (feat_gain - min_gain_shift) * penalty
+
+    # ---- across features: first max wins --------------------------------
+    best_f = jnp.argmax(out_gain, axis=0).astype(jnp.int32)
+    g = out_gain[best_f]
+    thr = feat_thr[best_f]
+    dleft = feat_dleft[best_f]
+
+    # recompute left stats of the winner (per chosen direction)
+    lg = jnp.where(dleft, left_g_m1[best_f, thr], cg[best_f, thr])
+    lh = jnp.where(dleft, left_h_m1[best_f, thr], ch[best_f, thr])
+    lc = jnp.where(dleft, left_c_m1[best_f, thr], cc[best_f, thr])
+    lo = jnp.clip(leaf_output(lg, lh, l1, l2, max_delta_step),
+                  min_constraint, max_constraint)
+    ro = jnp.clip(leaf_output(sum_g - lg, sum_h - lh, l1, l2, max_delta_step),
+                  min_constraint, max_constraint)
+
+    valid = feat_gain[best_f] > K_MIN_SCORE / 2
+    return SplitResult(
+        gain=jnp.where(valid, g, K_MIN_SCORE),
+        feature=best_f,
+        threshold=thr,
+        default_left=dleft,
+        left_sum_g=lg, left_sum_h=lh, left_count=lc,
+        left_output=lo, right_output=ro)

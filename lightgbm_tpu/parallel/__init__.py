@@ -1,0 +1,2 @@
+from .mesh import make_mesh, shard_rows
+from .data_parallel import make_data_parallel_grower

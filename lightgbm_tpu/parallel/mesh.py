@@ -1,0 +1,40 @@
+"""Device mesh plumbing: the TPU-native replacement for src/network.
+
+The reference builds an all-to-all TCP/MPI mesh with hand-written
+Bruck/recursive-halving/ring collectives (reference src/network/
+network.cpp:68-318).  On TPU the transport and algorithm selection belong to
+XLA: we declare a `jax.sharding.Mesh` with axes
+
+  * 'data'    — row shards (the reference's data_parallel machines)
+  * 'feature' — feature shards (the reference's feature_parallel machines)
+
+and express the collectives as `lax.psum` / `lax.all_gather` inside
+shard_map'ped growers.  `num_machines`/`machines` config maps to the mesh
+shape; ICI vs DCN placement is XLA's concern.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(num_data_shards: int = 1, num_feature_shards: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    need = num_data_shards * num_feature_shards
+    if need > len(devices):
+        raise ValueError(
+            f"mesh {num_data_shards}x{num_feature_shards} needs {need} "
+            f"devices, have {len(devices)}")
+    dev = np.array(devices[:need]).reshape(num_data_shards, num_feature_shards)
+    return Mesh(dev, ("data", "feature"))
+
+
+def shard_rows(n: int, num_shards: int) -> int:
+    """Rows per shard, padded so every shard is equal-size."""
+    return (n + num_shards - 1) // num_shards

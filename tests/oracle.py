@@ -115,7 +115,7 @@ def parse_cli_metrics(stdout: str) -> Dict[str, List[float]]:
         try:
             head, val = line.rsplit(":", 1)
             value = float(val)
-            key = head.split(",", 1)[1].strip().rsplit(" ", 1)[0].strip()
+            key = head.split(",", 1)[1].strip()  # e.g. 'training binary_logloss'
             out.setdefault(key, []).append(value)
         except (ValueError, IndexError):
             continue

@@ -1,0 +1,169 @@
+"""End-to-end binary classification vs the reference oracle (SURVEY.md §7 M2
+acceptance: logloss/AUC curve matches reference CPU within tolerance)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from .conftest import has_oracle
+
+
+@pytest.mark.skipif(not has_oracle(), reason="reference oracle not built")
+class TestBinaryParity:
+    @pytest.fixture(scope="class")
+    def ref_metrics(self, binary_example):
+        from .oracle import run_cli
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            stdout = run_cli({
+                "task": "train",
+                "data": binary_example["train_file"],
+                "valid_data": binary_example["test_file"],
+                "objective": "binary", "metric": "binary_logloss,auc",
+                "num_trees": "50", "num_leaves": "31", "learning_rate": "0.1",
+                "min_data_in_leaf": "20", "max_bin": "255",
+                "is_training_metric": "true",
+                "output_model": td + "/m.txt", "verbosity": "2"}, td)
+        from .oracle import parse_cli_metrics
+        return parse_cli_metrics(stdout)
+
+    def test_metric_curves_match(self, binary_example, ref_metrics):
+        # reference auto-loads the .weight sidecars next to the data files
+        wtr = np.loadtxt(binary_example["train_file"] + ".weight")
+        wte = np.loadtxt(binary_example["test_file"] + ".weight")
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"], weight=wtr,
+                         params={"max_bin": 255})
+        vs = ds.create_valid(binary_example["X_test"],
+                             label=binary_example["y_test"], weight=wte)
+        res = {}
+        lgb.train({"objective": "binary", "num_leaves": 31,
+                   "learning_rate": 0.1, "min_data_in_leaf": 20,
+                   "metric": ["binary_logloss", "auc"]},
+                  ds, num_boost_round=50, valid_sets=[ds, vs],
+                  valid_names=["training", "valid_1"], verbose_eval=False,
+                  evals_result=res)
+        ref_tr_ll = ref_metrics["training binary_logloss"]
+        my_tr_ll = res["training"]["binary_logloss"]
+        # early iterations must track closely; later ones drift slowly as
+        # f32-vs-f64 tie-breaks pick different (equally good) splits
+        for i in (0, 4, 9):
+            assert abs(my_tr_ll[i] - ref_tr_ll[i]) < 5e-3, \
+                f"iter {i}: {my_tr_ll[i]} vs {ref_tr_ll[i]}"
+        assert abs(my_tr_ll[49] - ref_tr_ll[49]) < 2e-2
+        ref_va_auc = ref_metrics["valid_1 auc"][-1]
+        my_va_auc = res["valid_1"]["auc"][-1]
+        assert my_va_auc > ref_va_auc - 0.01, \
+            f"valid auc {my_va_auc} vs ref {ref_va_auc}"
+
+    def test_first_tree_structure_matches(self, binary_example):
+        """With deterministic config the first tree should pick the same root
+        split as the reference (bin parity => identical histograms)."""
+        from .oracle import train_cli_and_read_model
+        ref = train_cli_and_read_model(
+            binary_example["train_file"],
+            {"objective": "binary", "num_trees": "1", "num_leaves": "15",
+             "learning_rate": "0.1", "min_data_in_leaf": "20",
+             "verbosity": "-1"})
+        ref_lines = dict(
+            l.split("=", 1) for l in ref["model"].split("\n")
+            if "=" in l and not l.startswith("["))
+        ref_root_feature = int(ref_lines["split_feature"].split()[0])
+        ref_root_threshold = float(ref_lines["threshold"].split()[0])
+
+        wtr = np.loadtxt(binary_example["train_file"] + ".weight")
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"], weight=wtr,
+                         params={"max_bin": 255})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "learning_rate": 0.1, "min_data_in_leaf": 20},
+                        ds, num_boost_round=1, verbose_eval=False)
+        d = bst.dump_model()
+        root = d["tree_info"][0]["tree_structure"]
+        assert root["split_feature"] == ref_root_feature
+        assert root["threshold"] == pytest.approx(ref_root_threshold, abs=1e-9)
+
+
+class TestTrainingBasics:
+    def test_regression(self, regression_example):
+        ds = lgb.Dataset(regression_example["X_train"],
+                         label=regression_example["y_train"])
+        vs = ds.create_valid(regression_example["X_test"],
+                             label=regression_example["y_test"])
+        res = {}
+        lgb.train({"objective": "regression", "num_leaves": 31,
+                   "learning_rate": 0.05, "metric": "l2"},
+                  ds, num_boost_round=50, valid_sets=[vs],
+                  verbose_eval=False, evals_result=res)
+        curve = res["valid_0"]["l2"]
+        assert curve[-1] < curve[0] * 0.8
+        assert curve[-1] < 0.4  # reference example reaches ~0.2 area
+
+    def test_early_stopping(self, binary_example):
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"])
+        vs = ds.create_valid(binary_example["X_test"],
+                             label=binary_example["y_test"])
+        bst = lgb.train({"objective": "binary", "num_leaves": 127,
+                         "learning_rate": 0.5, "metric": "binary_logloss"},
+                        ds, num_boost_round=200, valid_sets=[vs],
+                        early_stopping_rounds=5, verbose_eval=False)
+        assert bst.best_iteration > 0
+        assert bst.best_iteration < 200
+
+    def test_init_score_continuation(self, binary_example):
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "learning_rate": 0.1}
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"])
+        bst1 = lgb.train(params, ds, num_boost_round=5, verbose_eval=False)
+        ds2 = lgb.Dataset(binary_example["X_train"],
+                          label=binary_example["y_train"])
+        bst2 = lgb.train(params, ds2, num_boost_round=5, verbose_eval=False,
+                         init_model=bst1)
+        assert bst2.num_trees() == 10
+        # 5 + 5 continued must track a straight 10-iteration run: the loaded
+        # trees' scores are replayed through the binned traversal
+        ds3 = lgb.Dataset(binary_example["X_train"],
+                          label=binary_example["y_train"])
+        bst10 = lgb.train(params, ds3, num_boost_round=10, verbose_eval=False)
+        p2 = bst2.predict(binary_example["X_test"], raw_score=True)
+        p10 = bst10.predict(binary_example["X_test"], raw_score=True)
+        assert np.abs(p2 - p10).max() < 1e-3
+
+    def test_custom_objective(self, binary_example):
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"])
+
+        def fobj(score, dataset):
+            label = (binary_example["y_train"] > 0).astype(np.float64)
+            p = 1.0 / (1.0 + np.exp(-score))
+            return p - label, p * (1 - p)
+
+        bst = lgb.train({"objective": "none", "num_leaves": 15,
+                         "learning_rate": 0.1},
+                        ds, num_boost_round=10, fobj=fobj, verbose_eval=False)
+        p = bst.predict(binary_example["X_test"], raw_score=True)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(binary_example["y_test"] > 0, p) > 0.75
+
+    def test_bagging_and_feature_fraction(self, binary_example):
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"])
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "bagging_fraction": 0.5, "bagging_freq": 1,
+                         "feature_fraction": 0.5, "seed": 7},
+                        ds, num_boost_round=20, verbose_eval=False)
+        p = bst.predict(binary_example["X_test"])
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(binary_example["y_test"] > 0, p) > 0.75
+        # feature_fraction=0.5 must leave some features unused per tree
+        d = bst.dump_model()
+        feats_in_tree0 = set()
+        def walk(nd):
+            if "split_feature" in nd:
+                feats_in_tree0.add(nd["split_feature"])
+                walk(nd["left_child"]); walk(nd["right_child"])
+        walk(d["tree_info"][0]["tree_structure"])
+        assert len(feats_in_tree0) <= 14

@@ -1,0 +1,138 @@
+"""Tree model: structure, serialization, prediction (SURVEY.md §2.1 Tree)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.models.tree import Tree
+from lightgbm_tpu.models.gbdt import GBDT
+
+from .conftest import has_oracle
+
+
+def _small_tree():
+    t = Tree(4)
+    # root split on feature 0 @ 0.5
+    t.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=10,
+            threshold_double=0.5, left_value=-1.0, right_value=1.0,
+            left_cnt=60, right_cnt=40, left_weight=6.0, right_weight=4.0,
+            gain=10.0, missing_type=0, default_left=True)
+    # split left leaf on feature 1 @ -0.2
+    t.split(leaf=0, feature_inner=1, real_feature=1, threshold_bin=5,
+            threshold_double=-0.2, left_value=-2.0, right_value=-0.5,
+            left_cnt=30, right_cnt=30, left_weight=3.0, right_weight=3.0,
+            gain=5.0, missing_type=0, default_left=True)
+    return t
+
+
+class TestTreeStructure:
+    def test_split_bookkeeping(self):
+        t = _small_tree()
+        assert t.num_leaves == 3
+        # node 0: children = node 1 (left, was leaf 0) and ~1 (right leaf)
+        assert t.left_child[0] == 1
+        assert t.right_child[0] == ~1
+        assert t.left_child[1] == ~0
+        assert t.right_child[1] == ~2
+        assert t.internal_count[0] == 100
+        assert t.leaf_depth[0] == 2 and t.leaf_depth[2] == 2
+
+    def test_predict(self):
+        t = _small_tree()
+        X = np.array([[0.0, -0.5], [0.0, 0.0], [1.0, 0.0]])
+        np.testing.assert_allclose(t.predict(X), [-2.0, -0.5, 1.0])
+        assert list(t.predict_leaf(X)) == [0, 2, 1]
+
+    def test_shrinkage_and_bias(self):
+        t = _small_tree()
+        t.apply_shrinkage(0.1)
+        # leaf order: 0 = left of 2nd split, 1 = right of 1st, 2 = right of 2nd
+        np.testing.assert_allclose(t.leaf_value[:3], [-0.2, 0.1, -0.05])
+        assert t.shrinkage == pytest.approx(0.1)
+        t.add_bias(1.0)
+        np.testing.assert_allclose(t.leaf_value[:3], [0.8, 1.1, 0.95])
+        assert t.shrinkage == 1.0
+
+    def test_string_roundtrip(self):
+        t = _small_tree()
+        t2 = Tree.from_string(t.to_string())
+        X = np.random.default_rng(0).normal(size=(50, 2))
+        np.testing.assert_allclose(t.predict(X), t2.predict(X))
+        assert t2.num_leaves == 3
+
+    def test_missing_nan_default_left(self):
+        t = Tree(2)
+        t.split(leaf=0, feature_inner=0, real_feature=0, threshold_bin=1,
+                threshold_double=0.5, left_value=-1.0, right_value=1.0,
+                left_cnt=50, right_cnt=50, left_weight=5.0, right_weight=5.0,
+                gain=1.0, missing_type=2, default_left=True)
+        X = np.array([[np.nan], [0.2], [0.9]])
+        np.testing.assert_allclose(t.predict(X), [-1.0, -1.0, 1.0])
+        # default right
+        t.decision_type[0] = int(t.decision_type[0]) & ~2
+        np.testing.assert_allclose(t.predict(X), [1.0, -1.0, 1.0])
+
+
+@pytest.mark.skipif(not has_oracle(), reason="reference oracle not built")
+class TestModelInterchange:
+    """Model files interchange with the reference bit-exactly (SURVEY.md §2.2)."""
+
+    @pytest.fixture(scope="class")
+    def ref_model(self, binary_example, tmp_path_factory):
+        from .oracle import train_cli_and_read_model
+        return train_cli_and_read_model(
+            binary_example["train_file"],
+            {"objective": "binary", "num_trees": "10", "num_leaves": "31",
+             "learning_rate": "0.1", "min_data_in_leaf": "20",
+             "verbosity": "-1"})
+
+    def test_load_reference_model_and_predict(self, ref_model, binary_example,
+                                              tmp_path):
+        import subprocess
+        from .conftest import ORACLE_BIN
+        g = GBDT.from_model_string(ref_model["model"])
+        assert len(g.models) == 10
+        mine = g.predict(binary_example["X_test"])
+        model_path = tmp_path / "m.txt"
+        model_path.write_text(ref_model["model"])
+        out_path = tmp_path / "p.txt"
+        subprocess.run([ORACLE_BIN, "task=predict",
+                        f"data={binary_example['test_file']}",
+                        f"input_model={model_path}",
+                        f"output_result={out_path}", "verbosity=-1"],
+                       check=True, capture_output=True)
+        ref = np.loadtxt(out_path)
+        np.testing.assert_allclose(mine, ref, atol=1e-12)
+
+    def test_reference_loads_our_model(self, binary_example, tmp_path):
+        import subprocess
+        import lightgbm_tpu as lgb
+        from .conftest import ORACLE_BIN
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"],
+                         params={"max_bin": 255})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "learning_rate": 0.1, "min_data_in_leaf": 20},
+                        ds, num_boost_round=5, verbose_eval=False)
+        mine = bst.predict(binary_example["X_test"])
+        model_path = tmp_path / "m.txt"
+        bst.save_model(str(model_path))
+        out_path = tmp_path / "p.txt"
+        subprocess.run([ORACLE_BIN, "task=predict",
+                        f"data={binary_example['test_file']}",
+                        f"input_model={model_path}",
+                        f"output_result={out_path}", "verbosity=-1"],
+                       check=True, capture_output=True)
+        ref = np.loadtxt(out_path)
+        np.testing.assert_allclose(mine, ref, atol=1e-12)
+
+    def test_our_string_roundtrip(self, binary_example):
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.booster import Booster
+        ds = lgb.Dataset(binary_example["X_train"],
+                         label=binary_example["y_train"])
+        bst = lgb.train({"objective": "binary", "num_leaves": 7},
+                        ds, num_boost_round=3, verbose_eval=False)
+        s = bst.model_to_string()
+        bst2 = Booster(model_str=s)
+        np.testing.assert_allclose(bst.predict(binary_example["X_test"]),
+                                   bst2.predict(binary_example["X_test"]))
