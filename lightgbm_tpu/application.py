@@ -1,0 +1,121 @@
+"""CLI application: `python -m lightgbm_tpu task=train config=train.conf`.
+
+Mirrors the reference CLI (reference src/main.cpp:11, src/application/
+application.cpp:30-251): argv `key=value` pairs override the config file;
+tasks are train / predict / refit / convert_model (convert_model is out of
+scope, SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .config import Config
+
+
+def parse_argv(argv: List[str]) -> Dict[str, str]:
+    """argv key=value pairs + optional config file
+    (reference application.cpp:30-81 LoadParameters)."""
+    cli: Dict[str, str] = {}
+    for arg in argv:
+        if "=" in arg:
+            k, v = arg.split("=", 1)
+            cli[k.strip()] = v.strip()
+    params: Dict[str, str] = {}
+    conf_key = next((k for k in ("config", "config_file") if k in cli), None)
+    if conf_key:
+        params.update(Config.load_conf_file(cli[conf_key]))
+    params.update(cli)  # CLI overrides file values (application.cpp:62-66)
+    return params
+
+
+class Application:
+    def __init__(self, argv: List[str]):
+        self.raw_params = parse_argv(argv)
+        self.config = Config(self.raw_params)
+
+    def run(self) -> None:
+        task = str(self.config.task).lower()
+        if task == "train" or task == "training":
+            self.train()
+        elif task in ("predict", "prediction", "test"):
+            self.predict()
+        elif task == "refit" or task == "refit_tree":
+            raise NotImplementedError("task=refit lands with the refit milestone")
+        else:
+            raise ValueError(f"unknown task {task!r}")
+
+    # ------------------------------------------------------------------
+    def train(self) -> None:
+        from . import Dataset, train as train_fn
+        cfg = self.config
+        if not cfg.data:
+            raise ValueError("no training data: set data=<file>")
+        t0 = time.time()
+        train_set = Dataset(cfg.data, params=dict(self.raw_params))
+        train_set.construct()
+        print(f"[lightgbm_tpu] finished loading data in "
+              f"{time.time() - t0:.2f} seconds")
+
+        valid_sets, valid_names = [], []
+        if cfg.is_provide_training_metric:
+            valid_sets.append(train_set)
+            valid_names.append("training")
+        for i, vf in enumerate(cfg.valid):
+            vs = Dataset(vf, reference=train_set,
+                         params=dict(self.raw_params))
+            valid_sets.append(vs)
+            valid_names.append(f"valid_{i + 1}")
+
+        init_model = cfg.input_model if cfg.input_model else None
+        booster = train_fn(
+            dict(self.raw_params), train_set,
+            num_boost_round=int(cfg.num_iterations),
+            valid_sets=valid_sets, valid_names=valid_names,
+            init_model=init_model,
+            verbose_eval=(int(cfg.metric_freq)
+                          if int(cfg.verbosity) > 0 else False))
+        booster.save_model(cfg.output_model)
+        print(f"[lightgbm_tpu] finished training; model saved to "
+              f"{cfg.output_model}")
+
+    # ------------------------------------------------------------------
+    def predict(self) -> None:
+        from . import Booster
+        cfg = self.config
+        if not cfg.data:
+            raise ValueError("no prediction data: set data=<file>")
+        if not cfg.input_model:
+            raise ValueError("no model file: set input_model=<file>")
+        booster = Booster(model_file=cfg.input_model)
+        result = booster.predict(
+            cfg.data,
+            num_iteration=(int(cfg.num_iteration_predict)
+                           if int(cfg.num_iteration_predict) > 0 else None),
+            raw_score=bool(cfg.predict_raw_score),
+            pred_leaf=bool(cfg.predict_leaf_index),
+            pred_contrib=bool(cfg.predict_contrib))
+        out = np.asarray(result)
+        with open(cfg.output_result, "w") as f:
+            if out.ndim == 1:
+                for v in out:
+                    f.write(f"{v:g}\n")
+            else:
+                for row in out:
+                    f.write("\t".join(f"{v:g}" for v in row) + "\n")
+        print(f"[lightgbm_tpu] finished prediction; results saved to "
+              f"{cfg.output_result}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if not argv:
+        print("usage: python -m lightgbm_tpu task=train config=train.conf "
+              "[key=value ...]")
+        return 1
+    Application(argv).run()
+    return 0

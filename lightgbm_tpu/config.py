@@ -145,6 +145,7 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "eval_at": ("int_list", [1, 2, 3, 4, 5], ("ndcg_eval_at", "ndcg_at", "map_eval_at",
                                               "map_at")),
     "multi_error_top_k": ("int", 1, ()),
+    "auc_mu_weights": ("float_list", [], ()),
     # --- network (mesh) ---
     "num_machines": ("int", 1, ("num_machine",)),
     "local_listen_port": ("int", 12400, ("local_port", "port")),
@@ -285,9 +286,6 @@ class Config:
             if not p["metric"]:
                 # default metric comes from the objective at Booster build time
                 pass
-        if p["boosting"] == "goss":
-            # bagging is managed by GOSS itself
-            p["bagging_freq"] = 0
         learner = p["tree_learner"]
         if learner not in ("serial", "feature", "data", "voting",
                            "feature_parallel", "data_parallel", "voting_parallel"):

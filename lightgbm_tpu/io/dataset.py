@@ -53,6 +53,15 @@ class Metadata:
     def num_queries(self) -> int:
         return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
 
+    def query_weights(self) -> Optional[np.ndarray]:
+        """Per-query weight = mean of row weights inside the query; None when
+        rows are unweighted (reference src/io/metadata.cpp:461-470)."""
+        if self.query_boundaries is None or self.weight is None:
+            return None
+        w = np.asarray(self.weight, np.float64)
+        sums = np.add.reduceat(w, self.query_boundaries[:-1])
+        return sums / np.diff(self.query_boundaries)
+
     def set_field(self, name: str, data: Optional[np.ndarray]) -> None:
         if name == "label":
             self.label = np.asarray(data, dtype=np.float32)

@@ -1,7 +1,135 @@
-"""DART boosting (reference src/boosting/dart.hpp) — full logic in M4."""
+"""DART boosting (reference src/boosting/dart.hpp).
 
-from .gbdt import GBDT
+Each iteration: drop a random subset of existing trees (weighted by tree
+weight unless uniform_drop), compute gradients on the dropped score, grow
+the new tree with shrinkage lr/(1+k), then rescale the dropped trees by
+k/(k+1) (or k/(k+lr) in xgboost_dart_mode) and restore their contribution
+(reference dart.hpp:58-139 DroppingTrees, :97 Normalize).
+
+The drop/restore bookkeeping is host-side score arithmetic (one binned
+traversal per dropped tree per dataset); gradient + tree growth still run
+on device via the synchronous driver path.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .gbdt import GBDT, _predict_binned
 
 
 class DART(GBDT):
-    pass
+    def init(self, config, train_data) -> None:
+        super().init(config, train_data)
+        self._train_step = None  # drop bookkeeping varies per iter: sync path
+        self._drop_rng = np.random.default_rng(int(config.drop_seed))
+        self.tree_weight: List[float] = []
+        self.sum_weight = 0.0
+        self._drop_idx: List[int] = []
+
+    def reset_config(self, config) -> None:
+        super().reset_config(config)
+        self._train_step = None
+        self._drop_rng = np.random.default_rng(int(config.drop_seed))
+        self.sum_weight = 0.0
+
+    # ------------------------------------------------------------------
+    def _tree_delta(self, tree, data, class_id: int) -> np.ndarray:
+        return _predict_binned(tree, data.bins, self.learner.meta_np) \
+            .astype(np.float32)
+
+    def _apply_tree_to_scores(self, iter_idx: int, sign: float) -> None:
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            tree = self.models[iter_idx * K + k]
+            if tree.num_leaves <= 1:
+                continue
+            self.train_scores.add(k, jnp.asarray(
+                sign * self._tree_delta(tree, self.train_data, k)))
+            for vs, vd in zip(self.valid_scores, self.valid_sets):
+                vs.add(k, jnp.asarray(
+                    sign * self._tree_delta(tree, vd, k)))
+
+    def _dropping_trees(self) -> None:
+        """Select and remove dropped trees from the scores
+        (reference dart.hpp:97-139)."""
+        cfg = self.config
+        self._drop_idx = []
+        if self._drop_rng.random() >= float(cfg.skip_drop):
+            drop_rate = float(cfg.drop_rate)
+            max_drop = int(cfg.max_drop)
+            if not cfg.uniform_drop:
+                if self.sum_weight > 0:
+                    inv_avg = len(self.tree_weight) / self.sum_weight
+                    if max_drop > 0:
+                        drop_rate = min(drop_rate,
+                                        max_drop * inv_avg / self.sum_weight)
+                    for i in range(self.iter_):
+                        if self._drop_rng.random() < \
+                                drop_rate * self.tree_weight[i] * inv_avg:
+                            self._drop_idx.append(self.num_init_iteration + i)
+                            if max_drop > 0 and len(self._drop_idx) >= max_drop:
+                                break
+            else:
+                if max_drop > 0 and self.iter_ > 0:
+                    drop_rate = min(drop_rate, max_drop / float(self.iter_))
+                for i in range(self.iter_):
+                    if self._drop_rng.random() < drop_rate:
+                        self._drop_idx.append(self.num_init_iteration + i)
+                        if max_drop > 0 and len(self._drop_idx) >= max_drop:
+                            break
+        for i in self._drop_idx:
+            self._apply_tree_to_scores(i, -1.0)
+        k = float(len(self._drop_idx))
+        lr = float(cfg.learning_rate)
+        if not cfg.xgboost_dart_mode:
+            self.shrinkage_rate = lr / (1.0 + k)
+        else:
+            self.shrinkage_rate = lr if not self._drop_idx else lr / (lr + k)
+
+    def _normalize(self) -> None:
+        """Rescale dropped trees and restore their contribution
+        (reference dart.hpp:152-196)."""
+        cfg = self.config
+        k = float(len(self._drop_idx))
+        if k == 0:
+            return
+        scale = k / (k + 1.0) if not cfg.xgboost_dart_mode \
+            else k / (k + float(cfg.learning_rate))
+        K = self.num_tree_per_iteration
+        for i in self._drop_idx:
+            for c in range(K):
+                self.models[i * K + c].apply_shrinkage(scale)
+            self._apply_tree_to_scores(i, 1.0)
+            if not cfg.uniform_drop:
+                j = i - self.num_init_iteration
+                if not cfg.xgboost_dart_mode:
+                    self.sum_weight -= self.tree_weight[j] / (k + 1.0)
+                else:
+                    self.sum_weight -= self.tree_weight[j] / \
+                        (k + float(cfg.learning_rate))
+                self.tree_weight[j] *= scale
+
+    # ------------------------------------------------------------------
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if self._stopped:
+            return True
+        self._materialize()
+        self._dropping_trees()
+        ret = self._train_one_iter_sync(grad, hess)
+        if ret:
+            # stalled: restore dropped contributions unscaled so eval on the
+            # final (unchanged) model stays consistent
+            for i in self._drop_idx:
+                self._apply_tree_to_scores(i, 1.0)
+            self._drop_idx = []
+            return True
+        self._normalize()
+        if not self.config.uniform_drop:
+            self.tree_weight.append(self.shrinkage_rate)
+            self.sum_weight += self.shrinkage_rate
+        return False

@@ -84,6 +84,11 @@ class _ScoreState:
     def add(self, class_id: int, delta):
         self.scores = self.scores.at[class_id].add(delta)
 
+    def multiply(self, class_id: int, val: float):
+        """Scale one class's scores (RF running average,
+        reference score_updater.hpp MultiplyScore)."""
+        self.scores = self.scores.at[class_id].multiply(np.float32(val))
+
     def numpy(self) -> np.ndarray:
         return np.asarray(jax.device_get(self.scores), np.float64)
 
@@ -117,6 +122,8 @@ class GBDT:
         self._stopped = False
         self._train_step = None
         self._bag_cfg = None
+        self._goss_cfg = None          # set by GOSS subclass
+        self.average_output = False    # set by RF subclass / model load
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TrainingData) -> None:
@@ -149,10 +156,13 @@ class GBDT:
         self._bag_key = jax.random.PRNGKey(int(config.bagging_seed))
         self._train_step = None
         self._bag_cfg = self._bagging_config()
-        if self.objective is not None and not self.objective.needs_renew:
+        if (self.objective is not None and not self.objective.needs_renew
+                and not self.objective.host_only
+                and all(self.objective.class_need_train(k)
+                        for k in range(self.num_tree_per_iteration))):
             self._train_step = self.learner.make_train_step(
                 self.objective.get_gradients, self.shrinkage_rate,
-                self._bag_cfg)
+                self._bag_cfg, self._goss_cfg)
 
     def _bagging_config(self) -> Optional[Dict]:
         cfg = self.config
@@ -253,15 +263,20 @@ class GBDT:
             return True
         if (grad is None or hess is None) and self._train_step is not None:
             bag = self._bag_cfg
+            extra = {}
+            if self._goss_cfg is not None:
+                extra["goss_on"] = self.iter_ >= self._goss_cfg["warmup"]
+            inits = [self._boost_from_average(k)
+                     for k in range(self.num_tree_per_iteration)]
+            base_scores = self.train_scores.scores
             for k in range(self.num_tree_per_iteration):
-                init = self._boost_from_average(k)
                 refresh = bag is not None and (self.iter_ % bag["freq"] == 0)
                 (records, scores, leaf_ids, leaf_out, self._key,
                  self._bag_key) = self._train_step(
-                    self.train_scores.scores, self._key, self._bag_key,
-                    k, refresh)
+                    base_scores, self.train_scores.scores,
+                    self._key, self._bag_key, k, refresh, **extra)
                 self.train_scores.scores = scores
-                self._pending.append((records, k, init))
+                self._pending.append((records, k, inits[k]))
             self.iter_ += 1
             return False
         return self._train_one_iter_sync(grad, hess)
@@ -414,8 +429,8 @@ class GBDT:
             scores = self.valid_scores[valid_idx].numpy()
             metrics = self.valid_metrics[valid_idx]
         for m in metrics:
-            out.append((name, m.name, m.eval(scores, self.objective),
-                        m.higher_is_better))
+            for metric_name, val in m.eval_all(scores, self.objective):
+                out.append((name, metric_name, val, m.higher_is_better))
         if feval is not None:
             ds = self.train_data if valid_idx < 0 else self.valid_sets[valid_idx]
             res = feval(scores.reshape(-1), _FevalData(ds))
@@ -440,6 +455,8 @@ class GBDT:
         out = np.zeros((k, X.shape[0]), np.float64)
         for i in range(total):
             out[i % k] += self.models[i].predict(X)
+        if self.average_output and total > 0:
+            out /= max(total // k, 1)  # RF averaging (gbdt_prediction.cpp:55)
         return out
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
@@ -478,10 +495,11 @@ class GBDT:
         if self.learner is not None:
             self.learner = TPUTreeLearner(config, self.train_data)
             self._bag_cfg = self._bagging_config()
-            if self.objective is not None and not self.objective.needs_renew:
+            if (self.objective is not None and not self.objective.needs_renew
+                    and not self.objective.host_only):
                 self._train_step = self.learner.make_train_step(
                     self.objective.get_gradients, self.shrinkage_rate,
-                    self._bag_cfg)
+                    self._bag_cfg, self._goss_cfg)
 
     def shuffle_models(self, start: int = 0, end: int = -1) -> None:
         self._materialize()
@@ -538,6 +556,8 @@ class GBDT:
         buf.write(f"max_feature_idx={self.max_feature_idx}\n")
         if self.objective is not None:
             buf.write(f"objective={self.objective.to_model_string()}\n")
+        if self.average_output:
+            buf.write("average_output\n")  # bare flag (gbdt_model_text.cpp:289)
         buf.write("feature_names=" + " ".join(self.feature_names) + "\n")
         buf.write("feature_infos=" + " ".join(self._feature_infos()) + "\n")
 
@@ -594,11 +614,14 @@ class GBDT:
                 continue
             if line.startswith("end of trees"):
                 break
-            if "=" in line:
+            if line.strip() == "average_output":
+                kv["average_output"] = "1"
+            elif "=" in line:
                 key, v = line.split("=", 1)
                 kv[key] = v
             i += 1
         self.num_class = int(kv.get("num_class", "1"))
+        self.average_output = "average_output" in kv
         self.num_tree_per_iteration = int(kv.get("num_tree_per_iteration", "1"))
         self.label_index = int(kv.get("label_index", "0"))
         self.max_feature_idx = int(kv.get("max_feature_idx", "0"))
@@ -664,6 +687,7 @@ class GBDT:
         out = {
             "name": "tree",
             "version": "v3",
+            "average_output": bool(self.average_output),
             "num_class": self.num_class,
             "num_tree_per_iteration": self.num_tree_per_iteration,
             "label_index": self.label_index,

@@ -1,7 +1,118 @@
-"""GOSS sampling (reference src/boosting/goss.hpp) — full logic in M4."""
+"""GOSS: gradient-based one-side sampling (reference src/boosting/goss.hpp).
+
+Keep the `top_rate` fraction of rows with the largest sum_k |g*h|, sample
+`other_rate` of the rest and upscale their grad/hess by (1-a)/b
+(reference goss.hpp:91-139), after a warm-up of 1/learning_rate full
+iterations (goss.hpp:144).
+
+TPU-first: the sampling runs INSIDE the fused device train step (top-k by
+sort + Bernoulli keep, see learner.make_train_step) — no host round trip.
+The reference's exact without-replacement draw of other_k rows becomes a
+Bernoulli keep with the same expectation (XLA-friendly; no sequential
+rejection loop).  Renew-objectives fall back to the host path below.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+import jax.numpy as jnp
 
 from .gbdt import GBDT
 
 
 class GOSS(GBDT):
-    pass
+    def init(self, config, train_data) -> None:
+        top_rate = float(config.top_rate)
+        other_rate = float(config.other_rate)
+        if top_rate + other_rate > 1.0:
+            raise ValueError("top_rate + other_rate must be <= 1.0 for GOSS")
+        if top_rate <= 0.0 or other_rate <= 0.0:
+            raise ValueError("top_rate and other_rate must be > 0 for GOSS")
+        if int(config.bagging_freq) > 0 and float(config.bagging_fraction) != 1.0:
+            raise ValueError("Cannot use bagging in GOSS")
+        lr = float(config.learning_rate)
+        self._goss_cfg = {
+            "top_rate": top_rate,
+            "other_rate": other_rate,
+            "warmup": int(1.0 / lr) if lr > 0 else 0,
+        }
+        super().init(config, train_data)
+        self._goss_rng = np.random.default_rng(int(config.bagging_seed))
+
+    def _goss_host(self, grad: np.ndarray, hess: np.ndarray):
+        """Host-side GOSS for the sync path (renew/host-only objectives).
+
+        grad/hess: [k, n] numpy.  Returns (grad', hess', row_mask f32[n])."""
+        n = grad.shape[1]
+        gh = np.abs(grad * hess).sum(axis=0)
+        top_k = max(1, int(n * self._goss_cfg["top_rate"]))
+        other_k = max(1, int(n * self._goss_cfg["other_rate"]))
+        thr = np.partition(gh, n - top_k)[n - top_k]
+        keep_top = gh >= thr
+        rest = np.flatnonzero(~keep_top)
+        sampled = self._goss_rng.choice(
+            rest, size=min(other_k, len(rest)), replace=False)
+        multiply = (n - top_k) / other_k
+        mask = keep_top.copy()
+        mask[sampled] = True
+        grad = grad.copy()
+        hess = hess.copy()
+        grad[:, sampled] *= multiply
+        hess[:, sampled] *= multiply
+        return grad, hess, mask.astype(np.float32)
+
+    def _train_one_iter_sync(self, grad=None, hess=None) -> bool:
+        # mirror GBDT sync path but inject GOSS sampling after gradients
+        if grad is not None or hess is not None:
+            return super()._train_one_iter_sync(grad, hess)
+        init_scores = [0.0] * self.num_tree_per_iteration
+        for k in range(self.num_tree_per_iteration):
+            init_scores[k] = self._boost_from_average(k)
+        import jax
+        g, h = self.objective.get_gradients(self.train_scores.scores)
+        g = np.asarray(jax.device_get(g), np.float32).reshape(
+            self.num_tree_per_iteration, -1)
+        h = np.asarray(jax.device_get(h), np.float32).reshape(
+            self.num_tree_per_iteration, -1)
+        mask = None
+        if self.iter_ >= self._goss_cfg["warmup"]:
+            g, h, mask_np = self._goss_host(g, h)
+            mask = jnp.asarray(mask_np)
+
+        self._materialize()
+        should_continue = False
+        from .gbdt import K_EPSILON
+        from .tree import Tree
+        for k in range(self.num_tree_per_iteration):
+            need = (self.objective is None
+                    or self.objective.class_need_train(k))
+            tree = None
+            if need:
+                tree, leaf_ids, out = self.learner.train(
+                    jnp.asarray(g[k]), jnp.asarray(h[k]), mask)
+            if tree is not None and tree.num_leaves > 1:
+                should_continue = True
+                self._renew_and_update(tree, leaf_ids, k, mask)
+                if abs(init_scores[k]) > K_EPSILON:
+                    tree.add_bias(init_scores[k])
+            else:
+                tree = Tree(2)
+                if len(self.models) < self.num_tree_per_iteration:
+                    output = (init_scores[k] if need or self.objective is None
+                              else self.objective.boost_from_score(k))
+                    tree.as_constant_tree(output)
+                    self.train_scores.add_constant(output, k)
+                    for vs in self.valid_scores:
+                        vs.add_constant(output, k)
+            self.models.append(tree)
+
+        if not should_continue:
+            if len(self.models) > self.num_tree_per_iteration:
+                del self.models[-self.num_tree_per_iteration:]
+            self._stopped = True
+            return True
+        self.iter_ += 1
+        return False

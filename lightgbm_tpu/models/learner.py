@@ -90,7 +90,8 @@ class TPUTreeLearner:
 
     # ------------------------------------------------------------------
     def make_train_step(self, grad_fn, learning_rate: float,
-                        bagging: Optional[Dict] = None):
+                        bagging: Optional[Dict] = None,
+                        goss: Optional[Dict] = None):
         """Fuse gradients + tree growth + train-score update into ONE device
         program per iteration.
 
@@ -118,8 +119,18 @@ class TPUTreeLearner:
         meta = self.meta
         bins_pad = self.bins_pad
 
-        def step(scores, key, bag_key, class_id, refresh_bag):
-            grad, hess = grad_fn(scores)
+        goss_top_k = goss_other_k = 0
+        if goss is not None:
+            goss_top_k = max(1, int(n * float(goss["top_rate"])))
+            goss_other_k = max(1, int(n * float(goss["other_rate"])))
+
+        def step(grad_scores, scores, key, bag_key, class_id, refresh_bag,
+                 goss_on=False):
+            # grad_scores = scores at ITERATION start: all classes' gradients
+            # come from the same snapshot, like the reference's single
+            # Boosting() call per iteration (gbdt.cpp:150-158); `scores`
+            # accumulates the per-class deltas within the iteration.
+            grad, hess = grad_fn(grad_scores)
             g = grad[class_id] if grad.ndim == 2 else grad
             h = hess[class_id] if hess.ndim == 2 else hess
             g = jnp.zeros(n_pad, jnp.float32).at[:n].set(g[:n])
@@ -129,7 +140,30 @@ class TPUTreeLearner:
             if refresh_bag:  # static: bagging_freq boundary
                 bag_key = jax.random.split(bag_key)[0]
             mask = ones_mask
-            if is_pos is not None:
+            if goss_on:
+                # GOSS on device (reference goss.hpp:91-139 BaggingHelper):
+                # keep the top_rate rows by sum_k |g*h|, Bernoulli-sample
+                # other_rate of the rest and upscale their grad/hess by
+                # (n - top_k) / other_k.  The reference samples exactly
+                # other_k without replacement; the Bernoulli form has the
+                # same expectation and is XLA-friendly.
+                if grad.ndim == 2:
+                    gh_all = jnp.sum(jnp.abs(grad * hess), axis=0)
+                else:
+                    gh_all = jnp.abs(grad * hess)
+                gh = jnp.full(n_pad, -1.0, jnp.float32).at[:n].set(gh_all[:n])
+                thr = jnp.sort(gh)[n_pad - goss_top_k]
+                keep_top = gh >= thr
+                bag_key = jax.random.split(bag_key)[0]
+                r = jax.random.uniform(bag_key, (n_pad,))
+                p_other = goss_other_k / max(n - goss_top_k, 1)
+                keep_other = (~keep_top) & (r < p_other)
+                multiply = (n - goss_top_k) / goss_other_k
+                scale = jnp.where(keep_other, multiply, 1.0)
+                g = g * scale
+                h = h * scale
+                mask = mask * (keep_top | keep_other).astype(jnp.float32)
+            elif is_pos is not None:
                 r = jax.random.uniform(bag_key, (n_pad,))
                 keep = jnp.where(is_pos, r < pos_frac, r < neg_frac)
                 mask = mask * keep.astype(jnp.float32)
@@ -150,7 +184,8 @@ class TPUTreeLearner:
             return (out["records"], new_scores, out["leaf_ids"][:n],
                     out["leaf_output"], key, bag_key)
 
-        return jax.jit(step, static_argnames=("class_id", "refresh_bag"))
+        return jax.jit(step,
+                       static_argnames=("class_id", "refresh_bag", "goss_on"))
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               row_mask: Optional[jnp.ndarray] = None

@@ -35,6 +35,11 @@ class Metric:
         """score: [k, n] raw scores."""
         raise NotImplementedError
 
+    def eval_all(self, score: np.ndarray, objective) -> List[Tuple[str, float]]:
+        """Multi-value interface (e.g. ndcg@1..5 report one value per k,
+        reference NDCGMetric::Eval rank_metric.hpp:93).  Default: one value."""
+        return [(self.name, self.eval(score, objective))]
+
 
 def _avg(loss: np.ndarray, weight: Optional[np.ndarray], sum_w: float) -> float:
     if weight is None:

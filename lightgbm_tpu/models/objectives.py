@@ -59,6 +59,11 @@ class Objective:
     #: device->host transfers of scores/leaf ids on the hot path)
     needs_renew = False
 
+    #: objectives whose gradients cannot be traced into the fused device
+    #: step (host RNG, data-dependent per-query work); the driver uses the
+    #: synchronous path for these
+    host_only = False
+
     def renew_tree_output(self, tree, score: np.ndarray,
                           leaf_ids: np.ndarray, row_mask: np.ndarray) -> None:
         """Post-hoc leaf re-fit (L1/quantile/MAPE family). Default: no-op."""
@@ -77,10 +82,14 @@ def _apply_weight(grad, hess, weights):
 
 
 class BinaryLogloss(Objective):
-    """reference src/objective/binary_objective.hpp:20-213."""
+    """reference src/objective/binary_objective.hpp:20-213.
+
+    `is_pos_fn` customizes label binarization — the hook MulticlassOVA uses
+    to build its per-class losses (reference multiclass_objective.hpp:186).
+    """
     name = "binary"
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, is_pos_fn=None):
         super().__init__(config)
         self.sigmoid = float(config.sigmoid)
         if self.sigmoid <= 0:
@@ -89,11 +98,13 @@ class BinaryLogloss(Objective):
         self.scale_pos_weight = float(config.scale_pos_weight)
         if self.is_unbalance and abs(self.scale_pos_weight - 1.0) > 1e-6:
             raise ValueError("cannot set is_unbalance and scale_pos_weight together")
+        self._is_pos_fn = is_pos_fn
 
     def init(self, metadata: Metadata, num_data: int) -> None:
         super().init(metadata, num_data)
         label = np.asarray(metadata.label)
-        is_pos = label > 0
+        is_pos = (label > 0 if self._is_pos_fn is None
+                  else self._is_pos_fn(label))
         cnt_pos = int(is_pos.sum())
         cnt_neg = num_data - cnt_pos
         self.need_train = cnt_pos > 0 and cnt_neg > 0
@@ -123,7 +134,8 @@ class BinaryLogloss(Objective):
 
     def boost_from_score(self, class_id: int) -> float:
         label = np.asarray(self.metadata.label)
-        is_pos = (label > 0).astype(np.float64)
+        is_pos = ((label > 0) if self._is_pos_fn is None
+                  else self._is_pos_fn(label)).astype(np.float64)
         w = self.metadata.weight
         if w is not None:
             suml = float((is_pos * w).sum())

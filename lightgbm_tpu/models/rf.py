@@ -1,7 +1,129 @@
-"""Random forest mode (reference src/boosting/rf.hpp) — full logic in M4."""
+"""Random forest mode (reference src/boosting/rf.hpp).
 
-from .gbdt import GBDT
+`average_output_=true`: scores are maintained as the running average of
+tree outputs, bagging is mandatory, there is no shrinkage, and gradients
+are computed ONCE from the constant boost-from-average scores
+(reference rf.hpp:84-103 Boosting, :105-168 TrainOneIter).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .gbdt import GBDT, K_EPSILON
+from .tree import Tree
 
 
 class RF(GBDT):
-    pass
+    def init(self, config, train_data) -> None:
+        if not (int(config.bagging_freq) > 0
+                and 0.0 < float(config.bagging_fraction) < 1.0):
+            raise ValueError(
+                "random forest requires bagging "
+                "(bagging_freq > 0 and bagging_fraction in (0, 1))")
+        if not (0.0 < float(config.feature_fraction) <= 1.0):
+            raise ValueError("feature_fraction must be in (0, 1] for RF")
+        super().init(config, train_data)
+        if self.objective is None:
+            raise ValueError("RF mode does not support custom objectives")
+        self.average_output = True
+        self.shrinkage_rate = 1.0
+        self._train_step = None  # running-average updates: sync driver path
+        # boost once from constant init scores (reference rf.hpp Boosting)
+        K = self.num_tree_per_iteration
+        self._rf_init_scores = np.zeros(K)
+        if self.config.boost_from_average:
+            for k in range(K):
+                self._rf_init_scores[k] = self.objective.boost_from_score(k)
+        tmp = jnp.asarray(
+            np.repeat(self._rf_init_scores[:, None],
+                      self.train_data.num_data, axis=1).astype(np.float32))
+        g, h = self.objective.get_gradients(tmp)
+        if g.ndim == 1:
+            g, h = g[None, :], h[None, :]
+        self._rf_grad = np.asarray(jax.device_get(g), np.float32)
+        self._rf_hess = np.asarray(jax.device_get(h), np.float32)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is not None or hess is not None:
+            raise ValueError("RF mode does not support custom gradients")
+        if self._stopped:
+            return True
+        mask = self.bagging_mask(self.iter_)
+        K = self.num_tree_per_iteration
+        it = self.iter_ + self.num_init_iteration
+        for k in range(K):
+            need = self.objective.class_need_train(k)
+            tree = None
+            if need:
+                tree, leaf_ids, _ = self.learner.train(
+                    jnp.asarray(self._rf_grad[k]),
+                    jnp.asarray(self._rf_hess[k]), mask)
+            if tree is not None and tree.num_leaves > 1:
+                init = self._rf_init_scores[k]
+                if self.objective.needs_renew:
+                    leaf_np = np.asarray(jax.device_get(leaf_ids))
+                    score_np = np.full(self.train_data.num_data, init)
+                    mask_np = (np.ones(len(leaf_np), bool) if mask is None
+                               else np.asarray(jax.device_get(mask))
+                               [:len(leaf_np)] > 0)
+                    self.objective.renew_tree_output(
+                        tree, score_np, leaf_np, mask_np)
+                if abs(init) > K_EPSILON:
+                    tree.add_bias(init)
+                self._update_average_score(tree, k, it)
+            else:
+                tree = Tree(2)
+                if len(self.models) < K:
+                    output = (self.objective.boost_from_score(k)
+                              if not need else self._rf_init_scores[k])
+                    tree.as_constant_tree(output)
+                    self._update_average_score(tree, k, it)
+            self.models.append(tree)
+        self.iter_ += 1
+        return False
+
+    def _update_average_score(self, tree: Tree, class_id: int, it: int):
+        """score = (score * it + tree_pred) / (it + 1)
+        (reference rf.hpp MultiplyScore sandwich, :146-149)."""
+        meta = self.learner.meta_np
+        from .gbdt import _predict_binned
+        delta = _predict_binned(tree, self.train_data.bins, meta) \
+            .astype(np.float32)
+        self.train_scores.multiply(class_id, float(it))
+        self.train_scores.add(class_id, jnp.asarray(delta))
+        self.train_scores.multiply(class_id, 1.0 / (it + 1))
+        for vs, vd in zip(self.valid_scores, self.valid_sets):
+            d = _predict_binned(tree, vd.bins, meta).astype(np.float32)
+            vs.multiply(class_id, float(it))
+            vs.add(class_id, jnp.asarray(d))
+            vs.multiply(class_id, 1.0 / (it + 1))
+
+    def rollback_one_iter(self) -> None:
+        if self.iter_ <= 0:
+            return
+        K = self.num_tree_per_iteration
+        it = self.iter_ + self.num_init_iteration - 1
+        meta = self.learner.meta_np
+        from .gbdt import _predict_binned
+        for k in range(K):
+            tree = self.models.pop()
+            k_id = K - 1 - k
+            if it >= 0:
+                self.train_scores.multiply(k_id, float(it + 1))
+                self.train_scores.add(k_id, jnp.asarray(
+                    -_predict_binned(tree, self.train_data.bins, meta)
+                    .astype(np.float32)))
+                for vs, vd in zip(self.valid_scores, self.valid_sets):
+                    vs.multiply(k_id, float(it + 1))
+                    vs.add(k_id, jnp.asarray(
+                        -_predict_binned(tree, vd.bins, meta)
+                        .astype(np.float32)))
+                if it > 0:
+                    self.train_scores.multiply(k_id, 1.0 / it)
+                    for vs in self.valid_scores:
+                        vs.multiply(k_id, 1.0 / it)
+        self.iter_ -= 1

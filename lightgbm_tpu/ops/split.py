@@ -71,7 +71,17 @@ class SplitResult(NamedTuple):
     right_output: jnp.ndarray
 
 
-def find_best_split_all_features(
+class PerFeatureBest(NamedTuple):
+    """Per-feature best-split candidates (pre cross-feature argmax)."""
+    gain: jnp.ndarray        # [F] net gain (min_gain_shift subtracted, penalized)
+    threshold: jnp.ndarray   # [F] i32
+    default_left: jnp.ndarray  # [F] bool
+    left_sum_g: jnp.ndarray  # [F]
+    left_sum_h: jnp.ndarray  # [F]
+    left_count: jnp.ndarray  # [F]
+
+
+def per_feature_best_split(
         hist: jnp.ndarray,        # [F, B, 3] (g, h, cnt)
         sum_g, sum_h, num_data,   # parent totals (scalars, f32)
         num_bin: jnp.ndarray,     # [F] i32 bins per feature
@@ -83,8 +93,9 @@ def find_best_split_all_features(
         *, l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: float, min_sum_hessian: float,
         min_gain_to_split: float,
-        min_constraint=-1e30, max_constraint=1e30) -> SplitResult:
-    """Best split for one leaf across all features. Fully vectorized.
+        min_constraint=-1e30, max_constraint=1e30) -> PerFeatureBest:
+    """Best candidate per feature (the voting-parallel building block,
+    reference voting_parallel_tree_learner.cpp:327-337 local candidates).
 
     min/max_constraint are the leaf's monotone value bounds, propagated down
     the tree by the grower (reference serial_tree_learner.cpp:840-851)."""
@@ -168,28 +179,64 @@ def find_best_split_all_features(
     feat_dleft = jnp.where(two_bin_nan, False, feat_dleft)
 
     feat_gain = jnp.where(feature_mask > 0, feat_gain, K_MIN_SCORE)
-    out_gain = (feat_gain - min_gain_shift) * penalty
+    out_gain = jnp.where(feat_gain > K_MIN_SCORE / 2,
+                         (feat_gain - min_gain_shift) * penalty,
+                         K_MIN_SCORE)
 
-    # ---- across features: first max wins --------------------------------
-    best_f = jnp.argmax(out_gain, axis=0).astype(jnp.int32)
-    g = out_gain[best_f]
-    thr = feat_thr[best_f]
-    dleft = feat_dleft[best_f]
+    # per-feature left stats at the chosen (threshold, direction)
+    f_iota = jnp.arange(F)
+    lg = jnp.where(feat_dleft, left_g_m1[f_iota, feat_thr],
+                   cg[f_iota, feat_thr])
+    lh = jnp.where(feat_dleft, left_h_m1[f_iota, feat_thr],
+                   ch[f_iota, feat_thr])
+    lc = jnp.where(feat_dleft, left_c_m1[f_iota, feat_thr],
+                   cc[f_iota, feat_thr])
+    return PerFeatureBest(gain=out_gain, threshold=feat_thr,
+                          default_left=feat_dleft,
+                          left_sum_g=lg, left_sum_h=lh, left_count=lc)
 
-    # recompute left stats of the winner (per chosen direction)
-    lg = jnp.where(dleft, left_g_m1[best_f, thr], cg[best_f, thr])
-    lh = jnp.where(dleft, left_h_m1[best_f, thr], ch[best_f, thr])
-    lc = jnp.where(dleft, left_c_m1[best_f, thr], cc[best_f, thr])
+
+def finalize_split(pf: PerFeatureBest, best_f, sum_g, sum_h,
+                   *, l1: float, l2: float, max_delta_step: float,
+                   min_constraint=-1e30, max_constraint=1e30) -> SplitResult:
+    """SplitResult for the chosen feature index (post argmax/vote/gather)."""
+    g = pf.gain[best_f]
+    thr = pf.threshold[best_f]
+    dleft = pf.default_left[best_f]
+    lg = pf.left_sum_g[best_f]
+    lh = pf.left_sum_h[best_f]
+    lc = pf.left_count[best_f]
     lo = jnp.clip(leaf_output(lg, lh, l1, l2, max_delta_step),
                   min_constraint, max_constraint)
     ro = jnp.clip(leaf_output(sum_g - lg, sum_h - lh, l1, l2, max_delta_step),
                   min_constraint, max_constraint)
-
-    valid = feat_gain[best_f] > K_MIN_SCORE / 2
     return SplitResult(
-        gain=jnp.where(valid, g, K_MIN_SCORE),
-        feature=best_f,
+        gain=g,
+        feature=best_f.astype(jnp.int32),
         threshold=thr,
         default_left=dleft,
         left_sum_g=lg, left_sum_h=lh, left_count=lc,
         left_output=lo, right_output=ro)
+
+
+def find_best_split_all_features(
+        hist: jnp.ndarray, sum_g, sum_h, num_data,
+        num_bin, missing_type, default_bin, monotone, penalty, feature_mask,
+        *, l1: float, l2: float, max_delta_step: float,
+        min_data_in_leaf: float, min_sum_hessian: float,
+        min_gain_to_split: float,
+        min_constraint=-1e30, max_constraint=1e30) -> SplitResult:
+    """Best split for one leaf across all features: per-feature candidates +
+    first-max-wins argmax (ArrayArgs::ArgMax semantics)."""
+    pf = per_feature_best_split(
+        hist, sum_g, sum_h, num_data, num_bin, missing_type, default_bin,
+        monotone, penalty, feature_mask,
+        l1=l1, l2=l2, max_delta_step=max_delta_step,
+        min_data_in_leaf=min_data_in_leaf, min_sum_hessian=min_sum_hessian,
+        min_gain_to_split=min_gain_to_split,
+        min_constraint=min_constraint, max_constraint=max_constraint)
+    best_f = jnp.argmax(pf.gain, axis=0).astype(jnp.int32)
+    return finalize_split(pf, best_f, sum_g, sum_h,
+                          l1=l1, l2=l2, max_delta_step=max_delta_step,
+                          min_constraint=min_constraint,
+                          max_constraint=max_constraint)

