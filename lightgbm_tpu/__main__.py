@@ -1,7 +1,48 @@
-"""`python -m lightgbm_tpu ...` = the reference CLI binary (src/main.cpp)."""
+"""`python -m lightgbm_tpu ...` = the reference CLI binary (src/main.cpp).
+
+Backend resilience: when the default accelerator backend cannot initialize
+(dead axon tunnel, or JAX_PLATFORM_NAME=cpu fighting a sitecustomize-latched
+JAX_PLATFORMS=axon), fall back to the CPU backend with a warning instead of
+dying — the CLI analog of bench.py's probe-and-degrade.
+"""
 
 import sys
 
-from .application import main
+
+def _ensure_backend() -> None:
+    # Probe OUT-OF-PROCESS first: a hung tunnel must hit the subprocess
+    # timeout, not hang this process (in-process jax.devices() has no
+    # timeout and cannot be interrupted once the plugin blocks).  Skipped
+    # entirely on hosts without the tunneled backend, and cached in an env
+    # var so child/repeat invocations don't re-pay the probe.
+    import os
+
+    from .utils.backend import (has_tunneled_backend, pin_cpu_backend,
+                                probe_default_backend)
+    from .utils.log import Log
+
+    if not has_tunneled_backend():
+        return
+    cached = os.environ.get("LGBM_BACKEND_PROBE_RESULT")
+    if cached == "ok":
+        return
+    if cached != "failed":
+        timeout_s = float(os.environ.get("LGBM_BACKEND_PROBE_TIMEOUT", 60))
+        platform = probe_default_backend(timeout_s=timeout_s, retries=0)
+        os.environ["LGBM_BACKEND_PROBE_RESULT"] = (
+            "failed" if platform is None else "ok")
+        if platform is not None:
+            return
+    pin_cpu_backend()
+    import jax
+
+    jax.devices()  # raises if even CPU is broken
+    Log.warning("accelerator backend unavailable (probe failed); "
+                "falling back to CPU")
+
+
+_ensure_backend()
+
+from .application import main  # noqa: E402
 
 sys.exit(main())

@@ -1,0 +1,100 @@
+"""Backend health plumbing for tunneled-TPU environments.
+
+The TPU attachment in this environment is a remote tunnel exposed as the
+`axon` jax backend.  When the tunnel is down, *any* jax call that triggers
+backend initialization either raises RuntimeError or — worse — hangs
+indefinitely inside the plugin's client construction (the failure modes of
+the round-1 proof artifacts: BENCH_r01 rc=1, MULTICHIP_r01 rc=124).
+
+Two defenses, used by bench.py / __graft_entry__ / __main__ /
+tests/conftest.py:
+
+* `probe_default_backend()` — initialize jax in a THROWAWAY SUBPROCESS with
+  a hard timeout, so a hung plugin can never take the caller with it.
+  Returns the platform name on success, None on failure.
+* `pin_cpu_backend()` — force the current process onto the CPU backend,
+  even though (a) the axon sitecustomize imports jax at interpreter start
+  and latches JAX_PLATFORMS=axon into jax.config, and (b) the plugin
+  ignores JAX_PLATFORMS.  Works post-import as long as no backend has been
+  initialized yet: update jax.config and drop the axon backend factory.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+import time
+from typing import Optional
+
+
+def has_tunneled_backend() -> bool:
+    """True when the tunneled `axon` backend factory is registered (i.e.
+    a hang at backend init is possible).  Plain CPU/TPU hosts return False
+    and need no out-of-process probing."""
+    try:
+        import jax._src.xla_bridge as _xb
+
+        return "axon" in _xb._backend_factories
+    except Exception:  # pragma: no cover - jax internals moved
+        return True  # be conservative: probe
+
+_PROBE_SRC = r"""
+import jax, sys
+import jax.numpy as jnp
+x = jnp.ones((128, 128))
+y = (x @ x).sum()
+y.block_until_ready()
+sys.stdout.write(jax.devices()[0].platform)
+"""
+
+
+def probe_default_backend(timeout_s: float = 120.0, retries: int = 1,
+                          retry_sleep_s: float = 10.0) -> Optional[str]:
+    """Platform name of the default jax backend, probed out-of-process.
+
+    A hung backend init (dead tunnel) hits the subprocess timeout instead of
+    hanging the caller.  Fast failures (nonzero exit) get bounded retries;
+    a TIMEOUT does not retry — a hung tunnel stays hung, and burning
+    retries*timeout of dead time risks tripping the caller's own deadline
+    (the round-1 rc=124 failure mode).
+    """
+    for attempt in range(retries + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_SRC],
+                capture_output=True, timeout=timeout_s, text=True)
+            if out.returncode == 0 and out.stdout.strip():
+                return out.stdout.strip().splitlines()[-1]
+        except subprocess.TimeoutExpired:
+            return None
+        if attempt < retries:
+            time.sleep(retry_sleep_s)
+    return None
+
+
+def pin_cpu_backend(force_device_count: Optional[int] = None) -> None:
+    """Pin this process to the CPU backend; optionally force N virtual
+    devices (must run before the first backend initialization)."""
+    os.environ["JAX_PLATFORM_NAME"] = "cpu"
+    os.environ.pop("JAX_PLATFORMS", None)
+    if force_device_count is not None:
+        flag = f"--xla_force_host_platform_device_count={force_device_count}"
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" in flags:
+            # rewrite an existing (possibly different) count, don't keep it
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", flag, flags)
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+    except Exception:  # pragma: no cover - jax internals moved
+        pass

@@ -7,35 +7,26 @@ validated on `--xla_force_host_platform_device_count=8` CPU devices instead
 """
 
 import os
-
-# NOTE: the axon TPU plugin ignores JAX_PLATFORMS; JAX_PLATFORM_NAME works
-os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
-os.environ.pop("JAX_PLATFORMS", None)
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-
-# Tests never touch the TPU: pin jax to the cpu backend and drop the
-# tunneled `axon` backend factory before the first backends() call, so a
-# dead/slow tunnel cannot hang CPU-only test runs (jax initializes ALL
-# registered backends on first use; a downed tunnel blocks
-# make_c_api_client indefinitely).  The env vars alone are not enough —
-# the axon sitecustomize imports jax at interpreter start, latching
-# JAX_PLATFORMS=axon into jax.config before this file runs.
-try:
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    import jax._src.xla_bridge as _xb
-
-    _xb._backend_factories.pop("axon", None)
-except Exception:  # pragma: no cover - jax internals moved; fall through
-    pass
-
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Tests never touch the TPU: pin jax to the cpu backend (8 virtual devices
+# for sharding tests) and drop the tunneled `axon` backend factory before
+# the first backends() call, so a dead/slow tunnel cannot hang CPU-only
+# test runs.  backend.py is loaded BY PATH, not via the package: importing
+# `lightgbm_tpu.utils.backend` would first execute the whole package
+# __init__ (basic/engine/models) before the pin runs — exactly the
+# import-order hazard this block exists to close.
+import importlib.util as _ilu
+
+_spec = _ilu.spec_from_file_location(
+    "_lgbm_backend_boot",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "lightgbm_tpu", "utils", "backend.py"))
+_mod = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+_mod.pin_cpu_backend(force_device_count=8)
 
 import numpy as np
 import pytest
