@@ -17,28 +17,31 @@ def _ensure_backend() -> None:
     # var so child/repeat invocations don't re-pay the probe.
     import os
 
-    from .utils.backend import (has_tunneled_backend, pin_cpu_backend,
+    from .utils.backend import (backend_health, pin_cpu_backend,
                                 probe_default_backend)
     from .utils.log import Log
 
-    if not has_tunneled_backend():
+    health = backend_health()
+    if health == "ok":
         return
-    cached = os.environ.get("LGBM_BACKEND_PROBE_RESULT")
-    if cached == "ok":
-        return
-    if cached != "failed":
-        timeout_s = float(os.environ.get("LGBM_BACKEND_PROBE_TIMEOUT", 60))
-        platform = probe_default_backend(timeout_s=timeout_s, retries=0)
-        os.environ["LGBM_BACKEND_PROBE_RESULT"] = (
-            "failed" if platform is None else "ok")
-        if platform is not None:
+    if health == "probe":
+        cached = os.environ.get("LGBM_BACKEND_PROBE_RESULT")
+        if cached == "ok":
             return
+        if cached != "failed":
+            timeout_s = float(
+                os.environ.get("LGBM_BACKEND_PROBE_TIMEOUT", 60))
+            platform = probe_default_backend(timeout_s=timeout_s, retries=0)
+            os.environ["LGBM_BACKEND_PROBE_RESULT"] = (
+                "failed" if platform is None else "ok")
+            if platform is not None:
+                return
     pin_cpu_backend()
     import jax
 
     jax.devices()  # raises if even CPU is broken
-    Log.warning("accelerator backend unavailable (probe failed); "
-                "falling back to CPU")
+    Log.warning("accelerator backend unavailable "
+                f"(backend {health}); falling back to CPU")
 
 
 _ensure_backend()

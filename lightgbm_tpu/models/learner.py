@@ -19,7 +19,11 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.bin_mapper import MissingType
 from ..io.dataset import TrainingData
-from ..ops.grower import GrowerParams, make_grower, pad_rows
+from ..ops.grower import GrowerParams, pad_rows
+from ..parallel.mesh import make_mesh
+from ..parallel.strategies import (bins_sharding, make_strategy_grower,
+                                   resolve_tree_learner, rows_sharding)
+from ..utils.log import Log
 from .tree import Tree
 
 
@@ -37,25 +41,84 @@ class TPUTreeLearner:
         B = int(meta_np["num_bin"].max())
         self.num_bins = B
 
+        # ---- strategy resolution (the factory slot,
+        # reference tree_learner.cpp:13-36 + CheckParamConflict which
+        # degrades parallel learners to serial when num_machines==1) ----
+        strategy = resolve_tree_learner(config.tree_learner)
+        n_shards = int(config.num_machines)
+        if strategy != "serial":
+            ndev = len(jax.devices())
+            if n_shards <= 1:
+                Log.warning(f"tree_learner={strategy} needs num_machines>1; "
+                            "falling back to serial")
+                strategy = "serial"
+            elif n_shards > ndev:
+                raise ValueError(
+                    f"num_machines={n_shards} exceeds the {ndev} available "
+                    f"devices ({jax.devices()[0].platform})")
+        self.strategy = strategy
+        self.n_shards = n_shards if strategy != "serial" else 1
+
         block = int(config.tpu_block_rows)
-        self.n_pad = pad_rows(n, block)
+        if strategy in ("data", "voting"):
+            # every shard holds an equal, whole number of histogram blocks
+            shard = pad_rows((n + self.n_shards - 1) // self.n_shards, block)
+            self.n_pad = shard * self.n_shards
+        else:
+            self.n_pad = pad_rows(n, block)
+
+        # feature axis padded to a multiple of the shard count; padding
+        # features are trivial (num_bin=1) and can never split
+        self.f_pad = self.num_features
+        if strategy == "feature":
+            self.f_pad = (-(-self.num_features // self.n_shards)
+                          * self.n_shards)
+
         bins = train_data.bins
-        if self.n_pad != n:
-            pad = np.zeros((self.n_pad - n, bins.shape[1]), dtype=bins.dtype)
-            bins = np.concatenate([bins, pad], axis=0)
-        # int32 bins: the one-hot compare needs a signed/iota-compatible dtype
-        self.bins_pad = jnp.asarray(bins.astype(np.int32))
+        if self.n_pad != n or self.f_pad != self.num_features:
+            padded = np.zeros((self.n_pad, self.f_pad), dtype=bins.dtype)
+            padded[:n, :self.num_features] = bins
+            bins = padded
+
+        meta_host = {}
+        for k, v in meta_np.items():
+            if k == "is_categorical":
+                continue
+            pad_val = 1 if k == "num_bin" else (1.0 if k == "penalty" else 0)
+            if self.f_pad != self.num_features:
+                v = np.concatenate(
+                    [v, np.full(self.f_pad - self.num_features, pad_val,
+                                dtype=v.dtype)])
+            meta_host[k] = v
+
+        if strategy == "serial":
+            self.mesh = None
+            # int32 bins: the one-hot compare needs an iota-compatible dtype
+            self.bins_pad = jnp.asarray(bins.astype(np.int32))
+            ones = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
+            self._ones_mask = ones
+        else:
+            if strategy == "feature":
+                self.mesh = make_mesh(num_feature_shards=self.n_shards)
+            else:
+                self.mesh = make_mesh(num_data_shards=self.n_shards)
+            self.bins_pad = jax.device_put(
+                bins.astype(np.int32), bins_sharding(self.mesh, strategy))
+            ones = np.ones(self.n_pad, np.float32)
+            ones[n:] = 0.0
+            self._ones_mask = jax.device_put(
+                ones, rows_sharding(self.mesh, strategy))
         self.n = n
 
         self.meta = {k: jnp.asarray(v.astype(np.int32) if v.dtype != np.float32
                                     else v)
-                     for k, v in meta_np.items() if k != "is_categorical"}
-        self.meta["penalty"] = jnp.asarray(meta_np["penalty"])
+                     for k, v in meta_host.items()}
 
         self.params = GrowerParams(
             num_leaves=max(int(config.num_leaves), 2),
             num_bins=B,
-            block_rows=min(block, self.n_pad),
+            block_rows=min(block, self.n_pad // self.n_shards
+                           if strategy in ("data", "voting") else self.n_pad),
             precision=str(config.tpu_hist_precision),
             l1=float(config.lambda_l1),
             l2=float(config.lambda_l2),
@@ -65,22 +128,25 @@ class TPUTreeLearner:
             min_gain_to_split=float(config.min_gain_to_split),
             max_depth=int(config.max_depth),
         )
-        self.grow = make_grower(self.params, self.num_features)
+        self.grow = make_strategy_grower(
+            self.params, self.f_pad, strategy, self.mesh,
+            voting_k=int(config.top_k))
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
-        self._ones_mask = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
 
     # ------------------------------------------------------------------
     def sample_features(self) -> jnp.ndarray:
         """Per-tree feature_fraction mask (reference GetUsedFeatures,
-        serial_tree_learner.cpp:271-319)."""
+        serial_tree_learner.cpp:271-319).  Sized to the padded feature axis;
+        padding features stay masked off."""
         frac = float(self.config.feature_fraction)
         F = self.num_features
-        mask = np.ones(F, np.float32)
+        mask = np.zeros(self.f_pad, np.float32)
         if frac < 1.0:
             k = max(1, int(np.ceil(F * frac)))
             used = self._feature_rng.choice(F, size=k, replace=False)
-            mask = np.zeros(F, np.float32)
             mask[used] = 1.0
+        else:
+            mask[:F] = 1.0
         return jnp.asarray(mask)
 
     def pad_vector(self, v: jnp.ndarray) -> jnp.ndarray:
@@ -115,6 +181,7 @@ class TPUTreeLearner:
         feature_frac = float(self.config.feature_fraction)
         ones_mask = self._ones_mask
         F = self.num_features
+        f_pad = self.f_pad
         grow = self.grow
         meta = self.meta
         bins_pad = self.bins_pad
@@ -170,11 +237,11 @@ class TPUTreeLearner:
             elif frac < 1.0:
                 r = jax.random.uniform(bag_key, (n_pad,))
                 mask = mask * (r < frac).astype(jnp.float32)
-            fmask = jnp.ones(F, jnp.float32)
+            fmask = jnp.zeros(f_pad, jnp.float32).at[:F].set(1.0)
             if feature_frac < 1.0:
                 k_used = max(1, int(np.ceil(F * feature_frac)))
                 perm = jax.random.permutation(kf, F)
-                fmask = jnp.zeros(F, jnp.float32).at[perm[:k_used]].set(1.0)
+                fmask = jnp.zeros(f_pad, jnp.float32).at[perm[:k_used]].set(1.0)
 
             out = grow(bins_pad, g, h, mask, fmask, meta)
             any_split = out["records"][0, 14] > 0.5  # REC_DID_SPLIT

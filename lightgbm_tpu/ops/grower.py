@@ -19,6 +19,31 @@ steps over fixed-shape tensors:
 * step records are emitted as scan outputs; the host assembles the Tree
   model from them afterwards.
 
+Distribution — the same grower body runs under shard_map in three sharded
+modes, mirroring the reference's parallel tree learners (SURVEY.md §2.3):
+
+* `data_axis` (DataParallelTreeLearner, data_parallel_tree_learner.cpp:
+  149-163): rows sharded; the [F, B, 3] histogram is psum-reduced so every
+  shard sees GLOBAL histograms and makes identical split decisions, while
+  partitioning only its local rows.  XLA lowers the psum to reduce-scatter
+  + all-gather over ICI — the hand-rolled Network::ReduceScatter +
+  HistogramBinEntry::SumReducer disappear into the compiler.
+* `feature_axis` (FeatureParallelTreeLearner, feature_parallel_tree_
+  learner.cpp:23-75): rows replicated, features sharded; each shard
+  histograms + searches only its own features, then the global best split
+  is an all_gather of per-shard best gains + argmax (replacing
+  SyncUpGlobalBestSplit's allreduce-by-max, parallel_tree_learner.h:
+  190-213).  The winning feature's bin column is broadcast with a one-shard
+  psum so every shard partitions identically.
+* `data_axis` + `voting_k` (VotingParallelTreeLearner, voting_parallel_
+  tree_learner.cpp:170-471 / PV-Tree): rows sharded, but only the top-k
+  VOTED features' histograms are aggregated.  Each shard proposes its local
+  top-2k features by gain (computed against LOCAL leaf sums with 1/p-scaled
+  minimum-data thresholds, :58-59); gains are psum-summed per feature (the
+  weighted-gain vote of GlobalVoting, :170-200); the global top-k features'
+  histograms are psum'ed ([k, B, 3] instead of [F, B, 3] — top-k gradient
+  compression on the data axis) and the final search runs on those.
+
 Cost model: each step is O(n) masked one-hot matmul work regardless of leaf
 size (vs the reference's O(n_leaf)); the subtraction trick halves it.  The
 perf milestone adds leaf-gather compaction; the win is that 500 trees x 254
@@ -28,15 +53,16 @@ splits run with 500 dispatches instead of 127k.
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, NamedTuple, Tuple
+from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .histogram import build_histogram_inline, pack_stats
-from .split import (K_MIN_SCORE, SplitResult, find_best_split_all_features,
-                    leaf_output, MISSING_NAN, MISSING_ZERO)
+from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
+                    per_feature_best_split, per_feature_best_split_categorical,
+                    MISSING_NAN, MISSING_ZERO)
 
 
 class GrowerParams(NamedTuple):
@@ -52,42 +78,63 @@ class GrowerParams(NamedTuple):
     min_sum_hessian: float
     min_gain_to_split: float
     max_depth: int
+    # categorical split search (feature_histogram.hpp:118-279); has_cat
+    # statically disables the whole categorical path for numerical data
+    has_cat: bool = False
+    max_cat_threshold: int = 32
+    cat_l2: float = 10.0
+    cat_smooth: float = 10.0
+    max_cat_to_onehot: int = 4
+    min_data_per_group: float = 100.0
 
 
 def make_grower(params: GrowerParams, num_features: int,
-                data_axis: Optional[str] = None, jit: bool = True):
-    """Build the jitted whole-tree grower for fixed shapes/params.
+                data_axis: Optional[str] = None,
+                feature_axis: Optional[str] = None,
+                voting_k: int = 0, num_shards: int = 1, jit: bool = True):
+    """Build the whole-tree grower for fixed shapes/params.
 
-    With `data_axis` set, the grower runs INSIDE shard_map over a mesh axis
-    holding row shards: histograms and scalar stats are psum-reduced across
-    the axis (the TPU-native replacement for the reference's
-    Network::ReduceScatter of histogram buffers + HistogramBinEntry::
-    SumReducer, data_parallel_tree_learner.cpp:149-163).  Every shard then
-    sees GLOBAL histograms, makes identical split decisions, and partitions
-    only its local rows — mirroring the reference data-parallel learner's
-    use of global counts with local partitions.
+    num_features is the LOCAL feature count: with `feature_axis` set it is
+    the per-shard shard width and the passed meta/feature_mask arrays are
+    the GLOBAL [F_local * num_shards] versions (sliced per shard inside).
     """
+    if voting_k and not data_axis:
+        raise ValueError("voting requires a data axis")
+    if data_axis and feature_axis:
+        raise ValueError("2-D (data x feature) growers not supported yet")
     L = params.num_leaves
     B = params.num_bins
     F = num_features
     precision = params.precision
 
-    def preduce(x):
+    def preduce_scalar(x):
         return jax.lax.psum(x, data_axis) if data_axis else x
+
+    def preduce_hist(x):
+        # plain data-parallel aggregates full histograms; voting keeps the
+        # pool LOCAL and aggregates only voted features inside select()
+        if data_axis and not voting_k:
+            return jax.lax.psum(x, data_axis)
+        return x
 
     split_kw = dict(l1=params.l1, l2=params.l2,
                     max_delta_step=params.max_delta_step,
                     min_data_in_leaf=params.min_data_in_leaf,
                     min_sum_hessian=params.min_sum_hessian,
                     min_gain_to_split=params.min_gain_to_split)
+    # local-vote thresholds scaled by 1/p (voting_parallel_tree_learner.
+    # cpp:58-59: local min_data/min_hessian are divided by num_machines)
+    local_kw = dict(split_kw)
+    if voting_k:
+        local_kw["min_data_in_leaf"] = params.min_data_in_leaf / num_shards
+        local_kw["min_sum_hessian"] = params.min_sum_hessian / num_shards
 
-    def best_split(hist, sg, sh, cnt, meta, feature_mask,
-                   min_c=-1e30, max_c=1e30):
-        return find_best_split_all_features(
+    def pf_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c):
+        return per_feature_best_split(
             hist, sg, sh, cnt,
             meta["num_bin"], meta["missing_type"], meta["default_bin"],
-            meta["monotone"], meta["penalty"], feature_mask,
-            min_constraint=min_c, max_constraint=max_c, **split_kw)
+            meta["monotone"], meta["penalty"], fmask,
+            min_constraint=min_c, max_constraint=max_c, **kw)
 
     def histogram(bins_pad, stats_pad):
         nb = bins_pad.shape[0] // params.block_rows if bins_pad.shape[0] >= params.block_rows else 1
@@ -104,19 +151,105 @@ def make_grower(params: GrowerParams, num_features: int,
              grad: jnp.ndarray,         # [n_pad] f32 (padding rows zero)
              hess: jnp.ndarray,         # [n_pad] f32
              row_mask: jnp.ndarray,     # [n_pad] f32 (bagging x padding)
-             feature_mask: jnp.ndarray,  # [F] f32
+             feature_mask: jnp.ndarray,  # [F] f32 ([F_global] w/ feature_axis)
              meta: Dict[str, jnp.ndarray]):
         n_pad = bins_pad.shape[0]
+
+        if feature_axis:
+            ax = jax.lax.axis_index(feature_axis)
+
+            def fslice(a):
+                return jax.lax.dynamic_slice_in_dim(a, ax * F, F)
+
+            meta_local = {k: fslice(v) for k, v in meta.items()}
+            fmask_local = fslice(feature_mask)
+        else:
+            ax = None
+            meta_local = meta
+            fmask_local = feature_mask
+
+        def select(hist, sg, sh, cnt, min_c=-1e30, max_c=1e30) -> SplitResult:
+            """Best split across all (global) features for one leaf; the
+            returned feature index is GLOBAL in every mode."""
+            if voting_k:
+                # local leaf totals from any one feature's bins (every row
+                # lands in exactly one bin per feature)
+                loc = jnp.sum(hist[0], axis=0)
+                pf_loc = pf_search(hist, loc[0], loc[1], loc[2], meta_local,
+                                   fmask_local, local_kw, min_c, max_c)
+                k2 = min(2 * voting_k, F)
+                vals, idx = jax.lax.top_k(pf_loc.gain, k2)
+                # weighted-gain vote across shards (GlobalVoting :170-200)
+                contrib = jnp.zeros(F, jnp.float32).at[idx].add(
+                    jnp.where(vals > K_MIN_SCORE / 2, vals, 0.0))
+                score = jax.lax.psum(contrib, data_axis)
+                kk = min(voting_k, F)
+                _, sel = jax.lax.top_k(score, kk)
+                sel = sel.astype(jnp.int32)
+                # aggregate ONLY the voted features' histograms
+                sel_hist = jax.lax.psum(hist[sel], data_axis)
+                sel_meta = {k: v[sel] for k, v in meta_local.items()}
+                pf = pf_search(sel_hist, sg, sh, cnt, sel_meta,
+                               fmask_local[sel], split_kw, min_c, max_c)
+                bi = jnp.argmax(pf.gain).astype(jnp.int32)
+                res = finalize_split(pf, bi, sg, sh,
+                                     l1=params.l1, l2=params.l2,
+                                     max_delta_step=params.max_delta_step,
+                                     min_constraint=min_c, max_constraint=max_c)
+                return res._replace(feature=sel[bi])
+
+            pf = pf_search(hist, sg, sh, cnt, meta_local, fmask_local,
+                           split_kw, min_c, max_c)
+            bf = jnp.argmax(pf.gain).astype(jnp.int32)
+            res = finalize_split(pf, bf, sg, sh,
+                                 l1=params.l1, l2=params.l2,
+                                 max_delta_step=params.max_delta_step,
+                                 min_constraint=min_c, max_constraint=max_c)
+            if feature_axis:
+                # global best = argmax over per-shard bests (replaces
+                # SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213);
+                # first-max-wins over shards + contiguous feature sharding
+                # reproduces the serial lowest-feature tie-break
+                gains = jax.lax.all_gather(res.gain, feature_axis)  # [P]
+                winner = jnp.argmax(gains).astype(jnp.int32)
+                own = (ax == winner)
+
+                def pick(x):
+                    return jax.lax.psum(
+                        jnp.where(own, x, jnp.zeros_like(x)), feature_axis)
+
+                res = SplitResult(
+                    gain=gains[winner],
+                    feature=(winner * F + pick(res.feature)).astype(jnp.int32),
+                    threshold=pick(res.threshold).astype(jnp.int32),
+                    default_left=pick(res.default_left.astype(jnp.int32)) > 0,
+                    left_sum_g=pick(res.left_sum_g),
+                    left_sum_h=pick(res.left_sum_h),
+                    left_count=pick(res.left_count),
+                    left_output=pick(res.left_output),
+                    right_output=pick(res.right_output))
+            return res
+
+        def feature_column(f):
+            """Bin column of (global) feature f, on every shard."""
+            if feature_axis:
+                shard = f // F
+                lf = jnp.mod(f, F)
+                own = (ax == shard)
+                col_l = jnp.take(bins_pad, lf, axis=1)
+                return jax.lax.psum(
+                    jnp.where(own, col_l, jnp.zeros_like(col_l)), feature_axis)
+            return jnp.take(bins_pad, f, axis=1)
 
         # ---- root ----------------------------------------------------
         g = grad * row_mask
         h = hess * row_mask
-        sum_g = preduce(jnp.sum(g))
-        sum_h = preduce(jnp.sum(h))
-        cnt = preduce(jnp.sum(row_mask))
-        root_hist = preduce(
+        sum_g = preduce_scalar(jnp.sum(g))
+        sum_h = preduce_scalar(jnp.sum(h))
+        cnt = preduce_scalar(jnp.sum(row_mask))
+        root_hist = preduce_hist(
             histogram(bins_pad, masked_stats(grad, hess, row_mask)))
-        root_split = best_split(root_hist, sum_g, sum_h, cnt, meta, feature_mask)
+        root_split = select(root_hist, sum_g, sum_h, cnt)
 
         def stash(arr, i, val, pred=True):
             return arr.at[i].set(jnp.where(pred, val, arr[i]))
@@ -173,7 +306,7 @@ def make_grower(params: GrowerParams, num_features: int,
             rg, rh, rc = pg - lg, ph - lh, pc - lc
 
             # ---- partition (reference dense_bin.hpp Split semantics) ----
-            col = jnp.take(bins_pad, f, axis=1)
+            col = feature_column(f)
             m_type = meta["missing_type"][f]
             nb_f = meta["num_bin"][f]
             db_f = meta["default_bin"][f]
@@ -190,7 +323,7 @@ def make_grower(params: GrowerParams, num_features: int,
             smaller_is_left = lc <= rc
             smaller_id = jnp.where(smaller_is_left, best_leaf, new_leaf)
             m = ((leaf_ids == smaller_id) & in_leaf).astype(jnp.float32) * row_mask
-            hist_small = preduce(
+            hist_small = preduce_hist(
                 histogram(bins_pad, masked_stats(grad, hess, m)))
             parent_hist = state["pool"][best_leaf]
             hist_large = parent_hist - hist_small
@@ -214,13 +347,8 @@ def make_grower(params: GrowerParams, num_features: int,
             r_max = jnp.where(mono_f < 0, mid, p_max)
 
             # ---- find best splits for the two children -----------------
-            split_l = best_split(hist_left, lg, lh, lc, meta, feature_mask,
-                                 l_min, l_max)
-            split_r = best_split(hist_right, rg, rh, rc, meta, feature_mask,
-                                 r_min, r_max)
-
-            def upd(key, i, val):
-                state[key] = stash(state[key], i, val, do)
+            split_l = select(hist_left, lg, lh, lc, l_min, l_max)
+            split_r = select(hist_right, rg, rh, rc, r_min, r_max)
 
             new_state = dict(state)
             new_state["leaf_ids"] = leaf_ids

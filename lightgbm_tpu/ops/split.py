@@ -26,7 +26,7 @@ lowest feature index wins ties (ArrayArgs::ArgMax semantics).
 from __future__ import annotations
 
 import functools
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -69,6 +69,8 @@ class SplitResult(NamedTuple):
     left_count: jnp.ndarray    # f32
     left_output: jnp.ndarray
     right_output: jnp.ndarray
+    is_cat: Optional[jnp.ndarray] = None    # categorical split? (None = no)
+    cat_mask: Optional[jnp.ndarray] = None  # [B] f32: bins going LEFT
 
 
 class PerFeatureBest(NamedTuple):
@@ -217,6 +219,181 @@ def finalize_split(pf: PerFeatureBest, best_f, sum_g, sum_h,
         default_left=dleft,
         left_sum_g=lg, left_sum_h=lh, left_count=lc,
         left_output=lo, right_output=ro)
+
+
+class PerFeatureCatBest(NamedTuple):
+    """Per-feature best CATEGORICAL split candidates."""
+    gain: jnp.ndarray        # [F] net gain (min_gain_shift subtracted, penalized)
+    cat_mask: jnp.ndarray    # [F, B] f32: 1.0 for bins going LEFT
+    left_sum_g: jnp.ndarray  # [F]
+    left_sum_h: jnp.ndarray  # [F]
+    left_count: jnp.ndarray  # [F]
+    left_output: jnp.ndarray   # [F] (computed with the categorical l2)
+    right_output: jnp.ndarray  # [F]
+
+
+def _gain_given_outputs(gl, hl, gr, hr, l1, l2, mds, min_c, max_c):
+    """GetSplitGains (feature_histogram.hpp:432-447): gain of the two leaf
+    outputs after monotone clipping."""
+    lo = jnp.clip(leaf_output(gl, hl, l1, l2, mds), min_c, max_c)
+    ro = jnp.clip(leaf_output(gr, hr, l1, l2, mds), min_c, max_c)
+    g_l = -(2.0 * _threshold_l1(gl, l1) * lo + (hl + l2) * lo * lo)
+    g_r = -(2.0 * _threshold_l1(gr, l1) * ro + (hr + l2) * ro * ro)
+    return g_l + g_r, lo, ro
+
+
+def per_feature_best_split_categorical(
+        hist: jnp.ndarray,        # [F, B, 3]
+        sum_g, sum_h, num_data,
+        num_bin: jnp.ndarray,     # [F] i32
+        missing_type: jnp.ndarray,  # [F] i32
+        penalty: jnp.ndarray,     # [F] f32
+        feature_mask: jnp.ndarray,  # [F]
+        *, l1: float, l2: float, max_delta_step: float,
+        min_data_in_leaf: float, min_sum_hessian: float,
+        min_gain_to_split: float,
+        cat_l2: float, cat_smooth: float, max_cat_threshold: int,
+        max_cat_to_onehot: int, min_data_per_group: float,
+        min_constraint=-1e30, max_constraint=1e30) -> PerFeatureCatBest:
+    """Categorical best-split search (FindBestThresholdCategorical,
+    reference feature_histogram.hpp:118-279).
+
+    Two modes per feature, selected by num_bin <= max_cat_to_onehot:
+    * one-hot: each category bin vs the rest, vectorized over bins;
+    * sorted-CTR subset: bins with count >= cat_smooth sorted by
+      sum_g/(sum_h + cat_smooth), prefix-scanned from both ends with the
+      reference's min_data_per_group grouping and early-break rules —
+      a lax.scan of <=B steps per direction, vmapped over features.
+
+    Returns per-feature candidates whose cat_mask marks the bins (i.e.
+    categories) routed LEFT; the grower turns the winning mask into
+    Tree.split_categorical bitsets.
+    """
+    F, B, _ = hist.shape
+    hg, hh, hc = hist[..., 0], hist[..., 1], hist[..., 2]
+    bin_iota = jnp.arange(B, dtype=jnp.int32)[None, :]
+
+    # used_bin = num_bin - 1 + (missing_type == None)  (hpp:130-131)
+    is_full = (missing_type == MISSING_NONE)
+    used_bin = num_bin - 1 + is_full.astype(jnp.int32)          # [F]
+
+    gain_shift = leaf_split_gain(sum_g, sum_h, l1, l2, max_delta_step)
+    min_gain_shift = gain_shift + min_gain_to_split
+
+    # ---- one-hot mode (hpp:137-169) ------------------------------------
+    in_range = bin_iota < used_bin[:, None]
+    oh_hl = hh + K_EPSILON
+    oh_hr = sum_h - hh - K_EPSILON
+    ok = (in_range
+          & (hc >= min_data_in_leaf) & (hh >= min_sum_hessian)
+          & ((num_data - hc) >= min_data_in_leaf)
+          & (oh_hr >= min_sum_hessian))
+    oh_gain, _, _ = _gain_given_outputs(
+        sum_g - hg, oh_hr, hg, oh_hl, l1, l2, max_delta_step,
+        min_constraint, max_constraint)
+    oh_gain = jnp.where(ok & (oh_gain > min_gain_shift), oh_gain, K_MIN_SCORE)
+    oh_best_t = jnp.argmax(oh_gain, axis=1)                     # [F]
+    f_iota = jnp.arange(F)
+    oh_best_gain = oh_gain[f_iota, oh_best_t]
+    oh_mask = (bin_iota == oh_best_t[:, None]).astype(jnp.float32)
+    oh_lg = hg[f_iota, oh_best_t]
+    oh_lh = hh[f_iota, oh_best_t] + K_EPSILON
+    oh_lc = hc[f_iota, oh_best_t]
+
+    # ---- sorted-CTR subset mode (hpp:170-243) --------------------------
+    l2c = l2 + cat_l2
+    valid = in_range & (hc >= cat_smooth)                       # [F, B]
+    ctr = hg / (hh + cat_smooth)
+    sort_key = jnp.where(valid, ctr, jnp.inf)
+    order = jnp.argsort(sort_key, axis=1).astype(jnp.int32)     # [F, B]
+    used_cnt = jnp.sum(valid, axis=1).astype(jnp.int32)         # [F]
+    max_cat = jnp.minimum(max_cat_threshold, (used_cnt + 1) // 2)
+
+    def scan_dir(order_f, used_f, limit_f, hg_f, hh_f, hc_f, ascending):
+        def body(carry, i):
+            slg, slh, slc, grp, dead, bg, bi, blg, blh, blc = carry
+            pos = jnp.where(ascending, i, used_f - 1 - i)
+            t = order_f[jnp.clip(pos, 0, B - 1)]
+            active = (i < limit_f) & (~dead)
+            slg = slg + jnp.where(active, hg_f[t], 0.0)
+            slh = slh + jnp.where(active, hh_f[t], 0.0)
+            slc = slc + jnp.where(active, hc_f[t], 0.0)
+            grp = grp + jnp.where(active, hc_f[t], 0.0)
+            cont1 = (slc < min_data_in_leaf) | (slh < min_sum_hessian)
+            rc = num_data - slc
+            srh = sum_h - slh
+            brk = ((rc < min_data_in_leaf) | (rc < min_data_per_group)
+                   | (srh < min_sum_hessian))
+            cont2 = grp < min_data_per_group
+            evaluate = active & (~cont1) & (~brk) & (~cont2)
+            gain, _, _ = _gain_given_outputs(
+                slg, slh, sum_g - slg, srh, l1, l2c, max_delta_step,
+                min_constraint, max_constraint)
+            good = evaluate & (gain > min_gain_shift) & (gain > bg)
+            grp = jnp.where(evaluate, 0.0, grp)
+            bg = jnp.where(good, gain, bg)
+            bi = jnp.where(good, i, bi)
+            blg = jnp.where(good, slg, blg)
+            blh = jnp.where(good, slh, blh)
+            blc = jnp.where(good, slc, blc)
+            dead = dead | (active & (~cont1) & brk)
+            return (slg, slh, slc, grp, dead, bg, bi, blg, blh, blc), None
+
+        init = (jnp.float32(0.0), jnp.float32(K_EPSILON), jnp.float32(0.0),
+                jnp.float32(0.0), jnp.asarray(False),
+                jnp.float32(K_MIN_SCORE), jnp.int32(-1),
+                jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0))
+        carry, _ = jax.lax.scan(body, init, jnp.arange(B, dtype=jnp.int32))
+        _, _, _, _, _, bg, bi, blg, blh, blc = carry
+        return bg, bi, blg, blh, blc
+
+    def per_feature(order_f, used_f, limit_f, hg_f, hh_f, hc_f):
+        g1, i1, lg1, lh1, lc1 = scan_dir(order_f, used_f, limit_f,
+                                         hg_f, hh_f, hc_f, True)
+        g2, i2, lg2, lh2, lc2 = scan_dir(order_f, used_f, limit_f,
+                                         hg_f, hh_f, hc_f, False)
+        use2 = g2 > g1                    # dir=+1 scanned first keeps ties
+        bg = jnp.where(use2, g2, g1)
+        bi = jnp.where(use2, i2, i1)
+        lg = jnp.where(use2, lg2, lg1)
+        lh = jnp.where(use2, lh2, lh1)
+        lc = jnp.where(use2, lc2, lc1)
+        # bins routed left: sorted positions 0..bi (asc) / last bi+1 (desc)
+        inv = jnp.zeros(B, jnp.int32).at[order_f].set(
+            jnp.arange(B, dtype=jnp.int32))
+        asc_mask = inv <= bi
+        desc_mask = (inv >= used_f - 1 - bi) & (inv < used_f)
+        mask = jnp.where(use2, desc_mask, asc_mask) & (bi >= 0)
+        return bg, mask.astype(jnp.float32), lg, lh, lc
+
+    so_gain, so_mask, so_lg, so_lh, so_lc = jax.vmap(per_feature)(
+        order, used_cnt, max_cat, hg, hh, hc)
+
+    # ---- merge modes per feature (hpp:136 use_onehot) ------------------
+    use_oh = num_bin <= max_cat_to_onehot
+    gain = jnp.where(use_oh, oh_best_gain, so_gain)
+    mask = jnp.where(use_oh[:, None], oh_mask, so_mask)
+    lg = jnp.where(use_oh, oh_lg, so_lg)
+    lh = jnp.where(use_oh, oh_lh, so_lh)
+    lc = jnp.where(use_oh, oh_lc, so_lc)
+    l2_out = jnp.where(use_oh, l2, l2c)
+
+    # leaf outputs with the mode's l2 (hpp:244-258)
+    lo = jnp.clip(-_threshold_l1(lg, l1) / (lh + l2_out),
+                  min_constraint, max_constraint)
+    ro = jnp.clip(-_threshold_l1(sum_g - lg, l1) / (sum_h - lh + l2_out),
+                  min_constraint, max_constraint)
+    if max_delta_step > 0.0:
+        lo = jnp.clip(lo, -max_delta_step, max_delta_step)
+        ro = jnp.clip(ro, -max_delta_step, max_delta_step)
+
+    gain = jnp.where(feature_mask > 0, gain, K_MIN_SCORE)
+    out_gain = jnp.where(gain > K_MIN_SCORE / 2,
+                         (gain - min_gain_shift) * penalty,
+                         K_MIN_SCORE)
+    return PerFeatureCatBest(gain=out_gain, cat_mask=mask,
+                             left_sum_g=lg, left_sum_h=lh, left_count=lc,
+                             left_output=lo, right_output=ro)
 
 
 def find_best_split_all_features(

@@ -1,2 +1,4 @@
 from .mesh import make_mesh, shard_rows
 from .data_parallel import make_data_parallel_grower
+from .strategies import (make_strategy_grower, resolve_tree_learner,
+                         bins_sharding, rows_sharding)

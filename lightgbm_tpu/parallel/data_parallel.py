@@ -10,21 +10,18 @@ over ICI on its own), so every shard runs the identical split search and
 identical tree — no SyncUpGlobalBestSplit step is needed, exactly like the
 reference's feature-parallel trick of making decisions reproducible on all
 machines.
+
+Kept as a thin alias of the 'data' strategy in strategies.py so older
+callers (and the driver dry run) exercise the SAME code path the tree
+learner uses.
 """
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Dict, Optional
+from jax.sharding import Mesh
 
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
-
-from ..ops.grower import GrowerParams, make_grower
+from ..ops.grower import GrowerParams
+from .strategies import make_strategy_grower
 
 
 def make_data_parallel_grower(params: GrowerParams, num_features: int,
@@ -34,21 +31,4 @@ def make_data_parallel_grower(params: GrowerParams, num_features: int,
     Inputs are globally-shaped arrays sharded along rows; outputs: records
     are replicated, leaf_ids stay row-sharded.
     """
-    grow = make_grower(params, num_features, data_axis="data", jit=False)
-
-    def wrapped(bins_pad, grad, hess, row_mask, feature_mask, meta):
-        out = grow(bins_pad, grad, hess, row_mask, feature_mask, meta)
-        # records / leaf stats are identical on every shard (computed from
-        # psum'ed histograms); mark them replicated for shard_map
-        return out
-
-    meta_spec = {k: P() for k in ("num_bin", "missing_type", "default_bin",
-                                  "monotone", "penalty")}
-    sharded = shard_map(
-        wrapped, mesh=mesh,
-        in_specs=(P("data", None), P("data"), P("data"), P("data"),
-                  P(), meta_spec),
-        out_specs={"records": P(), "leaf_ids": P("data"),
-                   "leaf_output": P(), "leaf_cnt": P(), "leaf_sum_h": P()},
-        check_rep=False)
-    return jax.jit(sharded)
+    return make_strategy_grower(params, num_features, "data", mesh)

@@ -1,0 +1,108 @@
+"""Parallel tree-learner strategies over a device mesh.
+
+The reference selects its learner in a factory keyed on (tree_learner,
+device_type) (reference src/treelearner/tree_learner.cpp:13-36) and the
+parallel learners are templates over the base learner (parallel_tree_
+learner.h:25-187) so device x {feature,data,voting} compose.  Here the
+device learner IS the base grower and each strategy is a shard_map wrapping
+of the same grower body over a `jax.sharding.Mesh` axis:
+
+  serial   — plain jit, one device
+  data     — rows sharded over 'data'; full-histogram psum
+             (DataParallelTreeLearner, data_parallel_tree_learner.cpp:149)
+  feature  — features sharded over 'feature'; all_gather + argmax of
+             per-shard bests (FeatureParallelTreeLearner,
+             feature_parallel_tree_learner.cpp:23-75)
+  voting   — rows sharded; top-k voted features' histograms psum'ed
+             (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp)
+
+All four present the SAME call signature
+    grow(bins_pad, grad, hess, row_mask, feature_mask, meta) -> out dict
+so the driver/learner code is strategy-agnostic.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.grower import GrowerParams, make_grower
+
+META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty")
+
+_CANON = {
+    "serial": "serial",
+    "data": "data", "data_parallel": "data",
+    "feature": "feature", "feature_parallel": "feature",
+    "voting": "voting", "voting_parallel": "voting",
+}
+
+
+def resolve_tree_learner(name: str) -> str:
+    """Canonical strategy name (reference tree_learner config aliases,
+    src/io/config.cpp ParseTreeLearnerType)."""
+    try:
+        return _CANON[str(name).strip().lower()]
+    except KeyError:
+        raise ValueError(f"unknown tree_learner {name!r}") from None
+
+
+def make_strategy_grower(params: GrowerParams, num_features: int,
+                         strategy: str, mesh: Optional[Mesh] = None,
+                         voting_k: int = 20):
+    """Grower for `strategy`; num_features is the GLOBAL (padded) count."""
+    if strategy == "serial" or mesh is None:
+        return make_grower(params, num_features)
+
+    meta_spec = {k: P() for k in META_KEYS}
+    if strategy in ("data", "voting"):
+        nshards = mesh.shape["data"]
+        grow = make_grower(
+            params, num_features, data_axis="data",
+            voting_k=(voting_k if strategy == "voting" else 0),
+            num_shards=nshards, jit=False)
+        fn = shard_map(
+            grow, mesh=mesh,
+            in_specs=(P("data", None), P("data"), P("data"), P("data"),
+                      P(), meta_spec),
+            out_specs={"records": P(), "leaf_ids": P("data"),
+                       "leaf_output": P(), "leaf_cnt": P(),
+                       "leaf_sum_h": P()},
+            check_vma=False)
+        return jax.jit(fn)
+    if strategy == "feature":
+        nshards = mesh.shape["feature"]
+        if num_features % nshards != 0:
+            raise ValueError(
+                f"feature count {num_features} must be padded to a multiple "
+                f"of the feature-shard count {nshards}")
+        f_local = num_features // nshards
+        grow = make_grower(params, f_local, feature_axis="feature", jit=False)
+        fn = shard_map(
+            grow, mesh=mesh,
+            in_specs=(P(None, "feature"), P(), P(), P(), P(), meta_spec),
+            out_specs={"records": P(), "leaf_ids": P(),
+                       "leaf_output": P(), "leaf_cnt": P(),
+                       "leaf_sum_h": P()},
+            check_vma=False)
+        return jax.jit(fn)
+    raise ValueError(f"unknown strategy {strategy!r}")
+
+
+def bins_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
+    """Sharding for the [n_pad, F] bin matrix under `strategy`."""
+    if strategy in ("data", "voting"):
+        return NamedSharding(mesh, P("data", None))
+    if strategy == "feature":
+        return NamedSharding(mesh, P(None, "feature"))
+    raise ValueError(strategy)
+
+
+def rows_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
+    """Sharding for [n_pad] per-row vectors under `strategy`."""
+    if strategy in ("data", "voting"):
+        return NamedSharding(mesh, P("data"))
+    return NamedSharding(mesh, P())

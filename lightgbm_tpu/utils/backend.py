@@ -29,16 +29,40 @@ import time
 from typing import Optional
 
 
-def has_tunneled_backend() -> bool:
-    """True when the tunneled `axon` backend factory is registered (i.e.
-    a hang at backend init is possible).  Plain CPU/TPU hosts return False
-    and need no out-of-process probing."""
+def backend_health() -> str:
+    """Classify the default-backend failure risk without initializing it.
+
+    * 'ok'     — no tunneled backend in play; default init is safe.
+    * 'probe'  — the tunneled `axon` factory is registered: init may hang
+                 on a dead tunnel; callers must probe out-of-process.
+    * 'broken' — jax_platforms requests a platform with NO registered
+                 factory (e.g. the sitecustomize latched JAX_PLATFORMS=axon
+                 but the plugin skipped registration — observed when
+                 XLA_FLAGS forces host-platform device counts): init fails
+                 fast and deterministically; pin CPU directly.
+    """
     try:
+        import jax
         import jax._src.xla_bridge as _xb
 
-        return "axon" in _xb._backend_factories
+        factories = set(_xb._backend_factories)
+        if "axon" in factories:
+            return "probe"
+        requested = [p for p in str(jax.config.jax_platforms or "").split(",")
+                     if p]
+        # only the axon name is judged here: other platforms may register
+        # lazily via plugin discovery or be aliases (gpu->cuda), so their
+        # absence from the factory table proves nothing
+        if "axon" in requested:
+            return "broken"
+        return "ok"
     except Exception:  # pragma: no cover - jax internals moved
-        return True  # be conservative: probe
+        return "probe"  # be conservative
+
+
+def has_tunneled_backend() -> bool:
+    """True when default-backend init needs either probing or pinning."""
+    return backend_health() != "ok"
 
 _PROBE_SRC = r"""
 import jax, sys
