@@ -57,6 +57,23 @@ def _predict_binned(tree: Tree, bins: np.ndarray,
         default_left = (dt & 2) != 0
         go_left = np.where(is_missing, default_left,
                            fbin <= tree.threshold_in_bin[nid])
+        is_cat = (dt & 1) != 0
+        if is_cat.any():
+            # bin-space bitset membership (CategoricalDecisionInner,
+            # reference tree.h:307-318): bins in the set go left
+            cat_words = np.asarray(tree.cat_threshold_inner, dtype=np.uint32)
+            cat_bounds = np.asarray(tree.cat_boundaries_inner, dtype=np.int64)
+            cat_idx = tree.threshold_in_bin[nid].astype(np.int64)
+            cat_idx = np.clip(cat_idx, 0, len(cat_bounds) - 2)
+            start = cat_bounds[cat_idx]
+            width = cat_bounds[cat_idx + 1] - start
+            word_idx = fbin // 32
+            in_range = word_idx < width
+            word = (cat_words[np.clip(start + word_idx, 0,
+                                      len(cat_words) - 1)]
+                    if len(cat_words) else np.zeros(len(nid), np.uint32))
+            bit = (word >> (fbin % 32).astype(np.uint32)) & 1
+            go_left = np.where(is_cat, in_range & (bit == 1), go_left)
         node[active] = np.where(go_left, tree.left_child[nid],
                                 tree.right_child[nid]).astype(np.int32)
     return tree.leaf_value[~node]
@@ -641,19 +658,41 @@ class GBDT:
         binned traversal (_predict_binned) is valid for score replay."""
         used_pos = {col: j for j, col in
                     enumerate(self.train_data.used_feature_idx)}
+        cat_nodes: Dict[int, List[int]] = {}  # cat_idx -> bin words
         for j in range(tree.num_leaves - 1):
             real_f = int(tree.split_feature[j])
             if real_f not in used_pos:
                 raise ValueError(
                     f"init model splits on feature {real_f} which is trivial/"
                     "unused in the new training data")
-            if int(tree.decision_type[j]) & 1:
-                raise NotImplementedError(
-                    "categorical splits in init models not yet supported")
             tree.split_feature_inner[j] = used_pos[real_f]
             mapper = self.train_data.mappers[real_f]
-            tree.threshold_in_bin[j] = mapper.value_to_bin(
-                float(tree.threshold[j]))
+            if int(tree.decision_type[j]) & 1:
+                # categorical: decode the raw-category value bitset, re-map
+                # each category to its bin in the NEW dataset, re-encode
+                cat_idx = int(tree.threshold[j])
+                start = tree.cat_boundaries[cat_idx]
+                end = tree.cat_boundaries[cat_idx + 1]
+                words = tree.cat_threshold[start:end]
+                cats = [w * 32 + b for w, word in enumerate(words)
+                        for b in range(32) if (int(word) >> b) & 1]
+                bins = [mapper.categorical_2_bin[c] for c in cats
+                        if c in mapper.categorical_2_bin]
+                bw = [0] * (max(bins) // 32 + 1 if bins else 1)
+                for b in bins:
+                    bw[b // 32] |= 1 << (b % 32)
+                cat_nodes[cat_idx] = bw
+            else:
+                tree.threshold_in_bin[j] = mapper.value_to_bin(
+                    float(tree.threshold[j]))
+        if cat_nodes:
+            bounds, words = [0], []
+            for ci in range(tree.num_cat):
+                bw = cat_nodes.get(ci, [0])
+                words.extend(bw)
+                bounds.append(bounds[-1] + len(bw))
+            tree.cat_boundaries_inner = bounds
+            tree.cat_threshold_inner = words
 
     def merge_from_model_string(self, text: str) -> None:
         """Continued training: prepend a loaded model (init_model)."""

@@ -27,6 +27,18 @@ from ..utils.log import Log
 from .tree import Tree
 
 
+def _to_bitset(values) -> list:
+    """Int values -> uint32 bitset words (reference Common::ConstructBitset,
+    include/LightGBM/utils/common.h)."""
+    vals = [int(v) for v in values if int(v) >= 0]
+    if not vals:
+        return [0]
+    words = [0] * (max(vals) // 32 + 1)
+    for v in vals:
+        words[v // 32] |= 1 << (v % 32)
+    return words
+
+
 class TPUTreeLearner:
     def __init__(self, config: Config, train_data: TrainingData):
         self.config = config
@@ -82,8 +94,6 @@ class TPUTreeLearner:
 
         meta_host = {}
         for k, v in meta_np.items():
-            if k == "is_categorical":
-                continue
             pad_val = 1 if k == "num_bin" else (1.0 if k == "penalty" else 0)
             if self.f_pad != self.num_features:
                 v = np.concatenate(
@@ -127,6 +137,12 @@ class TPUTreeLearner:
             min_sum_hessian=float(config.min_sum_hessian_in_leaf),
             min_gain_to_split=float(config.min_gain_to_split),
             max_depth=int(config.max_depth),
+            has_cat=bool(meta_np["is_categorical"].any()),
+            max_cat_threshold=int(config.max_cat_threshold),
+            cat_l2=float(config.cat_l2),
+            cat_smooth=float(config.cat_smooth),
+            max_cat_to_onehot=int(config.max_cat_to_onehot),
+            min_data_per_group=float(config.min_data_per_group),
         )
         self.grow = make_strategy_grower(
             self.params, self.f_pad, strategy, self.mesh,
@@ -285,12 +301,10 @@ class TPUTreeLearner:
             f = int(row[G.REC_FEATURE])
             thr_bin = int(row[G.REC_THRESHOLD])
             real_f = used[f]
-            tree.split(
+            common = dict(
                 leaf=int(row[G.REC_LEAF]),
                 feature_inner=f,
                 real_feature=real_f,
-                threshold_bin=thr_bin,
-                threshold_double=mappers[real_f].bin_to_value(thr_bin),
                 left_value=float(row[G.REC_LEFT_OUTPUT]),
                 right_value=float(row[G.REC_RIGHT_OUTPUT]),
                 left_cnt=int(round(float(row[G.REC_LEFT_COUNT]))),
@@ -298,6 +312,21 @@ class TPUTreeLearner:
                 left_weight=float(row[G.REC_LEFT_WEIGHT]),
                 right_weight=float(row[G.REC_RIGHT_WEIGHT]),
                 gain=float(row[G.REC_GAIN]),
-                missing_type=int(missing[f]),
-                default_left=row[G.REC_DEFAULT_LEFT] > 0.5)
+                missing_type=int(missing[f]))
+            if row[G.REC_IS_CAT] > 0.5:
+                # bins routed left -> bin bitset + raw-category bitset
+                # (Tree::SplitCategorical, reference tree.h:60-85)
+                bins_left = np.nonzero(row[G.REC_WIDTH:] > 0.5)[0]
+                cats_left = [mappers[real_f].bin_2_categorical[b]
+                             for b in bins_left]
+                tree.split_categorical(
+                    threshold_bins=_to_bitset(bins_left),
+                    thresholds=_to_bitset(cats_left),
+                    **common)
+            else:
+                tree.split(
+                    threshold_bin=thr_bin,
+                    threshold_double=mappers[real_f].bin_to_value(thr_bin),
+                    default_left=row[G.REC_DEFAULT_LEFT] > 0.5,
+                    **common)
         return tree

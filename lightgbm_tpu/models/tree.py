@@ -217,7 +217,10 @@ class Tree:
         nan_mask = np.isnan(fval)
         int_fval = np.where(nan_mask, 0, np.nan_to_num(fval, nan=0.0)).astype(np.int64)
         neg = int_fval < 0
-        cat_idx = self.threshold[nid].astype(np.int64)
+        # nid covers ALL active nodes (numerical ones too, masked by the
+        # caller); their thresholds are raw doubles — clip before indexing
+        cat_idx = np.clip(self.threshold[nid].astype(np.int64), 0,
+                          max(len(cat_boundaries) - 2, 0))
         start = cat_boundaries[cat_idx]
         width = cat_boundaries[cat_idx + 1] - start
         word_idx = int_fval // 32

@@ -129,12 +129,71 @@ def make_grower(params: GrowerParams, num_features: int,
         local_kw["min_data_in_leaf"] = params.min_data_in_leaf / num_shards
         local_kw["min_sum_hessian"] = params.min_sum_hessian / num_shards
 
+    # width of the carried categorical bin mask; 1 when the categorical
+    # path is statically disabled (numerical-only data)
+    CB = B if params.has_cat else 1
+
     def pf_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c):
         return per_feature_best_split(
             hist, sg, sh, cnt,
             meta["num_bin"], meta["missing_type"], meta["default_bin"],
             meta["monotone"], meta["penalty"], fmask,
             min_constraint=min_c, max_constraint=max_c, **kw)
+
+    def combined_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c):
+        """Per-feature bests merging numerical and categorical searches.
+
+        Returns (gain_vec [F'], finalize(best_idx) -> SplitResult) so the
+        callers (serial argmax, voting top-k, feature-parallel all-gather)
+        can each apply their own winner selection.
+        """
+        if not params.has_cat:
+            pf = pf_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c)
+
+            def fin_plain(bi):
+                res = finalize_split(pf, bi, sg, sh,
+                                     l1=params.l1, l2=params.l2,
+                                     max_delta_step=params.max_delta_step,
+                                     min_constraint=min_c,
+                                     max_constraint=max_c)
+                return res._replace(is_cat=jnp.asarray(False),
+                                    cat_mask=jnp.zeros(CB, jnp.float32))
+            return pf.gain, fin_plain
+
+        is_cat = meta["is_categorical"] > 0
+        catf = is_cat.astype(jnp.float32)
+        pf = pf_search(hist, sg, sh, cnt, meta, fmask * (1.0 - catf),
+                       kw, min_c, max_c)
+        pfc = per_feature_best_split_categorical(
+            hist, sg, sh, cnt, meta["num_bin"], meta["missing_type"],
+            meta["penalty"], fmask * catf,
+            cat_l2=params.cat_l2, cat_smooth=params.cat_smooth,
+            max_cat_threshold=params.max_cat_threshold,
+            max_cat_to_onehot=params.max_cat_to_onehot,
+            min_data_per_group=params.min_data_per_group,
+            min_constraint=min_c, max_constraint=max_c, **kw)
+        gain = jnp.where(is_cat, pfc.gain, pf.gain)
+
+        def fin(bi):
+            resn = finalize_split(pf, bi, sg, sh,
+                                  l1=params.l1, l2=params.l2,
+                                  max_delta_step=params.max_delta_step,
+                                  min_constraint=min_c, max_constraint=max_c)
+            c = is_cat[bi]
+            return SplitResult(
+                gain=gain[bi], feature=bi.astype(jnp.int32),
+                threshold=jnp.where(c, 0, resn.threshold).astype(jnp.int32),
+                default_left=jnp.where(c, False, resn.default_left),
+                left_sum_g=jnp.where(c, pfc.left_sum_g[bi], resn.left_sum_g),
+                left_sum_h=jnp.where(c, pfc.left_sum_h[bi], resn.left_sum_h),
+                left_count=jnp.where(c, pfc.left_count[bi], resn.left_count),
+                left_output=jnp.where(c, pfc.left_output[bi],
+                                      resn.left_output),
+                right_output=jnp.where(c, pfc.right_output[bi],
+                                       resn.right_output),
+                is_cat=c,
+                cat_mask=pfc.cat_mask[bi] * c.astype(jnp.float32))
+        return gain, fin
 
     def histogram(bins_pad, stats_pad):
         nb = bins_pad.shape[0] // params.block_rows if bins_pad.shape[0] >= params.block_rows else 1
@@ -175,10 +234,11 @@ def make_grower(params: GrowerParams, num_features: int,
                 # local leaf totals from any one feature's bins (every row
                 # lands in exactly one bin per feature)
                 loc = jnp.sum(hist[0], axis=0)
-                pf_loc = pf_search(hist, loc[0], loc[1], loc[2], meta_local,
-                                   fmask_local, local_kw, min_c, max_c)
+                gain_loc, _ = combined_search(hist, loc[0], loc[1], loc[2],
+                                              meta_local, fmask_local,
+                                              local_kw, min_c, max_c)
                 k2 = min(2 * voting_k, F)
-                vals, idx = jax.lax.top_k(pf_loc.gain, k2)
+                vals, idx = jax.lax.top_k(gain_loc, k2)
                 # weighted-gain vote across shards (GlobalVoting :170-200)
                 contrib = jnp.zeros(F, jnp.float32).at[idx].add(
                     jnp.where(vals > K_MIN_SCORE / 2, vals, 0.0))
@@ -189,22 +249,18 @@ def make_grower(params: GrowerParams, num_features: int,
                 # aggregate ONLY the voted features' histograms
                 sel_hist = jax.lax.psum(hist[sel], data_axis)
                 sel_meta = {k: v[sel] for k, v in meta_local.items()}
-                pf = pf_search(sel_hist, sg, sh, cnt, sel_meta,
-                               fmask_local[sel], split_kw, min_c, max_c)
-                bi = jnp.argmax(pf.gain).astype(jnp.int32)
-                res = finalize_split(pf, bi, sg, sh,
-                                     l1=params.l1, l2=params.l2,
-                                     max_delta_step=params.max_delta_step,
-                                     min_constraint=min_c, max_constraint=max_c)
+                gain_sel, fin = combined_search(sel_hist, sg, sh, cnt,
+                                                sel_meta, fmask_local[sel],
+                                                split_kw, min_c, max_c)
+                bi = jnp.argmax(gain_sel).astype(jnp.int32)
+                res = fin(bi)
                 return res._replace(feature=sel[bi])
 
-            pf = pf_search(hist, sg, sh, cnt, meta_local, fmask_local,
-                           split_kw, min_c, max_c)
-            bf = jnp.argmax(pf.gain).astype(jnp.int32)
-            res = finalize_split(pf, bf, sg, sh,
-                                 l1=params.l1, l2=params.l2,
-                                 max_delta_step=params.max_delta_step,
-                                 min_constraint=min_c, max_constraint=max_c)
+            gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_local,
+                                            fmask_local, split_kw,
+                                            min_c, max_c)
+            bf = jnp.argmax(gain_vec).astype(jnp.int32)
+            res = fin(bf)
             if feature_axis:
                 # global best = argmax over per-shard bests (replaces
                 # SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213);
@@ -227,7 +283,9 @@ def make_grower(params: GrowerParams, num_features: int,
                     left_sum_h=pick(res.left_sum_h),
                     left_count=pick(res.left_count),
                     left_output=pick(res.left_output),
-                    right_output=pick(res.right_output))
+                    right_output=pick(res.right_output),
+                    is_cat=pick(res.is_cat.astype(jnp.int32)) > 0,
+                    cat_mask=pick(res.cat_mask))
             return res
 
         def feature_column(f):
@@ -274,6 +332,10 @@ def make_grower(params: GrowerParams, num_features: int,
             "bs_lc": jnp.zeros(L, jnp.float32).at[0].set(root_split.left_count),
             "bs_lo": jnp.zeros(L, jnp.float32).at[0].set(root_split.left_output),
             "bs_ro": jnp.zeros(L, jnp.float32).at[0].set(root_split.right_output),
+            # categorical best-split carry: flag + bins-going-left mask
+            "bs_iscat": jnp.zeros(L, jnp.bool_).at[0].set(root_split.is_cat),
+            "bs_catmask": jnp.zeros((L, CB), jnp.float32).at[0].set(
+                root_split.cat_mask),
             # monotone value constraints per leaf (propagated on split)
             "leaf_min": jnp.full(L, -1e30, jnp.float32),
             "leaf_max": jnp.full(L, 1e30, jnp.float32),
@@ -305,7 +367,8 @@ def make_grower(params: GrowerParams, num_features: int,
             pc = state["leaf_cnt"][best_leaf]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
 
-            # ---- partition (reference dense_bin.hpp Split semantics) ----
+            # ---- partition (reference dense_bin.hpp Split /
+            # SplitCategorical semantics) ----
             col = feature_column(f)
             m_type = meta["missing_type"][f]
             nb_f = meta["num_bin"][f]
@@ -314,6 +377,13 @@ def make_grower(params: GrowerParams, num_features: int,
                 m_type == MISSING_NAN, col == nb_f - 1,
                 jnp.where(m_type == MISSING_ZERO, col == db_f, False))
             go_left = jnp.where(is_missing, dleft, col <= thr)
+            iscat_s = state["bs_iscat"][best_leaf]
+            if params.has_cat:
+                # bitset membership: bins in the stored mask go left,
+                # everything else (incl. the NaN bin) goes right
+                # (reference CategoricalDecisionInner, tree.h:307-318)
+                cmask = state["bs_catmask"][best_leaf]
+                go_left = jnp.where(iscat_s, cmask[col] > 0.5, go_left)
             in_leaf = state["leaf_ids"] == best_leaf
             new_leaf = (s + 1).astype(jnp.int32)
             leaf_ids = jnp.where(do & in_leaf & (~go_left), new_leaf,
@@ -374,22 +444,28 @@ def make_grower(params: GrowerParams, num_features: int,
                     ("bs_lh", split_l.left_sum_h, split_r.left_sum_h),
                     ("bs_lc", split_l.left_count, split_r.left_count),
                     ("bs_lo", split_l.left_output, split_r.left_output),
-                    ("bs_ro", split_l.right_output, split_r.right_output)):
+                    ("bs_ro", split_l.right_output, split_r.right_output),
+                    ("bs_iscat", split_l.is_cat, split_r.is_cat),
+                    ("bs_catmask", split_l.cat_mask, split_r.cat_mask)):
                 arr = new_state[key]
                 arr = stash(arr, best_leaf, lv, do)
                 arr = stash(arr, new_leaf, rv, do)
                 new_state[key] = arr
             new_state["active"] = do
 
-            # pack the step record into one f32 row: a single [L-1, 15] array
-            # means ONE device->host transfer per tree (transfer latency, not
-            # bandwidth, dominates on tunneled/remote TPU attachments)
+            # pack the step record into one f32 row: a single [L-1, 16(+B)]
+            # array means ONE device->host transfer per tree (transfer
+            # latency, not bandwidth, dominates on tunneled/remote TPU
+            # attachments); cat splits append their bin mask after col 16
             rec = jnp.stack([
                 best_leaf.astype(jnp.float32), f.astype(jnp.float32),
                 thr.astype(jnp.float32), dleft.astype(jnp.float32),
                 gain, lo, ro, lc, rc, lh, rh,
                 state["leaf_output"][best_leaf], ph, pc,
-                do.astype(jnp.float32)])
+                do.astype(jnp.float32), iscat_s.astype(jnp.float32)])
+            if params.has_cat:
+                rec = jnp.concatenate(
+                    [rec, state["bs_catmask"][best_leaf]])
             return new_state, rec
 
         state, records = jax.lax.scan(step, state, jnp.arange(L - 1))
@@ -404,11 +480,14 @@ def make_grower(params: GrowerParams, num_features: int,
     return jax.jit(grow) if jit else grow
 
 
-# record-row field indices (see `rec` stack in make_grower.step)
+# record-row field indices (see `rec` stack in make_grower.step); rows are
+# 16 wide, plus a trailing [B] categorical bin mask when has_cat
 REC_LEAF, REC_FEATURE, REC_THRESHOLD, REC_DEFAULT_LEFT, REC_GAIN, \
     REC_LEFT_OUTPUT, REC_RIGHT_OUTPUT, REC_LEFT_COUNT, REC_RIGHT_COUNT, \
     REC_LEFT_WEIGHT, REC_RIGHT_WEIGHT, REC_INTERNAL_VALUE, \
-    REC_INTERNAL_WEIGHT, REC_INTERNAL_COUNT, REC_DID_SPLIT = range(15)
+    REC_INTERNAL_WEIGHT, REC_INTERNAL_COUNT, REC_DID_SPLIT, \
+    REC_IS_CAT = range(16)
+REC_WIDTH = 16  # categorical mask starts at REC_WIDTH
 
 
 def pad_rows(n: int, block_rows: int) -> int:

@@ -31,7 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.grower import GrowerParams, make_grower
 
-META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty")
+META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty",
+             "is_categorical")
 
 _CANON = {
     "serial": "serial",

@@ -1,0 +1,125 @@
+"""Categorical split training (FindBestThresholdCategorical,
+reference feature_histogram.hpp:118-279; fixture = the reference cpp_test
+config: tests/cpp_test/train.conf on tests/data/categorical.data)."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.parser import load_text_file
+
+from .conftest import ORACLE_BIN, REFERENCE_DIR, has_oracle
+
+CAT_DATA = os.path.join(REFERENCE_DIR, "tests", "data", "categorical.data")
+CAT_COLS = [0, 1, 4, 5, 6]
+
+
+@pytest.fixture(scope="module")
+def cat_example():
+    X, y, _, _, _, _ = load_text_file(CAT_DATA)
+    return X, y
+
+
+def _train(X, y, extra=None, rounds=10):
+    params = {"objective": "binary", "verbosity": -1,
+              "metric": "binary_logloss"}
+    params.update(extra or {})
+    ds = lgb.Dataset(X, label=y, categorical_feature=CAT_COLS)
+    evals = {}
+    bst = lgb.train(params, ds, num_boost_round=rounds,
+                    valid_sets=[ds],
+                    evals_result=evals)
+    return bst, evals
+
+
+class TestCategoricalTraining:
+    def test_learns_and_uses_cat_splits(self, cat_example):
+        X, y = cat_example
+        bst, evals = _train(X, y)
+        ll = next(iter(evals.values()))["binary_logloss"]
+        assert ll[-1] < ll[0] * 0.9
+        dumped = bst.dump_model()
+        found_cat = []
+
+        def walk(node):
+            if "decision_type" in node:
+                found_cat.append(node["decision_type"] == "==")
+                walk(node["left_child"])
+                walk(node["right_child"])
+        for t in dumped["tree_info"]:
+            if "split_feature" in t["tree_structure"]:
+                walk(t["tree_structure"])
+        assert any(found_cat), "no categorical split in 10 trees"
+
+    def test_onehot_mode(self, cat_example):
+        X, y = cat_example
+        # force one-hot search for low-cardinality features
+        bst, evals = _train(X, y, {"max_cat_to_onehot": 64})
+        assert next(iter(evals.values()))["binary_logloss"][-1] < 0.6
+
+    def test_predict_consistency_raw_vs_binned(self, cat_example):
+        """Raw-value predict (bitset on category values) must agree with the
+        training-time binned routing (bitset on bins)."""
+        X, y = cat_example
+        bst, _ = _train(X, y, rounds=5)
+        pred = bst.predict(X, raw_score=True)
+        driver = bst._driver
+        import jax
+        train_scores = np.asarray(
+            jax.device_get(driver.train_scores.scores))[0]
+        np.testing.assert_allclose(pred, train_scores, rtol=1e-4, atol=1e-4)
+
+    def test_model_roundtrip(self, cat_example, tmp_path):
+        X, y = cat_example
+        bst, _ = _train(X, y, rounds=5)
+        p = tmp_path / "cat_model.txt"
+        bst.save_model(str(p))
+        bst2 = lgb.Booster(model_file=str(p))
+        np.testing.assert_allclose(bst.predict(X), bst2.predict(X),
+                                   rtol=1e-6)
+
+    @pytest.mark.skipif(not has_oracle(), reason="reference oracle not built")
+    def test_oracle_logloss_parity(self, cat_example, tmp_path):
+        """Final train logloss within tolerance of the reference CLI run on
+        the identical config (the cpp_test smoke config)."""
+        X, y = cat_example
+        rounds = 10
+        conf = tmp_path / "train.conf"
+        conf.write_text(
+            f"data={CAT_DATA}\nvalid_data={CAT_DATA}\napp=binary\n"
+            f"num_trees={rounds}\n"
+            f"categorical_column={','.join(map(str, CAT_COLS))}\n"
+            f"metric=binary_logloss\nmetric_freq=1\n"
+            f"output_model={tmp_path}/m.txt\n")
+        out = subprocess.run([ORACLE_BIN, f"config={conf}"],
+                             capture_output=True, text=True, timeout=120,
+                             cwd=str(tmp_path))
+        lls = [float(line.rsplit(":", 1)[1])
+               for line in out.stdout.splitlines()
+               if "binary_logloss" in line]
+        assert lls, out.stdout + out.stderr
+        bst, evals = _train(X, y, rounds=rounds)
+        mine = next(iter(evals.values()))["binary_logloss"][-1]
+        ref = lls[-1]
+        assert mine < ref + 0.02, f"logloss {mine} vs oracle {ref}"
+
+    def test_init_model_continuation(self, cat_example, tmp_path):
+        """Categorical init models rebind value-bitsets to the new dataset's
+        bins (GBDT._rebind_tree) and continue training."""
+        X, y = cat_example
+        bst, _ = _train(X, y, rounds=5)
+        p = tmp_path / "cat_init.txt"
+        bst.save_model(str(p))
+        ds = lgb.Dataset(X, label=y, categorical_feature=CAT_COLS)
+        evals = {}
+        bst2 = lgb.train({"objective": "binary", "verbosity": -1,
+                          "metric": "binary_logloss"}, ds,
+                         num_boost_round=3, init_model=str(p),
+                         valid_sets=[ds], evals_result=evals)
+        ll = next(iter(evals.values()))["binary_logloss"]
+        assert ll[-1] < 0.45
+        assert bst2.num_trees() >= 8
