@@ -163,6 +163,16 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "tpu_hist_precision": ("str", "hilo", ("hist_precision",)),
     # rows per histogram scan block (device-side); tuned for VMEM/HBM balance
     "tpu_block_rows": ("int", 16384, ()),
+    # leaves split per grower round: >1 batches histogram work onto the MXU
+    # (K*5 stat lanes -> 128-lane systolic tiles); 1 = strict reference
+    # best-first split order for parity runs; 0 = auto (num_leaves/16,
+    # capped at 25 so K*5 fills exactly one 128-lane tile): batching stays
+    # a small fraction of the frontier, so the split order tracks strict
+    # best-first closely even while histogramming K leaves per pass
+    "tpu_split_batch": ("int", 0, ()),
+    # only batch leaves whose gain >= alpha * the round's best gain (near
+    # ties); keeps batched split order close to strict best-first
+    "tpu_split_batch_alpha": ("float", 0.0, ()),
 }
 
 _ALIAS: Dict[str, str] = {}

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.bin_mapper import MissingType
 from ..io.dataset import TrainingData
-from ..ops.grower import GrowerParams, pad_rows
+from ..ops.grower import GrowerParams, pad_rows, resolve_split_batch
 from ..parallel.mesh import make_mesh
 from ..parallel.strategies import (bins_sharding, make_strategy_grower,
                                    resolve_tree_learner, rows_sharding)
@@ -143,6 +143,9 @@ class TPUTreeLearner:
             cat_smooth=float(config.cat_smooth),
             max_cat_to_onehot=int(config.max_cat_to_onehot),
             min_data_per_group=float(config.min_data_per_group),
+            split_batch=resolve_split_batch(int(config.tpu_split_batch),
+                                            int(config.num_leaves)),
+            split_batch_alpha=float(config.tpu_split_batch_alpha),
         )
         self.grow = make_strategy_grower(
             self.params, self.f_pad, strategy, self.mesh,

@@ -3,51 +3,66 @@
 The reference grows best-first one split at a time with pointer-chasing state
 (reference src/treelearner/serial_tree_learner.cpp:173-237): an LRU histogram
 pool, permuted row-index partitions, and per-leaf OrderedBin re-sorts.  None
-of that maps to XLA.  Here the whole tree is ONE `lax.scan` of num_leaves-1
-steps over fixed-shape tensors:
+of that maps to XLA.  Here the whole tree is ONE `lax.while_loop` over
+BATCHED ROUNDS, each splitting up to `split_batch` leaves at once:
 
 * leaf assignment is an [n] int32 vector (splits become `where` updates, the
   analog of DataPartition::Split, data_partition.hpp:111-163);
-* the smaller/larger-leaf trick + histogram subtraction carries over verbatim
-  as tensor subtraction (serial_tree_learner.cpp:428-437,566-572): each step
-  histograms only the smaller child and derives the larger by subtracting
-  from the parent's pooled histogram;
+* each round picks the top-K leaves by stored best gain (`lax.top_k` over
+  the per-leaf candidate table — K-wide best-first, degenerating to the
+  reference's strict best-first order at split_batch=1), partitions all K
+  leaves' rows in one vectorized pass, and histograms all K smaller
+  children in ONE [F*B, n] x [n, K*S] MXU contraction
+  (ops/histogram.py build_histogram_batched_inline).  Batching exists for
+  the MXU: a single-leaf histogram is an M=8 matmul (~3% MFU measured);
+  K leaves widen the small axis to K*S >= 128 lanes, the whole systolic
+  array lights up, and a tree takes ~254/K passes instead of 254;
+* the smaller/larger-leaf trick + histogram subtraction carries over
+  verbatim as tensor subtraction (serial_tree_learner.cpp:428-437,566-572):
+  each round histograms only the smaller child of every split and derives
+  the sibling from the parent's pooled histogram;
 * the histogram pool is a dense [num_leaves, F, B, 3] tensor (the analog of
   HistogramPool, feature_histogram.hpp:654-831, without the LRU since HBM
   holds it whole);
-* best-split search is the vectorized cumsum+argmax in ops/split.py;
-* step records are emitted as scan outputs; the host assembles the Tree
-  model from them afterwards.
+* best-split search for all 2K children is the vectorized cumsum+argmax of
+  ops/split.py, vmapped over children;
+* step records are written into a fixed [L-1, W] buffer at a dynamic
+  offset; the host assembles the Tree model from ONE fetch afterwards.
 
-Distribution — the same grower body runs under shard_map in three sharded
+The `while_loop` trip count is data-dependent (ceil(254/K) rounds when
+gains stay positive, up to 254 for pathological chain trees), which XLA
+supports natively — no wasted full-data passes on no-op steps.
+
+Distribution — the same round body runs under shard_map in three sharded
 modes, mirroring the reference's parallel tree learners (SURVEY.md §2.3):
 
 * `data_axis` (DataParallelTreeLearner, data_parallel_tree_learner.cpp:
-  149-163): rows sharded; the [F, B, 3] histogram is psum-reduced so every
-  shard sees GLOBAL histograms and makes identical split decisions, while
-  partitioning only its local rows.  XLA lowers the psum to reduce-scatter
-  + all-gather over ICI — the hand-rolled Network::ReduceScatter +
-  HistogramBinEntry::SumReducer disappear into the compiler.
+  149-163): rows sharded; the [K, F, B, 3] smaller-child histograms are
+  psum-reduced so every shard sees GLOBAL histograms and makes identical
+  split decisions, while partitioning only its local rows.  XLA lowers the
+  psum to reduce-scatter + all-gather over ICI — the hand-rolled
+  Network::ReduceScatter + HistogramBinEntry::SumReducer disappear into
+  the compiler.
 * `feature_axis` (FeatureParallelTreeLearner, feature_parallel_tree_
   learner.cpp:23-75): rows replicated, features sharded; each shard
   histograms + searches only its own features, then the global best split
   is an all_gather of per-shard best gains + argmax (replacing
   SyncUpGlobalBestSplit's allreduce-by-max, parallel_tree_learner.h:
-  190-213).  The winning feature's bin column is broadcast with a one-shard
-  psum so every shard partitions identically.
+  190-213).  The winning features' bin columns are broadcast with a
+  one-shard psum so every shard partitions identically.
 * `data_axis` + `voting_k` (VotingParallelTreeLearner, voting_parallel_
   tree_learner.cpp:170-471 / PV-Tree): rows sharded, but only the top-k
-  VOTED features' histograms are aggregated.  Each shard proposes its local
-  top-2k features by gain (computed against LOCAL leaf sums with 1/p-scaled
-  minimum-data thresholds, :58-59); gains are psum-summed per feature (the
-  weighted-gain vote of GlobalVoting, :170-200); the global top-k features'
-  histograms are psum'ed ([k, B, 3] instead of [F, B, 3] — top-k gradient
-  compression on the data axis) and the final search runs on those.
+  VOTED features' histograms are aggregated per leaf.  Each shard proposes
+  its local top-2k features by gain (computed against LOCAL leaf sums with
+  1/p-scaled minimum-data thresholds, :58-59); gains are psum-summed per
+  feature (the weighted-gain vote of GlobalVoting, :170-200); the global
+  top-k features' histograms are psum'ed ([k, B, 3] instead of [F, B, 3] —
+  top-k gradient compression on the data axis) and the final search runs
+  on those.
 
-Cost model: each step is O(n) masked one-hot matmul work regardless of leaf
-size (vs the reference's O(n_leaf)); the subtraction trick halves it.  The
-perf milestone adds leaf-gather compaction; the win is that 500 trees x 254
-splits run with 500 dispatches instead of 127k.
+Cost model: each round is one O(n) batched contraction covering up to K
+splits, so a 255-leaf tree costs ~ (log2(K) + 254/K) full-data passes at
+MXU-shaped operand sizes — versus 254 passes at M=8 shapes before.
 """
 
 from __future__ import annotations
@@ -59,7 +74,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import build_histogram_inline, pack_stats
+from .histogram import (build_histogram_batched_inline, build_histogram_inline,
+                        pack_stats)
 from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
                     per_feature_best_split, per_feature_best_split_categorical,
                     MISSING_NAN, MISSING_ZERO)
@@ -86,6 +102,28 @@ class GrowerParams(NamedTuple):
     cat_smooth: float = 10.0
     max_cat_to_onehot: int = 4
     min_data_per_group: float = 100.0
+    # leaves split per round; 1 = strict reference best-first order
+    split_batch: int = 16
+    # batch only leaves whose gain >= split_batch_alpha * round-max gain:
+    # batching near-ties keeps the split order close to strict best-first
+    # (a child's gain rarely exceeds a near-tie of its parent's round)
+    split_batch_alpha: float = 0.0
+
+
+def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
+    """Auto-pick the per-round split batch K.
+
+    K trades MXU utilization (bigger contraction N axis) against split-order
+    fidelity: each round splits the top-K frontier leaves at once, so
+    keeping K a small fraction of num_leaves means only the very top of the
+    frontier is batched and the order stays close to strict best-first
+    (measured: K=3 at 31 leaves already costs ~0.05 multiclass logloss).
+    Capped at 25: 25 slots x 5 hilo stat rows = 125 -> one padded 128-lane
+    MXU tile.
+    """
+    if split_batch > 0:
+        return split_batch
+    return max(1, min(25, num_leaves // 16))
 
 
 def make_grower(params: GrowerParams, num_features: int,
@@ -106,6 +144,7 @@ def make_grower(params: GrowerParams, num_features: int,
     B = params.num_bins
     F = num_features
     precision = params.precision
+    K = max(1, min(int(params.split_batch), L - 1))
 
     def preduce_scalar(x):
         return jax.lax.psum(x, data_axis) if data_axis else x
@@ -195,17 +234,6 @@ def make_grower(params: GrowerParams, num_features: int,
                 cat_mask=pfc.cat_mask[bi] * c.astype(jnp.float32))
         return gain, fin
 
-    def histogram(bins_pad, stats_pad):
-        nb = bins_pad.shape[0] // params.block_rows if bins_pad.shape[0] >= params.block_rows else 1
-        block = bins_pad.shape[0] // nb
-        return build_histogram_inline(
-            bins_pad.reshape(nb, block, F),
-            stats_pad.reshape(stats_pad.shape[0], nb, block),
-            B, precision)
-
-    def masked_stats(grad, hess, mask):
-        return pack_stats(grad * mask, hess * mask, mask, precision)
-
     def grow(bins_pad: jnp.ndarray,     # [n_pad, F] int32 (rows >= n zero-filled)
              grad: jnp.ndarray,         # [n_pad] f32 (padding rows zero)
              hess: jnp.ndarray,         # [n_pad] f32
@@ -213,6 +241,9 @@ def make_grower(params: GrowerParams, num_features: int,
              feature_mask: jnp.ndarray,  # [F] f32 ([F_global] w/ feature_axis)
              meta: Dict[str, jnp.ndarray]):
         n_pad = bins_pad.shape[0]
+        block = min(params.block_rows, n_pad)
+        nb = max(n_pad // block, 1)
+        block = n_pad // nb
 
         if feature_axis:
             ax = jax.lax.axis_index(feature_axis)
@@ -227,9 +258,10 @@ def make_grower(params: GrowerParams, num_features: int,
             meta_local = meta
             fmask_local = feature_mask
 
-        def select(hist, sg, sh, cnt, min_c=-1e30, max_c=1e30) -> SplitResult:
+        def select(hist, sg, sh, cnt, min_c, max_c) -> SplitResult:
             """Best split across all (global) features for one leaf; the
-            returned feature index is GLOBAL in every mode."""
+            returned feature index is GLOBAL in every mode.  vmapped over
+            children by the round body."""
             if voting_k:
                 # local leaf totals from any one feature's bins (every row
                 # lands in exactly one bin per feature)
@@ -288,16 +320,7 @@ def make_grower(params: GrowerParams, num_features: int,
                     cat_mask=pick(res.cat_mask))
             return res
 
-        def feature_column(f):
-            """Bin column of (global) feature f, on every shard."""
-            if feature_axis:
-                shard = f // F
-                lf = jnp.mod(f, F)
-                own = (ax == shard)
-                col_l = jnp.take(bins_pad, lf, axis=1)
-                return jax.lax.psum(
-                    jnp.where(own, col_l, jnp.zeros_like(col_l)), feature_axis)
-            return jnp.take(bins_pad, f, axis=1)
+        vselect = jax.vmap(select)
 
         # ---- root ----------------------------------------------------
         g = grad * row_mask
@@ -305,13 +328,17 @@ def make_grower(params: GrowerParams, num_features: int,
         sum_g = preduce_scalar(jnp.sum(g))
         sum_h = preduce_scalar(jnp.sum(h))
         cnt = preduce_scalar(jnp.sum(row_mask))
+        # per-tree packed stats, reused by every round's contraction
+        stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
+        S = stats.shape[0]
+        bins_blocks = bins_pad.reshape(nb, block, F)
+        stats_blocks = stats.reshape(S, nb, block)
         root_hist = preduce_hist(
-            histogram(bins_pad, masked_stats(grad, hess, row_mask)))
-        root_split = select(root_hist, sum_g, sum_h, cnt)
+            build_histogram_inline(bins_blocks, stats_blocks, B, precision))
+        big = jnp.float32(1e30)
+        root_split = select(root_hist, sum_g, sum_h, cnt, -big, big)
 
-        def stash(arr, i, val, pred=True):
-            return arr.at[i].set(jnp.where(pred, val, arr[i]))
-
+        RW = REC_WIDTH + (CB if params.has_cat else 0)
         state = {
             "leaf_ids": jnp.zeros(n_pad, jnp.int32),
             "pool": jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(root_hist),
@@ -339,86 +366,137 @@ def make_grower(params: GrowerParams, num_features: int,
             # monotone value constraints per leaf (propagated on split)
             "leaf_min": jnp.full(L, -1e30, jnp.float32),
             "leaf_max": jnp.full(L, 1e30, jnp.float32),
-            "active": jnp.array(True),
+            # records buffer: K slack rows so the last round's full-width
+            # write stays in bounds; trimmed to [L-1] on return
+            "records": jnp.zeros((L - 1 + K, RW), jnp.float32),
+            "n_splits": jnp.int32(0),
         }
 
-        def step(state, s):
-            # pick the leaf with max stored gain (only first s+1 slots filled;
-            # unfilled slots hold K_MIN_SCORE)
+        def cand_gains(state):
             depth_ok = jnp.logical_or(
                 params.max_depth <= 0,
                 state["leaf_depth"] < params.max_depth)
-            cand_gain = jnp.where(depth_ok, state["bs_gain"], K_MIN_SCORE)
-            best_leaf = jnp.argmax(cand_gain).astype(jnp.int32)
-            gain = cand_gain[best_leaf]
-            do = state["active"] & (gain > 0.0)
+            return jnp.where(depth_ok, state["bs_gain"], K_MIN_SCORE)
 
-            f = state["bs_feat"][best_leaf]
-            thr = state["bs_thr"][best_leaf]
-            dleft = state["bs_dleft"][best_leaf]
-            lg = state["bs_lg"][best_leaf]
-            lh = state["bs_lh"][best_leaf]
-            lc = state["bs_lc"][best_leaf]
-            lo = state["bs_lo"][best_leaf]
-            ro = state["bs_ro"][best_leaf]
+        def cond(state):
+            return ((state["n_splits"] < L - 1)
+                    & (jnp.max(cand_gains(state)) > 0.0))
 
-            pg = state["leaf_sum_g"][best_leaf]
-            ph = state["leaf_sum_h"][best_leaf]
-            pc = state["leaf_cnt"][best_leaf]
+        def scatter_set(arr, idx, val, valid):
+            # invalid slots write out of bounds -> dropped
+            safe = jnp.where(valid, idx, arr.shape[0])
+            return arr.at[safe].set(val, mode="drop")
+
+        def body(state):
+            leaf_ids = state["leaf_ids"]
+            vals, sel = jax.lax.top_k(cand_gains(state), K)
+            sel = sel.astype(jnp.int32)
+            kar = jnp.arange(K, dtype=jnp.int32)
+            budget = (L - 1) - state["n_splits"]
+            # vals is sorted descending, so do_k is a prefix mask: records
+            # written this round are contiguous
+            do_k = (vals > 0.0) & (kar < budget)
+            if params.split_batch_alpha > 0.0 and K > 1:
+                # near-tie guard (still a prefix: vals descending); alpha
+                # is clamped below 1 so slot 0 always qualifies and the
+                # while_loop is guaranteed to make progress
+                alpha = min(params.split_batch_alpha, 0.999)
+                do_k &= vals >= alpha * vals[0]
+            num_do = jnp.sum(do_k.astype(jnp.int32))
+            new_ids = state["n_splits"] + 1 + kar
+
+            sel_feat = state["bs_feat"][sel]
+            sel_thr = state["bs_thr"][sel]
+            sel_dleft = state["bs_dleft"][sel]
+            sel_iscat = state["bs_iscat"][sel]
+            cmask_sel = state["bs_catmask"][sel]             # [K, CB]
+            lg = state["bs_lg"][sel]
+            lh = state["bs_lh"][sel]
+            lc = state["bs_lc"][sel]
+            lo = state["bs_lo"][sel]
+            ro = state["bs_ro"][sel]
+            pg = state["leaf_sum_g"][sel]
+            ph = state["leaf_sum_h"][sel]
+            pc = state["leaf_cnt"][sel]
             rg, rh, rc = pg - lg, ph - lh, pc - lc
 
-            # ---- partition (reference dense_bin.hpp Split /
-            # SplitCategorical semantics) ----
-            col = feature_column(f)
-            m_type = meta["missing_type"][f]
-            nb_f = meta["num_bin"][f]
-            db_f = meta["default_bin"][f]
+            # ---- partition all K splits at once (reference dense_bin.hpp
+            # Split / SplitCategorical semantics).  Row->slot resolution is
+            # one [L]-table gather, NOT an [n, K] compare matrix ----
+            leaf_to_slot = jnp.full(L, -1, jnp.int32).at[
+                jnp.where(do_k, sel, L)].set(kar, mode="drop")
+            k_of_r = leaf_to_slot[leaf_ids]                  # [n]
+            valid_r = k_of_r >= 0
+            kk_r = jnp.maximum(k_of_r, 0)
+            if feature_axis:
+                # feature shards own disjoint columns: resolve each row's
+                # winning-feature bin locally, zero rows owned elsewhere,
+                # and psum-broadcast ONE [n] column (not [n, K]) so every
+                # shard partitions identically
+                shard_k = sel_feat // F
+                lf_k = jnp.mod(sel_feat, F)
+                own_r = shard_k[kk_r] == ax
+                col_l = jnp.take_along_axis(
+                    bins_pad, lf_k[kk_r][:, None], axis=1)[:, 0]
+                col_r = jax.lax.psum(
+                    jnp.where(own_r, col_l, 0), feature_axis)
+            else:
+                f_r = sel_feat[kk_r]
+                col_r = jnp.take_along_axis(
+                    bins_pad, f_r[:, None], axis=1)[:, 0]
+            mt_k = meta["missing_type"][sel_feat]
+            nb_k = meta["num_bin"][sel_feat]
+            db_k = meta["default_bin"][sel_feat]
+            mt_r = mt_k[kk_r]
             is_missing = jnp.where(
-                m_type == MISSING_NAN, col == nb_f - 1,
-                jnp.where(m_type == MISSING_ZERO, col == db_f, False))
-            go_left = jnp.where(is_missing, dleft, col <= thr)
-            iscat_s = state["bs_iscat"][best_leaf]
+                mt_r == MISSING_NAN, col_r == nb_k[kk_r] - 1,
+                jnp.where(mt_r == MISSING_ZERO, col_r == db_k[kk_r], False))
+            go_left = jnp.where(is_missing, sel_dleft[kk_r],
+                                col_r <= sel_thr[kk_r])
             if params.has_cat:
                 # bitset membership: bins in the stored mask go left,
                 # everything else (incl. the NaN bin) goes right
                 # (reference CategoricalDecisionInner, tree.h:307-318)
-                cmask = state["bs_catmask"][best_leaf]
-                go_left = jnp.where(iscat_s, cmask[col] > 0.5, go_left)
-            in_leaf = state["leaf_ids"] == best_leaf
-            new_leaf = (s + 1).astype(jnp.int32)
-            leaf_ids = jnp.where(do & in_leaf & (~go_left), new_leaf,
-                                 state["leaf_ids"])
+                cm_r = cmask_sel.reshape(-1)[kk_r * CB + col_r]
+                go_left = jnp.where(sel_iscat[kk_r], cm_r > 0.5, go_left)
+            leaf_ids = jnp.where(valid_r & (~go_left), new_ids[kk_r],
+                                 leaf_ids)
 
-            # ---- histograms: smaller child direct, larger by subtraction
+            # ---- histograms: all K smaller children in one contraction,
+            # siblings by subtraction ----
             smaller_is_left = lc <= rc
-            smaller_id = jnp.where(smaller_is_left, best_leaf, new_leaf)
-            m = ((leaf_ids == smaller_id) & in_leaf).astype(jnp.float32) * row_mask
-            hist_small = preduce_hist(
-                histogram(bins_pad, masked_stats(grad, hess, m)))
-            parent_hist = state["pool"][best_leaf]
+            smaller_ids = jnp.where(
+                do_k, jnp.where(smaller_is_left, sel, new_ids), -1)
+            hist_small = preduce_hist(build_histogram_batched_inline(
+                bins_blocks, stats_blocks, leaf_ids.reshape(nb, block),
+                smaller_ids, B, precision))                  # [K, F, B, 3]
+            parent_hist = state["pool"][sel]                 # [K, F, B, 3]
             hist_large = parent_hist - hist_small
-            hist_left = jnp.where(smaller_is_left, hist_small, hist_large)
-            hist_right = jnp.where(smaller_is_left, hist_large, hist_small)
+            sl = smaller_is_left[:, None, None, None]
+            hist_left = jnp.where(sl, hist_small, hist_large)
+            hist_right = jnp.where(sl, hist_large, hist_small)
 
-            pool = state["pool"]
-            pool = pool.at[best_leaf].set(jnp.where(do, hist_left, parent_hist))
-            pool = pool.at[new_leaf].set(jnp.where(do, hist_right,
-                                                   pool[new_leaf]))
+            pool = scatter_set(state["pool"], sel, hist_left, do_k)
+            pool = scatter_set(pool, new_ids, hist_right, do_k)
 
             # ---- monotone constraint propagation -----------------------
             # (reference serial_tree_learner.cpp:840-851)
-            p_min = state["leaf_min"][best_leaf]
-            p_max = state["leaf_max"][best_leaf]
-            mono_f = meta["monotone"][f]
+            p_min = state["leaf_min"][sel]
+            p_max = state["leaf_max"][sel]
+            mono_k = meta["monotone"][sel_feat]
             mid = (lo + ro) / 2.0
-            l_min = jnp.where(mono_f < 0, mid, p_min)
-            l_max = jnp.where(mono_f > 0, mid, p_max)
-            r_min = jnp.where(mono_f > 0, mid, p_min)
-            r_max = jnp.where(mono_f < 0, mid, p_max)
+            l_min = jnp.where(mono_k < 0, mid, p_min)
+            l_max = jnp.where(mono_k > 0, mid, p_max)
+            r_min = jnp.where(mono_k > 0, mid, p_min)
+            r_max = jnp.where(mono_k < 0, mid, p_max)
 
-            # ---- find best splits for the two children -----------------
-            split_l = select(hist_left, lg, lh, lc, l_min, l_max)
-            split_r = select(hist_right, rg, rh, rc, r_min, r_max)
+            # ---- best splits for all 2K children -----------------------
+            ch = vselect(
+                jnp.concatenate([hist_left, hist_right], axis=0),
+                jnp.concatenate([lg, rg]), jnp.concatenate([lh, rh]),
+                jnp.concatenate([lc, rc]),
+                jnp.concatenate([l_min, r_min]),
+                jnp.concatenate([l_max, r_max]))
 
             new_state = dict(state)
             new_state["leaf_ids"] = leaf_ids
@@ -427,50 +505,40 @@ def make_grower(params: GrowerParams, num_features: int,
                                 ("leaf_cnt", lc, rc), ("leaf_output", lo, ro),
                                 ("leaf_min", l_min, r_min),
                                 ("leaf_max", l_max, r_max)):
-                arr = new_state[key]
-                arr = stash(arr, best_leaf, li, do)
-                arr = stash(arr, new_leaf, ri, do)
-                new_state[key] = arr
-            d = new_state["leaf_depth"]
-            d = stash(d, new_leaf, d[best_leaf] + 1, do)
-            d = stash(d, best_leaf, d[best_leaf] + 1, do)
-            new_state["leaf_depth"] = d
-            for key, lv, rv in (
-                    ("bs_gain", split_l.gain, split_r.gain),
-                    ("bs_feat", split_l.feature, split_r.feature),
-                    ("bs_thr", split_l.threshold, split_r.threshold),
-                    ("bs_dleft", split_l.default_left, split_r.default_left),
-                    ("bs_lg", split_l.left_sum_g, split_r.left_sum_g),
-                    ("bs_lh", split_l.left_sum_h, split_r.left_sum_h),
-                    ("bs_lc", split_l.left_count, split_r.left_count),
-                    ("bs_lo", split_l.left_output, split_r.left_output),
-                    ("bs_ro", split_l.right_output, split_r.right_output),
-                    ("bs_iscat", split_l.is_cat, split_r.is_cat),
-                    ("bs_catmask", split_l.cat_mask, split_r.cat_mask)):
-                arr = new_state[key]
-                arr = stash(arr, best_leaf, lv, do)
-                arr = stash(arr, new_leaf, rv, do)
-                new_state[key] = arr
-            new_state["active"] = do
+                arr = scatter_set(new_state[key], sel, li, do_k)
+                new_state[key] = scatter_set(arr, new_ids, ri, do_k)
+            d_child = state["leaf_depth"][sel] + 1
+            d = scatter_set(state["leaf_depth"], sel, d_child, do_k)
+            new_state["leaf_depth"] = scatter_set(d, new_ids, d_child, do_k)
+            for key, cv in (("bs_gain", ch.gain), ("bs_feat", ch.feature),
+                            ("bs_thr", ch.threshold),
+                            ("bs_dleft", ch.default_left),
+                            ("bs_lg", ch.left_sum_g), ("bs_lh", ch.left_sum_h),
+                            ("bs_lc", ch.left_count), ("bs_lo", ch.left_output),
+                            ("bs_ro", ch.right_output),
+                            ("bs_iscat", ch.is_cat),
+                            ("bs_catmask", ch.cat_mask)):
+                arr = scatter_set(new_state[key], sel, cv[:K], do_k)
+                new_state[key] = scatter_set(arr, new_ids, cv[K:], do_k)
 
-            # pack the step record into one f32 row: a single [L-1, 16(+B)]
-            # array means ONE device->host transfer per tree (transfer
-            # latency, not bandwidth, dominates on tunneled/remote TPU
-            # attachments); cat splits append their bin mask after col 16
+            # ---- records: contiguous [K, W] block at row n_splits -------
             rec = jnp.stack([
-                best_leaf.astype(jnp.float32), f.astype(jnp.float32),
-                thr.astype(jnp.float32), dleft.astype(jnp.float32),
-                gain, lo, ro, lc, rc, lh, rh,
-                state["leaf_output"][best_leaf], ph, pc,
-                do.astype(jnp.float32), iscat_s.astype(jnp.float32)])
+                sel.astype(jnp.float32), sel_feat.astype(jnp.float32),
+                sel_thr.astype(jnp.float32), sel_dleft.astype(jnp.float32),
+                vals, lo, ro, lc, rc, lh, rh,
+                state["leaf_output"][sel], ph, pc,
+                do_k.astype(jnp.float32), sel_iscat.astype(jnp.float32)],
+                axis=1)                                      # [K, 16]
             if params.has_cat:
-                rec = jnp.concatenate(
-                    [rec, state["bs_catmask"][best_leaf]])
-            return new_state, rec
+                rec = jnp.concatenate([rec, cmask_sel], axis=1)
+            new_state["records"] = jax.lax.dynamic_update_slice(
+                state["records"], rec, (state["n_splits"], jnp.int32(0)))
+            new_state["n_splits"] = state["n_splits"] + num_do
+            return new_state
 
-        state, records = jax.lax.scan(step, state, jnp.arange(L - 1))
+        state = jax.lax.while_loop(cond, body, state)
         return {
-            "records": records,      # [L-1, 15] f32, fields per REC_* indices
+            "records": state["records"][:L - 1],  # [L-1, W], REC_* indices
             "leaf_ids": state["leaf_ids"],
             "leaf_output": state["leaf_output"],
             "leaf_cnt": state["leaf_cnt"],
@@ -480,7 +548,7 @@ def make_grower(params: GrowerParams, num_features: int,
     return jax.jit(grow) if jit else grow
 
 
-# record-row field indices (see `rec` stack in make_grower.step); rows are
+# record-row field indices (see `rec` stack in make_grower.body); rows are
 # 16 wide, plus a trailing [B] categorical bin mask when has_cat
 REC_LEAF, REC_FEATURE, REC_THRESHOLD, REC_DEFAULT_LEFT, REC_GAIN, \
     REC_LEFT_OUTPUT, REC_RIGHT_OUTPUT, REC_LEFT_COUNT, REC_RIGHT_COUNT, \

@@ -98,6 +98,31 @@ def probe_default_backend(timeout_s: float = 120.0, retries: int = 1,
     return None
 
 
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
+    """Turn on JAX's persistent compilation cache.
+
+    The whole-tree grower is one large XLA program; a cold compile costs
+    minutes (the analog hit does not exist in the reference, whose C++ is
+    AOT-compiled).  The persistent cache amortizes it to one-time-per-
+    (shape, params, platform): subsequent processes deserialize in seconds.
+    Defaults to `<repo>/.jax_cache` so the cache survives across runs of
+    bench.py / the CLI on the same checkout.
+    """
+    if cache_dir is None:
+        cache_dir = os.environ.get(
+            "LIGHTGBM_TPU_CACHE_DIR",
+            os.path.join(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:  # pragma: no cover - config knobs moved
+        pass
+
+
 def pin_cpu_backend(force_device_count: Optional[int] = None) -> None:
     """Pin this process to the CPU backend; optionally force N virtual
     devices (must run before the first backend initialization)."""

@@ -102,7 +102,8 @@ class TestCategoricalTraining:
                for line in out.stdout.splitlines()
                if "binary_logloss" in line]
         assert lls, out.stdout + out.stderr
-        bst, evals = _train(X, y, rounds=rounds)
+        # strict best-first split order for oracle parity
+        bst, evals = _train(X, y, {"tpu_split_batch": 1}, rounds=rounds)
         mine = next(iter(evals.values()))["binary_logloss"][-1]
         ref = lls[-1]
         assert mine < ref + 0.02, f"logloss {mine} vs oracle {ref}"

@@ -38,9 +38,11 @@ class TestBinaryParity:
         vs = ds.create_valid(binary_example["X_test"],
                              label=binary_example["y_test"], weight=wte)
         res = {}
+        # tpu_split_batch=1: strict best-first split order for oracle parity
         lgb.train({"objective": "binary", "num_leaves": 31,
                    "learning_rate": 0.1, "min_data_in_leaf": 20,
-                   "metric": ["binary_logloss", "auc"]},
+                   "metric": ["binary_logloss", "auc"],
+                   "tpu_split_batch": 1},
                   ds, num_boost_round=50, valid_sets=[ds, vs],
                   valid_names=["training", "valid_1"], verbose_eval=False,
                   evals_result=res)
