@@ -1,0 +1,350 @@
+"""Python-side backend for the native C API shim (src/capi).
+
+The reference stacks ctypes-Python ON TOP of a C++ core (reference
+python-package/lightgbm/basic.py:24-47 binding src/c_api.cpp).  This
+framework's engine is Python/JAX (the XLA program IS the native core), so
+the C ABI layer inverts: `lib_lightgbm_tpu.so` (src/capi/
+lightgbm_tpu_c_api.cpp) embeds CPython and routes each `LGBM_*` call here.
+Handles crossing the ABI are integer ids into `_registry`; raw buffer
+pointers are converted with ctypes/numpy on this side so the C++ stays a
+thin marshalling layer.
+
+Mirrors the behavior of reference src/c_api.cpp:98-320 (Booster wrapper)
+and the dataset creation entry points (reference include/LightGBM/
+c_api.h:52-256).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import itertools
+import threading
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from .basic import Booster, Dataset
+from .config import Config
+
+# C_API_DTYPE_* (reference include/LightGBM/c_api.h:26-35)
+DTYPE_FLOAT32 = 0
+DTYPE_FLOAT64 = 1
+DTYPE_INT32 = 2
+DTYPE_INT64 = 3
+DTYPE_INT8 = 4
+
+_CTYPES = {
+    DTYPE_FLOAT32: ctypes.c_float,
+    DTYPE_FLOAT64: ctypes.c_double,
+    DTYPE_INT32: ctypes.c_int32,
+    DTYPE_INT64: ctypes.c_int64,
+    DTYPE_INT8: ctypes.c_int8,
+}
+
+# C_API_PREDICT_* (c_api.h:37-40)
+PREDICT_NORMAL = 0
+PREDICT_RAW_SCORE = 1
+PREDICT_LEAF_INDEX = 2
+PREDICT_CONTRIB = 3
+
+_registry: Dict[int, object] = {}
+_handles = itertools.count(1)
+_lock = threading.Lock()
+# pinned arrays returned by dataset_get_field: the caller reads the raw
+# pointer after we return, so the array must outlive the call
+_field_pins: Dict[Tuple[int, str], np.ndarray] = {}
+
+
+def _put(obj) -> int:
+    with _lock:
+        h = next(_handles)
+        _registry[h] = obj
+    return h
+
+
+def _get(handle: int):
+    try:
+        return _registry[handle]
+    except KeyError:
+        raise ValueError(f"invalid handle {handle}") from None
+
+
+def free_handle(handle: int) -> None:
+    with _lock:
+        _registry.pop(handle, None)
+        for key in [k for k in _field_pins if k[0] == handle]:
+            _field_pins.pop(key, None)
+
+
+def _params_dict(params_str: str) -> dict:
+    return Config.str_to_map(params_str or "")
+
+
+def _mat_from_ptr(ptr: int, data_type: int, nrow: int, ncol: int,
+                  is_row_major: int) -> np.ndarray:
+    ct = _CTYPES[data_type]
+    buf = ctypes.cast(ptr, ctypes.POINTER(ct))
+    arr = np.ctypeslib.as_array(buf, shape=(nrow * ncol,))
+    if is_row_major:
+        return arr.reshape(nrow, ncol).astype(np.float64)
+    return arr.reshape(ncol, nrow).T.astype(np.float64)
+
+
+def _vec_from_ptr(ptr: int, data_type: int, n: int) -> np.ndarray:
+    ct = _CTYPES[data_type]
+    buf = ctypes.cast(ptr, ctypes.POINTER(ct))
+    return np.ctypeslib.as_array(buf, shape=(n,)).copy()
+
+
+# ---------------------------------------------------------------- dataset
+def dataset_create_from_mat(ptr: int, data_type: int, nrow: int, ncol: int,
+                            is_row_major: int, params: str,
+                            ref_handle: int) -> int:
+    X = _mat_from_ptr(ptr, data_type, nrow, ncol, is_row_major)
+    ref = _get(ref_handle) if ref_handle else None
+    ds = Dataset(X, reference=ref, params=_params_dict(params))
+    ds.construct()
+    return _put(ds)
+
+
+def dataset_create_from_csr(indptr_ptr: int, indptr_type: int, indices_ptr: int,
+                            data_ptr: int, data_type: int, nindptr: int,
+                            nelem: int, num_col: int, params: str,
+                            ref_handle: int) -> int:
+    indptr = _vec_from_ptr(indptr_ptr, indptr_type, nindptr).astype(np.int64)
+    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int64)
+    vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
+    nrow = nindptr - 1
+    # densified (the binned core is dense; EFB re-compresses at bin time):
+    # one vectorized scatter, no per-row Python loop
+    X = np.zeros((nrow, num_col), np.float64)
+    row_of = np.repeat(np.arange(nrow), np.diff(indptr))
+    X[row_of, indices] = vals
+    ref = _get(ref_handle) if ref_handle else None
+    ds = Dataset(X, reference=ref, params=_params_dict(params))
+    ds.construct()
+    return _put(ds)
+
+
+def dataset_create_from_file(filename: str, params: str,
+                             ref_handle: int) -> int:
+    p = _params_dict(params)
+    from .io.parser import load_text_file
+
+    X, y, weight, group, _, _ = load_text_file(
+        filename, label_column=str(p.get("label_column", "")))
+    ref = _get(ref_handle) if ref_handle else None
+    ds = Dataset(X, label=y, weight=weight, group=group, reference=ref,
+                 params=p)
+    ds.construct()
+    return _put(ds)
+
+
+def dataset_num_data(handle: int) -> int:
+    return int(_get(handle).num_data())
+
+
+def dataset_num_feature(handle: int) -> int:
+    return int(_get(handle).num_feature())
+
+
+def dataset_set_field(handle: int, name: str, ptr: int, n: int,
+                      data_type: int) -> None:
+    ds = _get(handle)
+    data = _vec_from_ptr(ptr, data_type, n) if n > 0 else None
+    ds.set_field(name, data)
+
+
+def dataset_get_field(handle: int, name: str) -> Tuple[int, int, int]:
+    """(ptr, len, dtype) of the pinned field array; (0, 0, -1) if absent."""
+    ds = _get(handle)
+    data = ds.get_field(name)
+    if data is None:
+        return 0, 0, -1
+    if name == "group":
+        arr = np.ascontiguousarray(data, dtype=np.int32)
+        dt = DTYPE_INT32
+    else:
+        arr = np.ascontiguousarray(data, dtype=np.float32)
+        dt = DTYPE_FLOAT32
+    _field_pins[(handle, name)] = arr
+    return arr.ctypes.data, int(arr.shape[0]), dt
+
+
+def dataset_save_binary(handle: int, filename: str) -> None:
+    raise NotImplementedError("binary dataset cache not supported yet")
+
+
+# ---------------------------------------------------------------- booster
+def booster_create(train_handle: int, params: str) -> int:
+    ds = _get(train_handle)
+    bst = Booster(params=_params_dict(params), train_set=ds)
+    return _put(bst)
+
+
+def booster_create_from_modelfile(filename: str) -> Tuple[int, int]:
+    bst = Booster(model_file=filename)
+    return _put(bst), int(bst.current_iteration)
+
+
+def booster_load_from_string(model_str: str) -> Tuple[int, int]:
+    bst = Booster(model_str=model_str)
+    return _put(bst), int(bst.current_iteration)
+
+
+def booster_add_valid(bh: int, dh: int) -> None:
+    bst = _get(bh)
+    n = len(bst._valid_names) + 1
+    bst.add_valid(_get(dh), f"valid_{n}")
+
+
+def booster_num_classes(bh: int) -> int:
+    return int(_get(bh).num_model_per_iteration())
+
+
+def booster_update(bh: int) -> int:
+    finished = _get(bh).update()
+    return 1 if finished else 0
+
+
+def booster_update_custom(bh: int, grad_ptr: int, hess_ptr: int) -> int:
+    bst = _get(bh)
+    n = bst._train_set.num_data() * bst.num_model_per_iteration()
+    grad = _vec_from_ptr(grad_ptr, DTYPE_FLOAT32, n).astype(np.float64)
+    hess = _vec_from_ptr(hess_ptr, DTYPE_FLOAT32, n).astype(np.float64)
+    finished = bst.update(fobj=lambda score, ds: (grad, hess))
+    return 1 if finished else 0
+
+
+def booster_rollback(bh: int) -> None:
+    _get(bh).rollback_one_iter()
+
+
+def booster_current_iteration(bh: int) -> int:
+    return int(_get(bh).current_iteration)
+
+
+def booster_num_total_model(bh: int) -> int:
+    return int(_get(bh).num_trees())
+
+
+def booster_num_feature(bh: int) -> int:
+    return int(_get(bh).num_feature())
+
+
+def _eval_results(bst: Booster, data_idx: int) -> List[Tuple[str, float]]:
+    if data_idx == 0:
+        res = bst.eval_train()
+    else:
+        res = [r for r in bst.eval_valid()
+               if r[0] == f"valid_{data_idx}"]
+    return [(r[1], float(r[2])) for r in res]
+
+
+def booster_eval_counts(bh: int) -> int:
+    return len(_eval_results(_get(bh), 0))
+
+
+def booster_get_eval(bh: int, data_idx: int, out_ptr: int) -> int:
+    res = _eval_results(_get(bh), data_idx)
+    out = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_double)),
+        shape=(len(res),))
+    for i, (_, v) in enumerate(res):
+        out[i] = v
+    return len(res)
+
+
+def booster_get_eval_names(bh: int) -> str:
+    return "\n".join(name for name, _ in _eval_results(_get(bh), 0))
+
+
+def booster_predict_for_mat(bh: int, ptr: int, data_type: int, nrow: int,
+                            ncol: int, is_row_major: int, predict_type: int,
+                            num_iteration: int, params: str,
+                            out_ptr: int) -> int:
+    bst = _get(bh)
+    X = _mat_from_ptr(ptr, data_type, nrow, ncol, is_row_major)
+    ni = num_iteration if num_iteration > 0 else None
+    kw = {}
+    if predict_type == PREDICT_RAW_SCORE:
+        kw["raw_score"] = True
+    elif predict_type == PREDICT_LEAF_INDEX:
+        kw["pred_leaf"] = True
+    elif predict_type == PREDICT_CONTRIB:
+        kw["pred_contrib"] = True
+    pred = np.asarray(bst.predict(X, num_iteration=ni, **kw),
+                      dtype=np.float64).reshape(-1)
+    out = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_double)),
+        shape=(pred.shape[0],))
+    out[:] = pred
+    return int(pred.shape[0])
+
+
+def booster_calc_num_predict(bh: int, nrow: int, predict_type: int,
+                             num_iteration: int) -> int:
+    bst = _get(bh)
+    k = bst.num_model_per_iteration()
+    if predict_type == PREDICT_LEAF_INDEX:
+        ni = num_iteration if num_iteration > 0 else max(
+            1, bst.num_trees() // max(k, 1))
+        return nrow * k * ni
+    if predict_type == PREDICT_CONTRIB:
+        return nrow * k * (bst.num_feature() + 1)
+    return nrow * k
+
+
+def booster_save_model(bh: int, num_iteration: int, filename: str) -> None:
+    ni = num_iteration if num_iteration > 0 else None
+    _get(bh).save_model(filename, num_iteration=ni)
+
+
+def booster_save_to_string(bh: int, num_iteration: int) -> str:
+    ni = num_iteration if num_iteration > 0 else None
+    return _get(bh).model_to_string(num_iteration=ni)
+
+
+def booster_dump_model(bh: int, num_iteration: int) -> str:
+    import json
+
+    ni = num_iteration if num_iteration > 0 else None
+    return json.dumps(_get(bh).dump_model(num_iteration=ni))
+
+
+def booster_feature_importance(bh: int, num_iteration: int,
+                               importance_type: int, out_ptr: int) -> int:
+    bst = _get(bh)
+    itype = "split" if importance_type == 0 else "gain"
+    imp = np.asarray(bst.feature_importance(importance_type=itype),
+                     dtype=np.float64)
+    out = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_double)),
+        shape=(imp.shape[0],))
+    out[:] = imp
+    return int(imp.shape[0])
+
+
+# ---------------------------------------------------------------- network
+_network: Dict[str, int] = {"num_machines": 1, "rank": 0}
+
+
+def network_init(machines: str, local_listen_port: int, listen_time_out: int,
+                 num_machines: int) -> None:
+    """Record the network config; the actual collective transport is the
+    jax.distributed / mesh layer (reference LGBM_NetworkInit c_api.h:999
+    maps to Linkers; here ICI/DCN collectives are compiled into the XLA
+    program, so init only validates and stores the topology request)."""
+    if num_machines > 1:
+        from .parallel.mesh import available_devices
+
+        if num_machines > available_devices():
+            raise ValueError(
+                f"num_machines={num_machines} exceeds available devices")
+    _network["num_machines"] = int(num_machines)
+    _network["rank"] = 0
+
+
+def network_free() -> None:
+    _network["num_machines"] = 1
+    _network["rank"] = 0

@@ -1,0 +1,16 @@
+#!/bin/sh
+# Build lib_lightgbm_tpu.so — the native LGBM_* C ABI shim.
+# Usage: src/capi/build.sh [outdir]   (default: repo root)
+set -e
+HERE="$(cd "$(dirname "$0")" && pwd)"
+ROOT="$(cd "$HERE/../.." && pwd)"
+OUT="${1:-$ROOT}"
+PYINC="$(python3 -c 'import sysconfig; print(sysconfig.get_paths()["include"])')"
+PYLIBDIR="$(python3 -c 'import sysconfig; print(sysconfig.get_config_var("LIBDIR"))')"
+PYLIB="$(python3 -c 'import sysconfig; v=sysconfig.get_config_var("LDVERSION"); print("python"+v)')"
+g++ -O2 -fPIC -shared -std=c++17 \
+    -I"$PYINC" \
+    "$HERE/lightgbm_tpu_c_api.cpp" \
+    -L"$PYLIBDIR" -l"$PYLIB" \
+    -o "$OUT/lib_lightgbm_tpu.so"
+echo "built $OUT/lib_lightgbm_tpu.so"

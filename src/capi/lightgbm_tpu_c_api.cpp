@@ -1,0 +1,658 @@
+// lib_lightgbm_tpu.so — the LGBM_* C ABI for the TPU-native framework.
+//
+// Role mirror of reference src/c_api.cpp (the ABI consumed by the Python /
+// R / SWIG bindings and external integrations, reference
+// include/LightGBM/c_api.h:52-1018) with the stack inverted: the compute
+// engine here is Python+JAX (the XLA executable is the native core), so
+// this C++ layer embeds CPython and marshals each call into
+// lightgbm_tpu.capi_support.  Handles are integer ids owned by the Python
+// registry; buffers cross as raw pointers and are wrapped with numpy on
+// the Python side.
+//
+// Error contract matches the reference: every entry point returns 0/-1 and
+// LGBM_GetLastError() returns the last failure message (thread-local, like
+// the reference's error ring, c_api.h:40).
+//
+// Build: see src/capi/build.sh (g++ -shared against libpython).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+namespace {
+
+thread_local std::string g_last_error = "everything is fine";
+std::once_flag g_init_flag;
+PyObject* g_support = nullptr;  // lightgbm_tpu.capi_support module
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+// Initialize the embedded interpreter exactly once.  When the host process
+// already runs Python (e.g. a ctypes test), reuse its interpreter and only
+// import the support module under the GIL.
+void ensure_python() {
+  std::call_once(g_init_flag, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // embedded host: release the GIL acquired by Py_Initialize so that
+      // PyGILState_Ensure works from any caller thread
+      PyEval_SaveThread();
+    }
+    PyGILState_STATE st = PyGILState_Ensure();
+    // make the package importable: LIGHTGBM_TPU_PYROOT or this .so's repo
+    const char* root = std::getenv("LIGHTGBM_TPU_PYROOT");
+    PyObject* sys_path = PySys_GetObject("path");
+    if (root && sys_path) {
+      PyObject* p = PyUnicode_FromString(root);
+      PyList_Insert(sys_path, 0, p);
+      Py_DECREF(p);
+    }
+    g_support = PyImport_ImportModule("lightgbm_tpu.capi_support");
+    if (!g_support) {
+      PyErr_Print();
+    }
+    PyGILState_Release(st);
+  });
+}
+
+std::string py_error_string() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = "unknown python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  if (trace) {  // append the traceback for diagnosability
+    PyObject* tb_mod = PyImport_ImportModule("traceback");
+    if (tb_mod) {
+      PyObject* lines = PyObject_CallMethod(tb_mod, "format_exception",
+                                            "OOO", type, value, trace);
+      if (lines) {
+        PyObject* sep = PyUnicode_FromString("");
+        PyObject* joined = PyUnicode_Join(sep, lines);
+        if (joined) {
+          const char* c = PyUnicode_AsUTF8(joined);
+          if (c) msg = c;
+          Py_DECREF(joined);
+        }
+        Py_DECREF(sep);
+        Py_DECREF(lines);
+      }
+      Py_DECREF(tb_mod);
+    }
+    PyErr_Clear();
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return msg;
+}
+
+// Call capi_support.<fn>(args...) under the GIL; returns a NEW reference or
+// nullptr (error already recorded).
+PyObject* call_support(const char* fn, const char* fmt, ...) {
+  ensure_python();
+  if (!g_support) {
+    set_error("lightgbm_tpu.capi_support import failed "
+              "(set LIGHTGBM_TPU_PYROOT to the repo root)");
+    return nullptr;
+  }
+  PyGILState_STATE st = PyGILState_Ensure();
+  PyObject* callee = PyObject_GetAttrString(g_support, fn);
+  PyObject* result = nullptr;
+  if (callee) {
+    va_list va;
+    va_start(va, fmt);
+    PyObject* args = Py_VaBuildValue(fmt, va);
+    va_end(va);
+    if (args) {
+      result = PyObject_CallObject(callee, args);
+      Py_DECREF(args);
+    }
+    Py_DECREF(callee);
+  }
+  if (!result) {
+    set_error(py_error_string());
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  return result;  // caller must take GIL again to DECREF… see drop()
+}
+
+// DECREF helper that re-takes the GIL (call_support released it).
+void drop(PyObject* o) {
+  if (!o) return;
+  PyGILState_STATE st = PyGILState_Ensure();
+  Py_DECREF(o);
+  PyGILState_Release(st);
+}
+
+long long as_int(PyObject* o, bool* ok) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  long long v = PyLong_AsLongLong(o);
+  *ok = !PyErr_Occurred();
+  if (!*ok) {
+    set_error(py_error_string());
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  return v;
+}
+
+// unpack an (a, b) int tuple
+bool as_int2(PyObject* o, long long* a, long long* b) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  bool ok = false;
+  if (PyTuple_Check(o) && PyTuple_Size(o) == 2) {
+    *a = PyLong_AsLongLong(PyTuple_GetItem(o, 0));
+    *b = PyLong_AsLongLong(PyTuple_GetItem(o, 1));
+    ok = !PyErr_Occurred();
+  }
+  if (!ok) {
+    set_error("expected (int, int) result");
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  return ok;
+}
+
+std::string as_str(PyObject* o, bool* ok) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  std::string out;
+  const char* c = PyUnicode_AsUTF8(o);
+  *ok = (c != nullptr);
+  if (c) out = c;
+  else {
+    set_error(py_error_string());
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  return out;
+}
+
+inline void* to_handle(long long id) {
+  return reinterpret_cast<void*>(static_cast<intptr_t>(id));
+}
+inline long long from_handle(const void* h) {
+  return static_cast<long long>(reinterpret_cast<intptr_t>(h));
+}
+
+}  // namespace
+
+LGBM_EXPORT const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+// ------------------------------------------------------------------ dataset
+
+LGBM_EXPORT int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major,
+                                          const char* parameters,
+                                          const DatasetHandle reference,
+                                          DatasetHandle* out) {
+  PyObject* r = call_support("dataset_create_from_mat", "(LiiiisL)",
+                             (long long)(intptr_t)data, data_type,
+                             (int)nrow, (int)ncol, is_row_major,
+                             parameters ? parameters : "",
+                             from_handle(reference));
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSR(
+    const void* indptr, int indptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t nindptr, int64_t nelem,
+    int64_t num_col, const char* parameters, const DatasetHandle reference,
+    DatasetHandle* out) {
+  PyObject* r = call_support(
+      "dataset_create_from_csr", "(LiLLiLLLsL)",
+      (long long)(intptr_t)indptr, indptr_type,
+      (long long)(intptr_t)indices, (long long)(intptr_t)data, data_type,
+      (long long)nindptr, (long long)nelem, (long long)num_col,
+      parameters ? parameters : "", from_handle(reference));
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
+                                           const char* parameters,
+                                           const DatasetHandle reference,
+                                           DatasetHandle* out) {
+  PyObject* r = call_support("dataset_create_from_file", "(ssL)", filename,
+                             parameters ? parameters : "",
+                             from_handle(reference));
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
+  PyObject* r = call_support("free_handle", "(L)", from_handle(handle));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  PyObject* r = call_support("dataset_num_data", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = (int32_t)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetNumFeature(DatasetHandle handle,
+                                          int32_t* out) {
+  PyObject* r =
+      call_support("dataset_num_feature", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = (int32_t)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetSetField(DatasetHandle handle,
+                                     const char* field_name,
+                                     const void* field_data, int num_element,
+                                     int type) {
+  PyObject* r = call_support("dataset_set_field", "(LsLii)",
+                             from_handle(handle), field_name,
+                             (long long)(intptr_t)field_data, num_element,
+                             type);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetField(DatasetHandle handle,
+                                     const char* field_name, int* out_len,
+                                     const void** out_ptr, int* out_type) {
+  PyObject* r = call_support("dataset_get_field", "(Ls)",
+                             from_handle(handle), field_name);
+  if (!r) return -1;
+  PyGILState_STATE st = PyGILState_Ensure();
+  bool ok = PyTuple_Check(r) && PyTuple_Size(r) == 3;
+  long long ptr = 0, len = 0, dt = -1;
+  if (ok) {
+    ptr = PyLong_AsLongLong(PyTuple_GetItem(r, 0));
+    len = PyLong_AsLongLong(PyTuple_GetItem(r, 1));
+    dt = PyLong_AsLongLong(PyTuple_GetItem(r, 2));
+    ok = !PyErr_Occurred();
+  }
+  if (!ok) {
+    set_error("dataset_get_field returned malformed tuple");
+    PyErr_Clear();
+  }
+  Py_DECREF(r);
+  PyGILState_Release(st);
+  if (!ok) return -1;
+  *out_ptr = reinterpret_cast<const void*>(static_cast<intptr_t>(ptr));
+  *out_len = (int)len;
+  *out_type = (int)dt;
+  return 0;
+}
+
+// ------------------------------------------------------------------ booster
+
+LGBM_EXPORT int LGBM_BoosterCreate(const DatasetHandle train_data,
+                                   const char* parameters,
+                                   BoosterHandle* out) {
+  PyObject* r = call_support("booster_create", "(Ls)",
+                             from_handle(train_data),
+                             parameters ? parameters : "");
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  PyObject* r = call_support("booster_create_from_modelfile", "(s)", filename);
+  if (!r) return -1;
+  long long h, iters;
+  bool ok = as_int2(r, &h, &iters);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  *out_num_iterations = (int)iters;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                                int* out_num_iterations,
+                                                BoosterHandle* out) {
+  PyObject* r = call_support("booster_load_from_string", "(s)", model_str);
+  if (!r) return -1;
+  long long h, iters;
+  bool ok = as_int2(r, &h, &iters);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  *out_num_iterations = (int)iters;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterFree(BoosterHandle handle) {
+  PyObject* r = call_support("free_handle", "(L)", from_handle(handle));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                         const DatasetHandle valid_data) {
+  PyObject* r = call_support("booster_add_valid", "(LL)",
+                             from_handle(handle), from_handle(valid_data));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumClasses(BoosterHandle handle,
+                                          int* out_len) {
+  PyObject* r =
+      call_support("booster_num_classes", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                          int* is_finished) {
+  PyObject* r = call_support("booster_update", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *is_finished = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                                const float* grad,
+                                                const float* hess,
+                                                int* is_finished) {
+  PyObject* r = call_support("booster_update_custom", "(LLL)",
+                             from_handle(handle), (long long)(intptr_t)grad,
+                             (long long)(intptr_t)hess);
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *is_finished = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  PyObject* r = call_support("booster_rollback", "(L)", from_handle(handle));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                                int* out_iteration) {
+  PyObject* r =
+      call_support("booster_current_iteration", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_iteration = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                               int* out_models) {
+  PyObject* r =
+      call_support("booster_num_total_model", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_models = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
+  PyObject* r =
+      call_support("booster_num_feature", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalCounts(BoosterHandle handle,
+                                          int* out_len) {
+  PyObject* r =
+      call_support("booster_eval_counts", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEvalNames(BoosterHandle handle, int* out_len,
+                                         char** out_strs) {
+  PyObject* r =
+      call_support("booster_get_eval_names", "(L)", from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  std::string joined = as_str(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  int n = 0;
+  size_t start = 0;
+  while (start <= joined.size() && !joined.empty()) {
+    size_t end = joined.find('\n', start);
+    std::string item = joined.substr(
+        start, end == std::string::npos ? std::string::npos : end - start);
+    std::strcpy(out_strs[n++], item.c_str());
+    if (end == std::string::npos) break;
+    start = end + 1;
+  }
+  *out_len = n;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                                    int* out_len, double* out_results) {
+  PyObject* r = call_support("booster_get_eval", "(LiL)",
+                             from_handle(handle), data_idx,
+                             (long long)(intptr_t)out_results);
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                                           int predict_type,
+                                           int num_iteration,
+                                           int64_t* out_len) {
+  PyObject* r = call_support("booster_calc_num_predict", "(Liii)",
+                             from_handle(handle), num_row, predict_type,
+                             num_iteration);
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMat(BoosterHandle handle,
+                                          const void* data, int data_type,
+                                          int32_t nrow, int32_t ncol,
+                                          int is_row_major, int predict_type,
+                                          int num_iteration,
+                                          const char* parameter,
+                                          int64_t* out_len,
+                                          double* out_result) {
+  PyObject* r = call_support(
+      "booster_predict_for_mat", "(LLiiiiiisL)", from_handle(handle),
+      (long long)(intptr_t)data, data_type, (int)nrow, (int)ncol,
+      is_row_major, predict_type, num_iteration, parameter ? parameter : "",
+      (long long)(intptr_t)out_result);
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModel(BoosterHandle handle, int num_iteration,
+                                      const char* filename) {
+  PyObject* r = call_support("booster_save_model", "(Lis)",
+                             from_handle(handle), num_iteration, filename);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                              int num_iteration,
+                                              int64_t buffer_len,
+                                              int64_t* out_len,
+                                              char* out_str) {
+  PyObject* r = call_support("booster_save_to_string", "(Li)",
+                             from_handle(handle), num_iteration);
+  if (!r) return -1;
+  bool ok;
+  std::string s = as_str(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = (int64_t)s.size() + 1;
+  if ((int64_t)s.size() + 1 <= buffer_len && out_str) {
+    std::memcpy(out_str, s.c_str(), s.size() + 1);
+  }
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterDumpModel(BoosterHandle handle, int num_iteration,
+                                      int64_t buffer_len, int64_t* out_len,
+                                      char* out_str) {
+  PyObject* r = call_support("booster_dump_model", "(Li)",
+                             from_handle(handle), num_iteration);
+  if (!r) return -1;
+  bool ok;
+  std::string s = as_str(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = (int64_t)s.size() + 1;
+  if ((int64_t)s.size() + 1 <= buffer_len && out_str) {
+    std::memcpy(out_str, s.c_str(), s.size() + 1);
+  }
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                              int num_iteration,
+                                              int importance_type,
+                                              double* out_results) {
+  PyObject* r = call_support("booster_feature_importance", "(LiiL)",
+                             from_handle(handle), num_iteration,
+                             importance_type,
+                             (long long)(intptr_t)out_results);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+// ------------------------------------------------------------------ network
+
+LGBM_EXPORT int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                                 int listen_time_out, int num_machines) {
+  PyObject* r = call_support("network_init", "(siii)",
+                             machines ? machines : "", local_listen_port,
+                             listen_time_out, num_machines);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_NetworkFree() {
+  PyObject* r = call_support("network_free", "()");
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+// External collective injection (reference c_api.h:1018
+// LGBM_NetworkInitWithFunctions).  The reference swaps its socket
+// reduce-scatter/allgather for caller-supplied function pointers; here the
+// collectives are XLA programs compiled against a mesh, so injected host
+// function pointers cannot participate in the compiled path.  Accept a
+// single-machine no-op (rank 0 / num_machines 1) for wrapper compatibility
+// and reject real multi-machine injection loudly.
+LGBM_EXPORT int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
+                                              void* reduce_scatter_ext_fun,
+                                              void* allgather_ext_fun) {
+  (void)reduce_scatter_ext_fun;
+  (void)allgather_ext_fun;
+  if (num_machines <= 1) return 0;
+  set_error(
+      "LGBM_NetworkInitWithFunctions: host-side collective injection is "
+      "incompatible with compiled XLA collectives; configure a device mesh "
+      "(num_machines/machines) instead");
+  return -1;
+}
