@@ -1,8 +1,25 @@
-"""Training callbacks (reference python-package/lightgbm/callback.py:55-219)."""
+"""Training callbacks.
+
+Plays the role of reference python-package/lightgbm/callback.py (the
+engine's per-iteration hook system), re-derived for this framework:
+callbacks here are small callable OBJECTS carrying their own state, not
+closure bundles.  The engine contract is shared with the reference so user
+callbacks port over unchanged:
+
+* a callback is called with a `CallbackEnv` after (or, when its
+  `before_iteration` attribute is true, before) every boosting iteration;
+* `order` sorts multiple callbacks within one iteration;
+* raising `EarlyStopException` stops the training loop, carrying the best
+  iteration + its evaluation snapshot.
+
+Evaluation entries are `(dataset_name, metric_name, value,
+higher_is_better)` tuples, with a 5th stdv element in cv runs.
+"""
 
 from __future__ import annotations
 
 import collections
+import math
 from typing import Any, Callable, Dict, List
 
 CallbackEnv = collections.namedtuple(
@@ -18,122 +35,184 @@ class EarlyStopException(Exception):
         self.best_score = best_score
 
 
+def _entry_text(entry, show_stdv: bool = True) -> str:
+    """One eval tuple -> report text (cv entries carry a trailing stdv)."""
+    name, metric, value = entry[0], entry[1], entry[2]
+    if len(entry) == 5 and show_stdv:
+        return f"{name}'s {metric}: {value:g} + {entry[4]:g}"
+    if len(entry) not in (4, 5):
+        raise ValueError("Wrong metric value")
+    return f"{name}'s {metric}: {value:g}"
+
+
+def _report(entries, show_stdv: bool = True) -> str:
+    return "\t".join(_entry_text(e, show_stdv) for e in entries)
+
+
+class _LogEvaluation:
+    order = 10
+    before_iteration = False
+
+    def __init__(self, period: int, show_stdv: bool):
+        self.period = period
+        self.show_stdv = show_stdv
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if self.period <= 0 or not env.evaluation_result_list:
+            return
+        done = env.iteration + 1
+        if done % self.period == 0:
+            print(f"[{done}]\t"
+                  f"{_report(env.evaluation_result_list, self.show_stdv)}")
+
+
 def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
-    def _callback(env: CallbackEnv) -> None:
-        if period > 0 and env.evaluation_result_list and \
-                (env.iteration + 1) % period == 0:
-            result = "\t".join(
-                _format_eval_result(x, show_stdv) for x in env.evaluation_result_list)
-            print(f"[{env.iteration + 1}]\t{result}")
-    _callback.order = 10
-    return _callback
+    return _LogEvaluation(period, show_stdv)
 
 
 # back-compat alias matching the reference's print_evaluation
 print_evaluation = log_evaluation
 
 
-def _format_eval_result(value, show_stdv: bool = True) -> str:
-    if len(value) == 4:
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    if len(value) == 5:
-        if show_stdv:
-            return f"{value[0]}'s {value[1]}: {value[2]:g} + {value[4]:g}"
-        return f"{value[0]}'s {value[1]}: {value[2]:g}"
-    raise ValueError("Wrong metric value")
+class _RecordEvaluation:
+    order = 20
+    before_iteration = False
+
+    def __init__(self, target: Dict[str, Dict[str, List[float]]]):
+        self.target = target
+
+    def __call__(self, env: CallbackEnv) -> None:
+        for entry in env.evaluation_result_list:
+            series = self.target.setdefault(
+                entry[0], collections.OrderedDict()).setdefault(entry[1], [])
+            series.append(entry[2])
 
 
-def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callable:
+def record_evaluation(eval_result: Dict) -> Callable:
     if not isinstance(eval_result, dict):
         raise TypeError("eval_result should be a dict")
     eval_result.clear()
+    return _RecordEvaluation(eval_result)
 
-    def _callback(env: CallbackEnv) -> None:
-        for item in env.evaluation_result_list:
-            data_name, eval_name = item[0], item[1]
-            eval_result.setdefault(data_name, collections.OrderedDict())
-            eval_result[data_name].setdefault(eval_name, [])
-            eval_result[data_name][eval_name].append(item[2])
-    _callback.order = 20
-    return _callback
+
+class _ResetParameter:
+    order = 10
+    before_iteration = True
+
+    def __init__(self, schedules: Dict[str, Any]):
+        self.schedules = schedules
+
+    def __call__(self, env: CallbackEnv) -> None:
+        step = env.iteration - env.begin_iteration
+        updates = {}
+        for key, sched in self.schedules.items():
+            if isinstance(sched, list):
+                if len(sched) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"length of list {key!r} must equal num_boost_round")
+                updates[key] = sched[step]
+            elif callable(sched):
+                updates[key] = sched(step)
+        if updates:
+            env.model.reset_parameter(updates)
 
 
 def reset_parameter(**kwargs: Any) -> Callable:
-    def _callback(env: CallbackEnv) -> None:
-        new_params = {}
-        for key, value in kwargs.items():
-            if isinstance(value, list):
-                if len(value) != env.end_iteration - env.begin_iteration:
-                    raise ValueError(f"length of list {key!r} must equal num_boost_round")
-                new_params[key] = value[env.iteration - env.begin_iteration]
-            elif callable(value):
-                new_params[key] = value(env.iteration - env.begin_iteration)
-        if new_params:
-            env.model.reset_parameter(new_params)
-    _callback.before_iteration = True
-    _callback.order = 10
-    return _callback
+    return _ResetParameter(kwargs)
+
+
+class _SeriesState:
+    """Best-so-far tracker for one (dataset, metric) series."""
+
+    __slots__ = ("maximize", "value", "round", "snapshot")
+
+    def __init__(self, maximize: bool):
+        self.maximize = maximize
+        self.value = -math.inf if maximize else math.inf
+        self.round = 0
+        self.snapshot = None
+
+    def offer(self, value: float, iteration: int, entries) -> None:
+        better = (value > self.value) if self.maximize else (value < self.value)
+        if self.snapshot is None or better:
+            self.value = value
+            self.round = iteration
+            self.snapshot = list(entries)
+
+
+class _EarlyStopping:
+    """Stop when no watched series improves for `stopping_rounds` rounds.
+
+    Matches the reference semantics (dart disables stopping; the training
+    set's own metrics never trigger a stop while validation sets exist;
+    `first_metric_only` restricts triggering to the first metric) without
+    its code shape: state lives in per-series `_SeriesState` objects
+    created on the first evaluated iteration.
+    """
+
+    order = 30
+    before_iteration = False
+
+    def __init__(self, stopping_rounds: int, first_metric_only: bool,
+                 verbose: bool):
+        if stopping_rounds <= 0:
+            raise ValueError("stopping_rounds must be > 0")
+        self.patience = stopping_rounds
+        self.first_metric_only = first_metric_only
+        self.verbose = verbose
+        self.series: List[_SeriesState] = []
+        self.active = True
+        self.primed = False
+        self.first_metric = ""
+
+    def _say(self, text: str) -> None:
+        if self.verbose:
+            print(text)
+
+    def _prime(self, env: CallbackEnv) -> None:
+        self.primed = True
+        booster_mode = next(
+            (env.params[a] for a in ("boosting", "boosting_type", "boost")
+             if a in env.params), "gbdt")
+        if booster_mode == "dart":
+            self.active = False
+            self._say("Early stopping is not available in dart mode")
+            return
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one validation set is required")
+        self.first_metric = env.evaluation_result_list[0][1]
+        self.series = [_SeriesState(maximize=bool(e[3]))
+                       for e in env.evaluation_result_list]
+        self._say(f"Training until validation scores don't improve for "
+                  f"{self.patience} rounds")
+
+    def _halt(self, state: _SeriesState, reason: str) -> None:
+        self._say(f"{reason}\n[{state.round + 1}]\t"
+                  f"{_report(state.snapshot)}")
+        raise EarlyStopException(state.round, state.snapshot)
+
+    def __call__(self, env: CallbackEnv) -> None:
+        if not self.primed:
+            self._prime(env)
+        if not self.active:
+            return
+        last_round = env.iteration == env.end_iteration - 1
+        for state, entry in zip(self.series, env.evaluation_result_list):
+            state.offer(entry[2], env.iteration, env.evaluation_result_list)
+            if self.first_metric_only and entry[1] != self.first_metric:
+                continue
+            if entry[0] == "training" and len(self.series) > 1:
+                # train metrics are reported but never trigger a stop
+                # while real validation sets exist
+                continue
+            if env.iteration - state.round >= self.patience:
+                self._halt(state, "Early stopping, best iteration is:")
+            if last_round:
+                self._halt(state,
+                           "Did not meet early stopping. Best iteration is:")
 
 
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
-    best_score: List[float] = []
-    best_iter: List[int] = []
-    best_score_list: List[List] = []
-    cmp_op: List[Callable] = []
-    enabled: List[bool] = [True]
-    first_metric: List[str] = [""]
-
-    def _init(env: CallbackEnv) -> None:
-        enabled[0] = not any(
-            env.params.get(alias, "") == "dart"
-            for alias in ("boosting", "boosting_type", "boost"))
-        if not enabled[0]:
-            if verbose:
-                print("Early stopping is not available in dart mode")
-            return
-        if not env.evaluation_result_list:
-            raise ValueError("For early stopping, at least one validation set is required")
-        if verbose:
-            print(f"Training until validation scores don't improve for "
-                  f"{stopping_rounds} rounds")
-        first_metric[0] = env.evaluation_result_list[0][1]
-        for item in env.evaluation_result_list:
-            best_iter.append(0)
-            best_score_list.append(None)
-            if item[3]:  # higher is better
-                best_score.append(float("-inf"))
-                cmp_op.append(lambda x, y: x > y)
-            else:
-                best_score.append(float("inf"))
-                cmp_op.append(lambda x, y: x < y)
-
-    def _callback(env: CallbackEnv) -> None:
-        if not cmp_op:
-            _init(env)
-        if not enabled[0]:
-            return
-        for i, item in enumerate(env.evaluation_result_list):
-            score = item[2]
-            if best_score_list[i] is None or cmp_op[i](score, best_score[i]):
-                best_score[i] = score
-                best_iter[i] = env.iteration
-                best_score_list[i] = env.evaluation_result_list
-            if first_metric_only and first_metric[0] != item[1]:
-                continue
-            if item[0] == "training" and len(env.evaluation_result_list) > 1:
-                continue  # train metric doesn't trigger early stop when valids exist
-            if env.iteration - best_iter[i] >= stopping_rounds:
-                if verbose:
-                    print(f"Early stopping, best iteration is:\n"
-                          f"[{best_iter[i] + 1}]\t"
-                          + "\t".join(_format_eval_result(x) for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-            if env.iteration == env.end_iteration - 1:
-                if verbose:
-                    print(f"Did not meet early stopping. Best iteration is:\n"
-                          f"[{best_iter[i] + 1}]\t"
-                          + "\t".join(_format_eval_result(x) for x in best_score_list[i]))
-                raise EarlyStopException(best_iter[i], best_score_list[i])
-    _callback.order = 30
-    return _callback
+    return _EarlyStopping(stopping_rounds, first_metric_only, verbose)

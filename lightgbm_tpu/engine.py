@@ -123,10 +123,23 @@ class CVBooster:
         self.boosters.append(booster)
 
     def __getattr__(self, name: str):
-        def handler_function(*args: Any, **kwargs: Any) -> List[Any]:
-            return [getattr(booster, name)(*args, **kwargs)
-                    for booster in self.boosters]
-        return handler_function
+        # fan any Booster method out across the fold ensemble, collecting
+        # one result per fold
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _FoldFanout(self.boosters, name)
+
+
+class _FoldFanout:
+    """Callable that maps a Booster method over every cv fold."""
+
+    def __init__(self, boosters: List[Booster], method: str):
+        self._boosters = boosters
+        self._method = method
+
+    def __call__(self, *args: Any, **kwargs: Any) -> List[Any]:
+        return [getattr(b, self._method)(*args, **kwargs)
+                for b in self._boosters]
 
 
 def _make_n_folds(full_data: Dataset, nfold: int, params: Dict, seed: int,
