@@ -8,6 +8,8 @@ import subprocess
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute e2e trainings
+
 import lightgbm_tpu as lgb
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.parser import load_text_file

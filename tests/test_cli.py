@@ -8,6 +8,8 @@ import sys
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute e2e trainings
+
 from .conftest import REFERENCE_DIR
 
 BINARY_DIR = os.path.join(REFERENCE_DIR, "examples", "binary_classification")

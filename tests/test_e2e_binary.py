@@ -4,6 +4,8 @@ acceptance: logloss/AUC curve matches reference CPU within tolerance)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute e2e trainings
+
 import lightgbm_tpu as lgb
 
 from .conftest import has_oracle

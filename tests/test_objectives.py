@@ -4,6 +4,8 @@ oracles plus small end-to-end runs for every model family."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute e2e trainings
+
 import lightgbm_tpu as lgb
 from lightgbm_tpu.config import Config
 from lightgbm_tpu.io.dataset import Metadata

@@ -10,6 +10,8 @@ examples/parallel_learning runbook).
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute e2e trainings
+
 import jax
 import jax.numpy as jnp
 

@@ -4,6 +4,8 @@ surface, scaled down)."""
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-minute e2e trainings
+
 from lightgbm_tpu.sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
                                   LGBMRegressor)
 

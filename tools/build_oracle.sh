@@ -1,0 +1,23 @@
+#!/bin/sh
+# Build the reference LightGBM oracle into .refbuild/ for parity tests.
+#
+# The reference CMake writes its outputs into the SOURCE tree
+# (EXECUTABLE_OUTPUT_PATH), so the binaries are moved out afterwards to
+# keep /root/reference pristine.
+set -e
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+REF="${1:-/root/reference}"
+OUT="$ROOT/.refbuild"
+if [ -x "$OUT/lightgbm" ] && [ -e "$OUT/lib_lightgbm.so" ]; then
+    echo "oracle already built at $OUT"
+    exit 0
+fi
+mkdir -p "$OUT"
+cd "$OUT"
+cmake "$REF" -DCMAKE_BUILD_TYPE=Release > cmake.log 2>&1
+make -j"$(nproc)" > make.log 2>&1 || true
+for f in lightgbm lib_lightgbm.so; do
+    if [ -e "$REF/$f" ]; then mv "$REF/$f" "$OUT/$f"; fi
+done
+test -x "$OUT/lightgbm" && test -e "$OUT/lib_lightgbm.so"
+echo "oracle built at $OUT"
