@@ -459,9 +459,21 @@ class GBDT:
         raise NotImplementedError("use add_valid before training")
 
     # ------------------------------------------------------------------
+    def _forest_tables(self):
+        """Concatenated node tables for the native predictor, cached per
+        model count (models only ever grow or get truncated wholesale)."""
+        from ..native import ForestTables
+
+        key = (len(self.models),
+               id(self.models[-1]) if self.models else 0)
+        if getattr(self, "_ft_key", None) != key:
+            self._ft = ForestTables(self.models)
+            self._ft_key = key
+        return self._ft
+
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        self._materialize()
         """[k, n] raw scores from raw feature matrix."""
+        self._materialize()
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
             X = X[None, :]
@@ -469,9 +481,14 @@ class GBDT:
         total = len(self.models)
         if num_iteration is not None and num_iteration > 0:
             total = min(total, num_iteration * k)
-        out = np.zeros((k, X.shape[0]), np.float64)
-        for i in range(total):
-            out[i % k] += self.models[i].predict(X)
+        # native OpenMP walker over all trees at once (the per-tree Python
+        # loop dominated wall-clock at hundreds of trees); numpy fallback
+        # when the native lib is unavailable
+        out = self._forest_tables().predict(X, total, k)
+        if out is None:
+            out = np.zeros((k, X.shape[0]), np.float64)
+            for i in range(total):
+                out[i % k] += self.models[i].predict(X)
         if self.average_output and total > 0:
             out /= max(total // k, 1)  # RF averaging (gbdt_prediction.cpp:55)
         return out
@@ -488,8 +505,10 @@ class GBDT:
             total = len(self.models)
             if num_iteration is not None and num_iteration > 0:
                 total = min(total, num_iteration * k)
-            leaves = np.stack([self.models[i].predict_leaf(X)
-                               for i in range(total)], axis=1)
+            leaves = self._forest_tables().predict_leaf(X, total)
+            if leaves is None:
+                leaves = np.stack([self.models[i].predict_leaf(X)
+                                   for i in range(total)], axis=1)
             return leaves
         if pred_contrib:
             raise NotImplementedError("pred_contrib lands with the SHAP milestone")

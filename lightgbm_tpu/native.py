@@ -1,0 +1,139 @@
+"""Loader + marshalling for the native runtime (lib_lightgbm_tpu.so).
+
+The shared library carries the LGBM_* C ABI (src/capi/
+lightgbm_tpu_c_api.cpp) and the OpenMP forest predictor (src/capi/
+forest_predictor.cpp) — the native pieces of the runtime, mirroring where
+the reference keeps its prediction hot loop in C++ (reference
+src/boosting/gbdt_prediction.cpp).  The library is optional: everything
+falls back to the numpy implementations when it is absent or the
+toolchain can't build it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_CANDIDATES = (
+    os.path.join(_REPO, "build", "lib_lightgbm_tpu.so"),
+    os.path.join(_REPO, "lib_lightgbm_tpu.so"),
+)
+_lib = None
+_lib_tried = False
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use when possible."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+    if path is None and os.environ.get("LIGHTGBM_TPU_NO_BUILD", "") != "1":
+        out_dir = os.path.join(_REPO, "build")
+        os.makedirs(out_dir, exist_ok=True)
+        build = os.path.join(_REPO, "src", "capi", "build.sh")
+        try:
+            r = subprocess.run([build, out_dir], capture_output=True,
+                               timeout=240)
+            if r.returncode == 0:
+                path = _LIB_CANDIDATES[0]
+        except Exception:
+            path = None
+    if path is None:
+        return None
+    try:
+        _lib = ctypes.CDLL(path)
+    except OSError:
+        _lib = None
+    return _lib
+
+
+class ForestTables:
+    """All trees' node tables concatenated for one native call.
+
+    Rebuilt (cheaply, numpy concatenation) whenever the model list grows;
+    GBDT caches an instance keyed by len(models).
+    """
+
+    def __init__(self, trees: List):
+        T = len(trees)
+        self.num_trees = T
+        no, lo, cbo, cwo = [0], [0], [0], [0]
+        sf, th, dt, lc, rc, lv, cb, cw = [], [], [], [], [], [], [], []
+        for t in trees:
+            ni = max(t.num_leaves - 1, 0)
+            no.append(no[-1] + ni)
+            lo.append(lo[-1] + t.num_leaves)
+            sf.append(t.split_feature[:ni])
+            th.append(t.threshold[:ni])
+            dt.append(t.decision_type[:ni])
+            lc.append(t.left_child[:ni])
+            rc.append(t.right_child[:ni])
+            lv.append(t.leaf_value[:t.num_leaves])
+            cb.append(np.asarray(t.cat_boundaries, np.int32))
+            cw.append(np.asarray(t.cat_threshold, np.uint32))
+            cbo.append(cbo[-1] + len(t.cat_boundaries))
+            cwo.append(cwo[-1] + len(t.cat_threshold))
+
+        def cat_(parts, dtype):
+            return (np.ascontiguousarray(np.concatenate(parts), dtype=dtype)
+                    if parts else np.zeros(0, dtype))
+
+        self.node_offset = np.asarray(no, np.int32)
+        self.leaf_offset = np.asarray(lo, np.int32)
+        self.split_feature = cat_(sf, np.int32)
+        self.threshold = cat_(th, np.float64)
+        self.decision_type = cat_(dt, np.int8)
+        self.left_child = cat_(lc, np.int32)
+        self.right_child = cat_(rc, np.int32)
+        self.leaf_value = cat_(lv, np.float64)
+        self.cat_bound_offset = np.asarray(cbo, np.int32)
+        self.cat_boundaries = cat_(cb, np.int32)
+        self.cat_word_offset = np.asarray(cwo, np.int32)
+        self.cat_words = cat_(cw, np.uint32)
+
+    def _common_args(self):
+        c = np.ctypeslib.as_ctypes
+        return (self.node_offset.ctypes, self.leaf_offset.ctypes,
+                self.split_feature.ctypes, self.threshold.ctypes,
+                self.decision_type.ctypes, self.left_child.ctypes,
+                self.right_child.ctypes, self.leaf_value.ctypes,
+                self.cat_bound_offset.ctypes, self.cat_boundaries.ctypes,
+                self.cat_word_offset.ctypes, self.cat_words.ctypes)
+
+    def predict(self, X: np.ndarray, num_trees: int,
+                num_class: int) -> Optional[np.ndarray]:
+        """[k, n] summed raw scores via the native walker; None = no lib."""
+        lib = native_lib()
+        if lib is None:
+            return None
+        X = np.ascontiguousarray(X, np.float64)
+        n = X.shape[0]
+        out = np.zeros((num_class, n), np.float64)
+        args = self._common_args()
+        lib.LGBMTPU_ForestPredict(
+            X.ctypes, ctypes.c_int64(n), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(num_trees), ctypes.c_int32(num_class),
+            *args, out.ctypes)
+        return out
+
+    def predict_leaf(self, X: np.ndarray,
+                     num_trees: int) -> Optional[np.ndarray]:
+        """[n, T] leaf indices via the native walker; None = no lib."""
+        lib = native_lib()
+        if lib is None:
+            return None
+        X = np.ascontiguousarray(X, np.float64)
+        n = X.shape[0]
+        out = np.zeros((n, num_trees), np.int32)
+        args = self._common_args()
+        lib.LGBMTPU_ForestPredictLeaf(
+            X.ctypes, ctypes.c_int64(n), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(num_trees), *args, out.ctypes)
+        return out

@@ -8,9 +8,9 @@ OUT="${1:-$ROOT}"
 PYINC="$(python3 -c 'import sysconfig; print(sysconfig.get_paths()["include"])')"
 PYLIBDIR="$(python3 -c 'import sysconfig; print(sysconfig.get_config_var("LIBDIR"))')"
 PYLIB="$(python3 -c 'import sysconfig; v=sysconfig.get_config_var("LDVERSION"); print("python"+v)')"
-g++ -O2 -fPIC -shared -std=c++17 \
+g++ -O3 -fPIC -shared -std=c++17 -fopenmp \
     -I"$PYINC" \
-    "$HERE/lightgbm_tpu_c_api.cpp" \
+    "$HERE/lightgbm_tpu_c_api.cpp" "$HERE/forest_predictor.cpp" \
     -L"$PYLIBDIR" -l"$PYLIB" \
     -o "$OUT/lib_lightgbm_tpu.so"
 echo "built $OUT/lib_lightgbm_tpu.so"

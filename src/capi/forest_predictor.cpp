@@ -1,0 +1,134 @@
+// OpenMP forest predictor: the whole-model traversal hot loop in native
+// code.
+//
+// Role mirror of the reference's prediction path (reference
+// src/boosting/gbdt_prediction.cpp:13-58 PredictRaw over per-row OMP,
+// tree walk in include/LightGBM/tree.h:238-318).  The Python/JAX side
+// packs every tree's node tables into ONE set of concatenated arrays
+// (offsets per tree), so a single C call scores all rows x all trees with
+// no per-tree Python dispatch — the fix for the host-side per-tree loop
+// that dominated multi-hundred-tree predicts.
+//
+// Decision semantics match lightgbm_tpu/models/tree.py Tree.predict /
+// Tree._categorical_go_left exactly (f64 thresholds, zero/nan missing
+// handling, category bitsets), which in turn match the reference model
+// format — verified by the oracle interchange tests.
+
+#include <cmath>
+#include <cstdint>
+
+#define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+constexpr double kZeroThreshold = 1e-35;
+constexpr int8_t kCategoricalMask = 1;
+constexpr int8_t kDefaultLeftMask = 2;
+
+struct Forest {
+  const int32_t* node_offset;   // [T+1] into node tables
+  const int32_t* leaf_offset;   // [T+1] into leaf_value
+  const int32_t* split_feature;
+  const double* threshold;
+  const int8_t* decision_type;
+  const int32_t* left_child;
+  const int32_t* right_child;
+  const double* leaf_value;
+  const int32_t* cat_bound_offset;  // [T+1] into cat_boundaries
+  const int32_t* cat_boundaries;    // per-tree word-range boundaries
+  const int32_t* cat_word_offset;   // [T+1] into cat_words
+  const uint32_t* cat_words;        // bitset words (categories going left)
+};
+
+// leaf index (within the tree's leaf block) for one row of one tree
+inline int32_t walk(const Forest& f, int32_t tree, const double* row) {
+  const int32_t base = f.node_offset[tree];
+  const int32_t num_nodes = f.node_offset[tree + 1] - base;
+  if (num_nodes == 0) return 0;
+  int32_t node = 0;
+  while (node >= 0) {
+    const int32_t k = base + node;
+    const double v = row[f.split_feature[k]];
+    const int8_t dt = f.decision_type[k];
+    const int mt = (dt >> 2) & 3;
+    bool left;
+    if (dt & kCategoricalMask) {
+      // category bitset membership; negatives / NaN route right
+      left = false;
+      if (!(std::isnan(v) || v < 0)) {
+        const int64_t cat = static_cast<int64_t>(v);
+        const int32_t cidx = static_cast<int32_t>(f.threshold[k]);
+        const int32_t* bounds = f.cat_boundaries + f.cat_bound_offset[tree];
+        const uint32_t* words = f.cat_words + f.cat_word_offset[tree];
+        const int64_t w = cat / 32;
+        if (w < bounds[cidx + 1] - bounds[cidx]) {
+          left = (words[bounds[cidx] + w] >> (cat % 32)) & 1u;
+        }
+      }
+    } else {
+      double fv = v;
+      bool is_default;
+      if (mt == 2) {  // NaN missing
+        is_default = std::isnan(fv);
+      } else {
+        if (std::isnan(fv)) fv = 0.0;
+        is_default = (mt == 1) && std::fabs(fv) <= kZeroThreshold;
+      }
+      left = is_default ? (dt & kDefaultLeftMask) != 0
+                        : fv <= f.threshold[k];
+    }
+    node = left ? f.left_child[k] : f.right_child[k];
+  }
+  return ~node;
+}
+
+}  // namespace
+
+// Sum leaf values of trees [0, num_trees) into out[class][row]; tree i
+// belongs to class i % num_class (the reference's per-iteration class
+// interleaving, gbdt_prediction.cpp:17-29).
+LGBM_EXPORT int LGBMTPU_ForestPredict(
+    const double* X, int64_t nrow, int32_t ncol, int32_t num_trees,
+    int32_t num_class, const int32_t* node_offset,
+    const int32_t* leaf_offset, const int32_t* split_feature,
+    const double* threshold, const int8_t* decision_type,
+    const int32_t* left_child, const int32_t* right_child,
+    const double* leaf_value, const int32_t* cat_bound_offset,
+    const int32_t* cat_boundaries, const int32_t* cat_word_offset,
+    const uint32_t* cat_words, double* out) {
+  Forest f{node_offset, leaf_offset, split_feature, threshold,
+           decision_type, left_child, right_child, leaf_value,
+           cat_bound_offset, cat_boundaries, cat_word_offset, cat_words};
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < nrow; ++r) {
+    const double* row = X + r * ncol;
+    for (int32_t t = 0; t < num_trees; ++t) {
+      const int32_t leaf = walk(f, t, row);
+      out[(t % num_class) * nrow + r] += leaf_value[f.leaf_offset[t] + leaf];
+    }
+  }
+  return 0;
+}
+
+// Leaf indices instead of summed values: out[row][tree].
+LGBM_EXPORT int LGBMTPU_ForestPredictLeaf(
+    const double* X, int64_t nrow, int32_t ncol, int32_t num_trees,
+    const int32_t* node_offset, const int32_t* leaf_offset,
+    const int32_t* split_feature, const double* threshold,
+    const int8_t* decision_type, const int32_t* left_child,
+    const int32_t* right_child, const double* leaf_value,
+    const int32_t* cat_bound_offset, const int32_t* cat_boundaries,
+    const int32_t* cat_word_offset, const uint32_t* cat_words,
+    int32_t* out) {
+  Forest f{node_offset, leaf_offset, split_feature, threshold,
+           decision_type, left_child, right_child, leaf_value,
+           cat_bound_offset, cat_boundaries, cat_word_offset, cat_words};
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < nrow; ++r) {
+    const double* row = X + r * ncol;
+    for (int32_t t = 0; t < num_trees; ++t) {
+      out[r * num_trees + t] = walk(f, t, row);
+    }
+  }
+  return 0;
+}
