@@ -45,7 +45,7 @@ class Application:
         elif task in ("predict", "prediction", "test"):
             self.predict()
         elif task == "refit" or task == "refit_tree":
-            raise NotImplementedError("task=refit lands with the refit milestone")
+            self.refit()
         else:
             raise ValueError(f"unknown task {task!r}")
 
@@ -84,6 +84,25 @@ class Application:
               f"{cfg.output_model}")
 
     # ------------------------------------------------------------------
+    def refit(self) -> None:
+        """task=refit: re-fit the input model's leaf values on `data`
+        (reference Application::RefitTree, application.cpp:231-251)."""
+        from . import Booster
+        from .io.parser import load_text_file
+        cfg = self.config
+        if not cfg.data:
+            raise ValueError("no refit data: set data=<file>")
+        if not cfg.input_model:
+            raise ValueError("no model file: set input_model=<file>")
+        booster = Booster(model_file=cfg.input_model)
+        X, y, _, _, _, _ = load_text_file(
+            cfg.data, label_column=str(cfg.label_column or ""))
+        new_booster = booster.refit(X, y,
+                                    decay_rate=float(cfg.refit_decay_rate))
+        new_booster.save_model(cfg.output_model)
+        print(f"[lightgbm_tpu] finished refit, model saved to "
+              f"{cfg.output_model}")
+
     def predict(self) -> None:
         from . import Booster
         cfg = self.config

@@ -119,11 +119,17 @@ class Booster:
                                     raw_score=raw_score, pred_leaf=pred_leaf,
                                     pred_contrib=pred_contrib)
 
-    def refit(self, data, label, decay_rate: float = 0.9):
+    def refit(self, data, label, decay_rate: float = 0.9) -> "Booster":
+        """New Booster with every tree's leaf values re-fit on `data`
+        (reference basic.py Booster.refit -> GBDT::RefitTree)."""
         from .basic import _to_2d_array
+        from .config import Config
+
         X = _to_2d_array(data)
-        new_driver = self._driver.refit(X, np.asarray(label), decay_rate)
-        out = Booster(model_str=new_driver.save_model_to_string())
+        out = Booster(model_str=self._driver.save_model_to_string())
+        out.params = dict(self.params)
+        out._driver.refit(X, np.asarray(label), decay_rate,
+                          config=Config(self.params) if self.params else None)
         return out
 
     # -- model IO ------------------------------------------------------

@@ -511,7 +511,16 @@ class GBDT:
                                    for i in range(total)], axis=1)
             return leaves
         if pred_contrib:
-            raise NotImplementedError("pred_contrib lands with the SHAP milestone")
+            from .shap import forest_contribs
+
+            k = self.num_tree_per_iteration
+            total = len(self.models)
+            if num_iteration is not None and num_iteration > 0:
+                total = min(total, num_iteration * k)
+            out = forest_contribs(self.models, X, total, k)
+            if k == 1:
+                return out[:, 0, :]                      # [n, F+1]
+            return out.reshape(X.shape[0], -1)           # [n, k*(F+1)]
         raw = self.predict_raw(X, num_iteration)
         if not raw_score and self.objective is not None:
             conv = self.objective.convert_output(raw)
@@ -521,8 +530,67 @@ class GBDT:
         return raw.T  # [n, k] multiclass
 
     # ------------------------------------------------------------------
-    def refit(self, X: np.ndarray, label: np.ndarray, decay_rate: float):
-        raise NotImplementedError("refit lands with the boosting-modes milestone")
+    def refit(self, X: np.ndarray, label: np.ndarray,
+              decay_rate: float = 0.9,
+              config: Optional[Config] = None) -> None:
+        """Re-fit leaf values on new data, keeping every tree's structure.
+
+        The analog of GBDT::RefitTree (reference src/boosting/gbdt.cpp:298)
+        + FitByExistingTree (serial_tree_learner.cpp:239-270): per
+        iteration, gradients are taken at the running refit scores; each
+        tree's rows are grouped by the OLD tree's leaf assignment on the
+        new data, the regularized leaf output is recomputed from the new
+        sums, and blended as decay*old + (1-decay)*new*shrinkage.
+        """
+        self._materialize()
+        cfg = config or self.config or Config({})
+        X = np.asarray(X, np.float64)
+        if X.ndim == 1:
+            X = X[None, :]
+        n = X.shape[0]
+        leaf_preds = self.predict(X, pred_leaf=True)       # [n, T]
+        k = self.num_tree_per_iteration
+
+        from ..io.dataset import Metadata
+
+        md = Metadata(num_data=n, label=np.asarray(label, np.float32))
+        # a fresh objective instance bound to the NEW labels (never re-init
+        # the live training objective)
+        obj = create_objective(cfg)
+        if obj is None:
+            obj = create_objective_from_model_string(
+                self.loaded_params.get("objective", ""))
+        if obj is None:
+            raise ValueError("cannot refit without an objective")
+        obj.init(md, n)
+
+        l1 = float(cfg.lambda_l1)
+        l2 = float(cfg.lambda_l2)
+        mds = float(cfg.max_delta_step)
+        decay = float(decay_rate)
+        scores = np.zeros((k, n), np.float64)
+        grad = hess = None
+        for i, tree in enumerate(self.models):
+            cid = i % k
+            if cid == 0:
+                g, h = obj.get_gradients(jnp.asarray(scores, jnp.float32))
+                grad = np.asarray(g, np.float64).reshape(k, n)
+                hess = np.asarray(h, np.float64).reshape(k, n)
+            leaves = leaf_preds[:, i].astype(np.int64)
+            nl = tree.num_leaves
+            sum_g = np.bincount(leaves, weights=grad[cid], minlength=nl)
+            sum_h = np.bincount(leaves, weights=hess[cid], minlength=nl) \
+                + K_EPSILON
+            # CalculateSplittedLeafOutput (feature_histogram.hpp:449-456)
+            reg = np.maximum(np.abs(sum_g) - l1, 0.0) * np.sign(sum_g)
+            new_out = -reg / (sum_h + l2)
+            if mds > 0.0:
+                new_out = np.clip(new_out, -mds, mds)
+            old = tree.leaf_value[:nl]
+            tree.leaf_value[:nl] = (decay * old
+                                    + (1.0 - decay) * new_out * tree.shrinkage)
+            scores[cid] += tree.leaf_value[leaves]
+        self._ft_key = None  # leaf values changed: drop packed tables
 
     def reset_config(self, config: Config) -> None:
         self._materialize()
@@ -662,7 +730,8 @@ class GBDT:
         self.label_index = int(kv.get("label_index", "0"))
         self.max_feature_idx = int(kv.get("max_feature_idx", "0"))
         self.feature_names = kv.get("feature_names", "").split()
-        self.loaded_params = {"feature_infos": kv.get("feature_infos", "").split()}
+        self.loaded_params = {"feature_infos": kv.get("feature_infos", "").split(),
+                              "objective": kv.get("objective", "")}
         if "objective" in kv:
             self.objective = create_objective_from_model_string(kv["objective"])
         for block in tree_blocks:

@@ -1,0 +1,206 @@
+"""TreeSHAP feature contributions (`pred_contrib`).
+
+Plays the role of the reference's `Tree::PredictContrib` path (reference
+include/LightGBM/tree.h:133, used by PredictForMat with
+C_API_PREDICT_CONTRIB): per-row, per-feature Shapley values such that
+`sum(contribs) + expected_value == raw prediction`.
+
+Implements the polynomial-time TreeSHAP algorithm (Lundberg et al.): a
+root-to-leaf walk carrying a "unique path" of (feature, zero_fraction,
+one_fraction, pweight) entries, EXTENDed at every split and UNWOUND to
+attribute each leaf's value to the features on its path.  Node covers
+(training row counts) weight the "cold" branches, exactly like the
+reference's count-based weighting.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import (K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK,
+                   K_ZERO_THRESHOLD, Tree)
+
+
+class _Path:
+    """Unique feature path: parallel arrays grown/shrunk in place."""
+
+    __slots__ = ("feature", "zero", "one", "pweight", "length")
+
+    def __init__(self, max_depth: int):
+        cap = max_depth + 2
+        self.feature = np.full(cap, -1, np.int64)
+        self.zero = np.zeros(cap, np.float64)
+        self.one = np.zeros(cap, np.float64)
+        self.pweight = np.zeros(cap, np.float64)
+        self.length = 0
+
+    def copy_of(self) -> "_Path":
+        p = _Path(len(self.feature) - 2)
+        p.feature[:] = self.feature
+        p.zero[:] = self.zero
+        p.one[:] = self.one
+        p.pweight[:] = self.pweight
+        p.length = self.length
+        return p
+
+    def extend(self, zero_fraction: float, one_fraction: float,
+               feature: int) -> None:
+        i = self.length
+        self.feature[i] = feature
+        self.zero[i] = zero_fraction
+        self.one[i] = one_fraction
+        self.pweight[i] = 1.0 if i == 0 else 0.0
+        self.length += 1
+        l = self.length
+        for j in range(l - 2, -1, -1):
+            self.pweight[j + 1] += one_fraction * self.pweight[j] * (j + 1) / l
+            self.pweight[j] = zero_fraction * self.pweight[j] * (l - j - 1) / l
+
+    def unwind(self, i: int) -> None:
+        l = self.length
+        one = self.one[i]
+        zero = self.zero[i]
+        n = self.pweight[l - 1]
+        for j in range(l - 2, -1, -1):
+            if one != 0.0:
+                t = self.pweight[j]
+                self.pweight[j] = n * l / ((j + 1) * one)
+                n = t - self.pweight[j] * zero * (l - j - 1) / l
+            else:
+                self.pweight[j] = self.pweight[j] * l / (zero * (l - j - 1))
+        for j in range(i, l - 1):
+            self.feature[j] = self.feature[j + 1]
+            self.zero[j] = self.zero[j + 1]
+            self.one[j] = self.one[j + 1]
+        self.length -= 1
+
+    def unwound_sum(self, i: int) -> float:
+        """Sum of pweights as if entry i were unwound (without mutating)."""
+        l = self.length
+        one = self.one[i]
+        zero = self.zero[i]
+        n = self.pweight[l - 1]
+        total = 0.0
+        for j in range(l - 2, -1, -1):
+            if one != 0.0:
+                tmp = n * l / ((j + 1) * one)
+                total += tmp
+                n = self.pweight[j] - tmp * zero * (l - j - 1) / l
+            else:
+                total += self.pweight[j] * l / (zero * (l - j - 1))
+        return total
+
+
+def _node_decision(tree: Tree, node: int, row: np.ndarray) -> bool:
+    """go-left for one row at one internal node (Tree.predict semantics)."""
+    v = row[tree.split_feature[node]]
+    dt = int(tree.decision_type[node])
+    mt = (dt >> 2) & 3
+    if dt & K_CATEGORICAL_MASK:
+        if np.isnan(v) or v < 0:
+            return False
+        cat = int(v)
+        cidx = int(tree.threshold[node])
+        lo = tree.cat_boundaries[cidx]
+        hi = tree.cat_boundaries[cidx + 1]
+        w = cat // 32
+        if w >= hi - lo:
+            return False
+        return bool((tree.cat_threshold[lo + w] >> (cat % 32)) & 1)
+    if mt == 2:
+        if np.isnan(v):
+            return (dt & K_DEFAULT_LEFT_MASK) != 0
+        fv = v
+    else:
+        fv = 0.0 if np.isnan(v) else v
+        if mt == 1 and abs(fv) <= K_ZERO_THRESHOLD:
+            return (dt & K_DEFAULT_LEFT_MASK) != 0
+    return fv <= tree.threshold[node]
+
+
+def _covers(tree: Tree):
+    """(internal_cover, leaf_cover) row counts per node."""
+    return (tree.internal_count.astype(np.float64),
+            tree.leaf_count.astype(np.float64))
+
+
+def tree_expected_value(tree: Tree) -> float:
+    """Cover-weighted mean leaf value (reference ExpectedValue)."""
+    nl = tree.num_leaves
+    if nl == 1:
+        return float(tree.leaf_value[0])
+    w = tree.leaf_count[:nl].astype(np.float64)
+    tot = w.sum()
+    if tot <= 0:
+        return 0.0
+    return float((w * tree.leaf_value[:nl]).sum() / tot)
+
+
+def tree_shap_row(tree: Tree, row: np.ndarray, phi: np.ndarray) -> None:
+    """Accumulate one tree's contributions for one row into phi [F+1]."""
+    if tree.num_leaves == 1:
+        return
+    icov, lcov = _covers(tree)
+
+    def recurse(node: int, path: _Path, zero_fraction: float,
+                one_fraction: float, feature: int) -> None:
+        path = path.copy_of()
+        path.extend(zero_fraction, one_fraction, feature)
+        if node < 0:  # leaf
+            leaf = ~node
+            for i in range(1, path.length):
+                w = path.unwound_sum(i)
+                phi[path.feature[i]] += (
+                    w * (path.one[i] - path.zero[i])
+                    * tree.leaf_value[leaf])
+            return
+        go_left = _node_decision(tree, node, row)
+        hot = tree.left_child[node] if go_left else tree.right_child[node]
+        cold = tree.right_child[node] if go_left else tree.left_child[node]
+        cover = icov[node]
+        hot_cover = (icov[hot] if hot >= 0 else lcov[~hot])
+        cold_cover = (icov[cold] if cold >= 0 else lcov[~cold])
+        incoming_zero, incoming_one = 1.0, 1.0
+        split_f = int(tree.split_feature[node])
+        # if this feature already appears on the path, undo its previous
+        # extension first (unique-path invariant)
+        prev = -1
+        for i in range(path.length):
+            if path.feature[i] == split_f:
+                prev = i
+                break
+        if prev >= 0:
+            incoming_zero = path.zero[prev]
+            incoming_one = path.one[prev]
+            path.unwind(prev)
+        denom = cover if cover > 0 else 1.0
+        recurse(hot, path, incoming_zero * hot_cover / denom,
+                incoming_one, split_f)
+        recurse(cold, path, incoming_zero * cold_cover / denom,
+                0.0, split_f)
+
+    recurse(0, _Path(tree.max_depth()), 1.0, 1.0, -1)
+
+
+def forest_contribs(models: List[Tree], X: np.ndarray, num_trees: int,
+                    num_class: int) -> np.ndarray:
+    """[n, num_class, F+1] contributions (last slot = expected value).
+
+    Matches the reference layout for PredictForMat with
+    C_API_PREDICT_CONTRIB: per class, per-feature SHAP values plus the
+    model's expected value so rows sum to the raw prediction.
+    """
+    n, F = X.shape
+    out = np.zeros((n, num_class, F + 1), np.float64)
+    expected = np.zeros(num_class, np.float64)
+    for t in range(num_trees):
+        expected[t % num_class] += tree_expected_value(models[t])
+    out[:, :, F] = expected[None, :]
+    for r in range(n):
+        row = X[r]
+        for t in range(num_trees):
+            phi = out[r, t % num_class]
+            tree_shap_row(models[t], row, phi)
+    return out

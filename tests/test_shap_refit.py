@@ -1,0 +1,160 @@
+"""SHAP contributions (pred_contrib) + refit.
+
+SHAP mirrors reference Tree::PredictContrib (include/LightGBM/tree.h:133);
+refit mirrors GBDT::RefitTree (src/boosting/gbdt.cpp:298) +
+FitByExistingTree (src/treelearner/serial_tree_learner.cpp:239-270).
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+from .conftest import ORACLE_LIB, has_oracle
+
+pytestmark = pytest.mark.slow  # e2e trainings
+
+
+@pytest.fixture(scope="module")
+def model_and_data():
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(1500, 6))
+    X[rng.random(X.shape) < 0.03] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.6 * np.nan_to_num(X[:, 1]) ** 2
+         - 0.4 * np.nan_to_num(X[:, 2]) > 0.5).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "min_data_in_leaf": 10, "use_missing": True},
+                    ds, num_boost_round=12, verbose_eval=False)
+    return bst, X, y
+
+
+class TestSHAP:
+    def test_additivity(self, model_and_data):
+        bst, X, _ = model_and_data
+        Xs = X[:80]
+        contrib = bst.predict(Xs, pred_contrib=True)
+        raw = bst.predict(Xs, raw_score=True)
+        assert contrib.shape == (80, X.shape[1] + 1)
+        np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                                   rtol=1e-9, atol=1e-9)
+
+    @pytest.mark.skipif(not has_oracle(), reason="reference oracle not built")
+    def test_matches_reference_treeshap(self, model_and_data, tmp_path):
+        bst, X, _ = model_and_data
+        Xs = np.ascontiguousarray(X[:60], np.float64)
+        contrib = bst.predict(Xs, pred_contrib=True)
+        bst.save_model(str(tmp_path / "m.txt"))
+        ref = ctypes.CDLL(ORACLE_LIB)
+        ref.LGBM_GetLastError.restype = ctypes.c_char_p
+        bh = ctypes.c_void_p()
+        it = ctypes.c_int()
+        assert ref.LGBM_BoosterCreateFromModelfile(
+            str(tmp_path / "m.txt").encode(), ctypes.byref(it),
+            ctypes.byref(bh)) == 0
+        n, F = Xs.shape
+        out = (ctypes.c_double * (n * (F + 1)))()
+        olen = ctypes.c_int64()
+        assert ref.LGBM_BoosterPredictForMat(
+            bh, Xs.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(n),
+            ctypes.c_int32(F), 1, 3, 0, b"", ctypes.byref(olen), out) == 0
+        ref_contrib = np.ctypeslib.as_array(out).reshape(n, F + 1)
+        np.testing.assert_allclose(contrib, ref_contrib,
+                                   rtol=1e-7, atol=1e-9)
+
+    def test_multiclass_shape(self, multiclass_example):
+        X, y = multiclass_example["X_train"], multiclass_example["y_train"]
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "multiclass", "num_class": 5,
+                         "num_leaves": 7}, ds, num_boost_round=3,
+                        verbose_eval=False)
+        Xs = X[:20]
+        contrib = bst.predict(Xs, pred_contrib=True)
+        assert contrib.shape == (20, 5 * (X.shape[1] + 1))
+        raw = bst.predict(Xs, raw_score=True)
+        per_class = contrib.reshape(20, 5, X.shape[1] + 1).sum(axis=2)
+        np.testing.assert_allclose(per_class, raw, rtol=1e-9, atol=1e-9)
+
+
+class TestRefit:
+    def test_refit_moves_leaves_toward_new_data(self, model_and_data):
+        bst, X, y = model_and_data
+        rng = np.random.default_rng(11)
+        # new data with flipped relationship on feature 2
+        X2 = rng.normal(size=(1500, 6))
+        y2 = (np.nan_to_num(X2[:, 0]) > 0.2).astype(np.float64)
+        refitted = bst.refit(X2, y2, decay_rate=0.5)
+        assert refitted.num_trees() == bst.num_trees()
+        # structure unchanged: same leaf assignment on any input
+        np.testing.assert_array_equal(
+            bst.predict(X2[:100], pred_leaf=True),
+            refitted.predict(X2[:100], pred_leaf=True))
+        # quality on the NEW task must improve
+        from sklearn.metrics import log_loss
+        p_old = bst.predict(X2)
+        p_new = refitted.predict(X2)
+        assert log_loss(y2, p_new) < log_loss(y2, p_old)
+
+    def test_decay_one_is_identity(self, model_and_data):
+        bst, X, y = model_and_data
+        same = bst.refit(X, y, decay_rate=1.0)
+        np.testing.assert_allclose(same.predict(X[:50]), bst.predict(X[:50]),
+                                   rtol=1e-12)
+
+    @pytest.mark.skipif(not has_oracle(), reason="reference oracle not built")
+    def test_refit_matches_reference(self, model_and_data, tmp_path):
+        """Same model + same new data through the reference's refit must
+        give the same refitted leaf values."""
+        bst, X, y = model_and_data
+        rng = np.random.default_rng(3)
+        X2 = np.nan_to_num(X) + rng.normal(scale=0.1, size=X.shape)
+        y2 = (X2[:, 0] > 0.1).astype(np.float64)
+        mine = bst.refit(X2, y2, decay_rate=0.7)
+
+        model_file = str(tmp_path / "m.txt")
+        bst.save_model(model_file)
+        ref = ctypes.CDLL(ORACLE_LIB)
+        ref.LGBM_GetLastError.restype = ctypes.c_char_p
+        bh = ctypes.c_void_p()
+        it = ctypes.c_int()
+        assert ref.LGBM_BoosterCreateFromModelfile(
+            model_file.encode(), ctypes.byref(it), ctypes.byref(bh)) == 0
+
+        # reference refit needs a Dataset + leaf predictions
+        n, F = X2.shape
+        Xc = np.ascontiguousarray(X2, np.float64)
+        dh = ctypes.c_void_p()
+        params = b"max_bin=63 objective=binary refit_decay_rate=0.7 verbosity=-1"
+        assert ref.LGBM_DatasetCreateFromMat(
+            Xc.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(n),
+            ctypes.c_int32(F), 1, params, None, ctypes.byref(dh)) == 0
+        lab = y2.astype(np.float32)
+        assert ref.LGBM_DatasetSetField(
+            dh, b"label", lab.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(n), 0) == 0
+        bh2 = ctypes.c_void_p()
+        assert ref.LGBM_BoosterCreate(dh, params, ctypes.byref(bh2)) == 0, \
+            ref.LGBM_GetLastError()
+        assert ref.LGBM_BoosterMerge(bh2, bh) == 0
+        T = bst.num_trees()
+        leaf_preds = bst.predict(X2, pred_leaf=True).astype(np.int32)
+        leaf_flat = np.ascontiguousarray(leaf_preds.reshape(-1))
+        assert ref.LGBM_BoosterRefit(
+            bh2, leaf_flat.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(n), ctypes.c_int32(T)) == 0, \
+            ref.LGBM_GetLastError()
+
+        pred_ref = (ctypes.c_double * n)()
+        olen = ctypes.c_int64()
+        assert ref.LGBM_BoosterPredictForMat(
+            bh2, Xc.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(n),
+            ctypes.c_int32(F), 1, 1, 0, b"", ctypes.byref(olen),
+            pred_ref) == 0
+        p_ref = np.ctypeslib.as_array(pred_ref)
+        p_mine = mine.predict(X2, raw_score=True)
+        np.testing.assert_allclose(p_mine, p_ref, rtol=1e-5, atol=1e-5)
