@@ -117,7 +117,10 @@ class Application:
                            if int(cfg.num_iteration_predict) > 0 else None),
             raw_score=bool(cfg.predict_raw_score),
             pred_leaf=bool(cfg.predict_leaf_index),
-            pred_contrib=bool(cfg.predict_contrib))
+            pred_contrib=bool(cfg.predict_contrib),
+            pred_early_stop=bool(cfg.pred_early_stop),
+            pred_early_stop_freq=int(cfg.pred_early_stop_freq),
+            pred_early_stop_margin=float(cfg.pred_early_stop_margin))
         out = np.asarray(result)
         with open(cfg.output_result, "w") as f:
             if out.ndim == 1:

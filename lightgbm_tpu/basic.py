@@ -43,6 +43,16 @@ class Dataset:
         if self._inner is not None:
             return self
         cfg = Config(self.params)
+        if bool(cfg.two_round):
+            from .utils.log import Log
+
+            # two_round is a host-memory loading strategy in the reference
+            # (sampled bin-finding then a second streaming pass,
+            # dataset_loader.cpp:188-216); loading here is single-pass
+            # in-memory and produces identical bins, so the key changes
+            # nothing — say so instead of silently accepting it
+            Log.warning("two_round=true is a no-op: loading is single-pass "
+                        "in-memory and yields identical bins")
         ref_inner = self.reference._inner if self.reference is not None else None
         if self.reference is not None and ref_inner is None:
             self.reference.construct()

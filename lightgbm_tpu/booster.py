@@ -115,9 +115,13 @@ class Booster:
             X = _to_2d_array(data)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
-        return self._driver.predict(X, num_iteration=num_iteration,
-                                    raw_score=raw_score, pred_leaf=pred_leaf,
-                                    pred_contrib=pred_contrib)
+        return self._driver.predict(
+            X, num_iteration=num_iteration, raw_score=raw_score,
+            pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+            pred_early_stop=bool(kwargs.get("pred_early_stop", False)),
+            pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq", 10)),
+            pred_early_stop_margin=float(
+                kwargs.get("pred_early_stop_margin", 10.0)))
 
     def refit(self, data, label, decay_rate: float = 0.9) -> "Booster":
         """New Booster with every tree's leaf values re-fit on `data`

@@ -81,6 +81,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cb_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
+    # training snapshots (reference GBDT::Train, gbdt.cpp:290-294: every
+    # snapshot_freq iterations the model is saved as <out>.snapshot_iter_N)
+    snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
+    snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
+
     evaluation_result_list: List = []
     for i in range(num_boost_round):
         for cb in cb_before:
@@ -88,6 +93,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
                            begin_iteration=0, end_iteration=num_boost_round,
                            evaluation_result_list=None))
         booster.update(fobj=fobj)
+        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+            booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
 
         evaluation_result_list: List = []
         if valid_sets:

@@ -471,8 +471,15 @@ class GBDT:
             self._ft_key = key
         return self._ft
 
-    def predict_raw(self, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
-        """[k, n] raw scores from raw feature matrix."""
+    def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
+                    early_stop_freq: int = 0,
+                    early_stop_margin: float = 0.0) -> np.ndarray:
+        """[k, n] raw scores from raw feature matrix.
+
+        early_stop_freq > 0 enables prediction early stopping (reference
+        src/boosting/prediction_early_stop.cpp:75-81): rows whose margin
+        already exceeds early_stop_margin skip the remaining trees.
+        """
         self._materialize()
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
@@ -484,18 +491,36 @@ class GBDT:
         # native OpenMP walker over all trees at once (the per-tree Python
         # loop dominated wall-clock at hundreds of trees); numpy fallback
         # when the native lib is unavailable
-        out = self._forest_tables().predict(X, total, k)
+        out = self._forest_tables().predict(X, total, k, early_stop_freq,
+                                            early_stop_margin)
         if out is None:
             out = np.zeros((k, X.shape[0]), np.float64)
+            active = np.ones(X.shape[0], bool)
             for i in range(total):
-                out[i % k] += self.models[i].predict(X)
+                if early_stop_freq > 0 and not active.any():
+                    break
+                Xa = X[active] if early_stop_freq > 0 else X
+                if early_stop_freq > 0:
+                    out[i % k, active] += self.models[i].predict(Xa)
+                else:
+                    out[i % k] += self.models[i].predict(X)
+                if (early_stop_freq > 0 and i % k == k - 1
+                        and (i // k + 1) % early_stop_freq == 0):
+                    if k == 1:
+                        margin = np.abs(out[0])
+                    else:
+                        top2 = np.sort(out, axis=0)[-2:]
+                        margin = top2[1] - top2[0]
+                    active &= margin < early_stop_margin
         if self.average_output and total > 0:
             out /= max(total // k, 1)  # RF averaging (gbdt_prediction.cpp:55)
         return out
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
-                pred_contrib: bool = False) -> np.ndarray:
+                pred_contrib: bool = False, pred_early_stop: bool = False,
+                pred_early_stop_freq: int = 10,
+                pred_early_stop_margin: float = 10.0) -> np.ndarray:
         self._materialize()
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
@@ -521,7 +546,11 @@ class GBDT:
             if k == 1:
                 return out[:, 0, :]                      # [n, F+1]
             return out.reshape(X.shape[0], -1)           # [n, k*(F+1)]
-        raw = self.predict_raw(X, num_iteration)
+        raw = self.predict_raw(
+            X, num_iteration,
+            early_stop_freq=(int(pred_early_stop_freq)
+                             if pred_early_stop else 0),
+            early_stop_margin=float(pred_early_stop_margin))
         if not raw_score and self.objective is not None:
             conv = self.objective.convert_output(raw)
             raw = conv

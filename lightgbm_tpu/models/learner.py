@@ -48,8 +48,23 @@ class TPUTreeLearner:
         if self.num_features == 0:
             raise ValueError("no usable features in training data")
 
-        meta_np = train_data.feature_arrays()
+        meta_np = dict(train_data.feature_arrays())
+        # CEGB coupled feature-acquisition penalties, mapped onto used
+        # features (reference config.h cegb_penalty_feature_coupled; lazy
+        # penalties need per-row paid-cost tracking and are rejected)
+        if list(config.cegb_penalty_feature_lazy):
+            raise NotImplementedError(
+                "cegb_penalty_feature_lazy is not supported; use "
+                "cegb_penalty_feature_coupled")
+        coupled_raw = [float(v) for v in config.cegb_penalty_feature_coupled]
+        coupled = np.zeros(train_data.num_features, np.float32)
+        for j, col in enumerate(train_data.used_feature_idx):
+            if col < len(coupled_raw):
+                coupled[j] = coupled_raw[col]
+        meta_np["cegb_coupled"] = coupled
+        has_cegb = bool(coupled_raw) or float(config.cegb_penalty_split) != 0.0
         self.meta_np = meta_np
+        forced = self._parse_forced_splits(config, train_data)
         B = int(meta_np["num_bin"].max())
         self.num_bins = B
 
@@ -146,6 +161,11 @@ class TPUTreeLearner:
             split_batch=resolve_split_batch(int(config.tpu_split_batch),
                                             int(config.num_leaves)),
             split_batch_alpha=float(config.tpu_split_batch_alpha),
+            feature_fraction_bynode=float(config.feature_fraction_bynode),
+            has_cegb=has_cegb,
+            cegb_tradeoff=float(config.cegb_tradeoff),
+            cegb_penalty_split=float(config.cegb_penalty_split),
+            forced=forced,
         )
         self.grow = make_strategy_grower(
             self.params, self.f_pad, strategy, self.mesh,
@@ -153,6 +173,48 @@ class TPUTreeLearner:
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _parse_forced_splits(config: Config, train_data: TrainingData
+                             ) -> tuple:
+        """forcedsplits_filename JSON -> static BFS (parent_leaf, feature,
+        thr_bin) triples for the grower (reference ForceSplits reads the
+        same nested {feature, threshold, left, right} JSON,
+        serial_tree_learner.cpp:617-669)."""
+        path = str(config.forcedsplits_filename or "")
+        if not path:
+            return ()
+        import json
+
+        with open(path) as f:
+            root = json.load(f)
+        pos_of = {col: j for j, col in enumerate(train_data.used_feature_idx)}
+        out = []
+        queue = [(root, 0)]
+        while queue and len(out) < max(int(config.num_leaves) - 1, 0):
+            node, leaf = queue.pop(0)
+            real_f = int(node["feature"])
+            if real_f not in pos_of:
+                raise ValueError(
+                    f"forced split on unused/trivial feature {real_f}")
+            inner = pos_of[real_f]
+            mapper = train_data.mappers[real_f]
+            from ..io.bin_mapper import BinType
+
+            if mapper.bin_type != BinType.NUMERICAL:
+                raise NotImplementedError(
+                    "forced splits on categorical features are not "
+                    "supported")
+            thr_bin = int(mapper.value_to_bin(float(node["threshold"])))
+            i = len(out)
+            out.append((leaf, inner, thr_bin))
+            # left child keeps the parent's leaf id; right child is the
+            # (i+1)-th leaf created (the grower's record/new-leaf contract)
+            if isinstance(node.get("left"), dict) and "feature" in node["left"]:
+                queue.append((node["left"], leaf))
+            if isinstance(node.get("right"), dict) and "feature" in node["right"]:
+                queue.append((node["right"], i + 1))
+        return tuple(out)
+
     def sample_features(self) -> jnp.ndarray:
         """Per-tree feature_fraction mask (reference GetUsedFeatures,
         serial_tree_learner.cpp:271-319).  Sized to the padded feature axis;
@@ -262,7 +324,8 @@ class TPUTreeLearner:
                 perm = jax.random.permutation(kf, F)
                 fmask = jnp.zeros(f_pad, jnp.float32).at[perm[:k_used]].set(1.0)
 
-            out = grow(bins_pad, g, h, mask, fmask, meta)
+            key, k_node = jax.random.split(key)
+            out = grow(bins_pad, g, h, mask, fmask, meta, k_node)
             any_split = out["records"][0, 14] > 0.5  # REC_DID_SPLIT
             delta = out["leaf_output"][out["leaf_ids"]] * learning_rate
             delta = jnp.where(any_split, delta, 0.0)
@@ -281,7 +344,9 @@ class TPUTreeLearner:
             self.pad_vector(row_mask) * self._ones_mask
         out = self.grow(self.bins_pad, self.pad_vector(grad),
                         self.pad_vector(hess), mask,
-                        self.sample_features(), self.meta)
+                        self.sample_features(), self.meta,
+                        jax.random.PRNGKey(
+                            int(self._feature_rng.integers(2 ** 31))))
         tree = self.build_tree(out)
         return tree, out["leaf_ids"][:self.n], out
 

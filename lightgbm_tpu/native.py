@@ -107,8 +107,9 @@ class ForestTables:
                 self.cat_bound_offset.ctypes, self.cat_boundaries.ctypes,
                 self.cat_word_offset.ctypes, self.cat_words.ctypes)
 
-    def predict(self, X: np.ndarray, num_trees: int,
-                num_class: int) -> Optional[np.ndarray]:
+    def predict(self, X: np.ndarray, num_trees: int, num_class: int,
+                early_stop_freq: int = 0,
+                early_stop_margin: float = 0.0) -> Optional[np.ndarray]:
         """[k, n] summed raw scores via the native walker; None = no lib."""
         lib = native_lib()
         if lib is None:
@@ -120,7 +121,8 @@ class ForestTables:
         lib.LGBMTPU_ForestPredict(
             X.ctypes, ctypes.c_int64(n), ctypes.c_int32(X.shape[1]),
             ctypes.c_int32(num_trees), ctypes.c_int32(num_class),
-            *args, out.ctypes)
+            *args, ctypes.c_int32(early_stop_freq),
+            ctypes.c_double(early_stop_margin), out.ctypes)
         return out
 
     def predict_leaf(self, X: np.ndarray,

@@ -77,7 +77,8 @@ import numpy as np
 from .histogram import (build_histogram_batched_inline, build_histogram_inline,
                         pack_stats)
 from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
-                    per_feature_best_split, per_feature_best_split_categorical,
+                    leaf_split_gain, per_feature_best_split,
+                    per_feature_best_split_categorical,
                     MISSING_NAN, MISSING_ZERO)
 
 
@@ -108,6 +109,20 @@ class GrowerParams(NamedTuple):
     # batching near-ties keeps the split order close to strict best-first
     # (a child's gain rarely exceeds a near-tie of its parent's round)
     split_batch_alpha: float = 0.0
+    # per-NODE feature sampling (reference GetUsedFeatures with
+    # is_tree_level=false, serial_tree_learner.cpp:271-319); Bernoulli
+    # form of the reference's exact-count sample, like the GOSS sampler
+    feature_fraction_bynode: float = 1.0
+    # CEGB (reference cost_effective_gradient_boosting.hpp:21-80): gains
+    # are charged tradeoff * (split penalty + coupled per-feature penalty
+    # for features not yet used anywhere in the model)
+    has_cegb: bool = False
+    cegb_tradeoff: float = 1.0
+    cegb_penalty_split: float = 0.0
+    # forced splits (reference ForceSplits, serial_tree_learner.cpp:
+    # 607-769): static BFS-ordered tuple of (parent_leaf, feature, thr_bin)
+    # applied as unrolled rounds before best-gain growth
+    forced: tuple = ()
 
 
 def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
@@ -234,12 +249,15 @@ def make_grower(params: GrowerParams, num_features: int,
                 cat_mask=pfc.cat_mask[bi] * c.astype(jnp.float32))
         return gain, fin
 
+    bynode = params.feature_fraction_bynode < 1.0
+
     def grow(bins_pad: jnp.ndarray,     # [n_pad, F] int32 (rows >= n zero-filled)
              grad: jnp.ndarray,         # [n_pad] f32 (padding rows zero)
              hess: jnp.ndarray,         # [n_pad] f32
              row_mask: jnp.ndarray,     # [n_pad] f32 (bagging x padding)
              feature_mask: jnp.ndarray,  # [F] f32 ([F_global] w/ feature_axis)
-             meta: Dict[str, jnp.ndarray]):
+             meta: Dict[str, jnp.ndarray],
+             key: jnp.ndarray):         # PRNG key (per-node sampling)
         n_pad = bins_pad.shape[0]
         block = min(params.block_rows, n_pad)
         nb = max(n_pad // block, 1)
@@ -252,16 +270,42 @@ def make_grower(params: GrowerParams, num_features: int,
                 return jax.lax.dynamic_slice_in_dim(a, ax * F, F)
 
             meta_local = {k: fslice(v) for k, v in meta.items()}
-            fmask_local = fslice(feature_mask)
         else:
             ax = None
             meta_local = meta
-            fmask_local = feature_mask
 
-        def select(hist, sg, sh, cnt, min_c, max_c) -> SplitResult:
+        FG = feature_mask.shape[0]  # global feature width
+
+        def bynode_masks(k, shape_prefix):
+            """Per-node feature masks: Bernoulli(frac) over the tree-level
+            mask, falling back to the full mask for empty draws."""
+            r = jax.random.uniform(k, shape_prefix + (FG,))
+            samp = ((r < params.feature_fraction_bynode)
+                    & (feature_mask > 0)).astype(jnp.float32)
+            nonempty = jnp.sum(samp, axis=-1, keepdims=True) > 0
+            return jnp.where(nonempty, samp, feature_mask)
+
+        def cegb_delta(used):
+            """Per-feature gain charge (DetlaGain,
+            cost_effective_gradient_boosting.hpp:50): the split penalty
+            plus the coupled feature-acquisition penalty for features the
+            model has not used yet."""
+            return params.cegb_tradeoff * (
+                params.cegb_penalty_split
+                + meta["cegb_coupled"] * (1.0 - used))
+
+        def apply_delta(gain_vec, delta):
+            return jnp.where(gain_vec > K_MIN_SCORE / 2, gain_vec - delta,
+                             gain_vec)
+
+        def select(hist, sg, sh, cnt, min_c, max_c, fmask,
+                   delta) -> SplitResult:
             """Best split across all (global) features for one leaf; the
             returned feature index is GLOBAL in every mode.  vmapped over
-            children by the round body."""
+            children by the round body.  fmask/delta are global-width."""
+            fmask_local = fslice(fmask) if feature_axis else fmask
+            delta_local = (fslice(delta) if feature_axis else delta) \
+                if params.has_cegb else None
             if voting_k:
                 # local leaf totals from any one feature's bins (every row
                 # lands in exactly one bin per feature)
@@ -284,15 +328,21 @@ def make_grower(params: GrowerParams, num_features: int,
                 gain_sel, fin = combined_search(sel_hist, sg, sh, cnt,
                                                 sel_meta, fmask_local[sel],
                                                 split_kw, min_c, max_c)
+                if params.has_cegb:
+                    gain_sel = apply_delta(gain_sel, delta_local[sel])
                 bi = jnp.argmax(gain_sel).astype(jnp.int32)
                 res = fin(bi)
-                return res._replace(feature=sel[bi])
+                return res._replace(feature=sel[bi], gain=gain_sel[bi])
 
             gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_local,
                                             fmask_local, split_kw,
                                             min_c, max_c)
+            if params.has_cegb:
+                gain_vec = apply_delta(gain_vec, delta_local)
             bf = jnp.argmax(gain_vec).astype(jnp.int32)
             res = fin(bf)
+            if params.has_cegb:
+                res = res._replace(gain=gain_vec[bf])
             if feature_axis:
                 # global best = argmax over per-shard bests (replaces
                 # SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213);
@@ -320,7 +370,9 @@ def make_grower(params: GrowerParams, num_features: int,
                     cat_mask=pick(res.cat_mask))
             return res
 
-        vselect = jax.vmap(select)
+        vselect = jax.vmap(select,
+                           in_axes=(0, 0, 0, 0, 0, 0,
+                                    0 if bynode else None, None))
 
         # ---- root ----------------------------------------------------
         g = grad * row_mask
@@ -336,7 +388,15 @@ def make_grower(params: GrowerParams, num_features: int,
         root_hist = preduce_hist(
             build_histogram_inline(bins_blocks, stats_blocks, B, precision))
         big = jnp.float32(1e30)
-        root_split = select(root_hist, sum_g, sum_h, cnt, -big, big)
+        if bynode:
+            key, k_root = jax.random.split(key)
+            root_fmask = bynode_masks(k_root, ())
+        else:
+            root_fmask = feature_mask
+        used0 = jnp.zeros(FG, jnp.float32)
+        delta0 = cegb_delta(used0) if params.has_cegb else None
+        root_split = select(root_hist, sum_g, sum_h, cnt, -big, big,
+                            root_fmask, delta0)
 
         RW = REC_WIDTH + (CB if params.has_cat else 0)
         state = {
@@ -371,6 +431,10 @@ def make_grower(params: GrowerParams, num_features: int,
             "records": jnp.zeros((L - 1 + K, RW), jnp.float32),
             "n_splits": jnp.int32(0),
         }
+        if bynode:
+            state["key"] = key
+        if params.has_cegb:
+            state["used"] = used0
 
         def cand_gains(state):
             depth_ok = jnp.logical_or(
@@ -387,34 +451,16 @@ def make_grower(params: GrowerParams, num_features: int,
             safe = jnp.where(valid, idx, arr.shape[0])
             return arr.at[safe].set(val, mode="drop")
 
-        def body(state):
+        def exec_round(state, sel, vals, do_k, sel_feat, sel_thr, sel_dleft,
+                       sel_iscat, cmask_sel, lg, lh, lc, lo, ro):
+            """Execute up to K splits (slot k: leaf sel[k] on feature
+            sel_feat[k]) — partition, batched child histograms, child
+            search, state/record updates.  Shared by the best-gain round
+            body and the unrolled forced-split rounds."""
             leaf_ids = state["leaf_ids"]
-            vals, sel = jax.lax.top_k(cand_gains(state), K)
-            sel = sel.astype(jnp.int32)
             kar = jnp.arange(K, dtype=jnp.int32)
-            budget = (L - 1) - state["n_splits"]
-            # vals is sorted descending, so do_k is a prefix mask: records
-            # written this round are contiguous
-            do_k = (vals > 0.0) & (kar < budget)
-            if params.split_batch_alpha > 0.0 and K > 1:
-                # near-tie guard (still a prefix: vals descending); alpha
-                # is clamped below 1 so slot 0 always qualifies and the
-                # while_loop is guaranteed to make progress
-                alpha = min(params.split_batch_alpha, 0.999)
-                do_k &= vals >= alpha * vals[0]
             num_do = jnp.sum(do_k.astype(jnp.int32))
             new_ids = state["n_splits"] + 1 + kar
-
-            sel_feat = state["bs_feat"][sel]
-            sel_thr = state["bs_thr"][sel]
-            sel_dleft = state["bs_dleft"][sel]
-            sel_iscat = state["bs_iscat"][sel]
-            cmask_sel = state["bs_catmask"][sel]             # [K, CB]
-            lg = state["bs_lg"][sel]
-            lh = state["bs_lh"][sel]
-            lc = state["bs_lc"][sel]
-            lo = state["bs_lo"][sel]
-            ro = state["bs_ro"][sel]
             pg = state["leaf_sum_g"][sel]
             ph = state["leaf_sum_h"][sel]
             pc = state["leaf_cnt"][sel]
@@ -491,14 +537,28 @@ def make_grower(params: GrowerParams, num_features: int,
             r_max = jnp.where(mono_k < 0, mid, p_max)
 
             # ---- best splits for all 2K children -----------------------
+            new_state = dict(state)
+            if bynode:
+                nkey, k_nodes = jax.random.split(state["key"])
+                child_masks = bynode_masks(k_nodes, (2 * K,))
+                new_state["key"] = nkey
+            else:
+                child_masks = feature_mask
+            if params.has_cegb:
+                used = scatter_set(state["used"], sel_feat,
+                                   jnp.ones(K, jnp.float32), do_k)
+                new_state["used"] = used
+                delta = cegb_delta(used)
+            else:
+                delta = None
             ch = vselect(
                 jnp.concatenate([hist_left, hist_right], axis=0),
                 jnp.concatenate([lg, rg]), jnp.concatenate([lh, rh]),
                 jnp.concatenate([lc, rc]),
                 jnp.concatenate([l_min, r_min]),
-                jnp.concatenate([l_max, r_max]))
+                jnp.concatenate([l_max, r_max]),
+                child_masks, delta)
 
-            new_state = dict(state)
             new_state["leaf_ids"] = leaf_ids
             new_state["pool"] = pool
             for key, li, ri in (("leaf_sum_g", lg, rg), ("leaf_sum_h", lh, rh),
@@ -535,6 +595,104 @@ def make_grower(params: GrowerParams, num_features: int,
                 state["records"], rec, (state["n_splits"], jnp.int32(0)))
             new_state["n_splits"] = state["n_splits"] + num_do
             return new_state
+
+        def body(state):
+            vals, sel = jax.lax.top_k(cand_gains(state), K)
+            sel = sel.astype(jnp.int32)
+            kar = jnp.arange(K, dtype=jnp.int32)
+            budget = (L - 1) - state["n_splits"]
+            # vals is sorted descending, so do_k is a prefix mask: records
+            # written this round are contiguous
+            do_k = (vals > 0.0) & (kar < budget)
+            if params.split_batch_alpha > 0.0 and K > 1:
+                # near-tie guard (still a prefix: vals descending); alpha
+                # is clamped below 1 so slot 0 always qualifies and the
+                # while_loop is guaranteed to make progress
+                alpha = min(params.split_batch_alpha, 0.999)
+                do_k &= vals >= alpha * vals[0]
+            return exec_round(
+                state, sel, vals, do_k,
+                state["bs_feat"][sel], state["bs_thr"][sel],
+                state["bs_dleft"][sel], state["bs_iscat"][sel],
+                state["bs_catmask"][sel],
+                state["bs_lg"][sel], state["bs_lh"][sel],
+                state["bs_lc"][sel], state["bs_lo"][sel],
+                state["bs_ro"][sel])
+
+        def forced_round(state, ok, parent, feat, thr):
+            """One forced split (reference ForceSplits, serial_tree_
+            learner.cpp:607-769): leaf `parent` splits on static (feat,
+            thr) regardless of best gain; left stats come from the pooled
+            histogram at the threshold (GatherInfoForThreshold,
+            feature_histogram.hpp:281-419).  A negative forced gain aborts
+            this and all remaining forced splits, like the reference's
+            aborted_last_force_split."""
+            p = jnp.int32(parent)
+            iota_b = jnp.arange(B, dtype=jnp.int32)
+            mt = meta["missing_type"][feat]
+            nb_f = meta["num_bin"][feat]
+            db_f = meta["default_bin"][feat]
+            nan_excl = (mt == MISSING_NAN) & (iota_b == nb_f - 1)
+            mask_b = ((iota_b <= thr) & (iota_b < nb_f)
+                      & (~nan_excl)).astype(jnp.float32)
+            if feature_axis:
+                own = (feat // F) == ax
+                col_hist = state["pool"][p, feat % F]        # [B, 3]
+                sums = jnp.sum(col_hist * mask_b[:, None], axis=0)
+                sums = jax.lax.psum(
+                    jnp.where(own, sums, jnp.zeros_like(sums)), feature_axis)
+            else:
+                col_hist = state["pool"][p, feat]
+                sums = jnp.sum(col_hist * mask_b[:, None], axis=0)
+            if data_axis and voting_k:
+                # voting keeps the pool local: forced stats need the
+                # global sums
+                sums = jax.lax.psum(sums, data_axis)
+            lg0, lh0, lc0 = sums[0], sums[1], sums[2]
+            pg0 = state["leaf_sum_g"][p]
+            ph0 = state["leaf_sum_h"][p]
+            pc0 = state["leaf_cnt"][p]
+            rg0, rh0, rc0 = pg0 - lg0, ph0 - lh0, pc0 - lc0
+            min_c = state["leaf_min"][p]
+            max_c = state["leaf_max"][p]
+            lo0 = jnp.clip(leaf_output(lg0, lh0, params.l1, params.l2,
+                                       params.max_delta_step), min_c, max_c)
+            ro0 = jnp.clip(leaf_output(rg0, rh0, params.l1, params.l2,
+                                       params.max_delta_step), min_c, max_c)
+            shift = leaf_split_gain(pg0, ph0 + 2e-15, params.l1, params.l2,
+                                    params.max_delta_step)
+            gain0 = (leaf_split_gain(lg0, lh0, params.l1, params.l2,
+                                     params.max_delta_step)
+                     + leaf_split_gain(rg0, rh0, params.l1, params.l2,
+                                       params.max_delta_step)
+                     - shift - params.min_gain_to_split)
+            do0 = ok & (gain0 >= 0.0) & (lc0 > 0) & (rc0 > 0)
+            kar = jnp.arange(K, dtype=jnp.int32)
+            first = kar == 0
+
+            def bcast(v, fill=0):
+                return jnp.where(first, v, fill)
+
+            dleft0 = (mt == MISSING_ZERO) & (db_f <= thr)
+            new_state = exec_round(
+                state,
+                jnp.full(K, p, jnp.int32),
+                bcast(gain0, K_MIN_SCORE),
+                first & do0,
+                jnp.full(K, feat, jnp.int32),
+                jnp.full(K, thr, jnp.int32),
+                jnp.broadcast_to(dleft0, (K,)),
+                jnp.zeros(K, jnp.bool_),
+                jnp.zeros((K, CB), jnp.float32),
+                bcast(lg0), bcast(lh0), bcast(lc0), bcast(lo0), bcast(ro0))
+            return new_state, do0
+
+        # forced splits run first as statically-unrolled rounds (the
+        # forced table is compile-time constant for a training run)
+        forced_ok = jnp.asarray(True)
+        for parent, feat, thr in params.forced:
+            state, forced_ok = forced_round(state, forced_ok,
+                                            int(parent), int(feat), int(thr))
 
         state = jax.lax.while_loop(cond, body, state)
         return {

@@ -17,7 +17,7 @@ of the same grower body over a `jax.sharding.Mesh` axis:
              (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp)
 
 All four present the SAME call signature
-    grow(bins_pad, grad, hess, row_mask, feature_mask, meta) -> out dict
+    grow(bins_pad, grad, hess, row_mask, feature_mask, meta, key) -> out dict
 so the driver/learner code is strategy-agnostic.
 """
 
@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.grower import GrowerParams, make_grower
 
 META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty",
-             "is_categorical")
+             "is_categorical", "cegb_coupled")
 
 _CANON = {
     "serial": "serial",
@@ -68,7 +68,7 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         fn = shard_map(
             grow, mesh=mesh,
             in_specs=(P("data", None), P("data"), P("data"), P("data"),
-                      P(), meta_spec),
+                      P(), meta_spec, P()),
             out_specs={"records": P(), "leaf_ids": P("data"),
                        "leaf_output": P(), "leaf_cnt": P(),
                        "leaf_sum_h": P()},
@@ -84,7 +84,7 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         grow = make_grower(params, f_local, feature_axis="feature", jit=False)
         fn = shard_map(
             grow, mesh=mesh,
-            in_specs=(P(None, "feature"), P(), P(), P(), P(), meta_spec),
+            in_specs=(P(None, "feature"), P(), P(), P(), P(), meta_spec, P()),
             out_specs={"records": P(), "leaf_ids": P(),
                        "leaf_output": P(), "leaf_cnt": P(),
                        "leaf_sum_h": P()},

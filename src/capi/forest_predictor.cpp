@@ -86,7 +86,12 @@ inline int32_t walk(const Forest& f, int32_t tree, const double* row) {
 
 // Sum leaf values of trees [0, num_trees) into out[class][row]; tree i
 // belongs to class i % num_class (the reference's per-iteration class
-// interleaving, gbdt_prediction.cpp:17-29).
+// interleaving, gbdt_prediction.cpp:17-29).  early_stop_freq > 0 enables
+// prediction early stopping (reference src/boosting/
+// prediction_early_stop.cpp:75-81): every freq iterations the row's
+// margin — |score| for binary, best-minus-second for multiclass — is
+// checked against early_stop_margin and the remaining trees are skipped
+// once it is exceeded.
 LGBM_EXPORT int LGBMTPU_ForestPredict(
     const double* X, int64_t nrow, int32_t ncol, int32_t num_trees,
     int32_t num_class, const int32_t* node_offset,
@@ -95,7 +100,8 @@ LGBM_EXPORT int LGBMTPU_ForestPredict(
     const int32_t* left_child, const int32_t* right_child,
     const double* leaf_value, const int32_t* cat_bound_offset,
     const int32_t* cat_boundaries, const int32_t* cat_word_offset,
-    const uint32_t* cat_words, double* out) {
+    const uint32_t* cat_words, int32_t early_stop_freq,
+    double early_stop_margin, double* out) {
   Forest f{node_offset, leaf_offset, split_feature, threshold,
            decision_type, left_child, right_child, leaf_value,
            cat_bound_offset, cat_boundaries, cat_word_offset, cat_words};
@@ -105,6 +111,24 @@ LGBM_EXPORT int LGBMTPU_ForestPredict(
     for (int32_t t = 0; t < num_trees; ++t) {
       const int32_t leaf = walk(f, t, row);
       out[(t % num_class) * nrow + r] += leaf_value[f.leaf_offset[t] + leaf];
+      if (early_stop_freq > 0 && t % num_class == num_class - 1) {
+        const int32_t iter = t / num_class + 1;
+        if (iter % early_stop_freq == 0) {
+          double margin;
+          if (num_class == 1) {
+            margin = std::fabs(out[r]);
+          } else {
+            double best = out[r], second = -1e300;
+            for (int32_t c = 1; c < num_class; ++c) {
+              const double v = out[c * nrow + r];
+              if (v > best) { second = best; best = v; }
+              else if (v > second) { second = v; }
+            }
+            margin = best - second;
+          }
+          if (margin >= early_stop_margin) break;
+        }
+      }
     }
   }
   return 0;

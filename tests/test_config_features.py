@@ -1,0 +1,161 @@
+"""Config keys that change training semantics: forced splits,
+feature_fraction_bynode, CEGB, snapshot_freq, pred_early_stop.
+
+These were VERDICT round-2's "silent no-op" keys; each now either works
+(tested here) or raises loudly (lazy CEGB).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # e2e trainings
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def xy():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(3000, 8))
+    y = (X[:, 0] + 0.4 * X[:, 1] - 0.3 * X[:, 3] > 0.2).astype(np.float64)
+    return X, y
+
+
+def _tree_features(bst):
+    used = set()
+
+    def walk(nd):
+        if "split_feature" in nd:
+            used.add(nd["split_feature"])
+            walk(nd["left_child"])
+            walk(nd["right_child"])
+    for t in bst.dump_model()["tree_info"]:
+        if "split_feature" in t["tree_structure"]:
+            walk(t["tree_structure"])
+    return used
+
+
+class TestForcedSplits:
+    def test_forced_root_and_child(self, xy, tmp_path):
+        X, y = xy
+        fs = {"feature": 7, "threshold": 0.0,
+              "right": {"feature": 6, "threshold": 0.5}}
+        p = tmp_path / "forced.json"
+        p.write_text(json.dumps(fs))
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "forcedsplits_filename": str(p)},
+                        ds, num_boost_round=3, verbose_eval=False)
+        for t in bst.dump_model()["tree_info"]:
+            root = t["tree_structure"]
+            assert root["split_feature"] == 7
+            assert root["right_child"]["split_feature"] == 6
+
+    def test_forced_matches_oracle_structure(self, xy, tmp_path):
+        from .conftest import ORACLE_BIN, has_oracle
+        if not has_oracle():
+            pytest.skip("reference oracle not built")
+        import subprocess
+        X, y = xy
+        fs = {"feature": 7, "threshold": 0.0}
+        fjson = tmp_path / "forced.json"
+        fjson.write_text(json.dumps(fs))
+        data = tmp_path / "train.csv"
+        np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+        subprocess.run(
+            [ORACLE_BIN, "task=train", f"data={data}", "objective=binary",
+             "num_trees=1", "num_leaves=15", "min_data_in_leaf=20",
+             f"forcedsplits_filename={fjson}", "verbosity=-1",
+             f"output_model={tmp_path}/ref.txt"],
+            check=True, capture_output=True, cwd=str(tmp_path))
+        ref = (tmp_path / "ref.txt").read_text()
+        ref_kv = dict(l.split("=", 1) for l in ref.splitlines()
+                      if "=" in l and not l.startswith("["))
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 255})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "min_data_in_leaf": 20, "tpu_split_batch": 1,
+                         "forcedsplits_filename": str(fjson)},
+                        ds, num_boost_round=1, verbose_eval=False)
+        root = bst.dump_model()["tree_info"][0]["tree_structure"]
+        assert root["split_feature"] == \
+            int(ref_kv["split_feature"].split()[0])
+
+
+class TestFeatureFractionByNode:
+    def test_learns_with_diverse_features(self, xy):
+        X, y = xy
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "feature_fraction_bynode": 0.4, "seed": 3},
+                        ds, num_boost_round=15, verbose_eval=False)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, bst.predict(X)) > 0.8
+        assert len(_tree_features(bst)) >= 5
+
+
+class TestCEGB:
+    def test_coupled_penalty_avoids_feature(self, xy):
+        X, y = xy
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "cegb_penalty_feature_coupled":
+                             [1e6] + [0.0] * 7},
+                        ds, num_boost_round=5, verbose_eval=False)
+        assert 0 not in _tree_features(bst)
+
+    def test_split_penalty_prunes(self, xy):
+        X, y = xy
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        free = lgb.train({"objective": "binary", "num_leaves": 63},
+                         ds, num_boost_round=3, verbose_eval=False)
+        ds2 = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        taxed = lgb.train({"objective": "binary", "num_leaves": 63,
+                           "cegb_penalty_split": 50.0},
+                          ds2, num_boost_round=3, verbose_eval=False)
+        n_free = sum(t["num_leaves"] for t in free.dump_model()["tree_info"])
+        n_taxed = sum(t["num_leaves"] for t in taxed.dump_model()["tree_info"])
+        assert n_taxed < n_free
+
+    def test_lazy_penalty_raises(self, xy):
+        X, y = xy
+        ds = lgb.Dataset(X, label=y)
+        with pytest.raises(NotImplementedError):
+            lgb.train({"objective": "binary",
+                       "cegb_penalty_feature_lazy": [1.0] * 8},
+                      ds, num_boost_round=1, verbose_eval=False)
+
+
+class TestSnapshots:
+    def test_snapshot_files_written(self, xy, tmp_path):
+        X, y = xy
+        ds = lgb.Dataset(X, label=y)
+        out = tmp_path / "model.txt"
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "snapshot_freq": 2, "output_model": str(out)},
+                  ds, num_boost_round=5, verbose_eval=False)
+        snaps = sorted(p.name for p in tmp_path.glob("*.snapshot_iter_*"))
+        assert snaps == ["model.txt.snapshot_iter_2",
+                        "model.txt.snapshot_iter_4"]
+        snap = lgb.Booster(model_file=str(tmp_path / snaps[0]))
+        assert snap.num_trees() == 2
+
+
+class TestPredEarlyStop:
+    def test_confident_rows_stop_early(self, xy):
+        X, y = xy
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "learning_rate": 0.3},
+                        ds, num_boost_round=40, verbose_eval=False)
+        p_full = bst.predict(X, raw_score=True)
+        p_es = bst.predict(X, raw_score=True, pred_early_stop=True,
+                           pred_early_stop_freq=5,
+                           pred_early_stop_margin=3.0)
+        stopped = np.abs(p_es - p_full) > 1e-12
+        assert stopped.any()
+        # stopped rows are on the right side with margin already reached
+        assert (np.sign(p_es[stopped]) == np.sign(p_full[stopped])).all()
+        assert (np.abs(p_es[stopped]) >= 3.0).all()
