@@ -170,6 +170,8 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # a small fraction of the frontier, so the split order tracks strict
     # best-first closely even while histogramming K leaves per pass
     "tpu_split_batch": ("int", 0, ()),
+    # batched-histogram backend: xla | pallas
+    "tpu_hist_impl": ("str", "xla", ()),
     # only batch leaves whose gain >= alpha * the round's best gain (near
     # ties); keeps batched split order close to strict best-first
     "tpu_split_batch_alpha": ("float", 0.0, ()),

@@ -101,11 +101,11 @@ class TPUTreeLearner:
             self.f_pad = (-(-self.num_features // self.n_shards)
                           * self.n_shards)
 
-        bins = train_data.bins
-        if self.n_pad != n or self.f_pad != self.num_features:
-            padded = np.zeros((self.n_pad, self.f_pad), dtype=bins.dtype)
-            padded[:n, :self.num_features] = bins
-            bins = padded
+        # transposed [F, n] bin matrix: rows ride the 128-lane minor axis
+        # for the histogram contraction (see ops/histogram.py)
+        bins_t = np.zeros((self.f_pad, self.n_pad),
+                          dtype=train_data.bins.dtype)
+        bins_t[:self.num_features, :n] = train_data.bins.T
 
         meta_host = {}
         for k, v in meta_np.items():
@@ -119,7 +119,7 @@ class TPUTreeLearner:
         if strategy == "serial":
             self.mesh = None
             # int32 bins: the one-hot compare needs an iota-compatible dtype
-            self.bins_pad = jnp.asarray(bins.astype(np.int32))
+            self.bins_t = jnp.asarray(bins_t.astype(np.int32))
             ones = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
             self._ones_mask = ones
         else:
@@ -127,8 +127,8 @@ class TPUTreeLearner:
                 self.mesh = make_mesh(num_feature_shards=self.n_shards)
             else:
                 self.mesh = make_mesh(num_data_shards=self.n_shards)
-            self.bins_pad = jax.device_put(
-                bins.astype(np.int32), bins_sharding(self.mesh, strategy))
+            self.bins_t = jax.device_put(
+                bins_t.astype(np.int32), bins_sharding(self.mesh, strategy))
             ones = np.ones(self.n_pad, np.float32)
             ones[n:] = 0.0
             self._ones_mask = jax.device_put(
@@ -166,6 +166,7 @@ class TPUTreeLearner:
             cegb_tradeoff=float(config.cegb_tradeoff),
             cegb_penalty_split=float(config.cegb_penalty_split),
             forced=forced,
+            hist_impl=str(config.tpu_hist_impl),
         )
         self.grow = make_strategy_grower(
             self.params, self.f_pad, strategy, self.mesh,
@@ -265,7 +266,7 @@ class TPUTreeLearner:
         f_pad = self.f_pad
         grow = self.grow
         meta = self.meta
-        bins_pad = self.bins_pad
+        bins_t = self.bins_t
 
         goss_top_k = goss_other_k = 0
         if goss is not None:
@@ -325,7 +326,7 @@ class TPUTreeLearner:
                 fmask = jnp.zeros(f_pad, jnp.float32).at[perm[:k_used]].set(1.0)
 
             key, k_node = jax.random.split(key)
-            out = grow(bins_pad, g, h, mask, fmask, meta, k_node)
+            out = grow(bins_t, g, h, mask, fmask, meta, k_node)
             any_split = out["records"][0, 14] > 0.5  # REC_DID_SPLIT
             delta = out["leaf_output"][out["leaf_ids"]] * learning_rate
             delta = jnp.where(any_split, delta, 0.0)
@@ -342,7 +343,7 @@ class TPUTreeLearner:
         """Grow one tree. Returns (tree, leaf_ids[n] device, raw grower out)."""
         mask = self._ones_mask if row_mask is None else \
             self.pad_vector(row_mask) * self._ones_mask
-        out = self.grow(self.bins_pad, self.pad_vector(grad),
+        out = self.grow(self.bins_t, self.pad_vector(grad),
                         self.pad_vector(hess), mask,
                         self.sample_features(), self.meta,
                         jax.random.PRNGKey(

@@ -74,7 +74,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import (build_histogram_batched_inline, build_histogram_inline,
+from .histogram import (build_histogram_batched_t, build_histogram_t,
                         pack_stats)
 from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
                     leaf_split_gain, per_feature_best_split,
@@ -123,6 +123,9 @@ class GrowerParams(NamedTuple):
     # 607-769): static BFS-ordered tuple of (parent_leaf, feature, thr_bin)
     # applied as unrolled rounds before best-gain growth
     forced: tuple = ()
+    # batched-histogram backend: "xla" (scan + dot_general) or "pallas"
+    # (fused VMEM kernel, ops/histogram.py _hist_pallas)
+    hist_impl: str = "xla"
 
 
 def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
@@ -251,14 +254,15 @@ def make_grower(params: GrowerParams, num_features: int,
 
     bynode = params.feature_fraction_bynode < 1.0
 
-    def grow(bins_pad: jnp.ndarray,     # [n_pad, F] int32 (rows >= n zero-filled)
+    def grow(bins_t: jnp.ndarray,       # [F, n_pad] int32 (rows on lanes;
+             #                            cols >= n zero-filled)
              grad: jnp.ndarray,         # [n_pad] f32 (padding rows zero)
              hess: jnp.ndarray,         # [n_pad] f32
              row_mask: jnp.ndarray,     # [n_pad] f32 (bagging x padding)
              feature_mask: jnp.ndarray,  # [F] f32 ([F_global] w/ feature_axis)
              meta: Dict[str, jnp.ndarray],
              key: jnp.ndarray):         # PRNG key (per-node sampling)
-        n_pad = bins_pad.shape[0]
+        n_pad = bins_t.shape[1]
         block = min(params.block_rows, n_pad)
         nb = max(n_pad // block, 1)
         block = n_pad // nb
@@ -383,10 +387,10 @@ def make_grower(params: GrowerParams, num_features: int,
         # per-tree packed stats, reused by every round's contraction
         stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
         S = stats.shape[0]
-        bins_blocks = bins_pad.reshape(nb, block, F)
+        bins_blocks = jnp.moveaxis(bins_t.reshape(F, nb, block), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
         root_hist = preduce_hist(
-            build_histogram_inline(bins_blocks, stats_blocks, B, precision))
+            build_histogram_t(bins_blocks, stats_blocks, B, precision))
         big = jnp.float32(1e30)
         if bynode:
             key, k_root = jax.random.split(key)
@@ -483,13 +487,13 @@ def make_grower(params: GrowerParams, num_features: int,
                 lf_k = jnp.mod(sel_feat, F)
                 own_r = shard_k[kk_r] == ax
                 col_l = jnp.take_along_axis(
-                    bins_pad, lf_k[kk_r][:, None], axis=1)[:, 0]
+                    bins_t, lf_k[kk_r][None, :], axis=0)[0]
                 col_r = jax.lax.psum(
                     jnp.where(own_r, col_l, 0), feature_axis)
             else:
                 f_r = sel_feat[kk_r]
                 col_r = jnp.take_along_axis(
-                    bins_pad, f_r[:, None], axis=1)[:, 0]
+                    bins_t, f_r[None, :], axis=0)[0]
             mt_k = meta["missing_type"][sel_feat]
             nb_k = meta["num_bin"][sel_feat]
             db_k = meta["default_bin"][sel_feat]
@@ -513,9 +517,10 @@ def make_grower(params: GrowerParams, num_features: int,
             smaller_is_left = lc <= rc
             smaller_ids = jnp.where(
                 do_k, jnp.where(smaller_is_left, sel, new_ids), -1)
-            hist_small = preduce_hist(build_histogram_batched_inline(
+            hist_small = preduce_hist(build_histogram_batched_t(
                 bins_blocks, stats_blocks, leaf_ids.reshape(nb, block),
-                smaller_ids, B, precision))                  # [K, F, B, 3]
+                smaller_ids, B, precision,
+                impl=params.hist_impl))                      # [K, F, B, 3]
             parent_hist = state["pool"][sel]                 # [K, F, B, 3]
             hist_large = parent_hist - hist_small
             sl = smaller_is_left[:, None, None, None]

@@ -115,6 +115,154 @@ def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
     return hist.reshape(num_features, num_bins, 3)
 
 
+def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
+                              slot_leaf_ids, num_bins: int,
+                              precision: str = "hilo",
+                              impl: str = "xla") -> jnp.ndarray:
+    """Transposed-layout batched histogram: rows on the lane axis.
+
+    Same contraction as `build_histogram_batched_inline` but with the bin
+    matrix stored [F, n] so every operand keeps rows in the 128-lane minor
+    dimension (bins [F, blk], stats [S, blk], leaf [1, blk]) — no 28-lane
+    padding waste and no layout changes between the one-hot generation and
+    the MXU feed.
+
+    bins_t_blocks: [nb, F, block] int32
+    stats_blocks:  [S, nb, block]
+    leaf_blocks:   [nb, block] int32
+    slot_leaf_ids: [K] int32 (-1 = dead slot)
+    impl: "xla" (lax.scan + dot_general) or "pallas" (fused VMEM kernel)
+    Returns [K, F, B, 3] f32.
+    """
+    if impl == "pallas":
+        return _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks,
+                            slot_leaf_ids, num_bins, precision)
+    nb, num_features, block = bins_t_blocks.shape
+    S = stats_blocks.shape[0]
+    K = slot_leaf_ids.shape[0]
+    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+    prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+            else jax.lax.Precision.DEFAULT)
+
+    def body(acc, xs):
+        b_t, s_blk, l_blk = xs  # [F, blk], [S, blk], [blk]
+        iota = jax.lax.broadcasted_iota(jnp.int32,
+                                        (num_features, num_bins, block), 1)
+        onehot = (b_t[:, None, :] == iota).astype(dot_dtype)
+        onehot = onehot.reshape(num_features * num_bins, block)
+        slot_oh = (slot_leaf_ids[:, None] == l_blk[None, :]).astype(dot_dtype)
+        sexp = (slot_oh[:, None, :] * s_blk[None, :, :].astype(dot_dtype))
+        sexp = sexp.reshape(K * S, block)
+        acc = acc + jax.lax.dot_general(
+            onehot, sexp, (((1,), (1,)), ((), ())),
+            precision=prec, preferred_element_type=jnp.float32)
+        return acc, None
+
+    init = jnp.zeros((num_features * num_bins, K * S), jnp.float32)
+    raw, _ = jax.lax.scan(
+        body, init, (bins_t_blocks, jnp.moveaxis(stats_blocks, 1, 0),
+                     leaf_blocks))
+    raw = jnp.transpose(
+        raw.reshape(num_features * num_bins, K, S), (1, 2, 0))
+    hist = jax.vmap(lambda r: _unpack_hist(r, precision))(raw)
+    return hist.reshape(K, num_features, num_bins, 3)
+
+
+def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
+                 num_bins: int, precision: str) -> jnp.ndarray:
+    """Pallas kernel: fused one-hot + slot-expansion + MXU contraction.
+
+    The TPU answer to the reference GPU kernel's workgroup-local
+    sub-histograms (reference src/treelearner/ocl/histogram256.cl:78-120):
+    each grid step keeps the full [F*B, K*S] accumulator resident in VMEM
+    and feeds the MXU straight from the in-register one-hot, so neither
+    the one-hot nor the expanded stats ever round-trip to HBM.
+    """
+    import functools as _ft
+
+    from jax.experimental import pallas as pl
+
+    nb, F, block = bins_t_blocks.shape
+    S = stats_blocks.shape[0]
+    K = slot_leaf_ids.shape[0]
+    B = num_bins
+    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+
+    def kernel(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
+        i = pl.program_id(0)
+        b_t = bins_ref[0]                       # [F, blk] i32
+        s = stats_ref[:, 0, :]                  # [S, blk]
+        l = leaf_ref[:]                         # [1, blk] i32
+        slots = slots_ref[:]                    # [K, 1] i32
+        iota = jax.lax.broadcasted_iota(jnp.int32, (F, B, block), 1)
+        onehot = (b_t[:, None, :] == iota).astype(dot_dtype)
+        onehot = onehot.reshape(F * B, block)
+        slot_oh = (slots == l).astype(dot_dtype)            # [K, blk]
+        sexp = (slot_oh[:, None, :] * s[None, :, :].astype(dot_dtype))
+        sexp = sexp.reshape(K * S, block)
+        acc = jax.lax.dot_general(
+            onehot, sexp, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+        @pl.when(i == 0)
+        def _():
+            out_ref[:] = acc
+
+        @pl.when(i > 0)
+        def _():
+            out_ref[:] += acc
+
+    raw = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((1, F, block), lambda i: (i, 0, 0)),
+            pl.BlockSpec((S, 1, block), lambda i: (0, i, 0)),
+            pl.BlockSpec((1, block), lambda i: (i, 0)),
+            pl.BlockSpec((K, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((F * B, K * S), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F * B, K * S), jnp.float32),
+        # the Mosaic TPU backend is the target; interpret on CPU (tests)
+        interpret=jax.devices()[0].platform not in ("tpu",),
+    )(bins_t_blocks, stats_blocks, leaf_blocks.reshape(nb, block),
+      slot_leaf_ids.reshape(K, 1))
+    raw = jnp.transpose(raw.reshape(F * B, K, S), (1, 2, 0))
+    hist = jax.vmap(lambda r: _unpack_hist(r, precision))(raw)
+    return hist.reshape(K, F, B, 3)
+
+
+def build_histogram_t(bins_t_blocks, stats_blocks, num_bins: int,
+                      precision: str = "hilo") -> jnp.ndarray:
+    """Single-histogram (root) pass in the transposed layout.
+
+    bins_t_blocks: [nb, F, block]; stats_blocks: [S, nb, block].
+    Returns [F, B, 3] f32.
+    """
+    nb, num_features, block = bins_t_blocks.shape
+    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+    prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+            else jax.lax.Precision.DEFAULT)
+
+    def body(acc, xs):
+        b_t, s_blk = xs
+        iota = jax.lax.broadcasted_iota(jnp.int32,
+                                        (num_features, num_bins, block), 1)
+        onehot = (b_t[:, None, :] == iota).astype(dot_dtype)
+        onehot = onehot.reshape(num_features * num_bins, block)
+        acc = acc + jax.lax.dot_general(
+            onehot, s_blk.astype(dot_dtype), (((1,), (1,)), ((), ())),
+            precision=prec, preferred_element_type=jnp.float32)
+        return acc, None
+
+    init = jnp.zeros((num_features * num_bins, stats_blocks.shape[0]),
+                     jnp.float32)
+    raw, _ = jax.lax.scan(
+        body, init, (bins_t_blocks, jnp.moveaxis(stats_blocks, 1, 0)))
+    hist = _unpack_hist(raw.T, precision)
+    return hist.reshape(num_features, num_bins, 3)
+
+
 def build_histogram_batched_inline(bins_blocks, stats_blocks, leaf_blocks,
                                    slot_leaf_ids, num_bins: int,
                                    precision: str = "hilo") -> jnp.ndarray:

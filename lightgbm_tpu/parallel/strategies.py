@@ -17,7 +17,7 @@ of the same grower body over a `jax.sharding.Mesh` axis:
              (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp)
 
 All four present the SAME call signature
-    grow(bins_pad, grad, hess, row_mask, feature_mask, meta, key) -> out dict
+    grow(bins_t, grad, hess, row_mask, feature_mask, meta, key) -> out dict
 so the driver/learner code is strategy-agnostic.
 """
 
@@ -67,7 +67,7 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
             num_shards=nshards, jit=False)
         fn = shard_map(
             grow, mesh=mesh,
-            in_specs=(P("data", None), P("data"), P("data"), P("data"),
+            in_specs=(P(None, "data"), P("data"), P("data"), P("data"),
                       P(), meta_spec, P()),
             out_specs={"records": P(), "leaf_ids": P("data"),
                        "leaf_output": P(), "leaf_cnt": P(),
@@ -84,7 +84,7 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         grow = make_grower(params, f_local, feature_axis="feature", jit=False)
         fn = shard_map(
             grow, mesh=mesh,
-            in_specs=(P(None, "feature"), P(), P(), P(), P(), meta_spec, P()),
+            in_specs=(P("feature", None), P(), P(), P(), P(), meta_spec, P()),
             out_specs={"records": P(), "leaf_ids": P(),
                        "leaf_output": P(), "leaf_cnt": P(),
                        "leaf_sum_h": P()},
@@ -94,11 +94,11 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
 
 
 def bins_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
-    """Sharding for the [n_pad, F] bin matrix under `strategy`."""
+    """Sharding for the transposed [F, n_pad] bin matrix under `strategy`."""
     if strategy in ("data", "voting"):
-        return NamedSharding(mesh, P("data", None))
+        return NamedSharding(mesh, P(None, "data"))
     if strategy == "feature":
-        return NamedSharding(mesh, P(None, "feature"))
+        return NamedSharding(mesh, P("feature", None))
     raise ValueError(strategy)
 
 
