@@ -101,11 +101,49 @@ class TPUTreeLearner:
             self.f_pad = (-(-self.num_features // self.n_shards)
                           * self.n_shards)
 
-        # transposed [F, n] bin matrix: rows ride the 128-lane minor axis
+        # ---- EFB bundling (reference FindGroups/FastFeatureBundling,
+        # dataset.cpp:91-263): sparse zero-default features share columns,
+        # shrinking the histogram matrix's feature axis ----
+        plan = None
+        if (bool(config.enable_bundle) and strategy in ("serial", "data")
+                and not forced and self.num_features > 1):
+            from ..io.bundling import find_bundles
+
+            zero_frac = (train_data.bins == 0).mean(axis=0)
+            mfz = zero_frac >= float(config.sparse_threshold)
+            cand_plan = find_bundles(
+                train_data.bins, meta_np["num_bin"], mfz,
+                float(config.max_conflict_rate), B)
+            if not cand_plan.is_trivial:
+                plan = cand_plan
+                B = max(B, int(plan.num_bin.max()))
+                self.num_bins = B
+                Log.info(
+                    f"EFB: bundled {self.num_features} features into "
+                    f"{plan.num_columns} columns")
+        self.bundle_plan = plan
+
+        if plan is not None:
+            from ..io.bundling import apply_bundles
+
+            bundled = apply_bundles(train_data.bins, plan)
+            cols_src = bundled
+            meta_np["bundle_idx"] = plan.bundle_idx.astype(np.int32)
+            meta_np["bin_offset"] = plan.bin_offset.astype(np.int32)
+            meta_np["needs_fix"] = plan.needs_fix.astype(np.int32)
+        else:
+            cols_src = train_data.bins
+            F_ = self.num_features
+            meta_np["bundle_idx"] = np.arange(F_, dtype=np.int32)
+            meta_np["bin_offset"] = np.zeros(F_, np.int32)
+            meta_np["needs_fix"] = np.zeros(F_, np.int32)
+        self.num_columns = cols_src.shape[1]
+        self.g_pad = self.num_columns if strategy != "feature" else self.f_pad
+
+        # transposed [G, n] bin matrix: rows ride the 128-lane minor axis
         # for the histogram contraction (see ops/histogram.py)
-        bins_t = np.zeros((self.f_pad, self.n_pad),
-                          dtype=train_data.bins.dtype)
-        bins_t[:self.num_features, :n] = train_data.bins.T
+        bins_t = np.zeros((self.g_pad, self.n_pad), dtype=np.int32)
+        bins_t[:self.num_columns, :n] = cols_src.T
 
         meta_host = {}
         for k, v in meta_np.items():
@@ -167,10 +205,11 @@ class TPUTreeLearner:
             cegb_penalty_split=float(config.cegb_penalty_split),
             forced=forced,
             hist_impl=str(config.tpu_hist_impl),
+            has_bundles=plan is not None,
         )
         self.grow = make_strategy_grower(
             self.params, self.f_pad, strategy, self.mesh,
-            voting_k=int(config.top_k))
+            voting_k=int(config.top_k), num_columns=self.g_pad)
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
 
     # ------------------------------------------------------------------

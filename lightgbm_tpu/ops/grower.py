@@ -126,6 +126,12 @@ class GrowerParams(NamedTuple):
     # batched-histogram backend: "xla" (scan + dot_general) or "pallas"
     # (fused VMEM kernel, ops/histogram.py _hist_pallas)
     hist_impl: str = "xla"
+    # EFB (reference FindGroups/FastFeatureBundling, dataset.cpp:91-263):
+    # bins_t holds G <= F bundle columns; meta carries bundle_idx /
+    # bin_offset / needs_fix per feature and the search expands bundle
+    # histograms back to feature space, reconstructing each bundled
+    # feature's bin 0 from leaf totals (FixHistogram, dataset.cpp:1044)
+    has_bundles: bool = False
 
 
 def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
@@ -147,12 +153,15 @@ def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
 def make_grower(params: GrowerParams, num_features: int,
                 data_axis: Optional[str] = None,
                 feature_axis: Optional[str] = None,
-                voting_k: int = 0, num_shards: int = 1, jit: bool = True):
+                voting_k: int = 0, num_shards: int = 1, jit: bool = True,
+                num_columns: Optional[int] = None):
     """Build the whole-tree grower for fixed shapes/params.
 
     num_features is the LOCAL feature count: with `feature_axis` set it is
     the per-shard shard width and the passed meta/feature_mask arrays are
     the GLOBAL [F_local * num_shards] versions (sliced per shard inside).
+    num_columns is the bin-matrix column count: G < F when EFB bundling is
+    active (has_bundles), otherwise F.
     """
     if voting_k and not data_axis:
         raise ValueError("voting requires a data axis")
@@ -161,6 +170,13 @@ def make_grower(params: GrowerParams, num_features: int,
     L = params.num_leaves
     B = params.num_bins
     F = num_features
+    G = num_columns if num_columns is not None else F
+    if params.has_bundles and (feature_axis or voting_k):
+        raise ValueError("EFB bundling composes with serial/data learners "
+                         "only")
+    if params.has_bundles and params.forced:
+        raise ValueError("EFB bundling does not compose with forced splits; "
+                         "set enable_bundle=false")
     precision = params.precision
     K = max(1, min(int(params.split_batch), L - 1))
 
@@ -254,7 +270,7 @@ def make_grower(params: GrowerParams, num_features: int,
 
     bynode = params.feature_fraction_bynode < 1.0
 
-    def grow(bins_t: jnp.ndarray,       # [F, n_pad] int32 (rows on lanes;
+    def grow(bins_t: jnp.ndarray,       # [G, n_pad] int32 (rows on lanes;
              #                            cols >= n zero-filled)
              grad: jnp.ndarray,         # [n_pad] f32 (padding rows zero)
              hess: jnp.ndarray,         # [n_pad] f32
@@ -288,6 +304,35 @@ def make_grower(params: GrowerParams, num_features: int,
                     & (feature_mask > 0)).astype(jnp.float32)
             nonempty = jnp.sum(samp, axis=-1, keepdims=True) > 0
             return jnp.where(nonempty, samp, feature_mask)
+
+        def expand_bundles(hist_g, sg, sh, cnt):
+            """[G, B, 3] bundle histograms -> [F, B, 3] feature histograms.
+
+            Each bundled feature's bins live at bin_offset+1..+num_bin-1 of
+            its bundle column; its bin 0 (the shared all-default bin) is
+            reconstructed from the leaf totals minus the other bins — the
+            FixHistogram trick (reference src/io/dataset.cpp:1044-1063)."""
+            if not params.has_bundles:
+                return hist_g
+            bi = meta_local["bundle_idx"]                 # [F]
+            off = meta_local["bin_offset"]                # [F]
+            fix = meta_local["needs_fix"] > 0             # [F]
+            iota_b = jnp.arange(B, dtype=jnp.int32)
+            src = jnp.clip(off[:, None] + iota_b[None, :], 0, B - 1)
+            hist_f = hist_g[bi[:, None], src]             # [F, B, 3]
+            # bundled features: mask bins outside their range, then
+            # reconstruct bin 0 from totals
+            nbv = meta_local["num_bin"][:, None]
+            in_range = (iota_b[None, :] >= 1) & (iota_b[None, :] < nbv)
+            keep = jnp.where(fix[:, None], in_range,
+                             jnp.ones_like(in_range))
+            hist_f = jnp.where(keep[:, :, None], hist_f, 0.0)
+            totals = jnp.stack([sg, sh, cnt])             # [3]
+            rest = jnp.sum(hist_f, axis=1)                # [F, 3]
+            bin0 = totals[None, :] - rest                 # [F, 3]
+            hist_f = hist_f.at[:, 0, :].set(
+                jnp.where(fix[:, None], bin0, hist_f[:, 0, :]))
+            return hist_f
 
         def cegb_delta(used):
             """Per-feature gain charge (DetlaGain,
@@ -338,6 +383,7 @@ def make_grower(params: GrowerParams, num_features: int,
                 res = fin(bi)
                 return res._replace(feature=sel[bi], gain=gain_sel[bi])
 
+            hist = expand_bundles(hist, sg, sh, cnt)
             gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_local,
                                             fmask_local, split_kw,
                                             min_c, max_c)
@@ -387,7 +433,7 @@ def make_grower(params: GrowerParams, num_features: int,
         # per-tree packed stats, reused by every round's contraction
         stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
         S = stats.shape[0]
-        bins_blocks = jnp.moveaxis(bins_t.reshape(F, nb, block), 1, 0)
+        bins_blocks = jnp.moveaxis(bins_t.reshape(G, nb, block), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
         root_hist = preduce_hist(
             build_histogram_t(bins_blocks, stats_blocks, B, precision))
@@ -405,7 +451,7 @@ def make_grower(params: GrowerParams, num_features: int,
         RW = REC_WIDTH + (CB if params.has_cat else 0)
         state = {
             "leaf_ids": jnp.zeros(n_pad, jnp.int32),
-            "pool": jnp.zeros((L, F, B, 3), jnp.float32).at[0].set(root_hist),
+            "pool": jnp.zeros((L, G, B, 3), jnp.float32).at[0].set(root_hist),
             "leaf_sum_g": jnp.zeros(L, jnp.float32).at[0].set(sum_g),
             "leaf_sum_h": jnp.zeros(L, jnp.float32).at[0].set(sum_h),
             "leaf_cnt": jnp.zeros(L, jnp.float32).at[0].set(cnt),
@@ -492,8 +538,24 @@ def make_grower(params: GrowerParams, num_features: int,
                     jnp.where(own_r, col_l, 0), feature_axis)
             else:
                 f_r = sel_feat[kk_r]
-                col_r = jnp.take_along_axis(
-                    bins_t, f_r[None, :], axis=0)[0]
+                if params.has_bundles:
+                    # bundle column -> feature-space bin: bins outside the
+                    # feature's [offset+1, offset+num_bin-1] range are some
+                    # OTHER member's value, i.e. this feature sits at its
+                    # all-default bin 0
+                    g_r = meta["bundle_idx"][f_r]
+                    c_r = jnp.take_along_axis(bins_t, g_r[None, :],
+                                              axis=0)[0]
+                    off_r = meta["bin_offset"][f_r]
+                    nbf_r = meta["num_bin"][f_r]
+                    rel = c_r - off_r
+                    in_rng = (rel >= 1) & (rel < nbf_r)
+                    fixed_r = meta["needs_fix"][f_r] > 0
+                    col_r = jnp.where(fixed_r,
+                                      jnp.where(in_rng, rel, 0), c_r)
+                else:
+                    col_r = jnp.take_along_axis(
+                        bins_t, f_r[None, :], axis=0)[0]
             mt_k = meta["missing_type"][sel_feat]
             nb_k = meta["num_bin"][sel_feat]
             db_k = meta["default_bin"][sel_feat]

@@ -32,7 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.grower import GrowerParams, make_grower
 
 META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty",
-             "is_categorical", "cegb_coupled")
+             "is_categorical", "cegb_coupled", "bundle_idx", "bin_offset",
+             "needs_fix")
 
 _CANON = {
     "serial": "serial",
@@ -53,10 +54,12 @@ def resolve_tree_learner(name: str) -> str:
 
 def make_strategy_grower(params: GrowerParams, num_features: int,
                          strategy: str, mesh: Optional[Mesh] = None,
-                         voting_k: int = 20):
-    """Grower for `strategy`; num_features is the GLOBAL (padded) count."""
+                         voting_k: int = 20,
+                         num_columns: Optional[int] = None):
+    """Grower for `strategy`; num_features is the GLOBAL (padded) count;
+    num_columns the bin-matrix column count (< num_features under EFB)."""
     if strategy == "serial" or mesh is None:
-        return make_grower(params, num_features)
+        return make_grower(params, num_features, num_columns=num_columns)
 
     meta_spec = {k: P() for k in META_KEYS}
     if strategy in ("data", "voting"):
@@ -64,7 +67,7 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         grow = make_grower(
             params, num_features, data_axis="data",
             voting_k=(voting_k if strategy == "voting" else 0),
-            num_shards=nshards, jit=False)
+            num_shards=nshards, jit=False, num_columns=num_columns)
         fn = shard_map(
             grow, mesh=mesh,
             in_specs=(P(None, "data"), P("data"), P("data"), P("data"),

@@ -1,0 +1,104 @@
+"""EFB exclusive feature bundling (reference FindGroups/FastFeatureBundling,
+src/io/dataset.cpp:91-263 + FixHistogram, dataset.cpp:1044-1063)."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # e2e trainings
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.bundling import apply_bundles, find_bundles
+
+
+class TestBundlePlan:
+    def test_exclusive_features_bundle(self):
+        rng = np.random.default_rng(0)
+        n = 2000
+        which = rng.integers(0, 4, size=n)
+        bins = np.zeros((n, 4), np.int32)
+        for f in range(4):
+            rows = which == f
+            bins[rows, f] = rng.integers(1, 8, size=rows.sum())
+        plan = find_bundles(bins, np.full(4, 8, np.int32),
+                            np.ones(4, bool), 0.0, 64)
+        assert plan.num_columns == 1
+        assert len(plan.groups[0]) == 4
+        bundled = apply_bundles(bins, plan)
+        # zero-conflict bundling is lossless: round-trip every feature
+        for f in range(4):
+            off = plan.bin_offset[f]
+            rel = bundled[:, 0] - off
+            rec = np.where((rel >= 1) & (rel < 8), rel, 0)
+            np.testing.assert_array_equal(rec, bins[:, f])
+
+    def test_conflict_budget_respected(self):
+        rng = np.random.default_rng(1)
+        n = 1000
+        bins = rng.integers(0, 2, size=(n, 3)).astype(np.int32)  # ~50% dense
+        plan = find_bundles(bins, np.full(3, 2, np.int32),
+                            np.ones(3, bool), 0.0, 64)
+        # heavy mutual conflicts + zero budget: nothing may bundle
+        assert plan.is_trivial
+
+    def test_capacity_cap(self):
+        bins = np.zeros((100, 3), np.int32)
+        bins[0, 0] = 1; bins[1, 1] = 1; bins[2, 2] = 1
+        plan = find_bundles(bins, np.full(3, 60, np.int32),
+                            np.ones(3, bool), 0.0, 100)
+        # 3 x 59 nonzero bins don't fit 100: at most 1 pair bundles
+        for g, nb in zip(plan.groups, plan.num_bin):
+            assert nb <= 100
+
+
+class TestBundledTraining:
+    @pytest.fixture(scope="class")
+    def sparse_xy(self):
+        rng = np.random.default_rng(0)
+        n = 6000
+        cat = rng.integers(0, 30, size=n)
+        # binary indicators: 2 bins each, so dozens fit in one bundle
+        onehot = np.zeros((n, 30))
+        onehot[np.arange(n), cat] = 1.0
+        dense = rng.normal(size=(n, 4))
+        X = np.column_stack([onehot, dense])
+        y = ((cat % 3 == 0).astype(float) + 0.5 * dense[:, 0]
+             + 0.3 * rng.normal(size=n) > 0.6).astype(float)
+        return X, y
+
+    def test_quality_matches_unbundled(self, sparse_xy):
+        from sklearn.metrics import roc_auc_score
+        X, y = sparse_xy
+        params = {"objective": "binary", "num_leaves": 31,
+                  "min_data_in_leaf": 10, "max_bin": 63}
+        ds1 = lgb.Dataset(X, label=y)
+        b1 = lgb.train(params, ds1, num_boost_round=15, verbose_eval=False)
+        ds2 = lgb.Dataset(X, label=y)
+        b2 = lgb.train({**params, "enable_bundle": False}, ds2,
+                       num_boost_round=15, verbose_eval=False)
+        lrn = b1._driver.learner
+        assert lrn.num_columns < lrn.num_features
+        auc1 = roc_auc_score(y, b1.predict(X))
+        auc2 = roc_auc_score(y, b2.predict(X))
+        assert abs(auc1 - auc2) < 0.01
+
+    def test_model_io_and_predict_unaffected(self, sparse_xy, tmp_path):
+        """Bundling is a training-time representation: saved models and
+        predictions speak original feature space."""
+        X, y = sparse_xy
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15},
+                        ds, num_boost_round=5, verbose_eval=False)
+        p = bst.predict(X[:100])
+        bst.save_model(str(tmp_path / "m.txt"))
+        re = lgb.Booster(model_file=str(tmp_path / "m.txt"))
+        np.testing.assert_allclose(re.predict(X[:100]), p, rtol=1e-6)
+
+    def test_dense_data_not_bundled(self):
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(2000, 8))
+        y = (X[:, 0] > 0).astype(float)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15},
+                        ds, num_boost_round=2, verbose_eval=False)
+        lrn = bst._driver.learner
+        assert lrn.num_columns == lrn.num_features
