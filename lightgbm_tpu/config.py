@@ -172,6 +172,10 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "tpu_split_batch": ("int", 0, ()),
     # batched-histogram backend: xla | pallas
     "tpu_hist_impl": ("str", "xla", ()),
+    # f64 histogram accumulation everywhere (requires x64): serial and
+    # data-parallel split decisions become reduction-order independent,
+    # like the reference f64 HistogramBinEntry (bin.h:33-40)
+    "deterministic": ("bool", False, ()),
     # only batch leaves whose gain >= alpha * the round's best gain (near
     # ties); keeps batched split order close to strict best-first
     "tpu_split_batch_alpha": ("float", 0.0, ()),

@@ -182,7 +182,7 @@ class TPUTreeLearner:
             num_bins=B,
             block_rows=min(block, self.n_pad // self.n_shards
                            if strategy in ("data", "voting") else self.n_pad),
-            precision=str(config.tpu_hist_precision),
+            precision=self._resolve_precision(config),
             l1=float(config.lambda_l1),
             l2=float(config.lambda_l2),
             max_delta_step=float(config.max_delta_step),
@@ -213,6 +213,22 @@ class TPUTreeLearner:
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_precision(config: Config) -> str:
+        """Histogram precision, honoring deterministic mode.
+
+        deterministic=true accumulates everything in f64 (the reference's
+        HistogramBinEntry representation, bin.h:33-40) so serial and
+        data-parallel decisions agree exactly; requires jax x64, which is
+        enabled here process-wide."""
+        if not bool(config.deterministic):
+            return str(config.tpu_hist_precision)
+        jax.config.update("jax_enable_x64", True)
+        if str(config.tpu_hist_impl) == "pallas":
+            raise ValueError(
+                "deterministic=true requires tpu_hist_impl=xla")
+        return "f64"
+
     @staticmethod
     def _parse_forced_splits(config: Config, train_data: TrainingData
                              ) -> tuple:

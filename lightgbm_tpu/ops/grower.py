@@ -427,9 +427,14 @@ def make_grower(params: GrowerParams, num_features: int,
         # ---- root ----------------------------------------------------
         g = grad * row_mask
         h = hess * row_mask
-        sum_g = preduce_scalar(jnp.sum(g))
-        sum_h = preduce_scalar(jnp.sum(h))
-        cnt = preduce_scalar(jnp.sum(row_mask))
+        # deterministic (f64) mode: the scalar leaf sums must be reduced in
+        # f64 too, or psum reassociation of f32 partials re-enters by the
+        # back door
+        sum_t = jnp.float64 if precision == "f64" else jnp.float32
+        sum_g = preduce_scalar(jnp.sum(g, dtype=sum_t)).astype(jnp.float32)
+        sum_h = preduce_scalar(jnp.sum(h, dtype=sum_t)).astype(jnp.float32)
+        cnt = preduce_scalar(
+            jnp.sum(row_mask, dtype=sum_t)).astype(jnp.float32)
         # per-tree packed stats, reused by every round's contraction
         stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
         S = stats.shape[0]
@@ -509,7 +514,9 @@ def make_grower(params: GrowerParams, num_features: int,
             body and the unrolled forced-split rounds."""
             leaf_ids = state["leaf_ids"]
             kar = jnp.arange(K, dtype=jnp.int32)
-            num_do = jnp.sum(do_k.astype(jnp.int32))
+            # dtype pinned: under x64 (deterministic mode) jnp.sum would
+            # promote to int64 and break the while_loop carry contract
+            num_do = jnp.sum(do_k, dtype=jnp.int32)
             new_ids = state["n_splits"] + 1 + kar
             pg = state["leaf_sum_g"][sel]
             ph = state["leaf_sum_h"][sel]

@@ -41,8 +41,16 @@ def pack_stats(grad: jnp.ndarray, hess: jnp.ndarray, mask: jnp.ndarray,
 
     grad/hess must already be multiplied by `mask` by the caller if masking
     is intended (mask also serves as the count row).
-    Returns [5, n] bf16 for "hilo", [3, n] bf16/f32 for "bf16"/"f32".
+    Returns [5, n] bf16 for "hilo", [3, n] bf16/f32/f64 otherwise.
+
+    "f64" is the deterministic-parity mode (requires jax_enable_x64): all
+    accumulation runs in doubles like the reference's HistogramBinEntry
+    (reference include/LightGBM/bin.h:33-40), so serial and data-parallel
+    split decisions agree bit-for-bit on real data regardless of psum
+    reduction order.
     """
+    if precision == "f64":
+        return jnp.stack([grad, hess, mask]).astype(jnp.float64)
     if precision == "f32":
         return jnp.stack([grad, hess, mask]).astype(jnp.float32)
     if precision == "bf16":
@@ -57,10 +65,8 @@ def pack_stats(grad: jnp.ndarray, hess: jnp.ndarray, mask: jnp.ndarray,
 
 
 def _unpack_hist(raw: jnp.ndarray, precision: str) -> jnp.ndarray:
-    """[S, F*B] accumulated rows -> [F*B, 3] (g, h, cnt) f32."""
-    if precision == "f32":
-        g, h, c = raw[0], raw[1], raw[2]
-    elif precision == "bf16":
+    """[S, F*B] accumulated rows -> [F*B, 3] (g, h, cnt)."""
+    if precision in ("f32", "f64", "bf16"):
         g, h, c = raw[0], raw[1], raw[2]
     else:
         g = raw[0] + raw[1]
@@ -84,8 +90,9 @@ def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
     into the matmul operand.
     """
     n, num_features = bins.shape
-    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
-    prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+    dot_dtype = {"f32": jnp.float32,
+                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
+    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
             else jax.lax.Precision.DEFAULT)
 
     block = min(block_rows, max(n, 1))
@@ -99,16 +106,18 @@ def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
     stats_blocks = stats.reshape(stats.shape[0], num_blocks, block)
     iota = jnp.arange(num_bins, dtype=bins.dtype)
 
+    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
+
     def body(acc, xs):
         b_blk, s_blk = xs  # [block, F], [S, block]
         onehot = (b_blk[:, :, None] == iota).astype(dot_dtype)
         onehot = onehot.reshape(block, num_features * num_bins)
         acc = acc + jnp.dot(s_blk.astype(dot_dtype), onehot,
                             precision=prec,
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=acc_dtype)
         return acc, None
 
-    init = jnp.zeros((stats.shape[0], num_features * num_bins), jnp.float32)
+    init = jnp.zeros((stats.shape[0], num_features * num_bins), acc_dtype)
     raw, _ = jax.lax.scan(
         body, init, (bins_blocks, jnp.moveaxis(stats_blocks, 1, 0)))
     hist = _unpack_hist(raw, precision)
@@ -140,9 +149,12 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
     nb, num_features, block = bins_t_blocks.shape
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
-    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
-    prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+    dot_dtype = {"f32": jnp.float32,
+                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
+    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
             else jax.lax.Precision.DEFAULT)
+
+    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
 
     def body(acc, xs):
         b_t, s_blk, l_blk = xs  # [F, blk], [S, blk], [blk]
@@ -155,10 +167,10 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
         sexp = sexp.reshape(K * S, block)
         acc = acc + jax.lax.dot_general(
             onehot, sexp, (((1,), (1,)), ((), ())),
-            precision=prec, preferred_element_type=jnp.float32)
+            precision=prec, preferred_element_type=acc_dtype)
         return acc, None
 
-    init = jnp.zeros((num_features * num_bins, K * S), jnp.float32)
+    init = jnp.zeros((num_features * num_bins, K * S), acc_dtype)
     raw, _ = jax.lax.scan(
         body, init, (bins_t_blocks, jnp.moveaxis(stats_blocks, 1, 0),
                      leaf_blocks))
@@ -240,9 +252,12 @@ def build_histogram_t(bins_t_blocks, stats_blocks, num_bins: int,
     Returns [F, B, 3] f32.
     """
     nb, num_features, block = bins_t_blocks.shape
-    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
-    prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+    dot_dtype = {"f32": jnp.float32,
+                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
+    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
             else jax.lax.Precision.DEFAULT)
+
+    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
 
     def body(acc, xs):
         b_t, s_blk = xs
@@ -252,11 +267,11 @@ def build_histogram_t(bins_t_blocks, stats_blocks, num_bins: int,
         onehot = onehot.reshape(num_features * num_bins, block)
         acc = acc + jax.lax.dot_general(
             onehot, s_blk.astype(dot_dtype), (((1,), (1,)), ((), ())),
-            precision=prec, preferred_element_type=jnp.float32)
+            precision=prec, preferred_element_type=acc_dtype)
         return acc, None
 
     init = jnp.zeros((num_features * num_bins, stats_blocks.shape[0]),
-                     jnp.float32)
+                     acc_dtype)
     raw, _ = jax.lax.scan(
         body, init, (bins_t_blocks, jnp.moveaxis(stats_blocks, 1, 0)))
     hist = _unpack_hist(raw.T, precision)
@@ -292,8 +307,9 @@ def build_histogram_batched_inline(bins_blocks, stats_blocks, leaf_blocks,
     nb, block, num_features = bins_blocks.shape
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
-    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
-    prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+    dot_dtype = {"f32": jnp.float32,
+                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
+    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
             else jax.lax.Precision.DEFAULT)
     iota = jnp.arange(num_bins, dtype=bins_blocks.dtype)
 
@@ -327,8 +343,9 @@ def build_histogram_inline(bins_blocks, stats_blocks, num_bins: int,
     bins_blocks: [nb, block, F], stats_blocks: [S, nb, block] (already padded).
     """
     nb, block, num_features = bins_blocks.shape
-    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
-    prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+    dot_dtype = {"f32": jnp.float32,
+                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
+    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
             else jax.lax.Precision.DEFAULT)
     iota = jnp.arange(num_bins, dtype=bins_blocks.dtype)
 

@@ -54,6 +54,14 @@ def _assert_decisions_close(rec_a, rec_b, min_agreement=0.85):
     assert agreement >= min_agreement, f"decision agreement {agreement:.0%}"
 
 
+@pytest.fixture
+def _x64_reset():
+    # deterministic mode flips jax_enable_x64 process-wide; undo so later
+    # tests keep the default f32 promotion rules
+    yield
+    jax.config.update("jax_enable_x64", False)
+
+
 @pytest.fixture(scope="module")
 def serial_run():
     config, td, _ = _problem()
@@ -77,6 +85,20 @@ class TestStrategyParity:
         np.testing.assert_array_equal(leaf_s, leaf_f)
         np.testing.assert_allclose(rec_s[:, G.REC_GAIN],
                                    rec_f[:, G.REC_GAIN], rtol=1e-5)
+
+    def test_deterministic_data_parallel_exact(self, _x64_reset):
+        """deterministic=true (f64 accumulation end-to-end, the reference
+        HistogramBinEntry representation, bin.h:33-40) makes data-parallel
+        decisions EXACTLY match serial — reduction order stops mattering."""
+        config_s, td, _ = _problem(deterministic=True)
+        rec_s, leaf_s, _ = _grow_records(config_s, td)
+        config_d, _, _ = _problem(tree_learner="data", num_machines=8,
+                                  deterministic=True)
+        rec_d, leaf_d, _ = _grow_records(config_d, td)
+        _assert_decisions_close(rec_s, rec_d, 1.0)
+        np.testing.assert_array_equal(leaf_s, leaf_d)
+        np.testing.assert_allclose(rec_s[:, G.REC_GAIN],
+                                   rec_d[:, G.REC_GAIN], rtol=1e-12)
 
     def test_voting_parallel_matches_data(self, serial_run):
         (rec_s, _, _), td = serial_run
