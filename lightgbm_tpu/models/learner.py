@@ -74,6 +74,14 @@ class TPUTreeLearner:
         strategy = resolve_tree_learner(config.tree_learner)
         n_shards = int(config.num_machines)
         if strategy != "serial":
+            if str(config.machines):
+                # multi-host: machine list -> jax.distributed global mesh
+                # (the Linkers-socket rendezvous role,
+                # linkers_socket.cpp:165-220); single-process runs skip it
+                from ..parallel.mesh import init_multihost
+
+                init_multihost(str(config.machines),
+                               int(config.local_listen_port), n_shards)
             ndev = len(jax.devices())
             if n_shards <= 1:
                 Log.warning(f"tree_learner={strategy} needs num_machines>1; "

@@ -23,6 +23,64 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+_distributed_initialized = False
+
+
+def init_multihost(machines: str = "", local_listen_port: int = 0,
+                   num_machines: int = 1) -> bool:
+    """Map the reference's machine-list network config onto jax.distributed.
+
+    The reference rendezvouses an all-to-all TCP mesh from `machines` =
+    "ip1:port1,ip2:port2,..." (reference src/network/linkers_socket.cpp:
+    165-220).  The TPU equivalent: every host runs the same program and
+    calls `jax.distributed.initialize(coordinator, num_processes,
+    process_id)`; afterwards jax.devices() spans all hosts and the SAME
+    mesh/shard_map code runs globally — collectives ride ICI within a
+    slice and DCN across slices, placed by XLA instead of hand-built
+    Bruck/recursive-halving rings.
+
+    The first machine-list entry is the coordinator; this host's position
+    in the list (matched by LIGHTGBM_TPU_HOST_IP or the entry whose port
+    matches local_listen_port when unambiguous) is its process id.
+    Returns True if distributed init ran.  Single-process setups (CI, one
+    host) skip it — the in-process virtual mesh covers them.
+    """
+    global _distributed_initialized
+    if _distributed_initialized:
+        return True
+    entries = [m.strip() for m in str(machines).split(",") if m.strip()]
+    if len(entries) <= 1 or num_machines <= 1:
+        return False
+    import os
+
+    coordinator = entries[0]
+    my_ip = os.environ.get("LIGHTGBM_TPU_HOST_IP", "")
+    pid = None
+    if my_ip:
+        for i, e in enumerate(entries):
+            if e.split(":")[0] == my_ip:
+                pid = i
+                break
+    if pid is None:
+        env_pid = os.environ.get("LIGHTGBM_TPU_PROCESS_ID", "")
+        if env_pid:
+            pid = int(env_pid)
+    if pid is None:
+        raise ValueError(
+            "multi-host init: cannot determine this host's position in "
+            "`machines`; set LIGHTGBM_TPU_HOST_IP or "
+            "LIGHTGBM_TPU_PROCESS_ID")
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=len(entries),
+                               process_id=pid)
+    _distributed_initialized = True
+    return True
+
+
+def available_devices() -> int:
+    return len(jax.devices())
+
+
 def make_mesh(num_data_shards: int = 1, num_feature_shards: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

@@ -1,0 +1,46 @@
+"""Multi-host mesh mapping (the Linkers rendezvous role,
+reference src/network/linkers_socket.cpp:165-220 -> jax.distributed).
+
+Real multi-process initialization cannot run in a single-process CI; these
+tests cover the config-mapping logic and the single-process skip path.
+The in-process 8-device mesh tests (test_parallel.py) exercise the same
+sharded growers that a global mesh would run.
+"""
+
+import pytest
+
+from lightgbm_tpu.parallel import mesh
+
+
+class TestMultihostMapping:
+    def test_single_machine_skips(self):
+        assert mesh.init_multihost("", 0, 1) is False
+        assert mesh.init_multihost("127.0.0.1:12400", 12400, 1) is False
+
+    def test_unresolvable_process_id_raises(self, monkeypatch):
+        monkeypatch.delenv("LIGHTGBM_TPU_HOST_IP", raising=False)
+        monkeypatch.delenv("LIGHTGBM_TPU_PROCESS_ID", raising=False)
+        with pytest.raises(ValueError, match="position"):
+            mesh.init_multihost("10.0.0.1:12400,10.0.0.2:12400", 12400, 2)
+
+    def test_process_id_from_host_ip(self, monkeypatch):
+        """The pid resolution finds this host in the machine list; the
+        jax.distributed.initialize call itself is stubbed (no cluster)."""
+        calls = {}
+
+        def fake_init(coordinator_address, num_processes, process_id):
+            calls.update(coordinator=coordinator_address,
+                         n=num_processes, pid=process_id)
+
+        import jax
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+        monkeypatch.setenv("LIGHTGBM_TPU_HOST_IP", "10.0.0.2")
+        mesh._distributed_initialized = False
+        try:
+            assert mesh.init_multihost(
+                "10.0.0.1:12400,10.0.0.2:12400,10.0.0.3:12400", 12400, 3)
+            assert calls == {"coordinator": "10.0.0.1:12400", "n": 3,
+                             "pid": 1}
+        finally:
+            mesh._distributed_initialized = False
