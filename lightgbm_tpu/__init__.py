@@ -31,6 +31,13 @@ from .callback import (
     reset_parameter,
     EarlyStopException,
 )
+from .plotting import (
+    create_tree_digraph,
+    plot_importance,
+    plot_metric,
+    plot_split_value_histogram,
+    plot_tree,
+)
 
 __all__ = [
     "__version__",
@@ -45,6 +52,11 @@ __all__ = [
     "record_evaluation",
     "reset_parameter",
     "EarlyStopException",
+    "plot_importance",
+    "plot_metric",
+    "plot_split_value_histogram",
+    "plot_tree",
+    "create_tree_digraph",
 ]
 
 try:  # sklearn wrappers are optional (scikit-learn may be absent)

@@ -92,6 +92,14 @@ class Dataset:
                        group=group, init_score=init_score,
                        params=params if params is not None else self.params)
 
+    def save_binary(self, filename: str) -> "Dataset":
+        """Serialize the constructed binned dataset (reference
+        Dataset::SaveBinaryFile via LGBM_DatasetSaveBinary); reloading a
+        `<data>.bin` path skips parsing and bin finding."""
+        self.construct()
+        self._inner.save_binary(filename)
+        return self
+
     def set_field(self, name: str, data) -> "Dataset":
         self.construct()
         self._inner.metadata.set_field(name, data)

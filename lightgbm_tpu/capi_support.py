@@ -172,7 +172,9 @@ def dataset_get_field(handle: int, name: str) -> Tuple[int, int, int]:
 
 
 def dataset_save_binary(handle: int, filename: str) -> None:
-    raise NotImplementedError("binary dataset cache not supported yet")
+    ds = _get(handle)
+    ds.construct()
+    ds._inner.save_binary(filename)
 
 
 # ---------------------------------------------------------------- booster

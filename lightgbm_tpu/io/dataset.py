@@ -20,6 +20,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence
 
+import os
+
 import numpy as np
 
 from ..config import Config
@@ -222,6 +224,16 @@ class TrainingData:
     def from_file(cls, path: str, config: Optional[Config] = None,
                   reference: Optional["TrainingData"] = None) -> "TrainingData":
         config = config or Config()
+        # binary fast path (reference CheckCanLoadFromBin,
+        # dataset_loader.cpp:1217 + binary token check): <path>.bin skips
+        # parsing and re-binning entirely
+        if reference is None and os.path.exists(path + ".bin"):
+            try:
+                return cls.from_binary(path + ".bin")
+            except Exception as exc:
+                from ..utils.log import Log
+
+                Log.warning(f"ignoring stale binary cache {path}.bin: {exc}")
         X, y, w, group, init, names = load_text_file(
             path, label_column=config.label_column,
             header=True if config.header else None)
@@ -230,7 +242,81 @@ class TrainingData:
                                init_score=init, reference=reference,
                                feature_names=names, categorical_features=cat,
                                forced_bins=_load_forced_bins(config))
+        if bool(config.save_binary):
+            data.save_binary(path + ".bin")
         return data
+
+    # ------------------------------------------------------------------
+    _BINARY_TOKEN = "lightgbm_tpu.binned.v1"
+
+    def save_binary(self, path: str) -> None:
+        """Serialize the binned dataset (reference Dataset::SaveBinaryFile,
+        src/io/dataset.cpp:695): bins + mappers + metadata, so reloading
+        skips parsing and bin finding."""
+        import json
+
+        md = self.metadata
+        np.savez_compressed(
+            path,
+            token=np.frombuffer(self._BINARY_TOKEN.encode(), np.uint8),
+            bins=self.bins,
+            used_feature_idx=np.asarray(self.used_feature_idx, np.int64),
+            num_total_features=np.int64(self.num_total_features),
+            mappers=np.frombuffer(json.dumps(
+                [m.to_dict() for m in self.mappers]).encode(), np.uint8),
+            feature_names=np.frombuffer(
+                json.dumps(self.feature_names).encode(), np.uint8),
+            label=md.label,
+            weight=(md.weight if md.weight is not None
+                    else np.zeros(0, np.float32)),
+            query_boundaries=(md.query_boundaries
+                              if md.query_boundaries is not None
+                              else np.zeros(0, np.int64)),
+            init_score=(md.init_score if md.init_score is not None
+                        else np.zeros(0, np.float64)),
+            monotone=(self.monotone_constraints
+                      if self.monotone_constraints is not None
+                      else np.zeros(0, np.int32)),
+            penalty=(self.feature_penalty
+                     if self.feature_penalty is not None
+                     else np.zeros(0, np.float32)))
+        # numpy appends .npz; normalize to the requested name
+        if not path.endswith(".npz") and os.path.exists(path + ".npz"):
+            os.replace(path + ".npz", path)
+
+    @classmethod
+    def from_binary(cls, path: str) -> "TrainingData":
+        import json
+
+        from .bin_mapper import BinMapper
+
+        with np.load(path, allow_pickle=False) as z:
+            token = bytes(z["token"]).decode()
+            if token != cls._BINARY_TOKEN:
+                raise ValueError(f"unrecognized binary dataset token "
+                                 f"{token!r}")
+            self = cls()
+            self.bins = z["bins"]
+            self.used_feature_idx = [int(i) for i in z["used_feature_idx"]]
+            self.num_total_features = int(z["num_total_features"])
+            self.mappers = [BinMapper.from_dict(d) for d in
+                            json.loads(bytes(z["mappers"]).decode())]
+            self.feature_names = json.loads(
+                bytes(z["feature_names"]).decode())
+            self.num_data = int(self.bins.shape[0])
+            md = Metadata(self.num_data, label=z["label"])
+            if z["weight"].size:
+                md.weight = z["weight"]
+            if z["query_boundaries"].size:
+                md.query_boundaries = z["query_boundaries"]
+            if z["init_score"].size:
+                md.init_score = z["init_score"]
+            self.metadata = md
+            if z["monotone"].size:
+                self.monotone_constraints = z["monotone"]
+            if z["penalty"].size:
+                self.feature_penalty = z["penalty"]
+        return self
 
     # ------------------------------------------------------------------
     def _find_mappers(self, X: np.ndarray, config: Config,

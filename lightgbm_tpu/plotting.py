@@ -1,0 +1,227 @@
+"""Plotting helpers (role of reference python-package/lightgbm/
+plotting.py:29-473): feature importance, metric curves, split-value
+histograms, and tree diagrams.
+
+matplotlib is imported lazily; tree diagrams additionally need graphviz
+and raise a clear ImportError without it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster
+
+
+def _plt():
+    try:
+        import matplotlib.pyplot as plt
+    except ImportError as exc:  # pragma: no cover - env without matplotlib
+        raise ImportError("plotting requires matplotlib") from exc
+    return plt
+
+
+def _to_booster(model) -> Booster:
+    if isinstance(model, Booster):
+        return model
+    sk_booster = getattr(model, "booster_", None)
+    if sk_booster is not None:
+        return sk_booster
+    raise TypeError("expected a Booster or fitted sklearn wrapper")
+
+
+def plot_importance(booster, ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple] = None, ylim: Optional[Tuple] = None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "split",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True, figsize=None, dpi=None,
+                    grid: bool = True, precision: int = 3, **kwargs):
+    """Horizontal bar chart of per-feature importance."""
+    plt = _plt()
+    bst = _to_booster(booster)
+    importance = np.asarray(bst.feature_importance(importance_type))
+    names = bst.feature_name()
+    pairs = sorted(zip(names, importance), key=lambda kv: kv[1])
+    if ignore_zero:
+        pairs = [p for p in pairs if p[1] != 0]
+    if max_num_features is not None and max_num_features > 0:
+        pairs = pairs[-max_num_features:]
+    if not pairs:
+        raise ValueError("no importance to plot")
+    labels, values = zip(*pairs)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ypos = np.arange(len(values))
+    ax.barh(ypos, values, height=height, align="center", **kwargs)
+    for y, v in zip(ypos, values):
+        ax.text(v + 1e-9, y,
+                f"{v:.{precision}f}" if importance_type == "gain"
+                else str(int(v)),
+                va="center")
+    ax.set_yticks(ypos)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_metric(booster_or_record, metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim=None, ylim=None, title: str = "Metric during training",
+                xlabel: str = "Iterations", ylabel: str = "auto",
+                figsize=None, dpi=None, grid: bool = True):
+    """Metric curves from an evals_result dict (or a Booster trained with
+    record_evaluation)."""
+    plt = _plt()
+    if isinstance(booster_or_record, dict):
+        record = booster_or_record
+    else:
+        record = getattr(booster_or_record, "evals_result", None)
+        if not record:
+            raise ValueError(
+                "pass the evals_result dict from train(..., evals_result=)")
+    if not record:
+        raise ValueError("empty evaluation record")
+    names = dataset_names or list(record.keys())
+    first = record[names[0]]
+    if metric is None:
+        metric = next(iter(first.keys()))
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    for name in names:
+        series = record.get(name, {}).get(metric)
+        if series is None:
+            continue
+        ax.plot(np.arange(1, len(series) + 1), series, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(metric if ylabel == "auto" else ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def plot_split_value_histogram(booster, feature: Union[int, str], bins=None,
+                               ax=None, width_coef: float = 0.8, xlim=None,
+                               ylim=None,
+                               title: str = "Split value histogram for "
+                                            "feature with @index/name@ "
+                                            "@feature@",
+                               xlabel: str = "Feature split value",
+                               ylabel: str = "Count", figsize=None, dpi=None,
+                               grid: bool = True):
+    """Histogram of the model's split thresholds on one feature."""
+    plt = _plt()
+    bst = _to_booster(booster)
+    if isinstance(feature, str):
+        feature = bst.feature_name().index(feature)
+    values = []
+    for tree in bst._driver.models:
+        ni = tree.num_leaves - 1
+        for j in range(ni):
+            if (int(tree.split_feature[j]) == feature
+                    and not (int(tree.decision_type[j]) & 1)):
+                values.append(float(tree.threshold[j]))
+    if not values:
+        raise ValueError(
+            f"feature {feature} is not used in any numerical split")
+    counts, edges = np.histogram(values, bins=bins or "auto")
+    centers = (edges[:-1] + edges[1:]) / 2
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.bar(centers, counts, width=width_coef * (edges[1] - edges[0]))
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    ax.set_title(title.replace("@index/name@", "index")
+                 .replace("@feature@", str(feature)))
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+def _node_label(node: Dict[str, Any], feature_names: List[str],
+                precision: int) -> str:
+    if "split_feature" in node:
+        f = node["split_feature"]
+        name = (feature_names[f] if f < len(feature_names)
+                else f"Column_{f}")
+        op = "==" if node.get("decision_type") == "==" else "<="
+        return (f"{name} {op} {round(node['threshold'], precision)}\n"
+                f"gain: {round(node.get('split_gain', 0.0), precision)}\n"
+                f"count: {node.get('internal_count', 0)}")
+    return (f"leaf {node.get('leaf_index', 0)}: "
+            f"{round(node.get('leaf_value', 0.0), precision)}\n"
+            f"count: {node.get('leaf_count', 0)}")
+
+
+def create_tree_digraph(booster, tree_index: int = 0, precision: int = 3,
+                        **kwargs):
+    """graphviz Digraph of one tree (reference create_tree_digraph)."""
+    try:
+        import graphviz
+    except ImportError as exc:
+        raise ImportError("create_tree_digraph requires the graphviz "
+                          "package") from exc
+    bst = _to_booster(booster)
+    dump = bst.dump_model()
+    if tree_index >= len(dump["tree_info"]):
+        raise IndexError(f"tree_index {tree_index} out of range")
+    tree = dump["tree_info"][tree_index]["tree_structure"]
+    names = dump.get("feature_names", bst.feature_name())
+    g = graphviz.Digraph(**kwargs)
+    counter = [0]
+
+    def walk(node) -> str:
+        nid = f"n{counter[0]}"
+        counter[0] += 1
+        g.node(nid, _node_label(node, names, precision),
+               shape="rectangle" if "split_feature" in node else "ellipse")
+        if "split_feature" in node:
+            left = walk(node["left_child"])
+            right = walk(node["right_child"])
+            g.edge(nid, left, label="yes")
+            g.edge(nid, right, label="no")
+        return nid
+
+    walk(tree)
+    return g
+
+
+def plot_tree(booster, ax=None, tree_index: int = 0, figsize=None, dpi=None,
+              precision: int = 3, **kwargs):
+    """Render one tree into a matplotlib axes (via graphviz)."""
+    plt = _plt()
+    graph = create_tree_digraph(booster, tree_index=tree_index,
+                                precision=precision, **kwargs)
+    import io as _io
+
+    try:
+        image = graph.pipe(format="png")
+    except Exception as exc:  # graphviz binary missing
+        raise RuntimeError("graphviz executables are required to render "
+                           "trees") from exc
+    import matplotlib.image as mpimg
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.imshow(mpimg.imread(_io.BytesIO(image)))
+    ax.axis("off")
+    return ax

@@ -253,6 +253,15 @@ LGBM_EXPORT int LGBM_DatasetCreateFromFile(const char* filename,
   return 0;
 }
 
+LGBM_EXPORT int LGBM_DatasetSaveBinary(DatasetHandle handle,
+                                       const char* filename) {
+  PyObject* r = call_support("dataset_save_binary", "(Ls)",
+                             from_handle(handle), filename);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
 LGBM_EXPORT int LGBM_DatasetFree(DatasetHandle handle) {
   PyObject* r = call_support("free_handle", "(L)", from_handle(handle));
   if (!r) return -1;

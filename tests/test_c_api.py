@@ -221,3 +221,20 @@ class TestCAPIBooster:
         assert lib.LGBM_NetworkInitWithFunctions(1, 0, None, None) == 0
         # real multi-machine injection must fail loudly
         assert lib.LGBM_NetworkInitWithFunctions(4, 0, None, None) == -1
+
+
+class TestCAPIDatasetBinary:
+    def test_save_binary(self, lib, data, tmp_path):
+        X, y = data
+        h = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(X.shape[0]), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int(1), b"max_bin=63", None, ctypes.byref(h)))
+        out = str(tmp_path / "ds.bin").encode()
+        _check(lib, lib.LGBM_DatasetSaveBinary(h, out))
+        assert os.path.exists(out.decode())
+        from lightgbm_tpu.io.dataset import TrainingData
+        td = TrainingData.from_binary(out.decode())
+        assert td.num_data == X.shape[0]
+        _check(lib, lib.LGBM_DatasetFree(h))

@@ -1,0 +1,55 @@
+"""Plotting smoke tests (role of reference tests/python_package_test/
+test_plotting.py): importance bars, metric curves, split-value histograms,
+tree digraphs render without error on a trained model."""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(800, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0.2).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+    res = {}
+    vs = ds.create_valid(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "metric": "binary_logloss"}, ds, num_boost_round=5,
+                    valid_sets=[vs], verbose_eval=False, evals_result=res)
+    return bst, res
+
+
+class TestPlotting:
+    def test_plot_importance(self, trained):
+        bst, _ = trained
+        ax = lgb.plot_importance(bst)
+        assert len(ax.patches) >= 1
+        ax2 = lgb.plot_importance(bst, importance_type="gain",
+                                  max_num_features=2)
+        assert len(ax2.patches) <= 2
+
+    def test_plot_metric(self, trained):
+        _, res = trained
+        ax = lgb.plot_metric(res)
+        assert ax.get_ylabel() == "binary_logloss"
+        assert len(ax.get_lines()) == 1
+
+    def test_plot_split_value_histogram(self, trained):
+        bst, _ = trained
+        used = {int(f) for t in bst.dump_model()["tree_info"]
+                if "split_feature" in t["tree_structure"]
+                for f in [t["tree_structure"]["split_feature"]]}
+        ax = lgb.plot_split_value_histogram(bst, feature=used.pop())
+        assert len(ax.patches) >= 1
+
+    def test_tree_digraph(self, trained):
+        graphviz = pytest.importorskip("graphviz")
+        bst, _ = trained
+        g = lgb.create_tree_digraph(bst, tree_index=0)
+        assert "yes" in g.source and "no" in g.source
