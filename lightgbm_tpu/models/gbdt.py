@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.bin_mapper import BinMapper, MissingType
 from ..io.dataset import TrainingData
+from ..utils import timer
 from .learner import TPUTreeLearner
 from .metrics import Metric, create_metrics
 from .objectives import (Objective, create_objective,
@@ -352,6 +353,14 @@ class GBDT:
         """Fetch pending device records and build host Tree models."""
         if not self._pending:
             return
+        ctx = timer.PHASE("tree_materialize")
+        ctx.__enter__()
+        try:
+            self._materialize_inner()
+        finally:
+            ctx.__exit__(None, None, None)
+
+    def _materialize_inner(self) -> None:
         pending, self._pending = self._pending, []
         # one batched fetch for all pending trees
         recs = jax.device_get([p[0] for p in pending])

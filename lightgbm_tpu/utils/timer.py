@@ -1,0 +1,93 @@
+"""Phase timers — the TIMETAG subsystem analog.
+
+The reference accumulates per-phase wall time behind a compile-time flag
+(reference src/treelearner/serial_tree_learner.cpp:21-48 init/hist/
+find-split/split buckets, gpu_tree_learner.cpp:352-532 transfer timing,
+linkers.h:169 network_time_).  Here timing is always compiled in and
+gated by an env var at runtime, and device phases can additionally be
+captured with jax.profiler traces:
+
+* `PHASE("binning")` context blocks accumulate wall time per named phase;
+* `print_summary()` (atexit when LIGHTGBM_TPU_TIMETAG=1) prints the
+  table, like the reference's Log::Info TIMETAG dumps;
+* `trace(dir)` wraps a block in jax.profiler.trace for xprof/tensorboard
+  inspection of the on-device schedule.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Iterator
+
+from .log import Log
+
+_acc: Dict[str, float] = defaultdict(float)
+_cnt: Dict[str, int] = defaultdict(int)
+_enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    global _enabled
+    _enabled = on
+
+
+@contextlib.contextmanager
+def PHASE(name: str) -> Iterator[None]:
+    """Accumulate wall time under `name` (no-op unless enabled)."""
+    if not _enabled:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _acc[name] += time.perf_counter() - t0
+        _cnt[name] += 1
+
+
+def add(name: str, seconds: float) -> None:
+    if _enabled:
+        _acc[name] += seconds
+        _cnt[name] += 1
+
+
+def summary() -> Dict[str, float]:
+    return dict(_acc)
+
+
+def reset() -> None:
+    _acc.clear()
+    _cnt.clear()
+
+
+def print_summary() -> None:
+    if not _acc:
+        return
+    width = max(len(k) for k in _acc)
+    Log.info("phase timings:")
+    for name, secs in sorted(_acc.items(), key=lambda kv: -kv[1]):
+        Log.info(f"  {name:<{width}}  {secs:9.3f}s  x{_cnt[name]}")
+
+
+if _enabled:
+    atexit.register(print_summary)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/lightgbm_tpu_trace") -> Iterator[None]:
+    """jax.profiler trace around a block (view with xprof/tensorboard)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
