@@ -1,0 +1,97 @@
+"""Golden-config consistency: our CLI vs the reference CLI on the SHIPPED
+example train.conf files (the analog of reference tests/python_package_test/
+test_consistency.py, which uses examples/*/train.conf as fixtures).
+
+Each test runs both CLIs on the identical conf from the example directory
+and compares the final training metric within a small tolerance — the
+strongest end-to-end statement that config parsing, loading, binning,
+growth, and metrics line up.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # full example trainings
+
+from .conftest import ORACLE_BIN, REFERENCE_DIR, has_oracle
+
+EXAMPLES = os.path.join(REFERENCE_DIR, "examples")
+
+
+def _run_ref_cli(example: str, tmp, overrides=()):
+    conf = os.path.join(EXAMPLES, example, "train.conf")
+    out = subprocess.run(
+        [ORACLE_BIN, f"config={conf}", f"output_model={tmp}/ref_model.txt",
+         *overrides],
+        capture_output=True, text=True, timeout=600,
+        cwd=os.path.join(EXAMPLES, example))
+    assert out.returncode == 0, out.stderr[-500:]
+    return out.stdout
+
+
+def _run_our_cli(example: str, tmp, overrides=()):
+    conf = os.path.join(EXAMPLES, example, "train.conf")
+    env = dict(os.environ)
+    env["JAX_PLATFORM_NAME"] = "cpu"
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    out = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu", f"config={conf}",
+         f"output_model={tmp}/our_model.txt", "tpu_split_batch=1",
+         *overrides],
+        capture_output=True, text=True, timeout=900,
+        cwd=os.path.join(EXAMPLES, example), env=env)
+    assert out.returncode == 0, out.stderr[-800:]
+    return out.stdout
+
+
+def _final_metric(stdout: str, metric: str):
+    """Last reported value of `metric`, robust to both CLI line formats
+    (reference: 'Iteration:N, valid_1 auc : v' one metric per line; ours:
+    one tab-joined line per iteration with every metric)."""
+    pat = re.compile(re.escape(metric)
+                     + r"\s*:\s*([-+]?\d*\.?\d+(?:[eE][-+]?\d+)?)")
+    vals = [float(m.group(1)) for line in stdout.splitlines()
+            for m in pat.finditer(line)]
+    assert vals, f"no {metric} values in output"
+    return vals[-1]
+
+
+@pytest.mark.skipif(not has_oracle(), reason="reference oracle not built")
+class TestGoldenConfigs:
+    def test_binary_conf(self, tmp_path):
+        ref = _run_ref_cli("binary_classification", tmp_path)
+        ours = _run_our_cli("binary_classification", tmp_path)
+        for metric in ("binary_logloss", "auc"):
+            r = _final_metric(ref, metric)
+            o = _final_metric(ours, metric)
+            assert abs(r - o) < 0.01, f"{metric}: ref {r} vs ours {o}"
+
+    def test_regression_conf(self, tmp_path):
+        ref = _run_ref_cli("regression", tmp_path)
+        ours = _run_our_cli("regression", tmp_path)
+        r = _final_metric(ref, "l2")
+        o = _final_metric(ours, "l2")
+        assert abs(r - o) < 0.02 * max(r, 1e-9), f"l2: ref {r} vs ours {o}"
+
+    def test_multiclass_conf(self, tmp_path):
+        ref = _run_ref_cli("multiclass_classification", tmp_path)
+        ours = _run_our_cli("multiclass_classification", tmp_path)
+        r = _final_metric(ref, "multi_logloss")
+        o = _final_metric(ours, "multi_logloss")
+        assert abs(r - o) < 0.03, f"multi_logloss: ref {r} vs ours {o}"
+
+    def test_lambdarank_conf(self, tmp_path):
+        ref = _run_ref_cli("lambdarank", tmp_path)
+        ours = _run_our_cli("lambdarank", tmp_path)
+        # ndcg@5 on the validation set
+        r = _final_metric(ref, "ndcg@5")
+        o = _final_metric(ours, "ndcg@5")
+        assert abs(r - o) < 0.03, f"ndcg@5: ref {r} vs ours {o}"

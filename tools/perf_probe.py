@@ -1,0 +1,78 @@
+"""TPU perf sweep: histogram impl x split batch x block size.
+
+Run on the real chip when tuning the grower:
+    python tools/perf_probe.py                  # default sweep
+    K=25 BLOCK=16384 IMPL=pallas N=1000000 python tools/perf_probe.py one
+
+Reports ms/tree and train AUC for each configuration at the bench shape
+(Higgs-1M: 28 features, 255 leaves, 255 bins), so quality regressions
+from batching show up next to the throughput numbers.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def make_data(n, f=28, seed=42):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    w = rng.normal(size=(f,))
+    logits = (X[:, :8] ** 2 - 1.0).sum(axis=1) * 0.3 + X @ w * 0.5
+    y = (logits + rng.logistic(size=n) > 0).astype(np.float64)
+    return X.astype(np.float64), y
+
+
+def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255):
+    import jax
+    import lightgbm_tpu as lgb
+    from sklearn.metrics import roc_auc_score
+
+    ds = lgb.Dataset(X, label=y, params={"max_bin": bins})
+    bst = lgb.Booster(params={
+        "objective": "binary", "num_leaves": leaves, "learning_rate": 0.1,
+        "min_data_in_leaf": 20, "max_bin": bins, "tpu_split_batch": k,
+        "tpu_block_rows": block, "tpu_hist_impl": impl}, train_set=ds)
+    t0 = time.time()
+    bst.update()
+    jax.block_until_ready(bst._driver.train_scores.scores)
+    compile_s = time.time() - t0
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    jax.block_until_ready(bst._driver.train_scores.scores)
+    ms = (time.time() - t0) / iters * 1e3
+    auc = roc_auc_score(y, bst.predict(X, raw_score=True))
+    return ms, compile_s, auc
+
+
+def main():
+    n = int(os.environ.get("N", 1_000_000))
+    X, y = make_data(n)
+    if len(sys.argv) > 1 and sys.argv[1] == "one":
+        k = int(os.environ.get("K", 25))
+        block = int(os.environ.get("BLOCK", 16384))
+        impl = os.environ.get("IMPL", "xla")
+        ms, cs, auc = run_one(X, y, k, block, impl)
+        print(f"K={k} block={block} impl={impl}: {ms:.0f} ms/tree "
+              f"({1000/ms:.2f} it/s) compile {cs:.0f}s auc {auc:.4f}")
+        return
+    for impl in ("xla", "pallas"):
+        for k in (16, 25):
+            for block in (16384, 65536):
+                try:
+                    ms, cs, auc = run_one(X, y, k, block, impl, iters=5)
+                    print(f"impl={impl:6s} K={k:2d} block={block:6d}: "
+                          f"{ms:6.0f} ms/tree ({1000/ms:5.2f} it/s) "
+                          f"compile {cs:5.0f}s auc {auc:.4f}", flush=True)
+                except Exception as exc:
+                    print(f"impl={impl} K={k} block={block}: FAILED {exc}",
+                          flush=True)
+
+
+if __name__ == "__main__":
+    main()
