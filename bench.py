@@ -59,16 +59,18 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
               "learning_rate": 0.1, "min_data_in_leaf": 20,
               "max_bin": max_bin}
     bst = Booster(params=params, train_set=ds)
+    from lightgbm_tpu.utils.backend import host_sync
+
     t_compile = time.time()
     for _ in range(WARMUP_ITERS):
         bst.update()
-    jax.block_until_ready(bst._driver.train_scores.scores)
+    host_sync(bst._driver.train_scores.scores)
     compile_s = time.time() - t_compile
 
     t0 = time.time()
     for _ in range(bench_iters):
         bst.update()
-    jax.block_until_ready(bst._driver.train_scores.scores)
+    host_sync(bst._driver.train_scores.scores)
     train_s = time.time() - t0
     iters_per_sec = bench_iters / train_s
 

@@ -179,6 +179,9 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # only batch leaves whose gain >= alpha * the round's best gain (near
     # ties); keeps batched split order close to strict best-first
     "tpu_split_batch_alpha": ("float", 0.0, ()),
+    # row-partition lowering: select | gather (ops/grower.py GrowerParams.
+    # partition_impl; feature-parallel always uses gather)
+    "tpu_partition_impl": ("str", "select", ()),
 }
 
 _ALIAS: Dict[str, str] = {}

@@ -94,6 +94,12 @@ class TPUTreeLearner:
         self.strategy = strategy
         self.n_shards = n_shards if strategy != "serial" else 1
 
+        for key, allowed in (("tpu_partition_impl", ("select", "gather")),
+                             ("tpu_hist_impl", ("xla", "pallas"))):
+            if str(getattr(config, key)) not in allowed:
+                raise ValueError(f"{key}={getattr(config, key)!r}; "
+                                 f"expected one of {allowed}")
+
         block = int(config.tpu_block_rows)
         if strategy in ("data", "voting"):
             # every shard holds an equal, whole number of histogram blocks
@@ -213,6 +219,7 @@ class TPUTreeLearner:
             cegb_penalty_split=float(config.cegb_penalty_split),
             forced=forced,
             hist_impl=str(config.tpu_hist_impl),
+            partition_impl=str(config.tpu_partition_impl),
             has_bundles=plan is not None,
         )
         self.grow = make_strategy_grower(

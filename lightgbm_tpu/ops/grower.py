@@ -126,6 +126,11 @@ class GrowerParams(NamedTuple):
     # batched-histogram backend: "xla" (scan + dot_general) or "pallas"
     # (fused VMEM kernel, ops/histogram.py _hist_pallas)
     hist_impl: str = "xla"
+    # row-partition lowering: "select" unrolls K scalar-broadcast passes
+    # (one dynamic row slice + elementwise compare per split — no per-row
+    # table gathers, which XLA serializes on TPU); "gather" resolves each
+    # row's slot through [L]/[K] table lookups (one pass, but gather-bound)
+    partition_impl: str = "select"
     # EFB (reference FindGroups/FastFeatureBundling, dataset.cpp:91-263):
     # bins_t holds G <= F bundle columns; meta carries bundle_idx /
     # bin_offset / needs_fix per feature and the search expands bundle
@@ -506,6 +511,25 @@ def make_grower(params: GrowerParams, num_features: int,
             safe = jnp.where(valid, idx, arr.shape[0])
             return arr.at[safe].set(val, mode="drop")
 
+        def fix_bundle_col(raw, off, nbf, fixed):
+            """Bundle column -> feature-space bin (elementwise; scalars or
+            [n] vectors broadcast).  Bins outside the feature's
+            [offset+1, offset+num_bin-1] range are some OTHER bundle
+            member's value, i.e. this feature sits at its all-default
+            bin 0 (reference src/io/dataset.cpp:91-263)."""
+            rel = raw - off
+            in_rng = (rel >= 1) & (rel < nbf)
+            return jnp.where(fixed, jnp.where(in_rng, rel, 0), raw)
+
+        def numeric_go_left(col, mt, nbf, db, thr, dleft):
+            """Numerical split decision incl. missing-value routing
+            (reference dense_bin.hpp Split semantics); elementwise, the
+            single source of truth for both partition lowerings."""
+            is_miss = jnp.where(
+                mt == MISSING_NAN, col == nbf - 1,
+                jnp.where(mt == MISSING_ZERO, col == db, False))
+            return jnp.where(is_miss, dleft, col <= thr)
+
         def exec_round(state, sel, vals, do_k, sel_feat, sel_thr, sel_dleft,
                        sel_iscat, cmask_sel, lg, lh, lc, lo, ro):
             """Execute up to K splits (slot k: leaf sel[k] on feature
@@ -524,62 +548,89 @@ def make_grower(params: GrowerParams, num_features: int,
             rg, rh, rc = pg - lg, ph - lh, pc - lc
 
             # ---- partition all K splits at once (reference dense_bin.hpp
-            # Split / SplitCategorical semantics).  Row->slot resolution is
-            # one [L]-table gather, NOT an [n, K] compare matrix ----
-            leaf_to_slot = jnp.full(L, -1, jnp.int32).at[
-                jnp.where(do_k, sel, L)].set(kar, mode="drop")
-            k_of_r = leaf_to_slot[leaf_ids]                  # [n]
-            valid_r = k_of_r >= 0
-            kk_r = jnp.maximum(k_of_r, 0)
-            if feature_axis:
-                # feature shards own disjoint columns: resolve each row's
-                # winning-feature bin locally, zero rows owned elsewhere,
-                # and psum-broadcast ONE [n] column (not [n, K]) so every
-                # shard partitions identically
-                shard_k = sel_feat // F
-                lf_k = jnp.mod(sel_feat, F)
-                own_r = shard_k[kk_r] == ax
-                col_l = jnp.take_along_axis(
-                    bins_t, lf_k[kk_r][None, :], axis=0)[0]
-                col_r = jax.lax.psum(
-                    jnp.where(own_r, col_l, 0), feature_axis)
+            # Split / SplitCategorical semantics) ----
+            if not feature_axis and params.partition_impl == "select":
+                # K unrolled scalar-broadcast passes: each split reads ONE
+                # bin row (dynamic slice) and updates its own rows with
+                # elementwise compares.  No per-row table gathers — XLA's
+                # TPU gather for tiny tables serializes per element, and at
+                # ~8 gathers/round x ~20 rounds it dominated tree time.
+                new_leaf = leaf_ids
+                for k in range(K):
+                    f_k = sel_feat[k]
+                    if params.has_bundles:
+                        raw_k = jax.lax.dynamic_index_in_dim(
+                            bins_t, meta["bundle_idx"][f_k], 0,
+                            keepdims=False)
+                        col_k = fix_bundle_col(
+                            raw_k, meta["bin_offset"][f_k],
+                            meta["num_bin"][f_k],
+                            meta["needs_fix"][f_k] > 0)
+                    else:
+                        col_k = jax.lax.dynamic_index_in_dim(
+                            bins_t, f_k, 0, keepdims=False)
+                    go_left_k = numeric_go_left(
+                        col_k, meta["missing_type"][f_k],
+                        meta["num_bin"][f_k], meta["default_bin"][f_k],
+                        sel_thr[k], sel_dleft[k])
+                    if params.has_cat:
+                        cm_r = jnp.take(cmask_sel[k], col_k)
+                        go_left_k = jnp.where(sel_iscat[k], cm_r > 0.5,
+                                              go_left_k)
+                    in_k = (leaf_ids == sel[k]) & do_k[k]
+                    new_leaf = jnp.where(in_k & (~go_left_k),
+                                         new_ids[k], new_leaf)
+                leaf_ids = new_leaf
             else:
-                f_r = sel_feat[kk_r]
-                if params.has_bundles:
-                    # bundle column -> feature-space bin: bins outside the
-                    # feature's [offset+1, offset+num_bin-1] range are some
-                    # OTHER member's value, i.e. this feature sits at its
-                    # all-default bin 0
-                    g_r = meta["bundle_idx"][f_r]
-                    c_r = jnp.take_along_axis(bins_t, g_r[None, :],
-                                              axis=0)[0]
-                    off_r = meta["bin_offset"][f_r]
-                    nbf_r = meta["num_bin"][f_r]
-                    rel = c_r - off_r
-                    in_rng = (rel >= 1) & (rel < nbf_r)
-                    fixed_r = meta["needs_fix"][f_r] > 0
-                    col_r = jnp.where(fixed_r,
-                                      jnp.where(in_rng, rel, 0), c_r)
+                # single-pass gather form: row->slot via an [L]-table
+                # lookup, then [K]-table lookups per row.  Kept for the
+                # feature-parallel learner, where resolving bins locally +
+                # ONE psum-broadcast [n] column beats K per-slot psums.
+                leaf_to_slot = jnp.full(L, -1, jnp.int32).at[
+                    jnp.where(do_k, sel, L)].set(kar, mode="drop")
+                k_of_r = leaf_to_slot[leaf_ids]                  # [n]
+                valid_r = k_of_r >= 0
+                kk_r = jnp.maximum(k_of_r, 0)
+                if feature_axis:
+                    # feature shards own disjoint columns: resolve each
+                    # row's winning-feature bin locally, zero rows owned
+                    # elsewhere, and psum-broadcast ONE [n] column (not
+                    # [n, K]) so every shard partitions identically
+                    shard_k = sel_feat // F
+                    lf_k = jnp.mod(sel_feat, F)
+                    own_r = shard_k[kk_r] == ax
+                    col_l = jnp.take_along_axis(
+                        bins_t, lf_k[kk_r][None, :], axis=0)[0]
+                    col_r = jax.lax.psum(
+                        jnp.where(own_r, col_l, 0), feature_axis)
                 else:
-                    col_r = jnp.take_along_axis(
-                        bins_t, f_r[None, :], axis=0)[0]
-            mt_k = meta["missing_type"][sel_feat]
-            nb_k = meta["num_bin"][sel_feat]
-            db_k = meta["default_bin"][sel_feat]
-            mt_r = mt_k[kk_r]
-            is_missing = jnp.where(
-                mt_r == MISSING_NAN, col_r == nb_k[kk_r] - 1,
-                jnp.where(mt_r == MISSING_ZERO, col_r == db_k[kk_r], False))
-            go_left = jnp.where(is_missing, sel_dleft[kk_r],
-                                col_r <= sel_thr[kk_r])
-            if params.has_cat:
-                # bitset membership: bins in the stored mask go left,
-                # everything else (incl. the NaN bin) goes right
-                # (reference CategoricalDecisionInner, tree.h:307-318)
-                cm_r = cmask_sel.reshape(-1)[kk_r * CB + col_r]
-                go_left = jnp.where(sel_iscat[kk_r], cm_r > 0.5, go_left)
-            leaf_ids = jnp.where(valid_r & (~go_left), new_ids[kk_r],
-                                 leaf_ids)
+                    f_r = sel_feat[kk_r]
+                    if params.has_bundles:
+                        g_r = meta["bundle_idx"][f_r]
+                        c_r = jnp.take_along_axis(bins_t, g_r[None, :],
+                                                  axis=0)[0]
+                        col_r = fix_bundle_col(
+                            c_r, meta["bin_offset"][f_r],
+                            meta["num_bin"][f_r],
+                            meta["needs_fix"][f_r] > 0)
+                    else:
+                        col_r = jnp.take_along_axis(
+                            bins_t, f_r[None, :], axis=0)[0]
+                nb_k = meta["num_bin"][sel_feat]
+                db_k = meta["default_bin"][sel_feat]
+                go_left = numeric_go_left(
+                    col_r, meta["missing_type"][sel_feat][kk_r],
+                    nb_k[kk_r], db_k[kk_r],
+                    sel_thr[kk_r], sel_dleft[kk_r])
+                if params.has_cat:
+                    # bitset membership: bins in the stored mask go left,
+                    # everything else (incl. the NaN bin) goes right
+                    # (reference CategoricalDecisionInner, tree.h:307-318)
+                    cm_r = cmask_sel.reshape(-1)[kk_r * CB + col_r]
+                    go_left = jnp.where(sel_iscat[kk_r], cm_r > 0.5,
+                                        go_left)
+                leaf_ids = jnp.where(valid_r & (~go_left), new_ids[kk_r],
+                                     leaf_ids)
 
             # ---- histograms: all K smaller children in one contraction,
             # siblings by subtraction ----

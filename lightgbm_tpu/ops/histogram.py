@@ -203,8 +203,8 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
     def kernel(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
         i = pl.program_id(0)
         b_t = bins_ref[0]                       # [F, blk] i32
-        s = stats_ref[:, 0, :]                  # [S, blk]
-        l = leaf_ref[:]                         # [1, blk] i32
+        s = stats_ref[0]                        # [S, blk]
+        l = leaf_ref[0]                         # [1, blk] i32
         slots = slots_ref[:]                    # [K, 1] i32
         iota = jax.lax.broadcasted_iota(jnp.int32, (F, B, block), 1)
         onehot = (b_t[:, None, :] == iota).astype(dot_dtype)
@@ -224,20 +224,25 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
         def _():
             out_ref[:] += acc
 
+    # Mosaic block-shape rule: the last two dims of every block must be
+    # (8k, 128k)-aligned or equal the array's dims.  All operands are laid
+    # out [nb, ..., block] so each grid step's block matches the trailing
+    # dims exactly; the S/leaf axes ride along whole.
+    stats_nb = jnp.moveaxis(stats_blocks, 1, 0)             # [nb, S, blk]
     raw = pl.pallas_call(
         kernel,
         grid=(nb,),
         in_specs=[
             pl.BlockSpec((1, F, block), lambda i: (i, 0, 0)),
-            pl.BlockSpec((S, 1, block), lambda i: (0, i, 0)),
-            pl.BlockSpec((1, block), lambda i: (i, 0)),
+            pl.BlockSpec((1, S, block), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 1, block), lambda i: (i, 0, 0)),
             pl.BlockSpec((K, 1), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((F * B, K * S), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((F * B, K * S), jnp.float32),
         # the Mosaic TPU backend is the target; interpret on CPU (tests)
         interpret=jax.devices()[0].platform not in ("tpu",),
-    )(bins_t_blocks, stats_blocks, leaf_blocks.reshape(nb, block),
+    )(bins_t_blocks, stats_nb, leaf_blocks.reshape(nb, 1, block),
       slot_leaf_ids.reshape(K, 1))
     raw = jnp.transpose(raw.reshape(F * B, K, S), (1, 2, 0))
     hist = jax.vmap(lambda r: _unpack_hist(r, precision))(raw)

@@ -98,6 +98,20 @@ def probe_default_backend(timeout_s: float = 120.0, retries: int = 1,
     return None
 
 
+def host_sync(x):
+    """Barrier on device compute via a host fetch.
+
+    The tunneled axon backend's `block_until_ready` can return before the
+    device actually finishes, which silently turns timing loops into
+    dispatch-rate measurements.  A host fetch is the one barrier the tunnel
+    honors; every bench/profiling script must use this (and pay the
+    transfer OUTSIDE its timed region when possible).  Returns the fetched
+    numpy array."""
+    import numpy as _np
+
+    return _np.asarray(x)
+
+
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
     """Turn on JAX's persistent compilation cache.
 

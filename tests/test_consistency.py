@@ -13,7 +13,6 @@ import re
 import subprocess
 import sys
 
-import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow  # full example trainings

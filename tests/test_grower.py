@@ -175,3 +175,82 @@ class TestEndToEnd:
             grid = np.column_stack([xs, np.full(50, x1)])
             p = bst.predict(grid)
             assert np.all(np.diff(p) >= -1e-9)
+
+
+class TestPartitionImpls:
+    """select- and gather-lowered partitions must grow identical trees."""
+
+    def _train_dump(self, X, y, extra, impl):
+        import lightgbm_tpu as lgb
+        params = {"objective": "regression", "num_leaves": 31,
+                  "min_data_in_leaf": 5, "max_bin": 64,
+                  "tpu_partition_impl": impl, **extra}
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 64})
+        bst = lgb.train(params, ds, num_boost_round=8, verbose_eval=False)
+        # trees only: the parameters section embeds tpu_partition_impl
+        # itself and must differ between the two runs
+        return bst.model_to_string().split("parameters", 1)[0]
+
+    def test_numerical_identical(self):
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(3000, 6))
+        y = X[:, 0] ** 2 + np.sin(3 * X[:, 1]) + 0.1 * rng.normal(size=3000)
+        a = self._train_dump(X, y, {}, "select")
+        b = self._train_dump(X, y, {}, "gather")
+        assert a == b
+
+    def test_categorical_and_missing_identical(self):
+        rng = np.random.default_rng(8)
+        n = 3000
+        Xc = rng.integers(0, 8, size=n).astype(np.float64)
+        Xn = rng.normal(size=n)
+        Xn[rng.random(n) < 0.2] = np.nan  # exercise the missing path
+        X = np.column_stack([Xc, Xn])
+        y = (Xc % 3 == 1).astype(float) * 2 + np.nan_to_num(Xn) + \
+            0.1 * rng.normal(size=n)
+        extra = {"categorical_feature": [0]}
+        a = self._train_dump(X, y, extra, "select")
+        b = self._train_dump(X, y, extra, "gather")
+        assert a == b
+
+    def test_bundled_identical(self):
+        rng = np.random.default_rng(9)
+        n = 4000
+        # sparse one-hot-ish columns so EFB actually bundles
+        X = np.zeros((n, 6))
+        grp = rng.integers(0, 3, size=n)
+        for g in range(3):
+            X[grp == g, g] = rng.uniform(1, 2, size=(grp == g).sum())
+        X[:, 3:] = rng.normal(size=(n, 3))
+        y = X[:, 0] + 2 * X[:, 1] - X[:, 2] + X[:, 3] + \
+            0.1 * rng.normal(size=n)
+        extra = {"enable_bundle": True}
+        a = self._train_dump(X, y, extra, "select")
+        b = self._train_dump(X, y, extra, "gather")
+        assert a == b
+
+
+class TestBatchedHistogramImpls:
+    """xla and pallas backends of the batched kernel must agree bit-for-bit
+    (pallas runs in interpret mode on CPU)."""
+
+    def test_pallas_matches_xla(self):
+        from lightgbm_tpu.ops.histogram import (build_histogram_batched_t,
+                                                pack_stats)
+        rng = np.random.default_rng(3)
+        nb, F, block, B, K = 3, 4, 256, 16, 5
+        n = nb * block
+        bins_t = jnp.asarray(
+            rng.integers(0, B, size=(nb, F, block)), dtype=jnp.int32)
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        h = jnp.abs(g) + 0.1
+        stats = pack_stats(g, h, jnp.ones(n, jnp.float32), "hilo")
+        stats_blocks = stats.reshape(stats.shape[0], nb, block)
+        leaf_blocks = jnp.asarray(
+            rng.integers(0, K + 2, size=(nb, block)), dtype=jnp.int32)
+        slots = jnp.asarray([0, 2, 4, -1, 5], dtype=jnp.int32)
+        a = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                      slots, B, "hilo", impl="xla")
+        b = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                      slots, B, "hilo", impl="pallas")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

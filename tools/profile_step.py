@@ -1,0 +1,71 @@
+"""Capture a TPU profile of a few boosting iterations and print the op
+breakdown (self-time) so grower tuning targets measured hotspots.
+
+Usage: python tools/profile_step.py [n_rows] [iters]
+Writes the raw trace under /tmp/lgbm_trace and prints the hlo_op_profile
+table parsed via xprof.
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    iters = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils.backend import host_sync
+    from perf_probe import make_data
+
+    X, y = make_data(n)
+
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 255})
+    bst = lgb.Booster(params={
+        "objective": "binary", "num_leaves": 255, "learning_rate": 0.1,
+        "min_data_in_leaf": 20, "max_bin": 255,
+        **json.loads(os.environ.get("EXTRA", "{}"))}, train_set=ds)
+    for _ in range(2):  # compile + warm
+        bst.update()
+    host_sync(bst._driver.train_scores.scores)
+
+    trace_dir = "/tmp/lgbm_trace"
+    os.system(f"rm -rf {trace_dir}")
+    jax.profiler.start_trace(trace_dir)
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    host_sync(bst._driver.train_scores.scores)
+    wall = time.time() - t0
+    jax.profiler.stop_trace()
+    print(f"{iters} iters in {wall:.2f}s = {iters / wall:.3f} it/s")
+
+    xplanes = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    print("xplane files:", xplanes)
+    if not xplanes:
+        return
+    from xprof.convert import raw_to_tool_data as r
+
+    for tool in ("framework_op_stats", "hlo_op_profile", "op_profile"):
+        try:
+            data, _ = r.xspace_to_tool_data(xplanes, tool, {})
+            out = f"/tmp/lgbm_trace/{tool}.out"
+            mode = "wb" if isinstance(data, bytes) else "w"
+            with open(out, mode) as f:
+                f.write(data)
+            print(f"wrote {out} ({len(data)} bytes)")
+        except Exception as exc:
+            print(f"{tool}: {type(exc).__name__}: {str(exc)[:120]}")
+
+
+if __name__ == "__main__":
+    main()
