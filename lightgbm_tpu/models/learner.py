@@ -155,8 +155,13 @@ class TPUTreeLearner:
         self.g_pad = self.num_columns if strategy != "feature" else self.f_pad
 
         # transposed [G, n] bin matrix: rows ride the 128-lane minor axis
-        # for the histogram contraction (see ops/histogram.py)
-        bins_t = np.zeros((self.g_pad, self.n_pad), dtype=np.int32)
+        # for the histogram contraction (see ops/histogram.py).  Stored
+        # uint8 when bins fit (the reference's narrow dense bins,
+        # dense_bin.hpp / dense_nbits_bin.hpp): the matrix is re-read every
+        # grower round, so width directly scales histogram HBM traffic;
+        # the one-hot compare upcasts on the fly
+        bin_dtype = np.uint8 if B <= 256 else np.int32
+        bins_t = np.zeros((self.g_pad, self.n_pad), dtype=bin_dtype)
         bins_t[:self.num_columns, :n] = cols_src.T
 
         meta_host = {}
@@ -170,8 +175,7 @@ class TPUTreeLearner:
 
         if strategy == "serial":
             self.mesh = None
-            # int32 bins: the one-hot compare needs an iota-compatible dtype
-            self.bins_t = jnp.asarray(bins_t.astype(np.int32))
+            self.bins_t = jnp.asarray(bins_t)
             ones = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
             self._ones_mask = ones
         else:
@@ -180,7 +184,7 @@ class TPUTreeLearner:
             else:
                 self.mesh = make_mesh(num_data_shards=self.n_shards)
             self.bins_t = jax.device_put(
-                bins_t.astype(np.int32), bins_sharding(self.mesh, strategy))
+                bins_t, bins_sharding(self.mesh, strategy))
             ones = np.ones(self.n_pad, np.float32)
             ones[n:] = 0.0
             self._ones_mask = jax.device_put(

@@ -275,8 +275,8 @@ def make_grower(params: GrowerParams, num_features: int,
 
     bynode = params.feature_fraction_bynode < 1.0
 
-    def grow(bins_t: jnp.ndarray,       # [G, n_pad] int32 (rows on lanes;
-             #                            cols >= n zero-filled)
+    def grow(bins_t: jnp.ndarray,       # [G, n_pad] uint8/int32 (rows on
+             #                            lanes; cols >= n zero-filled)
              grad: jnp.ndarray,         # [n_pad] f32 (padding rows zero)
              hess: jnp.ndarray,         # [n_pad] f32
              row_mask: jnp.ndarray,     # [n_pad] f32 (bagging x padding)

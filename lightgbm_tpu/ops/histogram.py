@@ -104,7 +104,7 @@ def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
 
     bins_blocks = bins.reshape(num_blocks, block, num_features)
     stats_blocks = stats.reshape(stats.shape[0], num_blocks, block)
-    iota = jnp.arange(num_bins, dtype=bins.dtype)
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
 
     acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
 
@@ -136,7 +136,8 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
     padding waste and no layout changes between the one-hot generation and
     the MXU feed.
 
-    bins_t_blocks: [nb, F, block] int32
+    bins_t_blocks: [nb, F, block] integer bins (uint8 when
+        bins fit — the narrow dense storage — else int32)
     stats_blocks:  [S, nb, block]
     leaf_blocks:   [nb, block] int32
     slot_leaf_ids: [K] int32 (-1 = dead slot)
@@ -202,7 +203,9 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
 
     def kernel(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
         i = pl.program_id(0)
-        b_t = bins_ref[0]                       # [F, blk] i32
+        # explicit upcast: bins may arrive uint8 (narrow dense storage) and
+        # Mosaic's compare wants a full-width integer operand
+        b_t = bins_ref[0].astype(jnp.int32)     # [F, blk]
         s = stats_ref[0]                        # [S, blk]
         l = leaf_ref[0]                         # [1, blk] i32
         slots = slots_ref[:]                    # [K, 1] i32
@@ -316,7 +319,7 @@ def build_histogram_batched_inline(bins_blocks, stats_blocks, leaf_blocks,
                  "f64": jnp.float64}.get(precision, jnp.bfloat16)
     prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
             else jax.lax.Precision.DEFAULT)
-    iota = jnp.arange(num_bins, dtype=bins_blocks.dtype)
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
 
     def body(acc, xs):
         b_blk, s_blk, l_blk = xs  # [block, F], [S, block], [block]
@@ -352,7 +355,7 @@ def build_histogram_inline(bins_blocks, stats_blocks, num_bins: int,
                  "f64": jnp.float64}.get(precision, jnp.bfloat16)
     prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
             else jax.lax.Precision.DEFAULT)
-    iota = jnp.arange(num_bins, dtype=bins_blocks.dtype)
+    iota = jnp.arange(num_bins, dtype=jnp.int32)
 
     def body(acc, xs):
         b_blk, s_blk = xs

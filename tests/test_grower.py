@@ -254,3 +254,12 @@ class TestBatchedHistogramImpls:
         b = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
                                       slots, B, "hilo", impl="pallas")
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # narrow dense storage: uint8 bins (the serial learner's default
+        # when bins fit) must produce identical histograms on both backends
+        bins_u8 = bins_t.astype(jnp.uint8)
+        a8 = build_histogram_batched_t(bins_u8, stats_blocks, leaf_blocks,
+                                       slots, B, "hilo", impl="xla")
+        b8 = build_histogram_batched_t(bins_u8, stats_blocks, leaf_blocks,
+                                       slots, B, "hilo", impl="pallas")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(a8))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b8))

@@ -115,6 +115,38 @@ class TestStrategyParity:
         assert rec[0, G.REC_DID_SPLIT] > 0.5
         assert tree.num_leaves > 4
 
+    def test_voting_realistic_k_tracks_serial(self):
+        """PV-Tree at top_k < F is an approximation, not an equality: only
+        the voted features' histograms are aggregated, so a shard-local
+        favorite can displace the global winner and a single displaced
+        split renumbers every later leaf (field-wise agreement cascades to
+        noise; measured 10% at top_k=4 despite healthy trees).  The stable
+        grower-level invariants: the ROOT decision — where the vote sees
+        every shard's clear favorite — must match serial exactly, and the
+        tree must grow to the same size.  Quality-tracking at realistic k
+        is covered end-to-end by TestEndToEnd::test_train_api (top_k=10
+        must reach AUC>0.75)."""
+        # real logistic gradients (score=0), NOT the fixture's random ones:
+        # random grads make every feature a near-tie and the vote a coin
+        # flip, while a real objective gives feature 0 a dominant gain
+        def real_grad_records(cfg_kw):
+            config, td, _ = _problem(**cfg_kw)
+            learner = TPUTreeLearner(config, td)
+            y = np.asarray(td.metadata.label, np.float32)
+            grad = (0.5 - y).astype(np.float32)
+            hess = np.full_like(grad, 0.25)
+            _, _, out = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+            return np.asarray(jax.device_get(out["records"]))
+
+        rec_s = real_grad_records({})
+        rec_v = real_grad_records(dict(tree_learner="voting",
+                                       num_machines=8, top_k=4))
+        np.testing.assert_array_equal(rec_s[:, G.REC_DID_SPLIT],
+                                      rec_v[:, G.REC_DID_SPLIT])
+        for fld in (G.REC_LEAF, G.REC_FEATURE, G.REC_THRESHOLD):
+            assert rec_s[0, fld] == rec_v[0, fld], \
+                f"root split field {fld}: {rec_s[0, fld]} vs {rec_v[0, fld]}"
+
     def test_serial_fallback_warns_on_one_machine(self):
         config, td, _ = _problem(tree_learner="data", num_machines=1)
         learner = TPUTreeLearner(config, td)
