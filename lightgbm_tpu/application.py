@@ -2,8 +2,8 @@
 
 Mirrors the reference CLI (reference src/main.cpp:11, src/application/
 application.cpp:30-251): argv `key=value` pairs override the config file;
-tasks are train / predict / refit / convert_model (convert_model is out of
-scope, SURVEY.md §7).
+tasks are train / predict / refit / convert_model (if-else C++ codegen,
+codegen.py).
 """
 
 from __future__ import annotations
@@ -46,8 +46,36 @@ class Application:
             self.predict()
         elif task == "refit" or task == "refit_tree":
             self.refit()
+        elif task == "convert_model":
+            self.convert_model()
         else:
             raise ValueError(f"unknown task {task!r}")
+
+    # ------------------------------------------------------------------
+    def convert_model(self) -> None:
+        """Model file -> standalone C++ source (reference
+        application.cpp:222-229 ConvertModel + gbdt_model_text.cpp:87)."""
+        from .booster import Booster
+        from .codegen import model_to_cpp
+
+        cfg = self.config
+        if not cfg.input_model:
+            raise ValueError("convert_model needs input_model=<file>")
+        lang = str(cfg.convert_model_language).lower()
+        if lang not in ("", "cpp", "c++"):
+            raise ValueError(
+                f"convert_model_language={lang!r}: only cpp is supported")
+        bst = Booster(model_file=str(cfg.input_model))
+        drv = bst._driver
+        sigmoid = getattr(drv.objective, "sigmoid", 1.0)
+        name = drv.objective.name if drv.objective is not None else ""
+        src = model_to_cpp(drv.models, drv.num_tree_per_iteration, name,
+                           sigmoid=float(sigmoid),
+                           average_output=bool(drv.average_output))
+        out = str(cfg.convert_model)
+        with open(out, "w") as f:
+            f.write(src)
+        print(f"[lightgbm_tpu] model converted to C++ at {out}")
 
     # ------------------------------------------------------------------
     def train(self) -> None:

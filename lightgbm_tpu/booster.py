@@ -176,6 +176,32 @@ class Booster:
         self._driver.reset_config(Config(self.params))
         return self
 
+    def set_network(self, machines: str, local_listen_port: int = 12400,
+                    listen_time_out: int = 120, num_machines: int = 1
+                    ) -> "Booster":
+        """Join the multi-host training mesh (reference basic.py
+        Booster.set_network -> LGBM_NetworkInit; here the machine list maps
+        onto jax.distributed, parallel/mesh.py init_multihost).
+
+        listen_time_out is accepted for signature parity; rendezvous
+        timeouts are governed by jax.distributed itself."""
+        from .parallel.mesh import init_multihost
+
+        init_multihost(machines, int(local_listen_port), int(num_machines))
+        self.params.update({"machines": machines,
+                            "local_listen_port": int(local_listen_port),
+                            "num_machines": int(num_machines)})
+        self._network_set = True
+        return self
+
+    def free_network(self) -> "Booster":
+        """Reference Booster.free_network analog: forget the network params
+        (the jax.distributed runtime itself stays up for the process)."""
+        for k in ("machines", "local_listen_port", "num_machines"):
+            self.params.pop(k, None)
+        self._network_set = False
+        return self
+
     def set_train_data_name(self, name: str) -> "Booster":
         self._train_data_name = name
         return self

@@ -91,6 +91,9 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "data_random_seed": ("int", 1, ("data_seed",)),
     "output_model": ("str", "LightGBM_model.txt", ("model_output", "model_out")),
     "input_model": ("str", "", ("model_input", "model_in")),
+    # task=convert_model: if-else C++ codegen of input_model (codegen.py)
+    "convert_model": ("str", "gbdt_prediction.cpp", ("convert_model_file",)),
+    "convert_model_language": ("str", "cpp", ()),
     "output_result": ("str", "LightGBM_predict_result.txt",
                       ("predict_result", "prediction_result", "predict_name",
                        "prediction_name", "pred_name", "name_pred")),

@@ -1,0 +1,122 @@
+"""convert_model C++ codegen: generated source must compile (g++) and
+reproduce Booster.predict bit-for-nearly-bit.
+
+Mirrors the reference CLI task=convert_model (application.cpp:222-229,
+gbdt_model_text.cpp:87 ModelToIfElse).
+"""
+
+import ctypes
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # g++ in the loop
+
+
+def _compile_and_predict(cpp_path, tmp, X, num_out):
+    so = os.path.join(tmp, "model.so")
+    subprocess.run(["g++", "-O1", "-shared", "-fPIC", "-o", so, cpp_path],
+                   check=True, capture_output=True)
+    lib = ctypes.CDLL(so)
+    # C++ name mangling: ask the symbol table
+    syms = subprocess.run(["nm", "-D", so], capture_output=True,
+                          text=True).stdout
+    raw_sym = next(s.split()[-1] for s in syms.splitlines()
+                   if "PredictRaw" in s)
+    pred_sym = next(s.split()[-1] for s in syms.splitlines()
+                    if "Predict" in s and "PredictRaw" not in s)
+    out = np.zeros((len(X), num_out))
+    raw = np.zeros((len(X), num_out))
+    for fname, buf in ((raw_sym, raw), (pred_sym, out)):
+        fn = getattr(lib, fname)
+        fn.argtypes = [ctypes.POINTER(ctypes.c_double),
+                       ctypes.POINTER(ctypes.c_double)]
+        for i, row in enumerate(np.ascontiguousarray(X, np.float64)):
+            fn(row.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+               buf[i].ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    return raw, out
+
+
+def _roundtrip(tmp_path, params, X, y, num_out, categorical=None):
+    import lightgbm_tpu as lgb
+
+    if categorical is not None:
+        params = dict(params, categorical_feature=categorical)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+    bst = lgb.train(params, ds, num_boost_round=5, verbose_eval=False)
+    model_file = str(tmp_path / "m.txt")
+    bst.save_model(model_file)
+
+    from lightgbm_tpu.application import Application
+    cpp = str(tmp_path / "model.cpp")
+    Application(["task=convert_model", f"input_model={model_file}",
+                 f"convert_model={cpp}"]).run()
+    raw_c, pred_c = _compile_and_predict(cpp, str(tmp_path), X, num_out)
+    raw_py = bst.predict(X, raw_score=True)
+    pred_py = bst.predict(X)
+    if num_out == 1:
+        raw_py = raw_py.reshape(-1, 1)
+        pred_py = pred_py.reshape(-1, 1)
+    np.testing.assert_allclose(raw_c, raw_py, rtol=1e-10, atol=1e-10)
+    np.testing.assert_allclose(pred_c, pred_py, rtol=1e-6, atol=1e-9)
+
+
+class TestConvertModel:
+    def test_binary_with_missing(self, tmp_path):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(800, 5))
+        X[rng.random(X.shape) < 0.15] = np.nan
+        y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0).astype(
+            np.float64)
+        _roundtrip(tmp_path, {"objective": "binary", "num_leaves": 15,
+                              "min_data_in_leaf": 5}, X, y, 1)
+
+    def test_multiclass_softmax(self, tmp_path):
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(900, 4))
+        y = (np.digitize(X[:, 0], [-0.5, 0.5])).astype(np.float64)
+        _roundtrip(tmp_path, {"objective": "multiclass", "num_class": 3,
+                              "num_leaves": 7, "min_data_in_leaf": 5},
+                   X, y, 3)
+
+    def test_regression_categorical(self, tmp_path):
+        rng = np.random.default_rng(2)
+        n = 1000
+        Xc = rng.integers(0, 9, size=n).astype(np.float64)
+        Xn = rng.normal(size=n)
+        X = np.column_stack([Xc, Xn])
+        y = (Xc % 3) * 1.5 + Xn
+        _roundtrip(tmp_path, {"objective": "regression", "num_leaves": 15,
+                              "min_data_in_leaf": 5}, X, y, 1,
+                   categorical=[0])
+
+    def test_categorical_nan_routing(self, tmp_path):
+        """NaN in a categorical feature at PREDICT time: for non-NaN
+        missing types the tree folds it to category 0, so the generated
+        C++ must too (the train data has no NaNs, making missing_type
+        None/Zero)."""
+        rng = np.random.default_rng(3)
+        n = 1200
+        Xc = rng.integers(0, 6, size=n).astype(np.float64)
+        Xn = rng.normal(size=n)
+        X = np.column_stack([Xc, Xn])
+        y = (Xc < 2) * 2.0 + Xn  # category 0 lands left of the root split
+        import lightgbm_tpu as lgb
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "min_data_in_leaf": 5,
+                         "categorical_feature": [0]},
+                        ds, num_boost_round=5, verbose_eval=False)
+        model_file = str(tmp_path / "m.txt")
+        bst.save_model(model_file)
+        from lightgbm_tpu.application import Application
+        cpp = str(tmp_path / "model.cpp")
+        Application(["task=convert_model", f"input_model={model_file}",
+                     f"convert_model={cpp}"]).run()
+        Xq = np.column_stack([np.full(50, np.nan), rng.normal(size=50)])
+        raw_c, _ = _compile_and_predict(cpp, str(tmp_path), Xq, 1)
+        raw_py = bst.predict(Xq, raw_score=True).reshape(-1, 1)
+        np.testing.assert_allclose(raw_c, raw_py, rtol=1e-10, atol=1e-10)
