@@ -53,10 +53,19 @@ inline int32_t walk(const Forest& f, int32_t tree, const double* row) {
     const int mt = (dt >> 2) & 3;
     bool left;
     if (dt & kCategoricalMask) {
-      // category bitset membership; negatives / NaN route right
+      // category bitset membership; negatives route right.  NaN routes
+      // right only for missing_type NaN — otherwise it folds to category
+      // 0, matching Tree._categorical_go_left (models/tree.py:216-233)
       left = false;
-      if (!(std::isnan(v) || v < 0)) {
-        const int64_t cat = static_cast<int64_t>(v);
+      int64_t cat = -1;
+      if (std::isnan(v)) {
+        if (mt != 2) cat = 0;
+      } else {
+        // truncate BEFORE the negative test: values in (-1, 0) fold to
+        // category 0, like the oracle's int64(fval) then <0 check
+        cat = static_cast<int64_t>(v);
+      }
+      if (cat >= 0) {
         const int32_t cidx = static_cast<int32_t>(f.threshold[k]);
         const int32_t* bounds = f.cat_boundaries + f.cat_bound_offset[tree];
         const uint32_t* words = f.cat_words + f.cat_word_offset[tree];
