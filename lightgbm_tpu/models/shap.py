@@ -99,9 +99,17 @@ def _node_decision(tree: Tree, node: int, row: np.ndarray) -> bool:
     dt = int(tree.decision_type[node])
     mt = (dt >> 2) & 3
     if dt & K_CATEGORICAL_MASK:
-        if np.isnan(v) or v < 0:
+        # NaN folds to category 0 unless missing_type is NaN; truncation
+        # happens BEFORE the negative test so (-1, 0) folds to 0 as well
+        # (Tree._categorical_go_left, models/tree.py:216-233)
+        if np.isnan(v):
+            if mt == 2:
+                return False
+            cat = 0
+        else:
+            cat = int(v)
+        if cat < 0:
             return False
-        cat = int(v)
         cidx = int(tree.threshold[node])
         lo = tree.cat_boundaries[cidx]
         hi = tree.cat_boundaries[cidx + 1]

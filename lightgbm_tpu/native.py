@@ -27,6 +27,20 @@ _lib = None
 _lib_tried = False
 
 
+def _stale(lib_path: str) -> bool:
+    """True when any C++ source is newer than the built library."""
+    src_dir = os.path.join(_REPO, "src", "capi")
+    try:
+        lib_mtime = os.path.getmtime(lib_path)
+        for name in os.listdir(src_dir):
+            if name.endswith((".cpp", ".h", ".hpp")):
+                if os.path.getmtime(os.path.join(src_dir, name)) > lib_mtime:
+                    return True
+    except OSError:
+        return False
+    return False
+
+
 def native_lib() -> Optional[ctypes.CDLL]:
     """The native library, building it on first use when possible."""
     global _lib, _lib_tried
@@ -34,6 +48,9 @@ def native_lib() -> Optional[ctypes.CDLL]:
         return _lib
     _lib_tried = True
     path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+    if path is not None and _stale(path):
+        # a semantic fix to the C++ must not be masked by a cached build
+        path = None
     if path is None and os.environ.get("LIGHTGBM_TPU_NO_BUILD", "") != "1":
         out_dir = os.path.join(_REPO, "build")
         os.makedirs(out_dir, exist_ok=True)

@@ -67,6 +67,28 @@ class TestSHAP:
         np.testing.assert_allclose(contrib, ref_contrib,
                                    rtol=1e-7, atol=1e-9)
 
+    def test_additivity_categorical_nan(self, tmp_path):
+        """Contribs must sum to raw predictions when NaN / fractional
+        negatives hit a categorical split at predict time (both fold to
+        category 0 for non-NaN missing types)."""
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(5)
+        n = 1000
+        Xc = rng.integers(0, 6, size=n).astype(np.float64)
+        X = np.column_stack([Xc, rng.normal(size=n)])
+        y = (Xc < 2) * 2.0 + X[:, 1]
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "min_data_in_leaf": 5,
+                         "categorical_feature": [0]},
+                        ds, num_boost_round=5, verbose_eval=False)
+        vals = np.concatenate([np.full(20, np.nan), np.full(20, -0.5)])
+        Xq = np.column_stack([vals, rng.normal(size=40)])
+        contrib = bst.predict(Xq, pred_contrib=True)
+        raw = bst.predict(Xq, raw_score=True)
+        np.testing.assert_allclose(contrib.sum(axis=1), raw,
+                                   rtol=1e-6, atol=1e-6)
+
     def test_multiclass_shape(self, multiclass_example):
         X, y = multiclass_example["X_train"], multiclass_example["y_train"]
         ds = lgb.Dataset(X, label=y)
