@@ -43,16 +43,13 @@ class Dataset:
         if self._inner is not None:
             return self
         cfg = Config(self.params)
-        if bool(cfg.two_round):
+        if bool(cfg.two_round) and not isinstance(self.data, str):
             from .utils.log import Log
 
-            # two_round is a host-memory loading strategy in the reference
-            # (sampled bin-finding then a second streaming pass,
-            # dataset_loader.cpp:188-216); loading here is single-pass
-            # in-memory and produces identical bins, so the key changes
-            # nothing — say so instead of silently accepting it
-            Log.warning("two_round=true is a no-op: loading is single-pass "
-                        "in-memory and yields identical bins")
+            # two_round is a FILE-loading strategy (sampled bin-finding
+            # then a streaming second pass); in-memory matrices are
+            # already resident, so there is nothing to stream
+            Log.warning("two_round=true ignored for in-memory data")
         ref_inner = self.reference._inner if self.reference is not None else None
         if self.reference is not None and ref_inner is None:
             self.reference.construct()

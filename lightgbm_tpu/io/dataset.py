@@ -234,6 +234,16 @@ class TrainingData:
                 from ..utils.log import Log
 
                 Log.warning(f"ignoring stale binary cache {path}.bin: {exc}")
+        if bool(config.two_round):
+            try:
+                data = cls._from_file_two_round(path, config, reference)
+                if bool(config.save_binary):
+                    data.save_binary(path + ".bin")
+                return data
+            except ValueError as exc:  # e.g. libsvm: no streaming reader
+                from ..utils.log import Log
+
+                Log.warning(f"two_round fell back to one-pass load: {exc}")
         X, y, w, group, init, names = load_text_file(
             path, label_column=config.label_column,
             header=True if config.header else None)
@@ -245,6 +255,91 @@ class TrainingData:
         if bool(config.save_binary):
             data.save_binary(path + ".bin")
         return data
+
+    @classmethod
+    def _from_file_two_round(cls, path: str, config: Config,
+                             reference: Optional["TrainingData"],
+                             chunk_rows: int = 200_000) -> "TrainingData":
+        """Two-pass streaming load (reference two_round,
+        dataset_loader.cpp:188-216): pass 1 reservoir-samples
+        `bin_construct_sample_cnt` rows for bin finding and counts rows;
+        pass 2 streams chunks straight into the uint8/16 bin matrix.  The
+        raw float matrix is never resident — peak memory drops from
+        n*F*8 bytes to n*F*1 plus one chunk."""
+        from .parser import TextChunkReader, load_sidecars
+
+        reader = TextChunkReader(path, label_column=config.label_column,
+                                 header=True if config.header else None,
+                                 chunk_rows=chunk_rows)
+        names = reader.feature_names
+        sample_cnt = max(int(config.bin_construct_sample_cnt), 2)
+        rng = np.random.default_rng(int(config.data_random_seed))
+
+        # ---- pass 1: row count + algorithm-R reservoir over chunks
+        # (with a reference the mappers are reused, so only the count,
+        # labels, and column width are needed — no sampling) ----
+        n = 0
+        ncols = 0
+        sample: Optional[np.ndarray] = None
+        labels_parts: List[np.ndarray] = []
+        for Xc, yc in reader.chunks():
+            m = len(yc)
+            labels_parts.append(yc)
+            ncols = Xc.shape[1]
+            if reference is None:
+                if sample is None:
+                    sample = Xc[:sample_cnt].copy()
+                elif len(sample) < sample_cnt:
+                    # reservoir not yet full: the chunk's LEADING rows are
+                    # the next global positions < sample_cnt
+                    need = sample_cnt - len(sample)
+                    sample = np.vstack([sample, Xc[:need]])
+                start = max(n, sample_cnt)
+                if start < n + m:
+                    pos = np.arange(start, n + m)
+                    local = pos - n
+                    accept = rng.random(len(pos)) < sample_cnt / (pos + 1.0)
+                    slots = rng.integers(0, sample_cnt,
+                                         size=int(accept.sum()))
+                    sample[slots] = Xc[local[accept]]
+            n += m
+        if n == 0:
+            raise ValueError(f"empty data file {path}")
+        label = np.concatenate(labels_parts)
+
+        self = cls()
+        self.config = config
+        self.num_data = n
+        self.num_total_features = ncols
+        self.feature_names = list(names)
+        if reference is not None:
+            self.mappers = reference.mappers
+            self.used_feature_idx = list(reference.used_feature_idx)
+            self.monotone_constraints = reference.monotone_constraints
+            self.feature_penalty = reference.feature_penalty
+            if reference.num_total_features != self.num_total_features:
+                raise ValueError("validation data feature count mismatch")
+        else:
+            cat = _parse_column_spec(config.categorical_feature, names)
+            self._find_mappers(sample, config, cat or [],
+                               _load_forced_bins(config), total_rows=n)
+
+        # ---- pass 2: stream rows into bins ----
+        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+        bins = np.empty((n, self.num_features), dtype=dtype)
+        row = 0
+        for Xc, _ in reader.chunks():
+            m = Xc.shape[0]
+            for j, col in enumerate(self.used_feature_idx):
+                bins[row:row + m, j] = \
+                    self.mappers[col].values_to_bins(Xc[:, col]).astype(dtype)
+            row += m
+        self.bins = bins
+
+        weight, group, init_score = load_sidecars(path)
+        self.metadata = Metadata(n, label, weight, group, init_score)
+        self._set_constraints(config)
+        return self
 
     # ------------------------------------------------------------------
     _BINARY_TOKEN = "lightgbm_tpu.binned.v1"
@@ -321,8 +416,14 @@ class TrainingData:
     # ------------------------------------------------------------------
     def _find_mappers(self, X: np.ndarray, config: Config,
                       categorical_features: Sequence[int],
-                      forced_bins: Dict[int, List[float]]) -> None:
+                      forced_bins: Dict[int, List[float]],
+                      total_rows: Optional[int] = None) -> None:
+        # total_rows: full dataset size when X is already a sample (the
+        # two-round path) — the near-unsplittable filter must scale by
+        # sample/total like the reference (dataset_loader.cpp:599-600);
+        # the internal subsample below still indexes X's own rows
         n, nf = X.shape
+        full_n = max(int(total_rows), n) if total_rows is not None else n
         sample_cnt = min(n, int(config.bin_construct_sample_cnt))
         if sample_cnt < n:
             rng = np.random.default_rng(int(config.data_random_seed))
@@ -336,7 +437,7 @@ class TrainingData:
         cat_set = set(int(c) for c in categorical_features)
         max_bin_by_feature = list(config.max_bin_by_feature)
         # near-unsplittable feature filter (reference dataset_loader.cpp:599-600)
-        filter_cnt = int(float(config.min_data_in_leaf) * total / n)
+        filter_cnt = int(float(config.min_data_in_leaf) * total / full_n)
 
         self.mappers = []
         self.used_feature_idx = []

@@ -93,13 +93,20 @@ def load_text_file(path: str, label_column: str = "", header: Optional[bool] = N
         y = arr[:, label_idx].copy()
         X = np.delete(arr, label_idx, axis=1)
 
+    weight, group_arr, init_score = load_sidecars(path)
+    return X, y, weight, group_arr, init_score, feature_names
+
+
+def load_sidecars(path):
+    """(weight, group_sizes int64 or None, init_score) side-car files
+    next to the data file (reference dataset_loader.cpp metadata files)."""
     weight = _load_sidecar(path + ".weight")
     group = _load_sidecar(path + ".query")
     if group is None:
         group = _load_sidecar(path + ".group")
     init_score = _load_sidecar(path + ".init")
     group_arr = group.astype(np.int64) if group is not None else None
-    return X, y, weight, group_arr, init_score, feature_names
+    return weight, group_arr, init_score
 
 
 def _load_sidecar(path: str) -> Optional[np.ndarray]:
@@ -133,3 +140,71 @@ def _load_libsvm(path: str, num_features_hint: int = 0) -> Tuple[np.ndarray, np.
         for k, v in row.items():
             X[i, k] = v
     return X, np.asarray(labels, dtype=np.float64)
+
+
+class TextChunkReader:
+    """Streaming chunk reader for CSV/TSV/space files (two-round loading).
+
+    The reference's two_round path never holds the raw matrix: one pass
+    samples rows for bin finding, a second streams rows straight into bins
+    (reference src/io/dataset_loader.cpp:188-216).  LibSVM files fall back
+    to one-pass loading (load_text_file) — the sparse format is small on
+    disk by construction.
+    """
+
+    def __init__(self, path: str, label_column: str = "",
+                 header: Optional[bool] = None, chunk_rows: int = 200_000):
+        self.path = path
+        self.chunk_rows = int(chunk_rows)
+        with open(path) as f:
+            head = []
+            for _ in range(5):
+                line = f.readline()
+                if not line:
+                    break
+                if line.strip():
+                    head.append(line)
+        if not head:
+            raise ValueError(f"empty data file {path}")
+        self.kind, self.delim = _detect_format(head)
+        if self.kind == "libsvm":
+            raise ValueError("TextChunkReader does not stream libsvm")
+        self.use_header = (_has_header(head[0], self.delim)
+                           if header is None else header)
+        self.label_idx = 0
+        label_name = None
+        if label_column:
+            if str(label_column).startswith("name:"):
+                label_name = str(label_column)[5:]
+            elif str(label_column) != "":
+                self.label_idx = int(label_column)
+        if self.use_header:
+            # pandas-parsed names (quoting/padding aware) so the streaming
+            # path resolves label names exactly like load_text_file
+            import pandas as pd
+
+            cols = [str(c) for c in pd.read_csv(
+                path, sep=self.delim, nrows=0).columns]
+            if label_name is not None:
+                self.label_idx = cols.index(label_name)
+            self.feature_names = [c for i, c in enumerate(cols)
+                                  if i != self.label_idx]
+        else:
+            ncol = len([t for t in head[0].strip().split(self.delim)
+                        if t != ""])
+            self.feature_names = [f"Column_{i}" for i in range(ncol - 1)]
+
+    def chunks(self):
+        """Yield (X_chunk [m,F] f64, y_chunk [m]) in file order."""
+        import pandas as pd
+
+        reader = pd.read_csv(
+            self.path, sep=self.delim,
+            header=0 if self.use_header else None,
+            na_values=["", "NA", "N/A", "nan", "NaN", "null"],
+            chunksize=self.chunk_rows)
+        for df in reader:
+            arr = df.to_numpy(dtype=np.float64)
+            y = arr[:, self.label_idx].copy()
+            X = np.delete(arr, self.label_idx, axis=1)
+            yield X, y

@@ -47,11 +47,14 @@ def native_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    path = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
-    if path is not None and _stale(path):
-        # a semantic fix to the C++ must not be masked by a cached build
+    existing = next((p for p in _LIB_CANDIDATES if os.path.exists(p)), None)
+    path = existing
+    no_build = os.environ.get("LIGHTGBM_TPU_NO_BUILD", "") == "1"
+    if path is not None and _stale(path) and not no_build:
+        # a semantic fix to the C++ must not be masked by a cached build;
+        # with rebuilds disabled the existing lib stays in use (warned)
         path = None
-    if path is None and os.environ.get("LIGHTGBM_TPU_NO_BUILD", "") != "1":
+    if path is None and not no_build:
         out_dir = os.path.join(_REPO, "build")
         os.makedirs(out_dir, exist_ok=True)
         build = os.path.join(_REPO, "src", "capi", "build.sh")
@@ -62,6 +65,13 @@ def native_lib() -> Optional[ctypes.CDLL]:
                 path = _LIB_CANDIDATES[0]
         except Exception:
             path = None
+    if path is None and existing is not None:
+        # rebuild failed (or skipped): better a stale native lib than the
+        # slow fallback — the staleness is logged for the record
+        from .utils.log import Log
+
+        Log.warning(f"using possibly-stale native lib {existing}")
+        path = existing
     if path is None:
         return None
     try:
