@@ -207,7 +207,8 @@ class TrainingData:
             if reference.num_total_features != nf:
                 raise ValueError("validation data feature count mismatch")
         else:
-            self._find_mappers(X, config, categorical_features or [], forced_bins or {})
+            self._find_mappers_maybe_distributed(
+                X, config, categorical_features or [], forced_bins or {})
 
         # bin all used columns
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
@@ -227,7 +228,15 @@ class TrainingData:
         # binary fast path (reference CheckCanLoadFromBin,
         # dataset_loader.cpp:1217 + binary token check): <path>.bin skips
         # parsing and re-binning entirely
-        if reference is None and os.path.exists(path + ".bin"):
+        skip_cache = False
+        if bool(config.pre_partition):
+            import jax
+
+            # per-host cache presence may diverge; every host must walk
+            # the same (collective) bin-finding path or the group hangs
+            skip_cache = jax.process_count() > 1
+        if reference is None and not skip_cache \
+                and os.path.exists(path + ".bin"):
             try:
                 return cls.from_binary(path + ".bin")
             except Exception as exc:
@@ -321,8 +330,9 @@ class TrainingData:
                 raise ValueError("validation data feature count mismatch")
         else:
             cat = _parse_column_spec(config.categorical_feature, names)
-            self._find_mappers(sample, config, cat or [],
-                               _load_forced_bins(config), total_rows=n)
+            self._find_mappers_maybe_distributed(
+                sample, config, cat or [], _load_forced_bins(config),
+                total_rows=n)
 
         # ---- pass 2: stream rows into bins ----
         dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
@@ -414,14 +424,47 @@ class TrainingData:
         return self
 
     # ------------------------------------------------------------------
+    def _find_mappers_maybe_distributed(self, X, config, categorical,
+                                        forced_bins,
+                                        total_rows: Optional[int] = None
+                                        ) -> None:
+        """Feature-sharded multi-host bin finding when this process is
+        part of a pre-partitioned jax.distributed group (reference
+        dataset_loader.cpp:959-1042); plain local find otherwise.
+
+        NO silent fallback once pre_partition requests distribution: a
+        host that skipped the collective while its peers entered it would
+        deadlock the group, so errors here must be loud."""
+        use_dist = False
+        if bool(config.pre_partition):
+            import jax
+
+            use_dist = jax.process_count() > 1
+        if use_dist:
+            from .distributed_binning import find_mappers_multihost
+
+            self.mappers = find_mappers_multihost(X, config, categorical,
+                                                  forced_bins,
+                                                  total_rows=total_rows)
+            self.used_feature_idx = [i for i, m in enumerate(self.mappers)
+                                     if not m.is_trivial]
+            return
+        self._find_mappers(X, config, categorical, forced_bins,
+                           total_rows=total_rows)
+
     def _find_mappers(self, X: np.ndarray, config: Config,
                       categorical_features: Sequence[int],
                       forced_bins: Dict[int, List[float]],
-                      total_rows: Optional[int] = None) -> None:
+                      total_rows: Optional[int] = None,
+                      feature_subset: Optional[Sequence[int]] = None
+                      ) -> None:
         # total_rows: full dataset size when X is already a sample (the
         # two-round path) — the near-unsplittable filter must scale by
         # sample/total like the reference (dataset_loader.cpp:599-600);
-        # the internal subsample below still indexes X's own rows
+        # the internal subsample below still indexes X's own rows.
+        # feature_subset: X's columns' GLOBAL feature ids (distributed
+        # feature-sharded bin finding) — per-feature config (ignore,
+        # max_bin_by_feature, categorical, forced bins) is keyed globally
         n, nf = X.shape
         full_n = max(int(total_rows), n) if total_rows is not None else n
         sample_cnt = min(n, int(config.bin_construct_sample_cnt))
@@ -442,8 +485,10 @@ class TrainingData:
         self.mappers = []
         self.used_feature_idx = []
         for col in range(nf):
+            gcol = int(feature_subset[col]) if feature_subset is not None \
+                else col
             m = BinMapper()
-            if col in ignore:
+            if gcol in ignore:
                 m.num_bin = 1
                 m.is_trivial = True
                 self.mappers.append(m)
@@ -453,16 +498,16 @@ class TrainingData:
             # dataset_loader.cpp sparse-aware sampling)
             nonzero = colv[~((np.abs(colv) <= K_ZERO_THRESHOLD) & ~np.isnan(colv))]
             mb = int(config.max_bin)
-            if max_bin_by_feature and col < len(max_bin_by_feature):
-                mb = int(max_bin_by_feature[col])
+            if max_bin_by_feature and gcol < len(max_bin_by_feature):
+                mb = int(max_bin_by_feature[gcol])
             m.find_bin(nonzero, total, mb,
                        min_data_in_bin=int(config.min_data_in_bin),
                        min_split_data=filter_cnt,
-                       bin_type=(BinType.CATEGORICAL if col in cat_set
+                       bin_type=(BinType.CATEGORICAL if gcol in cat_set
                                  else BinType.NUMERICAL),
                        use_missing=bool(config.use_missing),
                        zero_as_missing=bool(config.zero_as_missing),
-                       forced_bounds=forced_bins.get(col))
+                       forced_bounds=forced_bins.get(gcol))
             self.mappers.append(m)
             if not m.is_trivial:
                 self.used_feature_idx.append(col)

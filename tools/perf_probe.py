@@ -30,7 +30,8 @@ def make_data(n, f=28, seed=42):
 _DS_CACHE = {}
 
 
-def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255):
+def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
+            partition="select"):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.backend import host_sync
     from sklearn.metrics import roc_auc_score
@@ -43,7 +44,8 @@ def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255):
     bst = lgb.Booster(params={
         "objective": "binary", "num_leaves": leaves, "learning_rate": 0.1,
         "min_data_in_leaf": 20, "max_bin": bins, "tpu_split_batch": k,
-        "tpu_block_rows": block, "tpu_hist_impl": impl}, train_set=ds)
+        "tpu_block_rows": block, "tpu_hist_impl": impl,
+        "tpu_partition_impl": partition}, train_set=ds)
     t0 = time.time()
     bst.update()
     host_sync(bst._driver.train_scores.scores)
@@ -64,9 +66,37 @@ def main():
         k = int(os.environ.get("K", 25))
         block = int(os.environ.get("BLOCK", 16384))
         impl = os.environ.get("IMPL", "xla")
-        ms, cs, auc = run_one(X, y, k, block, impl)
-        print(f"K={k} block={block} impl={impl}: {ms:.0f} ms/tree "
-              f"({1000/ms:.2f} it/s) compile {cs:.0f}s auc {auc:.4f}")
+        part = os.environ.get("PARTITION", "select")
+        ms, cs, auc = run_one(X, y, k, block, impl, partition=part)
+        print(f"K={k} block={block} impl={impl} part={part}: "
+              f"{ms:.0f} ms/tree ({1000/ms:.2f} it/s) compile {cs:.0f}s "
+              f"auc {auc:.4f}")
+        return
+    if len(sys.argv) > 1 and sys.argv[1] == "decide":
+        # the post-outage decision sweep: partition A/B at default K, then
+        # K scaling, then the pallas backend at a VMEM-sized block
+        for part, k, block, impl in (
+                ("gather", 15, 16384, "xla"),
+                ("select", 15, 16384, "xla"),
+                ("select", 25, 16384, "xla"),
+                ("select", 50, 16384, "xla"),
+                ("select", 25, 65536, "xla"),
+                # pallas: [F*B, block] bf16 one-hot + [F*B, K*S] f32
+                # accumulator must fit ~16MB VMEM -> block <= 512 at K=25
+                ("select", 25, 256, "pallas"),
+                ("select", 25, 512, "pallas"),
+                ("select", 12, 512, "pallas")):
+            try:
+                ms, cs, auc = run_one(X, y, k, block, impl, iters=6,
+                                      partition=part)
+                print(f"part={part:6s} K={k:2d} block={block:6d} "
+                      f"impl={impl:6s}: {ms:6.0f} ms/tree "
+                      f"({1000/ms:5.2f} it/s) compile {cs:5.0f}s "
+                      f"auc {auc:.4f}", flush=True)
+            except Exception as exc:
+                print(f"part={part} K={k} block={block} impl={impl}: "
+                      f"FAILED {type(exc).__name__}: {str(exc)[:150]}",
+                      flush=True)
         return
     for impl in ("xla", "pallas"):
         for k in (16, 25):
