@@ -301,17 +301,59 @@ class Config:
         self.params["tree_learner"] = str(self.params["tree_learner"]).strip().lower()
         self.params["device_type"] = str(self.params["device_type"]).strip().lower()
 
+    _MULTICLASS_OBJECTIVES = ("multiclass", "multiclassova", "softmax",
+                              "multiclass_ova", "ova", "ovr")
+    _MULTICLASS_METRICS = _MULTICLASS_OBJECTIVES + (
+        "multi_logloss", "multi_error", "auc_mu")
+
     def _check_conflicts(self) -> None:
-        # mirrors reference Config::CheckParamConflict (config.h:893)
+        # mirrors reference Config::CheckParamConflict (src/io/config.cpp:248)
         p = self.params
-        if p["is_provide_training_metric"] or p["valid"]:
-            if not p["metric"]:
-                # default metric comes from the objective at Booster build time
-                pass
         learner = p["tree_learner"]
         if learner not in ("serial", "feature", "data", "voting",
                            "feature_parallel", "data_parallel", "voting_parallel"):
             raise ValueError(f"unknown tree_learner {learner!r}")
+
+        # multiclass objective <-> num_class <-> metric consistency
+        obj = str(p["objective"])
+        num_class = int(p["num_class"])
+        # custom objectives count as multiclass when num_class > 1
+        # (reference config.cpp:251)
+        obj_multi = obj in self._MULTICLASS_OBJECTIVES or (
+            obj in ("custom", "none", "null", "na") and num_class > 1)
+        if obj_multi and num_class <= 1:
+            raise ValueError("num_class must be > 1 for multiclass training")
+        if not obj_multi and obj and num_class != 1 \
+                and str(p["task"]).lower() in ("train", "training"):
+            raise ValueError("num_class must be 1 for non-multiclass "
+                             "training")
+        for mt in p["metric"]:
+            norm = str(mt).strip().lower()
+            if norm in ("", "none", "null", "na", "custom"):
+                continue  # disabled/custom metrics match anything
+            mt_multi = norm in self._MULTICLASS_METRICS
+            if obj and (obj_multi != mt_multi):
+                raise ValueError(
+                    f"multiclass objective and metric {mt!r} don't match")
+
+        # max_depth caps num_leaves (config.cpp:303-315)
+        max_depth = int(p["max_depth"])
+        if max_depth > 0:
+            full = 2 ** min(max_depth, 30)
+            if full < int(p["num_leaves"]):
+                p["num_leaves"] = int(full)
+
+        # GOSS re-weights instead of bagging (reference goss.hpp ResetGoss
+        # fatals on bagging_fraction < 1 with goss)
+        if str(p["boosting"]) == "goss" and (
+                float(p["bagging_fraction"]) < 1.0
+                or int(p["bagging_freq"]) > 0):
+            from .utils.log import Log
+
+            Log.warning("bagging is not available with GOSS; disabling "
+                        "bagging_fraction/bagging_freq")
+            p["bagging_fraction"] = 1.0
+            p["bagging_freq"] = 0
 
     # -- string parsing ----------------------------------------------------
     @staticmethod

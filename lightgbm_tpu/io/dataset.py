@@ -228,13 +228,11 @@ class TrainingData:
         # binary fast path (reference CheckCanLoadFromBin,
         # dataset_loader.cpp:1217 + binary token check): <path>.bin skips
         # parsing and re-binning entirely
-        skip_cache = False
-        if bool(config.pre_partition):
-            import jax
+        # per-host cache presence may diverge; every host must walk the
+        # same (collective) bin-finding path or the group hangs
+        from .distributed_binning import config_wants_distributed
 
-            # per-host cache presence may diverge; every host must walk
-            # the same (collective) bin-finding path or the group hangs
-            skip_cache = jax.process_count() > 1
+        skip_cache = config_wants_distributed(config)
         if reference is None and not skip_cache \
                 and os.path.exists(path + ".bin"):
             try:
@@ -435,17 +433,14 @@ class TrainingData:
         NO silent fallback once pre_partition requests distribution: a
         host that skipped the collective while its peers entered it would
         deadlock the group, so errors here must be loud."""
-        use_dist = False
-        if bool(config.pre_partition):
-            import jax
+        from .distributed_binning import (config_wants_distributed,
+                                          find_mappers_multihost)
 
-            use_dist = jax.process_count() > 1
-        if use_dist:
-            from .distributed_binning import find_mappers_multihost
-
-            self.mappers = find_mappers_multihost(X, config, categorical,
-                                                  forced_bins,
-                                                  total_rows=total_rows)
+        if config_wants_distributed(config):
+            self.mappers = find_mappers_multihost(
+                X, config, categorical, forced_bins,
+                local_total_rows=total_rows,
+                feature_names=self.feature_names)
             self.used_feature_idx = [i for i, m in enumerate(self.mappers)
                                      if not m.is_trivial]
             return

@@ -25,6 +25,18 @@ from ..config import Config
 from .bin_mapper import BinMapper
 
 
+def config_wants_distributed(config: Config) -> bool:
+    """Single predicate for every site that must agree on whether this
+    process joins the collective bin-finding path — the cache-skip in
+    from_file and the routing in _find_mappers_maybe_distributed must
+    never diverge, or one host deadlocks the group's allgather."""
+    if not bool(config.pre_partition):
+        return False
+    import jax
+
+    return jax.process_count() > 1
+
+
 def assign_features(num_features: int, num_machines: int) -> List[List[int]]:
     """Contiguous per-machine feature ranges, balanced by count (the
     reference balances by bin count after a first pass; contiguous ranges
@@ -63,15 +75,20 @@ def merge_mapper_payloads(payloads: Sequence[str],
 def local_payload(X_local: np.ndarray, features: Sequence[int],
                   config: Config, categorical: Sequence[int] = (),
                   forced_bins: Optional[Dict[int, List[float]]] = None,
-                  total_rows: Optional[int] = None) -> str:
+                  total_rows: Optional[int] = None,
+                  feature_names: Optional[Sequence[str]] = None) -> str:
     """Find this machine's assigned features' mappers on its local rows.
 
     Per-feature config (ignore_column, max_bin_by_feature, categorical,
-    forced bins) stays keyed by GLOBAL feature id via feature_subset."""
+    forced bins) stays keyed by GLOBAL feature id via feature_subset;
+    feature_names must be the dataset's REAL names so name-based
+    ignore_column specs resolve identically on every host."""
     from .dataset import TrainingData
 
     td = TrainingData()
-    td.feature_names = [f"Column_{i}" for i in range(X_local.shape[1])]
+    td.feature_names = (list(feature_names) if feature_names is not None
+                        else [f"Column_{i}"
+                              for i in range(X_local.shape[1])])
     td._find_mappers(X_local[:, list(features)], config,
                      list(categorical), dict(forced_bins or {}),
                      total_rows=total_rows,
@@ -85,13 +102,15 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
                            categorical: Sequence[int] = (),
                            forced_bins: Optional[Dict[int, List[float]]]
                            = None,
-                           total_rows: Optional[int] = None
+                           local_total_rows: Optional[int] = None,
+                           feature_names: Optional[Sequence[str]] = None
                            ) -> List[BinMapper]:
     """Distributed bin finding across the jax.distributed process group.
 
     Single-process runs degrade to a plain local find over all features.
-    The near-unsplittable filter scales against the GLOBAL row count
-    (allgather-summed when not supplied).
+    local_total_rows is THIS host's full row count when X_local is already
+    a sample (two-round); the near-unsplittable filter always scales
+    against the allgather-summed GLOBAL count.
     """
     import jax
 
@@ -100,17 +119,20 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
     if nproc <= 1:
         payload = local_payload(X_local, list(range(nf)), config,
                                 categorical, forced_bins,
-                                total_rows=total_rows)
+                                total_rows=local_total_rows,
+                                feature_names=feature_names)
         return merge_mapper_payloads([payload], nf)
     from jax.experimental import multihost_utils
 
-    if total_rows is None:
-        total_rows = int(multihost_utils.process_allgather(
-            np.asarray([X_local.shape[0]], np.int64)).sum())
+    local_n = int(local_total_rows if local_total_rows is not None
+                  else X_local.shape[0])
+    global_rows = int(multihost_utils.process_allgather(
+        np.asarray([local_n], np.int64)).sum())
     assignment = assign_features(nf, nproc)
     mine = assignment[jax.process_index()]
     payload = local_payload(X_local, mine, config, categorical, forced_bins,
-                            total_rows=total_rows)
+                            total_rows=global_rows,
+                            feature_names=feature_names)
 
     # fixed-width byte tensor: allgather needs identical shapes per host
     raw = payload.encode()

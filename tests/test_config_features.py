@@ -159,3 +159,50 @@ class TestPredEarlyStop:
         # stopped rows are on the right side with margin already reached
         assert (np.sign(p_es[stopped]) == np.sign(p_full[stopped])).all()
         assert (np.abs(p_es[stopped]) >= 3.0).all()
+
+
+class TestParamConflicts:
+    """Config._check_conflicts mirrors reference CheckParamConflict
+    (src/io/config.cpp:248)."""
+
+    def test_multiclass_needs_num_class(self):
+        import pytest as _pt
+        from lightgbm_tpu.config import Config
+        with _pt.raises(ValueError, match="num_class"):
+            Config({"objective": "multiclass"})
+
+    def test_nonmulticlass_rejects_num_class(self):
+        import pytest as _pt
+        from lightgbm_tpu.config import Config
+        with _pt.raises(ValueError, match="num_class"):
+            Config({"objective": "binary", "num_class": 3})
+
+    def test_metric_objective_mismatch(self):
+        import pytest as _pt
+        from lightgbm_tpu.config import Config
+        with _pt.raises(ValueError, match="don't match"):
+            Config({"objective": "binary", "metric": "multi_logloss"})
+        with _pt.raises(ValueError, match="don't match"):
+            Config({"objective": "multiclass", "num_class": 3,
+                    "metric": "auc"})
+
+    def test_max_depth_caps_num_leaves(self):
+        from lightgbm_tpu.config import Config
+        c = Config({"max_depth": 3, "num_leaves": 100})
+        assert int(c.num_leaves) == 8
+
+    def test_goss_disables_bagging(self):
+        from lightgbm_tpu.config import Config
+        c = Config({"boosting": "goss", "bagging_fraction": 0.5,
+                    "bagging_freq": 1})
+        assert float(c.bagging_fraction) == 1.0
+        assert int(c.bagging_freq) == 0
+
+    def test_disabled_metric_matches_any_objective(self):
+        from lightgbm_tpu.config import Config
+        # "None" disables built-in metrics (custom feval training) and
+        # must not trip the multiclass consistency check
+        c = Config({"objective": "multiclass", "num_class": 3,
+                    "metric": "None"})
+        assert int(c.num_class) == 3
+        Config({"objective": "binary", "metric": "na"})
