@@ -12,36 +12,13 @@ import sys
 def _ensure_backend() -> None:
     # Probe OUT-OF-PROCESS first: a hung tunnel must hit the subprocess
     # timeout, not hang this process (in-process jax.devices() has no
-    # timeout and cannot be interrupted once the plugin blocks).  Skipped
-    # entirely on hosts without the tunneled backend, and cached in an env
-    # var so child/repeat invocations don't re-pay the probe.
-    import os
+    # timeout and cannot be interrupted once the plugin blocks).
+    from .utils.backend import ensure_backend_or_cpu
 
-    from .utils.backend import (backend_health, pin_cpu_backend,
-                                probe_default_backend)
-    from .utils.log import Log
-
-    health = backend_health()
-    if health == "ok":
-        return
-    if health == "probe":
-        cached = os.environ.get("LGBM_BACKEND_PROBE_RESULT")
-        if cached == "ok":
-            return
-        if cached != "failed":
-            timeout_s = float(
-                os.environ.get("LGBM_BACKEND_PROBE_TIMEOUT", 60))
-            platform = probe_default_backend(timeout_s=timeout_s, retries=0)
-            os.environ["LGBM_BACKEND_PROBE_RESULT"] = (
-                "failed" if platform is None else "ok")
-            if platform is not None:
-                return
-    pin_cpu_backend()
+    ensure_backend_or_cpu()
     import jax
 
     jax.devices()  # raises if even CPU is broken
-    Log.warning("accelerator backend unavailable "
-                f"(backend {health}); falling back to CPU")
 
 
 _ensure_backend()

@@ -23,6 +23,13 @@ from typing import Dict, List, Tuple
 
 import numpy as np
 
+# An embedded C consumer may be this process's first jax user: a dead
+# tunneled backend would hang the first LGBM_* call inside backend init,
+# so probe-or-pin BEFORE the engine import (same guard as the CLI).
+from .utils.backend import ensure_backend_or_cpu as _ensure
+
+_ensure()
+
 from .basic import Booster, Dataset
 from .config import Config
 
@@ -350,3 +357,98 @@ def network_init(machines: str, local_listen_port: int, listen_time_out: int,
 def network_free() -> None:
     _network["num_machines"] = 1
     _network["rank"] = 0
+
+
+def booster_reset_parameter(bh: int, params: str) -> None:
+    _get(bh).reset_parameter(_params_dict(params))
+
+
+def booster_merge(bh: int, other_bh: int) -> None:
+    """Append the other booster's trees (reference GBDT::MergeFrom,
+    gbdt.h:60)."""
+    other = _get(other_bh)
+    _get(bh)._driver.merge_from_model_string(other.model_to_string())
+
+
+def booster_shuffle_models(bh: int, start: int, end: int) -> None:
+    _get(bh).shuffle_models(start, end)
+
+
+def booster_get_leaf_value(bh: int, tree_idx: int, leaf_idx: int) -> float:
+    drv = _get(bh)._driver
+    drv._materialize()  # trees are built lazily from device records
+    return float(drv.models[tree_idx].leaf_value[leaf_idx])
+
+
+def booster_set_leaf_value(bh: int, tree_idx: int, leaf_idx: int,
+                           val: float) -> None:
+    drv = _get(bh)._driver
+    drv._materialize()
+    drv.models[tree_idx].set_leaf_value(leaf_idx, float(val))
+
+
+def booster_predict_for_file(bh: int, data_filename: str, has_header: int,
+                             predict_type: int, num_iteration: int,
+                             params: str, result_filename: str) -> None:
+    """Reference LGBM_BoosterPredictForFile (c_api.h:644): parse, predict,
+    write the text result file like the CLI predictor."""
+    from .config import Config
+    from .io.parser import load_text_file
+
+    bst = _get(bh)
+    p = _params_dict(params)
+    ni = num_iteration if num_iteration > 0 else None
+    kw = {}
+    if predict_type == PREDICT_RAW_SCORE:
+        kw["raw_score"] = True
+    elif predict_type == PREDICT_LEAF_INDEX:
+        kw["pred_leaf"] = True
+    elif predict_type == PREDICT_CONTRIB:
+        kw["pred_contrib"] = True
+    pcfg = Config({**bst.params, **p})
+    for key in ("pred_early_stop", "pred_early_stop_freq",
+                "pred_early_stop_margin"):
+        kw[key] = getattr(pcfg, key)
+    X = load_text_file(data_filename,
+                       label_column=str(pcfg.label_column or ""),
+                       header=bool(has_header) or None)[0]
+    out = np.asarray(bst.predict(X, num_iteration=ni, **kw))
+    with open(result_filename, "w") as f:
+        if out.ndim == 1:
+            for v in out:
+                f.write(f"{v:g}\n")
+        else:
+            for row in out:
+                f.write("\t".join(f"{v:g}" for v in row) + "\n")
+
+
+def dataset_set_feature_names(dh: int, names: str) -> None:
+    ds = _get(dh)
+    parts = names.split("\t") if names else []
+    nf = ds._inner.num_total_features if ds._inner is not None else None
+    if nf is not None and len(parts) != nf:
+        raise ValueError(
+            f"{len(parts)} feature names for {nf} features")
+    ds.feature_name = parts
+    if ds._inner is not None:
+        ds._inner.feature_names = list(parts)
+
+
+def dataset_get_feature_names(dh: int) -> str:
+    ds = _get(dh)
+    if ds._inner is not None:
+        return "\t".join(str(n) for n in ds._inner.feature_names)
+    fn = ds.feature_name
+    return "\t".join(fn) if isinstance(fn, (list, tuple)) else ""
+
+
+def dataset_get_subset(dh: int, idx_ptr: int, n_idx: int,
+                       params: str) -> int:
+    """Row subset sharing the parent's mappers (reference
+    Dataset::CopySubset via LGBM_DatasetGetSubset, c_api.h:286)."""
+    ds = _get(dh)
+    idx = np.ctypeslib.as_array(
+        ctypes.cast(idx_ptr, ctypes.POINTER(ctypes.c_int32)),
+        shape=(n_idx,)).copy()
+    sub = ds.subset(idx, params=_params_dict(params) or None)
+    return _put(sub)

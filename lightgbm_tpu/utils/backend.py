@@ -98,6 +98,35 @@ def probe_default_backend(timeout_s: float = 120.0, retries: int = 1,
     return None
 
 
+def ensure_backend_or_cpu(probe_timeout_env: str = "LGBM_BACKEND_PROBE_TIMEOUT",
+                          default_timeout_s: float = 60.0) -> None:
+    """Probe the default backend out-of-process; pin CPU when it is
+    dead or hung.  Shared by entry points that may be the FIRST jax
+    consumer in a process (CLI __main__, embedded C API): without this a
+    dead tunnel hangs the process inside backend init.  Probe results are
+    cached in the environment so child processes skip re-probing."""
+    health = backend_health()
+    if health == "ok":
+        return
+    if health == "probe":
+        cached = os.environ.get("LGBM_BACKEND_PROBE_RESULT")
+        if cached == "ok":
+            return
+        if cached != "failed":
+            timeout_s = float(os.environ.get(probe_timeout_env,
+                                             default_timeout_s))
+            platform = probe_default_backend(timeout_s=timeout_s, retries=0)
+            os.environ["LGBM_BACKEND_PROBE_RESULT"] = (
+                "failed" if platform is None else "ok")
+            if platform is not None:
+                return
+    pin_cpu_backend()
+    from .log import Log
+
+    Log.warning(f"accelerator backend unavailable (backend {health}); "
+                "falling back to CPU")
+
+
 def host_sync(x):
     """Barrier on device compute via a host fetch.
 

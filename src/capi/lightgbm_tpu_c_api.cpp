@@ -154,6 +154,17 @@ long long as_int(PyObject* o, bool* ok) {
 }
 
 // unpack an (a, b) int tuple
+double as_double(PyObject* o, bool* ok) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  double v = PyFloat_AsDouble(o);
+  *ok = !PyErr_Occurred();
+  if (!*ok) {
+    set_error(py_error_string());
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  return v;
+}
 bool as_int2(PyObject* o, long long* a, long long* b) {
   PyGILState_STATE st = PyGILState_Ensure();
   bool ok = false;
@@ -664,4 +675,148 @@ LGBM_EXPORT int LGBM_NetworkInitWithFunctions(int num_machines, int rank,
       "incompatible with compiled XLA collectives; configure a device mesh "
       "(num_machines/machines) instead");
   return -1;
+}
+
+// ---- round-3 API breadth: booster mutation / file predict / dataset
+// subset & names (reference c_api.h:286-470,644-720,905-960) ----
+
+LGBM_EXPORT int LGBM_BoosterResetParameter(BoosterHandle handle,
+                                           const char* parameters) {
+  PyObject* r = call_support("booster_reset_parameter", "(Ls)",
+                             from_handle(handle), parameters);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterMerge(BoosterHandle handle,
+                                  BoosterHandle other_handle) {
+  PyObject* r = call_support("booster_merge", "(LL)", from_handle(handle),
+                             from_handle(other_handle));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterShuffleModels(BoosterHandle handle,
+                                          int start_iter, int end_iter) {
+  PyObject* r = call_support("booster_shuffle_models", "(Lii)",
+                             from_handle(handle), start_iter, end_iter);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                                         int leaf_idx, double* out_val) {
+  PyObject* r = call_support("booster_get_leaf_value", "(Lii)",
+                             from_handle(handle), tree_idx, leaf_idx);
+  if (!r) return -1;
+  bool ok;
+  double v = as_double(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_val = v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                                         int leaf_idx, double val) {
+  PyObject* r = call_support("booster_set_leaf_value", "(Liid)",
+                             from_handle(handle), tree_idx, leaf_idx, val);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                                           const char* data_filename,
+                                           int data_has_header,
+                                           int predict_type,
+                                           int num_iteration,
+                                           const char* parameter,
+                                           const char* result_filename) {
+  PyObject* r = call_support("booster_predict_for_file", "(Lsiiiss)",
+                             from_handle(handle), data_filename,
+                             data_has_header, predict_type, num_iteration,
+                             parameter, result_filename);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                            const char** feature_names,
+                                            int num_feature_names) {
+  std::string joined;
+  for (int i = 0; i < num_feature_names; ++i) {
+    if (i) joined += "\t";
+    joined += feature_names[i];
+  }
+  PyObject* r = call_support("dataset_set_feature_names", "(Ls)",
+                             from_handle(handle), joined.c_str());
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                            char** feature_names,
+                                            int* num_feature_names) {
+  PyObject* r = call_support("dataset_get_feature_names", "(L)",
+                             from_handle(handle));
+  if (!r) return -1;
+  // the string view borrows from r: copy + split under the GIL, then drop
+  PyGILState_STATE st = PyGILState_Ensure();
+  const char* joined = PyUnicode_AsUTF8(r);
+  std::string copy = joined ? joined : "";
+  bool ok = joined != nullptr;
+  if (!ok) {
+    set_error(py_error_string());
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  drop(r);
+  if (!ok) return -1;
+  if (copy.empty()) {  // no names known: report zero, write nothing
+    *num_feature_names = 0;
+    return 0;
+  }
+  // split on tabs into the caller's preallocated buffers.  Contract is
+  // reference-v2.3.2-identical (c_api.h:303): the CALLER must provide at
+  // least num-features pointers, each wide enough for its name — the ABI
+  // carries no capacity information to check against.
+  int count = 0;
+  const char* start = copy.c_str();
+  while (true) {
+    const char* tab = std::strchr(start, '\t');
+    size_t len = tab ? static_cast<size_t>(tab - start) : std::strlen(start);
+    if (feature_names && feature_names[count]) {
+      std::memcpy(feature_names[count], start, len);
+      feature_names[count][len] = '\0';
+    }
+    ++count;
+    if (!tab) break;
+    start = tab + 1;
+  }
+  *num_feature_names = count;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetGetSubset(DatasetHandle handle,
+                                      const int32_t* used_row_indices,
+                                      int32_t num_used_row_indices,
+                                      const char* parameters,
+                                      DatasetHandle* out) {
+  PyObject* r = call_support("dataset_get_subset", "(LLis)",
+                             from_handle(handle),
+                             reinterpret_cast<long long>(used_row_indices),
+                             num_used_row_indices, parameters);
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
 }

@@ -238,3 +238,98 @@ class TestCAPIDatasetBinary:
         td = TrainingData.from_binary(out.decode())
         assert td.num_data == X.shape[0]
         _check(lib, lib.LGBM_DatasetFree(h))
+
+
+class TestCAPIBreadth:
+    """Round-3 additions: booster mutation, file predict, dataset subset
+    and feature names (reference c_api.h:286-470,644-720,905-960)."""
+
+    def _make_booster(self, lib, data, rounds=5):
+        X, y = data
+        dh = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(X.shape[0]), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(1), b"max_bin=32", None, ctypes.byref(dh)))
+        _check(lib, lib.LGBM_DatasetSetField(
+            dh, b"label", y.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(len(y)), C_API_DTYPE_FLOAT32))
+        bh = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            dh, b"objective=binary num_leaves=7 min_data_in_leaf=5",
+            ctypes.byref(bh)))
+        fin = ctypes.c_int32()
+        for _ in range(rounds):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)))
+        return dh, bh
+
+    def test_leaf_value_get_set(self, lib, data):
+        _, bh = self._make_booster(lib, data)
+        val = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(bh, 0, 0,
+                                                 ctypes.byref(val)))
+        _check(lib, lib.LGBM_BoosterSetLeafValue(bh, 0, 0,
+                                                 ctypes.c_double(1.25)))
+        val2 = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(bh, 0, 0,
+                                                 ctypes.byref(val2)))
+        assert val2.value == 1.25 and val2.value != val.value
+
+    def test_merge_and_shuffle(self, lib, data):
+        _, bh1 = self._make_booster(lib, data, rounds=3)
+        _, bh2 = self._make_booster(lib, data, rounds=2)
+        n1, n2 = ctypes.c_int32(), ctypes.c_int32()
+        _check(lib, lib.LGBM_BoosterNumberOfTotalModel(
+            bh1, ctypes.byref(n1)))
+        _check(lib, lib.LGBM_BoosterNumberOfTotalModel(
+            bh2, ctypes.byref(n2)))
+        _check(lib, lib.LGBM_BoosterMerge(bh1, bh2))
+        n3 = ctypes.c_int32()
+        _check(lib, lib.LGBM_BoosterNumberOfTotalModel(
+            bh1, ctypes.byref(n3)))
+        assert n3.value == n1.value + n2.value
+        _check(lib, lib.LGBM_BoosterShuffleModels(bh1, 0, -1))
+
+    def test_reset_parameter(self, lib, data):
+        _, bh = self._make_booster(lib, data)
+        _check(lib, lib.LGBM_BoosterResetParameter(
+            bh, b"learning_rate=0.05"))
+
+    def test_predict_for_file(self, lib, data, tmp_path):
+        X, y = data
+        _, bh = self._make_booster(lib, data)
+        src = tmp_path / "pred_in.tsv"
+        np.savetxt(src, np.column_stack([y, X]), delimiter="\t")
+        out = tmp_path / "pred_out.txt"
+        _check(lib, lib.LGBM_BoosterPredictForFile(
+            bh, str(src).encode(), 0, C_API_PREDICT_NORMAL, -1, b"",
+            str(out).encode()))
+        got = np.loadtxt(out)
+        assert got.shape == (len(y),)
+        assert 0.0 <= got.min() and got.max() <= 1.0
+
+    def test_feature_names_roundtrip(self, lib, data):
+        dh, _ = self._make_booster(lib, data)
+        names = [b"alpha", b"beta", b"gamma", b"delta", b"eps", b"zeta"]
+        arr = (ctypes.c_char_p * len(names))(*names)
+        _check(lib, lib.LGBM_DatasetSetFeatureNames(
+            dh, arr, ctypes.c_int32(len(names))))
+        bufs = [ctypes.create_string_buffer(64) for _ in names]
+        ptrs = (ctypes.c_char_p * len(names))(
+            *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+        cnt = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetFeatureNames(
+            dh, ptrs, ctypes.byref(cnt)))
+        assert cnt.value == len(names)
+        assert [b.value for b in bufs] == names
+
+    def test_dataset_subset(self, lib, data):
+        dh, _ = self._make_booster(lib, data)
+        idx = np.arange(0, 600, 2, dtype=np.int32)
+        sub = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetGetSubset(
+            dh, idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(len(idx)), b"", ctypes.byref(sub)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(sub, ctypes.byref(n)))
+        assert n.value == len(idx)
