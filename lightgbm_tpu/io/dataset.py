@@ -211,11 +211,15 @@ class TrainingData:
                 X, config, categorical_features or [], forced_bins or {})
 
         # bin all used columns
-        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
-        bins = np.empty((n, self.num_features), dtype=dtype)
-        for j, col in enumerate(self.used_feature_idx):
-            bins[:, j] = self.mappers[col].values_to_bins(X[:, col]).astype(dtype)
-        self.bins = bins
+        from ..utils import timer
+
+        with timer.PHASE("binning"):
+            dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+            bins = np.empty((n, self.num_features), dtype=dtype)
+            for j, col in enumerate(self.used_feature_idx):
+                bins[:, j] = self.mappers[col].values_to_bins(
+                    X[:, col]).astype(dtype)
+            self.bins = bins
 
         self.metadata = Metadata(n, label, weight, group_sizes, init_score)
         self._set_constraints(config)

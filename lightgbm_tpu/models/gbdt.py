@@ -280,6 +280,8 @@ class GBDT:
         if self._stopped:
             return True
         if (grad is None or hess is None) and self._train_step is not None:
+            ctx = timer.PHASE("train_dispatch")
+            ctx.__enter__()
             bag = self._bag_cfg
             extra = {}
             if self._goss_cfg is not None:
@@ -296,6 +298,7 @@ class GBDT:
                 self.train_scores.scores = scores
                 self._pending.append((records, k, inits[k]))
             self.iter_ += 1
+            ctx.__exit__(None, None, None)
             return False
         return self._train_one_iter_sync(grad, hess)
 
