@@ -86,7 +86,12 @@ def greedy_find_bin(distinct_values: Sequence[float], counts: Sequence[int],
             is_big[i] = True
             rest_bin_cnt -= 1
             rest_sample_cnt -= counts[i]
-    mean_bin_size = rest_sample_cnt / rest_bin_cnt
+    # C++ float semantics: x/0 is inf (every distinct value "big" leaves
+    # rest_bin_cnt == 0, reference bin.cpp:116 tolerates it); Python's /
+    # would raise instead
+    with np.errstate(divide="ignore", invalid="ignore"):
+        mean_bin_size = float(np.float64(rest_sample_cnt)
+                              / np.float64(rest_bin_cnt))
 
     uppers = [_F32_INF] * max_bin
     lowers = [_F32_INF] * max_bin
@@ -109,7 +114,9 @@ def greedy_find_bin(distinct_values: Sequence[float], counts: Sequence[int],
             cur_cnt_inbin = 0
             if not is_big[i]:
                 rest_bin_cnt -= 1
-                mean_bin_size = rest_sample_cnt / rest_bin_cnt
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    mean_bin_size = float(np.float64(rest_sample_cnt)
+                                          / np.float64(rest_bin_cnt))
     bin_cnt += 1
     for i in range(bin_cnt - 1):
         val = _upper_bound((uppers[i] + lowers[i + 1]) / 2.0)
@@ -277,31 +284,37 @@ class BinMapper:
         self.default_bin = 0
         zero_cnt = int(total_sample_cnt - values.size - na_cnt)
 
-        # distinct values with zero spliced in at its sorted position
+        # distinct values with zero spliced in at its sorted position.
+        # Vectorized equal-ordered grouping (the scalar loop was the
+        # binning hot spot at ~10s/1M rows): consecutive values with
+        # next <= nextafter(prev, inf) merge, keeping the LARGER value —
+        # i.e. each group's last element — exactly like the sequential
+        # merge (reference bin.cpp:332-352 semantics).
         values = np.sort(values, kind="stable")
         distinct_values: List[float] = []
         counts: List[int] = []
-        if values.size == 0 or (values[0] > 0.0 and zero_cnt > 0):
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
         if values.size:
-            distinct_values.append(float(values[0]))
-            counts.append(1)
-        for i in range(1, values.size):
-            prev, cur = values[i - 1], values[i]
-            if not _equal_ordered(prev, cur):
-                if prev < 0.0 and cur > 0.0:
-                    distinct_values.append(0.0)
-                    counts.append(zero_cnt)
-                distinct_values.append(float(cur))
-                counts.append(1)
-            else:
-                # treat as equal; keep the larger value
-                distinct_values[-1] = float(cur)
-                counts[-1] += 1
-        if values.size and values[-1] < 0.0 and zero_cnt > 0:
-            distinct_values.append(0.0)
-            counts.append(zero_cnt)
+            new_group = values[1:] > np.nextafter(values[:-1], np.inf)
+            last_idx = np.flatnonzero(np.append(new_group, True))
+            dv = values[last_idx]
+            cn = np.diff(np.concatenate([[-1], last_idx]))
+            # splice zero (its count is implied, never sampled) at its
+            # ordered position; sampled values are never exactly 0.0 (the
+            # caller filtered |v| <= kZeroThreshold), so the insertion
+            # point is unambiguous.  An INTERIOR zero (negatives and
+            # positives both present) is inserted even at count 0 — the
+            # scalar loop and reference bin.cpp:341-344 do, and the extra
+            # zero-count entry changes categorical bin assembly
+            if dv.size:
+                pos = int(np.searchsorted(dv, 0.0))
+                if zero_cnt > 0 or 0 < pos < len(dv):
+                    dv = np.insert(dv, pos, 0.0)
+                    cn = np.insert(cn, pos, zero_cnt)
+            distinct_values = dv.tolist()
+            counts = cn.tolist()
+        else:
+            distinct_values = [0.0]
+            counts = [zero_cnt]
 
         self.min_val = distinct_values[0] if distinct_values else 0.0
         self.max_val = distinct_values[-1] if distinct_values else 0.0
