@@ -183,7 +183,7 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # ties); keeps batched split order close to strict best-first
     "tpu_split_batch_alpha": ("float", 0.0, ()),
     # row-partition lowering: select | gather (ops/grower.py GrowerParams.
-    # partition_impl; feature-parallel always uses gather)
+    # partition_impl; honored by every tree learner)
     "tpu_partition_impl": ("str", "select", ()),
 }
 

@@ -44,12 +44,13 @@ modes, mirroring the reference's parallel tree learners (SURVEY.md §2.3):
   Network::ReduceScatter + HistogramBinEntry::SumReducer disappear into
   the compiler.
 * `feature_axis` (FeatureParallelTreeLearner, feature_parallel_tree_
-  learner.cpp:23-75): rows replicated, features sharded; each shard
-  histograms + searches only its own features, then the global best split
-  is an all_gather of per-shard best gains + argmax (replacing
+  learner.cpp:23-75): BINS REPLICATED (like the reference's all-data-on-
+  all-machines feature mode), search sharded; each shard histograms +
+  searches only its own feature slice, then the global best split is an
+  all_gather of per-shard best gains + argmax (replacing
   SyncUpGlobalBestSplit's allreduce-by-max, parallel_tree_learner.h:
-  190-213).  The winning features' bin columns are broadcast with a
-  one-shard psum so every shard partitions identically.
+  190-213).  Every shard partitions identically from its full local
+  matrix — no per-split column movement at all.
 * `data_axis` + `voting_k` (VotingParallelTreeLearner, voting_parallel_
   tree_learner.cpp:170-471 / PV-Tree): rows sharded, but only the top-k
   VOTED features' histograms are aggregated per leaf.  Each shard proposes
@@ -295,9 +296,14 @@ def make_grower(params: GrowerParams, num_features: int,
                 return jax.lax.dynamic_slice_in_dim(a, ax * F, F)
 
             meta_local = {k: fslice(v) for k, v in meta.items()}
+            # bins arrive REPLICATED [F_global, n] (the reference's
+            # all-data-on-all-machines feature mode): histogram only this
+            # shard's feature slice; the partition reads the full matrix
+            bins_hist_t = fslice(bins_t)
         else:
             ax = None
             meta_local = meta
+            bins_hist_t = bins_t
 
         FG = feature_mask.shape[0]  # global feature width
 
@@ -443,7 +449,7 @@ def make_grower(params: GrowerParams, num_features: int,
         # per-tree packed stats, reused by every round's contraction
         stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
         S = stats.shape[0]
-        bins_blocks = jnp.moveaxis(bins_t.reshape(G, nb, block), 1, 0)
+        bins_blocks = jnp.moveaxis(bins_hist_t.reshape(G, nb, block), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
         root_hist = preduce_hist(
             build_histogram_t(bins_blocks, stats_blocks, B, precision))
@@ -548,8 +554,11 @@ def make_grower(params: GrowerParams, num_features: int,
             rg, rh, rc = pg - lg, ph - lh, pc - lc
 
             # ---- partition all K splits at once (reference dense_bin.hpp
-            # Split / SplitCategorical semantics) ----
-            if not feature_axis and params.partition_impl == "select":
+            # Split / SplitCategorical semantics).  With feature sharding
+            # the bins are replicated, so sel_feat's GLOBAL ids index
+            # bins_t/meta directly in both lowerings — no column
+            # broadcast ----
+            if params.partition_impl == "select":
                 # K unrolled scalar-broadcast passes: each split reads ONE
                 # bin row (dynamic slice) and updates its own rows with
                 # elementwise compares.  No per-row table gathers — XLA's
@@ -583,39 +592,24 @@ def make_grower(params: GrowerParams, num_features: int,
                 leaf_ids = new_leaf
             else:
                 # single-pass gather form: row->slot via an [L]-table
-                # lookup, then [K]-table lookups per row.  Kept for the
-                # feature-parallel learner, where resolving bins locally +
-                # ONE psum-broadcast [n] column beats K per-slot psums.
+                # lookup, then [K]-table lookups per row
                 leaf_to_slot = jnp.full(L, -1, jnp.int32).at[
                     jnp.where(do_k, sel, L)].set(kar, mode="drop")
                 k_of_r = leaf_to_slot[leaf_ids]                  # [n]
                 valid_r = k_of_r >= 0
                 kk_r = jnp.maximum(k_of_r, 0)
-                if feature_axis:
-                    # feature shards own disjoint columns: resolve each
-                    # row's winning-feature bin locally, zero rows owned
-                    # elsewhere, and psum-broadcast ONE [n] column (not
-                    # [n, K]) so every shard partitions identically
-                    shard_k = sel_feat // F
-                    lf_k = jnp.mod(sel_feat, F)
-                    own_r = shard_k[kk_r] == ax
-                    col_l = jnp.take_along_axis(
-                        bins_t, lf_k[kk_r][None, :], axis=0)[0]
-                    col_r = jax.lax.psum(
-                        jnp.where(own_r, col_l, 0), feature_axis)
+                f_r = sel_feat[kk_r]
+                if params.has_bundles:
+                    g_r = meta["bundle_idx"][f_r]
+                    c_r = jnp.take_along_axis(bins_t, g_r[None, :],
+                                              axis=0)[0]
+                    col_r = fix_bundle_col(
+                        c_r, meta["bin_offset"][f_r],
+                        meta["num_bin"][f_r],
+                        meta["needs_fix"][f_r] > 0)
                 else:
-                    f_r = sel_feat[kk_r]
-                    if params.has_bundles:
-                        g_r = meta["bundle_idx"][f_r]
-                        c_r = jnp.take_along_axis(bins_t, g_r[None, :],
-                                                  axis=0)[0]
-                        col_r = fix_bundle_col(
-                            c_r, meta["bin_offset"][f_r],
-                            meta["num_bin"][f_r],
-                            meta["needs_fix"][f_r] > 0)
-                    else:
-                        col_r = jnp.take_along_axis(
-                            bins_t, f_r[None, :], axis=0)[0]
+                    col_r = jnp.take_along_axis(
+                        bins_t, f_r[None, :], axis=0)[0]
                 nb_k = meta["num_bin"][sel_feat]
                 db_k = meta["default_bin"][sel_feat]
                 go_left = numeric_go_left(

@@ -85,9 +85,15 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
                 f"of the feature-shard count {nshards}")
         f_local = num_features // nshards
         grow = make_grower(params, f_local, feature_axis="feature", jit=False)
+        # bins REPLICATED (P()), like the reference feature-parallel mode
+        # where every machine holds all data (feature_parallel_tree_
+        # learner.cpp:55-71): each shard histograms only its own feature
+        # slice but partitions rows from the full local matrix, so no
+        # per-split column broadcast is needed — the only collective left
+        # is the all_gather of per-shard best gains
         fn = shard_map(
             grow, mesh=mesh,
-            in_specs=(P("feature", None), P(), P(), P(), P(), meta_spec, P()),
+            in_specs=(P(), P(), P(), P(), P(), meta_spec, P()),
             out_specs={"records": P(), "leaf_ids": P(),
                        "leaf_output": P(), "leaf_cnt": P(),
                        "leaf_sum_h": P()},
@@ -101,7 +107,9 @@ def bins_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
     if strategy in ("data", "voting"):
         return NamedSharding(mesh, P(None, "data"))
     if strategy == "feature":
-        return NamedSharding(mesh, P("feature", None))
+        # replicated: every shard partitions rows from the full matrix
+        # (the reference's all-data-on-all-machines feature mode)
+        return NamedSharding(mesh, P())
     raise ValueError(strategy)
 
 
