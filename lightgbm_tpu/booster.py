@@ -75,8 +75,8 @@ class Booster:
         self._driver.rollback_one_iter()
         return self
 
-    @property
     def current_iteration(self) -> int:
+        # a METHOD, not a property — reference basic.py Booster API
         return self._driver.current_iteration()
 
     def num_trees(self) -> int:

@@ -193,12 +193,12 @@ def booster_create(train_handle: int, params: str) -> int:
 
 def booster_create_from_modelfile(filename: str) -> Tuple[int, int]:
     bst = Booster(model_file=filename)
-    return _put(bst), int(bst.current_iteration)
+    return _put(bst), int(bst.current_iteration())
 
 
 def booster_load_from_string(model_str: str) -> Tuple[int, int]:
     bst = Booster(model_str=model_str)
-    return _put(bst), int(bst.current_iteration)
+    return _put(bst), int(bst.current_iteration())
 
 
 def booster_add_valid(bh: int, dh: int) -> None:
@@ -230,7 +230,7 @@ def booster_rollback(bh: int) -> None:
 
 
 def booster_current_iteration(bh: int) -> int:
-    return int(_get(bh).current_iteration)
+    return int(_get(bh).current_iteration())
 
 
 def booster_num_total_model(bh: int) -> int:

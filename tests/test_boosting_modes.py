@@ -177,10 +177,10 @@ class TestRollbackAndSnapshots:
                           train_set=ds)
         for _ in range(5):
             bst.update()
-        assert bst.current_iteration == 5
+        assert bst.current_iteration() == 5
         scores_before = bst._driver.train_scores.numpy().copy()
         bst.update()
         bst.rollback_one_iter()
-        assert bst.current_iteration == 5
+        assert bst.current_iteration() == 5
         np.testing.assert_allclose(bst._driver.train_scores.numpy(),
                                    scores_before, atol=1e-5)
