@@ -97,6 +97,42 @@ class Dataset:
         self._inner.save_binary(filename)
         return self
 
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        """Reference basic.py:1279: must be called before construction —
+        bin types are fixed at bin-finding time."""
+        if self._inner is not None \
+                and list(categorical_feature) != list(
+                    self.categorical_feature or []):
+            raise RuntimeError(
+                "cannot change categorical_feature after the dataset is "
+                "constructed")
+        self.categorical_feature = categorical_feature
+        return self
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Reference basic.py:1327: align this (unconstructed) dataset's
+        bins with `reference`'s mappers."""
+        if self._inner is not None and self.reference is not reference:
+            raise RuntimeError(
+                "cannot change reference after the dataset is constructed")
+        self.reference = reference
+        return self
+
+    def set_feature_name(self, feature_name) -> "Dataset":
+        """Reference basic.py:1353."""
+        if feature_name == "auto":
+            self.feature_name = feature_name
+            return self
+        names = list(feature_name)  # materialize ONCE (generators)
+        if self._inner is not None:
+            if len(names) != self._inner.num_total_features:
+                raise ValueError(
+                    f"{len(names)} names for "
+                    f"{self._inner.num_total_features} features")
+            self._inner.feature_names = list(names)
+        self.feature_name = names
+        return self
+
     def set_field(self, name: str, data) -> "Dataset":
         self.construct()
         self._inner.metadata.set_field(name, data)

@@ -206,6 +206,26 @@ class Booster:
         self._train_data_name = name
         return self
 
+    def free_dataset(self) -> "Booster":
+        """Drop the training/validation data (reference basic.py:1808):
+        the trained model stays usable for predict/save/dump, but further
+        update()/eval calls need data and will fail — same contract as the
+        reference's freed booster."""
+        drv = self._driver
+        drv._materialize()
+        # snapshot the model-header fields that are derived from the
+        # training data at save time (the oracle rejects a model file
+        # without feature_infos)
+        drv.loaded_params["feature_infos"] = drv._feature_infos()
+        self._train_set = None
+        drv.train_data = None
+        drv.learner = None
+        drv.train_scores = None
+        drv.valid_sets = []
+        drv.valid_scores = []
+        drv._train_step = None
+        return self
+
     def shuffle_models(self, start_iteration: int = 0, end_iteration: int = -1):
         self._driver.shuffle_models(start_iteration, end_iteration)
         return self

@@ -18,12 +18,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
           valid_names: Optional[List[str]] = None,
           fobj: Optional[Callable] = None, feval: Optional[Callable] = None,
           init_model: Optional[Union[str, Booster]] = None,
+          feature_name: Union[str, List[str]] = "auto",
+          categorical_feature: Union[str, List] = "auto",
+          learning_rates=None,
           keep_training_booster: bool = False,
           callbacks: Optional[List[Callable]] = None,
           early_stopping_rounds: Optional[int] = None,
           verbose_eval: Union[bool, int] = True,
           evals_result: Optional[Dict] = None) -> Booster:
     params = copy.deepcopy(params)
+    if feature_name != "auto":
+        train_set.set_feature_name(feature_name)
+    if categorical_feature != "auto":
+        train_set.set_categorical_feature(categorical_feature)
     if fobj is not None:
         params["objective"] = "none"
     for alias in ("num_boost_round", "num_iterations", "num_iteration", "n_iter",
@@ -65,6 +72,16 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.add_valid(vs, name)
 
     callbacks = list(callbacks) if callbacks else []
+    if learning_rates is not None:
+        # per-iteration learning-rate schedule (reference engine.py:
+        # learning_rates -> callback.reset_parameter)
+        if not isinstance(learning_rates, list) \
+                and not callable(learning_rates):
+            raise ValueError(
+                "learning_rates must be a list or a callable")
+        from .callback import reset_parameter
+
+        callbacks.append(reset_parameter(learning_rate=learning_rates))
     if early_stopping_rounds is not None and early_stopping_rounds > 0:
         callbacks.append(early_stopping(early_stopping_rounds, first_metric_only,
                                         verbose=bool(verbose_eval)))
@@ -116,6 +133,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
     if booster.best_iteration < 0:
         booster.best_iteration = -1
+    if not keep_training_booster:
+        # reference engine.py: the returned booster becomes predict-only
+        # (training data freed); pass keep_training_booster=True to keep
+        # updating it
+        booster.free_dataset()
     return booster
 
 

@@ -127,3 +127,64 @@ class TestSklearnProtocol:
             assert m2.get_params()["num_leaves"] == 63
         except Exception:
             pytest.skip("sklearn clone needs full estimator protocol")
+
+
+class TestDatasetSetterParity:
+    """Reference basic.py Dataset setter surface (set_categorical_feature /
+    set_reference / set_feature_name) and Booster.free_dataset."""
+
+    def test_setters_before_construct(self):
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(0)
+        Xc = rng.integers(0, 5, size=500).astype(np.float64)
+        X = np.column_stack([Xc, rng.normal(size=500)])
+        y = (Xc % 2).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
+        ds.set_categorical_feature([0])
+        ds.set_feature_name(["cat", "num"])
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "min_data_in_leaf": 5}, ds,
+                        num_boost_round=3, verbose_eval=False)
+        assert bst.feature_name() == ["cat", "num"]
+        # the categorical split must materialize as a bitset decision
+        # ("==" decision_type) somewhere in the dumped forest
+        import json as _json
+        d = _json.dumps(bst.dump_model())
+        assert '"decision_type": "=="' in d
+
+    def test_set_reference_aligns_bins(self):
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(400, 3))
+        y = (X[:, 0] > 0).astype(np.float64)
+        train = lgb.Dataset(X, label=y)
+        train.construct()
+        valid = lgb.Dataset(X[:100], label=y[:100])
+        valid.set_reference(train)
+        valid.construct()
+        assert valid._inner.mappers is train._inner.mappers
+
+    def test_setters_after_construct_raise(self):
+        import lightgbm_tpu as lgb
+        import pytest as _pt
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(300, 2))
+        ds = lgb.Dataset(X, label=(X[:, 0] > 0).astype(float))
+        ds.construct()
+        with _pt.raises(RuntimeError):
+            ds.set_categorical_feature([1])
+        other = lgb.Dataset(X, label=(X[:, 0] > 0).astype(float))
+        with _pt.raises(RuntimeError):
+            ds.set_reference(other)
+
+    def test_free_dataset_keeps_model_usable(self):
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(400, 3))
+        y = (X[:, 0] > 0).astype(np.float64)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7}, ds,
+                        num_boost_round=3, verbose_eval=False)
+        bst.free_dataset()
+        p = bst.predict(X)
+        assert p.shape == (400,)
