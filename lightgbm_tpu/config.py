@@ -344,16 +344,11 @@ class Config:
                 p["num_leaves"] = int(full)
 
         # GOSS re-weights instead of bagging (reference goss.hpp ResetGoss
-        # fatals on bagging_fraction < 1 with goss)
+        # raises Log::Fatal on bagging with goss)
         if str(p["boosting"]) == "goss" and (
                 float(p["bagging_fraction"]) < 1.0
                 or int(p["bagging_freq"]) > 0):
-            from .utils.log import Log
-
-            Log.warning("bagging is not available with GOSS; disabling "
-                        "bagging_fraction/bagging_freq")
-            p["bagging_fraction"] = 1.0
-            p["bagging_freq"] = 0
+            raise ValueError("cannot use bagging in GOSS")
 
     # -- string parsing ----------------------------------------------------
     @staticmethod

@@ -90,7 +90,8 @@ class TestDART:
         bst = lgb.train({"boosting": "dart", "objective": "binary",
                          "num_leaves": 7, "learning_rate": 0.3,
                          "drop_rate": 0.6, "skip_drop": 0.0},
-                        ds, num_boost_round=8, verbose_eval=False)
+                        ds, num_boost_round=8, verbose_eval=False,
+                        keep_training_booster=True)
         drv = bst._driver
         drv._materialize()
         maintained = drv.train_scores.numpy()[0]
@@ -112,7 +113,8 @@ class TestDART:
                          "num_leaves": 7, "xgboost_dart_mode": True,
                          "drop_rate": 0.5, "skip_drop": 0.0},
                         lgb.Dataset(X, label=y), num_boost_round=8,
-                        verbose_eval=False)
+                        verbose_eval=False,
+                        keep_training_booster=True)
         drv = bst._driver
         drv._materialize()
         np.testing.assert_allclose(drv.train_scores.numpy()[0],
@@ -162,7 +164,8 @@ class TestRF:
                          "num_leaves": 15, "bagging_freq": 1,
                          "bagging_fraction": 0.6},
                         lgb.Dataset(X, label=y), num_boost_round=6,
-                        verbose_eval=False)
+                        verbose_eval=False,
+                        keep_training_booster=True)
         drv = bst._driver
         maintained = drv.train_scores.numpy()[0]
         replayed = drv.predict_raw(X)[0]  # predict_raw averages for RF

@@ -191,12 +191,12 @@ class TestParamConflicts:
         c = Config({"max_depth": 3, "num_leaves": 100})
         assert int(c.num_leaves) == 8
 
-    def test_goss_disables_bagging(self):
+    def test_goss_rejects_bagging(self):
+        import pytest as _pt
         from lightgbm_tpu.config import Config
-        c = Config({"boosting": "goss", "bagging_fraction": 0.5,
+        with _pt.raises(ValueError, match="bagging"):
+            Config({"boosting": "goss", "bagging_fraction": 0.5,
                     "bagging_freq": 1})
-        assert float(c.bagging_fraction) == 1.0
-        assert int(c.bagging_freq) == 0
 
     def test_disabled_metric_matches_any_objective(self):
         from lightgbm_tpu.config import Config
