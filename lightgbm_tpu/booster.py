@@ -7,12 +7,11 @@ src/c_api.cpp:98-320).
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple, Union
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .config import Config
-from .io.dataset import TrainingData
 
 
 class Booster:

@@ -68,7 +68,6 @@ MXU-shaped operand sizes — versus 254 passes at M=8 shapes before.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax

@@ -25,7 +25,6 @@ lowest feature index wins ties (ArrayArgs::ArgMax semantics).
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, NamedTuple, Optional
 
 import jax

@@ -7,7 +7,6 @@ semantics, driving the TPU booster through `lightgbm_tpu.train`.
 
 from __future__ import annotations
 
-import copy
 from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
