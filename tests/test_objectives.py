@@ -324,7 +324,7 @@ class TestMetricsAgainstSklearnStyleOracles:
 
     def test_auc_mu_separable(self):
         from lightgbm_tpu.models.metrics import create_metric
-        cfg = Config({"num_class": 3})
+        cfg = Config({"objective": "multiclass", "num_class": 3})
         m = create_metric("auc_mu", cfg)
         label = np.array([0, 0, 1, 1, 2, 2], np.float32)
         md = Metadata(6, label=label)
@@ -337,7 +337,8 @@ class TestMetricsAgainstSklearnStyleOracles:
 
     def test_multi_error_topk(self):
         from lightgbm_tpu.models.metrics import create_metric
-        cfg = Config({"num_class": 3, "multi_error_top_k": 2})
+        cfg = Config({"objective": "multiclass", "num_class": 3,
+              "multi_error_top_k": 2})
         m = create_metric("multi_error", cfg)
         label = np.array([0, 1, 2], np.float32)
         md = Metadata(3, label=label)
