@@ -385,6 +385,7 @@ def booster_set_leaf_value(bh: int, tree_idx: int, leaf_idx: int,
     drv = _get(bh)._driver
     drv._materialize()
     drv.models[tree_idx].set_leaf_value(leaf_idx, float(val))
+    drv._invalidate_tables()
 
 
 def booster_predict_for_file(bh: int, data_filename: str, has_header: int,

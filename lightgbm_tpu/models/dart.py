@@ -19,7 +19,7 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from .gbdt import GBDT, _predict_binned
+from .gbdt import GBDT
 
 
 class DART(GBDT):
@@ -38,21 +38,27 @@ class DART(GBDT):
         self.sum_weight = 0.0
 
     # ------------------------------------------------------------------
-    def _tree_delta(self, tree, data, class_id: int) -> np.ndarray:
-        return _predict_binned(tree, data.bins, self.learner.meta_np) \
-            .astype(np.float32)
-
-    def _apply_tree_to_scores(self, iter_idx: int, sign: float) -> None:
+    def _apply_iters_to_scores(self, iters, sign: float) -> None:
+        """Add sign * (all listed iterations' trees) to every score vector
+        — ONE native binned pass per (class, dataset) for the whole drop
+        set instead of a python loop per tree (reference dart.hpp:97-139
+        drop / :152-196 restore)."""
+        if not iters:
+            return
         K = self.num_tree_per_iteration
         for k in range(K):
-            tree = self.models[iter_idx * K + k]
-            if tree.num_leaves <= 1:
+            ids = [i * K + k for i in iters
+                   if self.models[i * K + k].num_leaves > 1]
+            if not ids:
                 continue
+            scales = [sign] * len(ids)
             self.train_scores.add(k, jnp.asarray(
-                sign * self._tree_delta(tree, self.train_data, k)))
+                self._score_trees_binned(self.train_data.bins, ids,
+                                         scales).astype(np.float32)))
             for vs, vd in zip(self.valid_scores, self.valid_sets):
                 vs.add(k, jnp.asarray(
-                    sign * self._tree_delta(tree, vd, k)))
+                    self._score_trees_binned(vd.bins, ids,
+                                             scales).astype(np.float32)))
 
     def _dropping_trees(self) -> None:
         """Select and remove dropped trees from the scores
@@ -82,8 +88,7 @@ class DART(GBDT):
                         self._drop_idx.append(self.num_init_iteration + i)
                         if max_drop > 0 and len(self._drop_idx) >= max_drop:
                             break
-        for i in self._drop_idx:
-            self._apply_tree_to_scores(i, -1.0)
+        self._apply_iters_to_scores(self._drop_idx, -1.0)
         k = float(len(self._drop_idx))
         lr = float(cfg.learning_rate)
         if not cfg.xgboost_dart_mode:
@@ -104,7 +109,6 @@ class DART(GBDT):
         for i in self._drop_idx:
             for c in range(K):
                 self.models[i * K + c].apply_shrinkage(scale)
-            self._apply_tree_to_scores(i, 1.0)
             if not cfg.uniform_drop:
                 j = i - self.num_init_iteration
                 if not cfg.xgboost_dart_mode:
@@ -113,6 +117,10 @@ class DART(GBDT):
                     self.sum_weight -= self.tree_weight[j] / \
                         (k + float(cfg.learning_rate))
                 self.tree_weight[j] *= scale
+        # leaf values changed in place: the RAW-value predictor tables
+        # are stale (the binned walker packs per call and cannot be)
+        self._invalidate_tables()
+        self._apply_iters_to_scores(self._drop_idx, 1.0)
 
     # ------------------------------------------------------------------
     def train_one_iter(self, grad=None, hess=None) -> bool:
@@ -124,8 +132,7 @@ class DART(GBDT):
         if ret:
             # stalled: restore dropped contributions unscaled so eval on the
             # final (unchanged) model stays consistent
-            for i in self._drop_idx:
-                self._apply_tree_to_scores(i, 1.0)
+            self._apply_iters_to_scores(self._drop_idx, 1.0)
             self._drop_idx = []
             return True
         self._normalize()

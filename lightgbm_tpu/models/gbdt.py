@@ -417,6 +417,7 @@ class GBDT:
 
     def rollback_one_iter(self) -> None:
         self._materialize()
+        self._invalidate_tables()
         if self.iter_ <= 0:
             return
         for k in range(self.num_tree_per_iteration):
@@ -471,6 +472,13 @@ class GBDT:
         raise NotImplementedError("use add_valid before training")
 
     # ------------------------------------------------------------------
+    def _invalidate_tables(self) -> None:
+        """Drop the cached raw-value node tables.  The cache keys on model
+        COUNT, so any in-place leaf mutation (DART shrinkage, refit,
+        set_leaf_value) must invalidate explicitly.  (The binned walker
+        packs its tables per call and has no cache to go stale.)"""
+        self._ft_key = None
+
     def _forest_tables(self):
         """Concatenated node tables for the native predictor, cached per
         model count (models only ever grow or get truncated wholesale)."""
@@ -482,6 +490,31 @@ class GBDT:
             self._ft = ForestTables(self.models)
             self._ft_key = key
         return self._ft
+
+    def _score_trees_binned(self, bins: np.ndarray, tree_ids, scales
+                            ) -> np.ndarray:
+        """sum_i scales[i] * models[tree_ids[i]](binned row) per row.
+
+        One native OMP pass over the listed trees (DART drop/restore and
+        rollback re-score many trees per dataset); numpy per-tree
+        level-walk fallback when the native lib is unavailable.  The node
+        tables are packed PER CALL from just the listed subset — drop
+        sets are small, and per-call packing cannot go stale when leaf
+        values mutate in place (DART shrinkage, refit, set_leaf_value)."""
+        from ..native import BinnedForestTables, native_lib
+
+        meta = self.learner.meta_np
+        if native_lib() is not None and bins.dtype in (np.uint8, np.uint16):
+            sel = [self.models[ti] for ti in tree_ids]
+            tables = BinnedForestTables(sel, meta)
+            out = tables.predict_subset(
+                bins, np.arange(len(sel), dtype=np.int32), scales)
+            if out is not None:
+                return out
+        acc = np.zeros(bins.shape[0], np.float64)
+        for ti, sc in zip(tree_ids, scales):
+            acc += sc * _predict_binned(self.models[ti], bins, meta)
+        return acc
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
                     early_stop_freq: int = 0,
@@ -631,7 +664,7 @@ class GBDT:
             tree.leaf_value[:nl] = (decay * old
                                     + (1.0 - decay) * new_out * tree.shrinkage)
             scores[cid] += tree.leaf_value[leaves]
-        self._ft_key = None  # leaf values changed: drop packed tables
+        self._invalidate_tables()  # leaf values changed in place
 
     def reset_config(self, config: Config) -> None:
         self._materialize()

@@ -166,3 +166,89 @@ class ForestTables:
             X.ctypes, ctypes.c_int64(n), ctypes.c_int32(X.shape[1]),
             ctypes.c_int32(num_trees), *args, out.ctypes)
         return out
+
+
+class BinnedForestTables:
+    """Bin-space node tables for the native binned walker.
+
+    The raw-value tables (ForestTables) walk double thresholds; these walk
+    threshold_in_bin / split_feature_inner with the per-feature bin
+    metadata, matching gbdt._predict_binned exactly.  Used by valid-score
+    updates, DART drop/restore, and rollback, where trees are re-scored
+    against already-binned datasets.
+    """
+
+    def __init__(self, trees: List, meta):
+        no, lo, cbo, cwo = [0], [0], [0], [0]
+        sf, th, dt, lc, rc, lv, cb, cw = [], [], [], [], [], [], [], []
+        for t in trees:
+            ni = max(t.num_leaves - 1, 0)
+            no.append(no[-1] + ni)
+            lo.append(lo[-1] + t.num_leaves)
+            sf.append(t.split_feature_inner[:ni])
+            th.append(t.threshold_in_bin[:ni])
+            dt.append(t.decision_type[:ni])
+            lc.append(t.left_child[:ni])
+            rc.append(t.right_child[:ni])
+            lv.append(t.leaf_value[:t.num_leaves])
+            cb.append(np.asarray(t.cat_boundaries_inner, np.int32))
+            cw.append(np.asarray(t.cat_threshold_inner, np.uint32))
+            cbo.append(cbo[-1] + len(t.cat_boundaries_inner))
+            cwo.append(cwo[-1] + len(t.cat_threshold_inner))
+
+        def cat_(parts, dtype):
+            return (np.ascontiguousarray(np.concatenate(parts), dtype=dtype)
+                    if parts else np.zeros(0, dtype))
+
+        self.num_trees = len(trees)
+        self.node_offset = np.asarray(no, np.int32)
+        self.leaf_offset = np.asarray(lo, np.int32)
+        self.split_feature_inner = cat_(sf, np.int32)
+        self.threshold_in_bin = cat_(th, np.int32)
+        self.decision_type = cat_(dt, np.int8)
+        self.left_child = cat_(lc, np.int32)
+        self.right_child = cat_(rc, np.int32)
+        self.leaf_value = cat_(lv, np.float64)
+        self.cat_bound_offset = np.asarray(cbo, np.int32)
+        self.cat_boundaries = cat_(cb, np.int32)
+        self.cat_word_offset = np.asarray(cwo, np.int32)
+        self.cat_words = cat_(cw, np.uint32)
+        self.num_bin = np.ascontiguousarray(meta["num_bin"], np.int32)
+        self.default_bin = np.ascontiguousarray(meta["default_bin"],
+                                                np.int32)
+        self.missing_type = np.ascontiguousarray(meta["missing_type"],
+                                                 np.int32)
+
+    def predict_subset(self, bins: np.ndarray, tree_ids, scales
+                       ) -> Optional[np.ndarray]:
+        """sum_i scales[i] * tree_ids[i](bins_row) per row; None = no lib
+        or unsupported bin dtype."""
+        lib = native_lib()
+        # stale prebuilt libs may predate this symbol: fall back, don't die
+        if lib is None or not hasattr(lib,
+                                      "LGBMTPU_ForestPredictBinnedSubset"):
+            return None
+        if bins.dtype == np.uint8:
+            dtype_flag = 0
+        elif bins.dtype == np.uint16:
+            dtype_flag = 1
+        else:
+            return None
+        bins = np.ascontiguousarray(bins)
+        tree_ids = np.ascontiguousarray(tree_ids, np.int32)
+        scales = np.ascontiguousarray(scales, np.float64)
+        n = bins.shape[0]
+        out = np.zeros(n, np.float64)
+        lib.LGBMTPU_ForestPredictBinnedSubset(
+            bins.ctypes, ctypes.c_int32(dtype_flag), ctypes.c_int64(n),
+            ctypes.c_int32(bins.shape[1]), tree_ids.ctypes, scales.ctypes,
+            ctypes.c_int32(len(tree_ids)),
+            self.node_offset.ctypes, self.leaf_offset.ctypes,
+            self.split_feature_inner.ctypes, self.threshold_in_bin.ctypes,
+            self.decision_type.ctypes, self.left_child.ctypes,
+            self.right_child.ctypes, self.leaf_value.ctypes,
+            self.cat_bound_offset.ctypes, self.cat_boundaries.ctypes,
+            self.cat_word_offset.ctypes, self.cat_words.ctypes,
+            self.num_bin.ctypes, self.default_bin.ctypes,
+            self.missing_type.ctypes, out.ctypes)
+        return out

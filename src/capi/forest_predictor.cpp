@@ -165,3 +165,113 @@ LGBM_EXPORT int LGBMTPU_ForestPredictLeaf(
   }
   return 0;
 }
+
+// ---- binned-space walker ---------------------------------------------
+//
+// Same node tables as the raw walker but thresholds are BIN ids
+// (threshold_in_bin / split_feature_inner / *_inner bitsets) and rows are
+// the uint8/uint16 bin matrix; missing routing consults per-feature
+// num_bin/default_bin/missing_type (the NumericalDecisionInner semantics,
+// reference tree.h:252-318).  Scores a SUBSET of trees with per-tree
+// scales in one OMP pass — the host-side per-tree loop this replaces
+// dominated DART drop/restore and rollback at many trees x datasets.
+
+namespace {
+
+struct BinnedForest {
+  const int32_t* node_offset;
+  const int32_t* leaf_offset;
+  const int32_t* split_feature_inner;
+  const int32_t* threshold_in_bin;
+  const int8_t* decision_type;
+  const int32_t* left_child;
+  const int32_t* right_child;
+  const double* leaf_value;
+  const int32_t* cat_bound_offset;
+  const int32_t* cat_boundaries;
+  const int32_t* cat_word_offset;
+  const uint32_t* cat_words;
+  const int32_t* num_bin;       // per inner feature
+  const int32_t* default_bin;
+  const int32_t* missing_type;
+};
+
+template <typename BinT>
+inline int32_t walk_binned(const BinnedForest& f, int32_t tree,
+                           const BinT* row, int64_t row_stride) {
+  const int32_t base = f.node_offset[tree];
+  if (f.node_offset[tree + 1] - base == 0) return 0;
+  int32_t node = 0;
+  while (node >= 0) {
+    const int32_t k = base + node;
+    const int32_t feat = f.split_feature_inner[k];
+    const int64_t fbin = static_cast<int64_t>(row[feat * row_stride]);
+    const int8_t dt = f.decision_type[k];
+    const int mt = f.missing_type[feat];
+    bool left;
+    if (dt & kCategoricalMask) {
+      left = false;
+      const int32_t cidx = f.threshold_in_bin[k];
+      const int32_t* bounds = f.cat_boundaries + f.cat_bound_offset[tree];
+      const uint32_t* words = f.cat_words + f.cat_word_offset[tree];
+      const int64_t w = fbin / 32;
+      if (w < bounds[cidx + 1] - bounds[cidx]) {
+        left = (words[bounds[cidx] + w] >> (fbin % 32)) & 1u;
+      }
+    } else {
+      bool is_missing;
+      if (mt == 2) {
+        is_missing = fbin == f.num_bin[feat] - 1;
+      } else if (mt == 1) {
+        is_missing = fbin == f.default_bin[feat];
+      } else {
+        is_missing = false;
+      }
+      left = is_missing ? (dt & kDefaultLeftMask) != 0
+                        : fbin <= f.threshold_in_bin[k];
+    }
+    node = left ? f.left_child[k] : f.right_child[k];
+  }
+  return ~node;
+}
+
+}  // namespace
+
+// bins laid out [nrow, ncol] row-major; bin_dtype: 0 = uint8, 1 = uint16.
+// For each listed tree t (tree_ids[i]) adds scale[i] * leaf_value to
+// out[row] — one call covers a DART drop set or a rollback.
+LGBM_EXPORT int LGBMTPU_ForestPredictBinnedSubset(
+    const void* bins, int32_t bin_dtype, int64_t nrow, int32_t ncol,
+    const int32_t* tree_ids, const double* scales, int32_t num_listed,
+    const int32_t* node_offset, const int32_t* leaf_offset,
+    const int32_t* split_feature_inner, const int32_t* threshold_in_bin,
+    const int8_t* decision_type, const int32_t* left_child,
+    const int32_t* right_child, const double* leaf_value,
+    const int32_t* cat_bound_offset, const int32_t* cat_boundaries,
+    const int32_t* cat_word_offset, const uint32_t* cat_words,
+    const int32_t* num_bin, const int32_t* default_bin,
+    const int32_t* missing_type, double* out) {
+  BinnedForest f{node_offset, leaf_offset, split_feature_inner,
+                 threshold_in_bin, decision_type, left_child, right_child,
+                 leaf_value, cat_bound_offset, cat_boundaries,
+                 cat_word_offset, cat_words, num_bin, default_bin,
+                 missing_type};
+#pragma omp parallel for schedule(static)
+  for (int64_t r = 0; r < nrow; ++r) {
+    double acc = 0.0;
+    for (int32_t i = 0; i < num_listed; ++i) {
+      const int32_t t = tree_ids[i];
+      int32_t leaf;
+      if (bin_dtype == 0) {
+        leaf = walk_binned<uint8_t>(
+            f, t, static_cast<const uint8_t*>(bins) + r * ncol, 1);
+      } else {
+        leaf = walk_binned<uint16_t>(
+            f, t, static_cast<const uint16_t*>(bins) + r * ncol, 1);
+      }
+      acc += scales[i] * leaf_value[f.leaf_offset[t] + leaf];
+    }
+    out[r] += acc;
+  }
+  return 0;
+}

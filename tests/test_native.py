@@ -18,10 +18,11 @@ pytestmark = pytest.mark.skipif(not _native_available(),
                                 reason="native lib not built")
 
 
-def _train(X, y, params, rounds=6):
+def _train(X, y, params, rounds=6, keep=False):
     import lightgbm_tpu as lgb
     ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
-    return lgb.train(params, ds, num_boost_round=rounds, verbose_eval=False)
+    return lgb.train(params, ds, num_boost_round=rounds, verbose_eval=False,
+                     keep_training_booster=keep)
 
 
 def _python_raw(bst, X):
@@ -72,3 +73,54 @@ class TestForestPredictor:
         expect = np.column_stack([t.predict_leaf(X)
                                   for t in bst._driver.models])
         np.testing.assert_array_equal(leaves, expect)
+
+
+class TestBinnedForestWalker:
+    def test_subset_matches_predict_binned(self):
+        """The native binned-subset walker must reproduce the numpy
+        bin-space traversal over mixed numerical/categorical trees with
+        per-tree scales."""
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.models.gbdt import _predict_binned
+
+        rng = np.random.default_rng(11)
+        n = 1500
+        Xc = rng.integers(0, 7, size=n).astype(np.float64)
+        Xn = rng.normal(size=n)
+        Xn[rng.random(n) < 0.15] = np.nan
+        X = np.column_stack([Xc, Xn, rng.normal(size=n)])
+        y = (Xc % 2) * 1.5 + np.nan_to_num(Xn)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+        bst = _train(X, y, {"objective": "regression", "num_leaves": 15,
+                            "min_data_in_leaf": 5,
+                            "categorical_feature": [0]}, rounds=8,
+                     keep=True)
+        drv = bst._driver
+        drv._materialize()
+        bins = drv.train_data.bins
+        meta = drv.learner.meta_np
+        ids = [1, 3, 6]
+        scales = [1.0, -2.0, 0.5]
+        got = drv._score_trees_binned(bins, ids, scales)
+        want = np.zeros(bins.shape[0])
+        for ti, sc in zip(ids, scales):
+            want += sc * _predict_binned(drv.models[ti], bins, meta)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-12)
+
+    def test_dart_scores_consistent(self):
+        """DART's batched native drop/restore keeps maintained scores
+        equal to recomputed model predictions."""
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(12)
+        X = rng.normal(size=(1200, 4))
+        y = X[:, 0] * 2 + np.sin(X[:, 1])
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+        bst = lgb.train({"objective": "regression", "boosting": "dart",
+                         "num_leaves": 15, "drop_rate": 0.5,
+                         "min_data_in_leaf": 5},
+                        ds, num_boost_round=12, verbose_eval=False,
+                        keep_training_booster=True)
+        maintained = bst._driver.train_scores.numpy()[0]
+        recomputed = bst.predict(X, raw_score=True)
+        np.testing.assert_allclose(maintained, recomputed,
+                                   rtol=2e-5, atol=2e-5)
