@@ -47,17 +47,17 @@ class DART(GBDT):
             return
         K = self.num_tree_per_iteration
         for k in range(K):
-            ids = [i * K + k for i in iters
-                   if self.models[i * K + k].num_leaves > 1]
-            if not ids:
+            trees = [self.models[i * K + k] for i in iters
+                     if self.models[i * K + k].num_leaves > 1]
+            if not trees:
                 continue
-            scales = [sign] * len(ids)
+            scales = [sign] * len(trees)
             self.train_scores.add(k, jnp.asarray(
-                self._score_trees_binned(self.train_data.bins, ids,
+                self._score_trees_binned(self.train_data.bins, trees,
                                          scales).astype(np.float32)))
             for vs, vd in zip(self.valid_scores, self.valid_sets):
                 vs.add(k, jnp.asarray(
-                    self._score_trees_binned(vd.bins, ids,
+                    self._score_trees_binned(vd.bins, trees,
                                              scales).astype(np.float32)))
 
     def _dropping_trees(self) -> None:

@@ -376,7 +376,7 @@ class GBDT:
                 tree.apply_shrinkage(self.shrinkage_rate)
                 for vs, vd in zip(self.valid_scores, self.valid_sets):
                     vs.add(class_id, jnp.asarray(
-                        _predict_binned(tree, vd.bins, meta)
+                        self._score_trees_binned(vd.bins, [tree], [1.0])
                         .astype(np.float32)))
                 if abs(init) > K_EPSILON:
                     tree.add_bias(init)
@@ -410,10 +410,10 @@ class GBDT:
                                 .astype(np.float32))
         self.train_scores.add(class_id, leaf_vals[leaf_ids])
         # valid scores: binned traversal
-        meta = self.learner.meta_np
         for vs, vd in zip(self.valid_scores, self.valid_sets):
             vs.add(class_id, jnp.asarray(
-                _predict_binned(tree, vd.bins, meta).astype(np.float32)))
+                self._score_trees_binned(vd.bins, [tree], [1.0])
+                .astype(np.float32)))
 
     def rollback_one_iter(self) -> None:
         self._materialize()
@@ -423,12 +423,12 @@ class GBDT:
         for k in range(self.num_tree_per_iteration):
             tree = self.models.pop()
             k_id = self.num_tree_per_iteration - 1 - k
-            delta = _predict_binned(tree, self.train_data.bins,
-                                    self.learner.meta_np).astype(np.float32)
-            self.train_scores.add(k_id, jnp.asarray(-delta))
+            self.train_scores.add(k_id, jnp.asarray(
+                self._score_trees_binned(self.train_data.bins, [tree],
+                                         [-1.0]).astype(np.float32)))
             for vs, vd in zip(self.valid_scores, self.valid_sets):
                 vs.add(k_id, jnp.asarray(
-                    -_predict_binned(tree, vd.bins, self.learner.meta_np)
+                    self._score_trees_binned(vd.bins, [tree], [-1.0])
                     .astype(np.float32)))
         self.iter_ -= 1
 
@@ -491,29 +491,28 @@ class GBDT:
             self._ft_key = key
         return self._ft
 
-    def _score_trees_binned(self, bins: np.ndarray, tree_ids, scales
+    def _score_trees_binned(self, bins: np.ndarray, trees, scales
                             ) -> np.ndarray:
-        """sum_i scales[i] * models[tree_ids[i]](binned row) per row.
+        """sum_i scales[i] * trees[i](binned row) per row.
 
-        One native OMP pass over the listed trees (DART drop/restore and
-        rollback re-score many trees per dataset); numpy per-tree
-        level-walk fallback when the native lib is unavailable.  The node
-        tables are packed PER CALL from just the listed subset — drop
-        sets are small, and per-call packing cannot go stale when leaf
-        values mutate in place (DART shrinkage, refit, set_leaf_value)."""
+        One native OMP pass over the listed Tree objects (valid-score
+        updates, DART drop/restore, rollback); numpy per-tree level-walk
+        fallback when the native lib is unavailable.  The node tables are
+        packed PER CALL from just the listed subset — the sets are small,
+        and per-call packing cannot go stale when leaf values mutate in
+        place (DART shrinkage, refit, set_leaf_value)."""
         from ..native import BinnedForestTables, native_lib
 
         meta = self.learner.meta_np
         if native_lib() is not None and bins.dtype in (np.uint8, np.uint16):
-            sel = [self.models[ti] for ti in tree_ids]
-            tables = BinnedForestTables(sel, meta)
+            tables = BinnedForestTables(list(trees), meta)
             out = tables.predict_subset(
-                bins, np.arange(len(sel), dtype=np.int32), scales)
+                bins, np.arange(len(trees), dtype=np.int32), scales)
             if out is not None:
                 return out
         acc = np.zeros(bins.shape[0], np.float64)
-        for ti, sc in zip(tree_ids, scales):
-            acc += sc * _predict_binned(self.models[ti], bins, meta)
+        for tree, sc in zip(trees, scales):
+            acc += sc * _predict_binned(tree, bins, meta)
         return acc
 
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,

@@ -101,7 +101,8 @@ class TestBinnedForestWalker:
         meta = drv.learner.meta_np
         ids = [1, 3, 6]
         scales = [1.0, -2.0, 0.5]
-        got = drv._score_trees_binned(bins, ids, scales)
+        got = drv._score_trees_binned(
+            bins, [drv.models[i] for i in ids], scales)
         want = np.zeros(bins.shape[0])
         for ti, sc in zip(ids, scales):
             want += sc * _predict_binned(drv.models[ti], bins, meta)
