@@ -34,7 +34,7 @@ def _train(X, y, extra=None, rounds=10):
     evals = {}
     bst = lgb.train(params, ds, num_boost_round=rounds,
                     valid_sets=[ds],
-                    evals_result=evals)
+                    evals_result=evals, keep_training_booster=True)
     return bst, evals
 
 
