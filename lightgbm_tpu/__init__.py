@@ -27,6 +27,7 @@ from .engine import train, cv, CVBooster
 from .callback import (
     early_stopping,
     log_evaluation,
+    print_evaluation,
     record_evaluation,
     reset_parameter,
     EarlyStopException,
@@ -49,6 +50,7 @@ __all__ = [
     "CVBooster",
     "early_stopping",
     "log_evaluation",
+    "print_evaluation",
     "record_evaluation",
     "reset_parameter",
     "EarlyStopException",

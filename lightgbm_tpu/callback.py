@@ -66,6 +66,12 @@ class _LogEvaluation:
                   f"{_report(env.evaluation_result_list, self.show_stdv)}")
 
 
+def print_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """Reference-era alias of log_evaluation (callback.py:55
+    print_evaluation)."""
+    return log_evaluation(period, show_stdv)
+
+
 def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
     return _LogEvaluation(period, show_stdv)
 
