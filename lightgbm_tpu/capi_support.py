@@ -118,15 +118,8 @@ def dataset_create_from_csr(indptr_ptr: int, indptr_type: int, indices_ptr: int,
                             data_ptr: int, data_type: int, nindptr: int,
                             nelem: int, num_col: int, params: str,
                             ref_handle: int) -> int:
-    indptr = _vec_from_ptr(indptr_ptr, indptr_type, nindptr).astype(np.int64)
-    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int64)
-    vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
-    nrow = nindptr - 1
-    # densified (the binned core is dense; EFB re-compresses at bin time):
-    # one vectorized scatter, no per-row Python loop
-    X = np.zeros((nrow, num_col), np.float64)
-    row_of = np.repeat(np.arange(nrow), np.diff(indptr))
-    X[row_of, indices] = vals
+    X = _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                     data_type, nindptr, nelem, num_col)
     ref = _get(ref_handle) if ref_handle else None
     ds = Dataset(X, reference=ref, params=_params_dict(params))
     ds.construct()
@@ -272,23 +265,8 @@ def booster_predict_for_mat(bh: int, ptr: int, data_type: int, nrow: int,
                             ncol: int, is_row_major: int, predict_type: int,
                             num_iteration: int, params: str,
                             out_ptr: int) -> int:
-    bst = _get(bh)
     X = _mat_from_ptr(ptr, data_type, nrow, ncol, is_row_major)
-    ni = num_iteration if num_iteration > 0 else None
-    kw = {}
-    if predict_type == PREDICT_RAW_SCORE:
-        kw["raw_score"] = True
-    elif predict_type == PREDICT_LEAF_INDEX:
-        kw["pred_leaf"] = True
-    elif predict_type == PREDICT_CONTRIB:
-        kw["pred_contrib"] = True
-    pred = np.asarray(bst.predict(X, num_iteration=ni, **kw),
-                      dtype=np.float64).reshape(-1)
-    out = np.ctypeslib.as_array(
-        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_double)),
-        shape=(pred.shape[0],))
-    out[:] = pred
-    return int(pred.shape[0])
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
 
 
 def booster_calc_num_predict(bh: int, nrow: int, predict_type: int,
@@ -453,3 +431,83 @@ def dataset_get_subset(dh: int, idx_ptr: int, n_idx: int,
         shape=(n_idx,)).copy()
     sub = ds.subset(idx, params=_params_dict(params) or None)
     return _put(sub)
+
+
+def booster_num_model_per_iteration(bh: int) -> int:
+    return booster_num_classes(bh)
+
+
+def booster_get_feature_names(bh: int) -> str:
+    return "\t".join(str(n) for n in _get(bh).feature_name())
+
+
+def _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                 data_type, nindptr, nelem, num_col):
+    """CSR pointers -> dense [nrow, num_col] f64 (the binned core is
+    dense; EFB re-compresses at bin time)."""
+    indptr = _vec_from_ptr(indptr_ptr, indptr_type, nindptr).astype(np.int64)
+    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int64)
+    vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
+    nrow = nindptr - 1
+    X = np.zeros((nrow, num_col), np.float64)
+    row_of = np.repeat(np.arange(nrow), np.diff(indptr))
+    X[row_of, indices] = vals
+    return X
+
+
+def _predict_kwargs(predict_type: int) -> dict:
+    if predict_type == PREDICT_RAW_SCORE:
+        return {"raw_score": True}
+    if predict_type == PREDICT_LEAF_INDEX:
+        return {"pred_leaf": True}
+    if predict_type == PREDICT_CONTRIB:
+        return {"pred_contrib": True}
+    return {}
+
+
+def _predict_into(bst, X, predict_type: int, num_iteration: int,
+                  out_ptr: int) -> int:
+    ni = num_iteration if num_iteration > 0 else None
+    pred = np.asarray(
+        bst.predict(X, num_iteration=ni, **_predict_kwargs(predict_type)),
+        dtype=np.float64).reshape(-1)
+    out = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_double)),
+        shape=(pred.shape[0],))
+    out[:] = pred
+    return int(pred.shape[0])
+
+
+def booster_predict_for_csr(bh: int, indptr_ptr: int, indptr_type: int,
+                            indices_ptr: int, data_ptr: int, data_type: int,
+                            nindptr: int, nelem: int, num_col: int,
+                            predict_type: int, num_iteration: int,
+                            params: str, out_ptr: int) -> int:
+    """Densify the CSR rows then share the matrix predict path
+    (reference c_api.h:644 PredictForCSR)."""
+    X = _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                     data_type, nindptr, nelem, num_col)
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
+
+
+def dataset_create_from_mats(ptrs_ptr: int, data_type: int, nrows_ptr: int,
+                             nmat: int, ncol: int, is_row_major: int,
+                             params: str, ref_handle: int) -> int:
+    """Stack several row-major blocks into one dataset (reference
+    LGBM_DatasetCreateFromMats, c_api.h:160)."""
+    # read the pointer array as raw uint64 words: numpy's buffer
+    # protocol has no PEP-3118 code for void*
+    ptrs = np.ctypeslib.as_array(
+        ctypes.cast(ptrs_ptr, ctypes.POINTER(ctypes.c_uint64)),
+        shape=(nmat,))
+    nrows = np.ctypeslib.as_array(
+        ctypes.cast(nrows_ptr, ctypes.POINTER(ctypes.c_int32)),
+        shape=(nmat,))
+    blocks = [_mat_from_ptr(int(ptrs[i]), data_type, int(nrows[i]), ncol,
+                            is_row_major)
+              for i in range(nmat)]
+    X = np.vstack(blocks)
+    ref = _get(ref_handle) if ref_handle else None
+    ds = Dataset(X, reference=ref, params=_params_dict(params))
+    ds.construct()
+    return _put(ds)

@@ -745,6 +745,46 @@ LGBM_EXPORT int LGBM_BoosterPredictForFile(BoosterHandle handle,
   return 0;
 }
 
+
+namespace {
+// Tab-joined python string -> caller's preallocated name buffers.
+// Contract is reference-v2.3.2-identical (c_api.h:303): the CALLER
+// provides at least num-names pointers, each wide enough for its name —
+// the ABI carries no capacity information to check against.
+int split_names_result(PyObject* r, char** names, int* num_names) {
+  PyGILState_STATE st = PyGILState_Ensure();
+  const char* joined = PyUnicode_AsUTF8(r);
+  std::string copy = joined ? joined : "";
+  bool ok = joined != nullptr;
+  if (!ok) {
+    set_error(py_error_string());
+    PyErr_Clear();
+  }
+  PyGILState_Release(st);
+  drop(r);
+  if (!ok) return -1;
+  if (copy.empty()) {  // no names known: report zero, write nothing
+    *num_names = 0;
+    return 0;
+  }
+  int count = 0;
+  const char* start = copy.c_str();
+  while (true) {
+    const char* tab = std::strchr(start, '\t');
+    size_t len = tab ? static_cast<size_t>(tab - start) : std::strlen(start);
+    if (names && names[count]) {
+      std::memcpy(names[count], start, len);
+      names[count][len] = '\0';
+    }
+    ++count;
+    if (!tab) break;
+    start = tab + 1;
+  }
+  *num_names = count;
+  return 0;
+}
+}  // namespace
+
 LGBM_EXPORT int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
                                             const char** feature_names,
                                             int num_feature_names) {
@@ -766,41 +806,7 @@ LGBM_EXPORT int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
   PyObject* r = call_support("dataset_get_feature_names", "(L)",
                              from_handle(handle));
   if (!r) return -1;
-  // the string view borrows from r: copy + split under the GIL, then drop
-  PyGILState_STATE st = PyGILState_Ensure();
-  const char* joined = PyUnicode_AsUTF8(r);
-  std::string copy = joined ? joined : "";
-  bool ok = joined != nullptr;
-  if (!ok) {
-    set_error(py_error_string());
-    PyErr_Clear();
-  }
-  PyGILState_Release(st);
-  drop(r);
-  if (!ok) return -1;
-  if (copy.empty()) {  // no names known: report zero, write nothing
-    *num_feature_names = 0;
-    return 0;
-  }
-  // split on tabs into the caller's preallocated buffers.  Contract is
-  // reference-v2.3.2-identical (c_api.h:303): the CALLER must provide at
-  // least num-features pointers, each wide enough for its name — the ABI
-  // carries no capacity information to check against.
-  int count = 0;
-  const char* start = copy.c_str();
-  while (true) {
-    const char* tab = std::strchr(start, '\t');
-    size_t len = tab ? static_cast<size_t>(tab - start) : std::strlen(start);
-    if (feature_names && feature_names[count]) {
-      std::memcpy(feature_names[count], start, len);
-      feature_names[count][len] = '\0';
-    }
-    ++count;
-    if (!tab) break;
-    start = tab + 1;
-  }
-  *num_feature_names = count;
-  return 0;
+  return split_names_result(r, feature_names, num_feature_names);
 }
 
 LGBM_EXPORT int LGBM_DatasetGetSubset(DatasetHandle handle,
@@ -812,6 +818,84 @@ LGBM_EXPORT int LGBM_DatasetGetSubset(DatasetHandle handle,
                              from_handle(handle),
                              reinterpret_cast<long long>(used_row_indices),
                              num_used_row_indices, parameters);
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_SetLastError(const char* msg) {
+  set_error(msg ? msg : "");
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                                 int* out_tree_per_iter) {
+  PyObject* r = call_support("booster_num_model_per_iteration", "(L)",
+                             from_handle(handle));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_tree_per_iter = (int)v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetFeatureNames(BoosterHandle handle,
+                                            char** feature_names,
+                                            int* num_feature_names) {
+  PyObject* r = call_support("booster_get_feature_names", "(L)",
+                             from_handle(handle));
+  if (!r) return -1;
+  return split_names_result(r, feature_names, num_feature_names);
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int num_iteration,
+    const char* parameter, int64_t* out_len, double* out_result) {
+  return LGBM_BoosterPredictForMat(handle, data, data_type, 1, ncol,
+                                   is_row_major, predict_type,
+                                   num_iteration, parameter, out_len,
+                                   out_result);
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSR(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  PyObject* r = call_support(
+      "booster_predict_for_csr", "(LLiLLiLLLiisL)", from_handle(handle),
+      reinterpret_cast<long long>(indptr), indptr_type,
+      reinterpret_cast<long long>(indices),
+      reinterpret_cast<long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), predict_type, num_iteration,
+      parameter, reinterpret_cast<long long>(out_result));
+  if (!r) return -1;
+  bool ok;
+  long long n = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = n;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromMats(
+    int32_t nmat, const void** data, int data_type, int32_t* nrow,
+    int32_t ncol, int is_row_major, const char* parameters,
+    DatasetHandle reference, DatasetHandle* out) {
+  PyObject* r = call_support(
+      "dataset_create_from_mats", "(LiLiiisL)",
+      reinterpret_cast<long long>(data), data_type,
+      reinterpret_cast<long long>(nrow), (int)nmat, (int)ncol,
+      is_row_major, parameters, from_handle(reference));
   if (!r) return -1;
   bool ok;
   long long h = as_int(r, &ok);

@@ -333,3 +333,85 @@ class TestCAPIBreadth:
         n = ctypes.c_int32()
         _check(lib, lib.LGBM_DatasetGetNumData(sub, ctypes.byref(n)))
         assert n.value == len(idx)
+
+
+class TestCAPIBreadth2:
+    """Second breadth batch: single-row / CSR predict, multi-mat dataset,
+    booster introspection, SetLastError."""
+
+    def test_set_last_error(self, lib):
+        lib.LGBM_SetLastError(b"custom message")
+        assert lib.LGBM_GetLastError() == b"custom message"
+
+    def test_num_model_per_iteration_and_names(self, lib, data):
+        helper = TestCAPIBreadth()
+        _, bh = helper._make_booster(lib, data)
+        k = ctypes.c_int32()
+        _check(lib, lib.LGBM_BoosterNumModelPerIteration(bh, ctypes.byref(k)))
+        assert k.value == 1
+        bufs = [ctypes.create_string_buffer(64) for _ in range(6)]
+        ptrs = (ctypes.c_char_p * 6)(
+            *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+        cnt = ctypes.c_int32()
+        _check(lib, lib.LGBM_BoosterGetFeatureNames(bh, ptrs,
+                                                    ctypes.byref(cnt)))
+        assert cnt.value == 6
+        assert bufs[0].value == b"Column_0"
+
+    def test_predict_single_row_and_csr(self, lib, data):
+        X, y = data
+        helper = TestCAPIBreadth()
+        _, bh = helper._make_booster(lib, data)
+        # dense single row
+        row = np.ascontiguousarray(X[0])
+        out_len = ctypes.c_int64()
+        out = np.zeros(1, np.float64)
+        _check(lib, lib.LGBM_BoosterPredictForMatSingleRow(
+            bh, row.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(X.shape[1]), ctypes.c_int32(1),
+            C_API_PREDICT_NORMAL, -1, b"", ctypes.byref(out_len),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        assert out_len.value == 1
+        # CSR of the first 5 rows must reproduce dense predictions
+        import scipy.sparse as sp
+        Xs = sp.csr_matrix(X[:5])
+        out5 = np.zeros(5, np.float64)
+        len5 = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForCSR(
+            bh, Xs.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_INT32),
+            Xs.indices.astype(np.int32).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)),
+            Xs.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_FLOAT64),
+            ctypes.c_int64(len(Xs.indptr)), ctypes.c_int64(Xs.nnz),
+            ctypes.c_int64(X.shape[1]), C_API_PREDICT_NORMAL, -1, b"",
+            ctypes.byref(len5),
+            out5.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        assert len5.value == 5
+        dense_out = np.zeros(5, np.float64)
+        dl = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bh, np.ascontiguousarray(X[:5]).ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64, ctypes.c_int32(5),
+            ctypes.c_int32(X.shape[1]), ctypes.c_int32(1),
+            C_API_PREDICT_NORMAL, -1, b"", ctypes.byref(dl),
+            dense_out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        np.testing.assert_allclose(out5, dense_out, rtol=1e-12)
+
+    def test_dataset_from_mats(self, lib, data):
+        X, y = data
+        a = np.ascontiguousarray(X[:400])
+        b = np.ascontiguousarray(X[400:])
+        ptrs = (ctypes.c_void_p * 2)(a.ctypes.data_as(ctypes.c_void_p),
+                                     b.ctypes.data_as(ctypes.c_void_p))
+        nrows = np.asarray([len(a), len(b)], np.int32)
+        dh = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMats(
+            ctypes.c_int32(2), ptrs, C_API_DTYPE_FLOAT64,
+            nrows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(X.shape[1]), ctypes.c_int32(1), b"max_bin=32",
+            None, ctypes.byref(dh)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(dh, ctypes.byref(n)))
+        assert n.value == len(X)
