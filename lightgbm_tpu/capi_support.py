@@ -511,3 +511,114 @@ def dataset_create_from_mats(ptrs_ptr: int, data_type: int, nrows_ptr: int,
     ds = Dataset(X, reference=ref, params=_params_dict(params))
     ds.construct()
     return _put(ds)
+
+
+def _score_state(drv, data_idx: int):
+    """data_idx -> maintained score state (0 = train, i+1 = valid i)."""
+    if data_idx == 0:
+        return drv.train_scores
+    if 0 < data_idx <= len(drv.valid_scores):
+        return drv.valid_scores[data_idx - 1]
+    raise IndexError(f"no dataset at data_idx {data_idx}")
+
+
+def booster_get_num_predict(bh: int, data_idx: int) -> int:
+    """Prediction count for dataset data_idx (reference
+    LGBM_BoosterGetNumPredict, c_api.h:608)."""
+    st = _score_state(_get(bh)._driver, data_idx)
+    return int(st.scores.shape[0] * st.scores.shape[1])
+
+
+def booster_get_predict(bh: int, data_idx: int, out_ptr: int) -> int:
+    """Converted predictions for dataset data_idx (reference
+    LGBM_BoosterGetPredict -> GBDT::GetPredictAt, which applies the
+    objective's ConvertOutput transform; written class-major)."""
+    drv = _get(bh)._driver
+    drv._materialize()
+    st = _score_state(drv, data_idx)
+    scores = st.numpy()
+    if drv.objective is not None:
+        scores = np.asarray(drv.objective.convert_output(scores),
+                            np.float64).reshape(scores.shape)
+    scores = scores.reshape(-1)
+    out = np.ctypeslib.as_array(
+        ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_double)),
+        shape=(scores.shape[0],))
+    out[:] = scores
+    return int(scores.shape[0])
+
+
+def dataset_update_param(dh: int, params: str) -> None:
+    """Merge new params, rejecting changes to bin-defining keys once
+    constructed (reference Dataset::ResetConfig, dataset.cpp:395-400)."""
+    ds = _get(dh)
+    new = _params_dict(params)
+    if ds._inner is not None:
+        frozen = ("max_bin", "max_bin_by_feature", "bin_construct_sample_cnt",
+                  "min_data_in_bin", "use_missing", "zero_as_missing",
+                  "categorical_feature", "forcedbins_filename")
+        # compare EFFECTIVE values (current config incl. defaults), so
+        # restating a default is the no-op the reference accepts
+        cur = Config(ds.params)
+        eff = Config({**ds.params, **new})
+        for k in frozen:
+            if k in new and getattr(eff, k) != getattr(cur, k):
+                raise ValueError(
+                    f"cannot change {k} after the dataset is constructed")
+    ds.params.update(new)
+
+
+def dataset_create_by_reference(ref_handle: int, num_total_row: int) -> int:
+    """Allocate an empty row buffer aligned with `ref` for streaming
+    construction via PushRows (reference c_api.h:266-311)."""
+    ref = _get(ref_handle)
+    ref.construct()
+    ncol = ref._inner.num_total_features
+    buf = np.full((int(num_total_row), ncol), np.nan, np.float64)
+    ds = Dataset(buf, reference=ref, params=dict(ref.params))
+    ds._pushed = np.zeros(int(num_total_row), bool)
+    ds._pushed_complete = False
+    # constructing with unpushed rows would silently train on NaN rows
+    orig_construct = ds.construct
+
+    def _guarded_construct():
+        if not ds._pushed_complete and ds._inner is None:
+            missing = int((~ds._pushed).sum())
+            raise RuntimeError(
+                f"{missing} of {len(ds._pushed)} rows never pushed")
+        return orig_construct()
+
+    ds.construct = _guarded_construct
+    return _put(ds)
+
+
+def dataset_push_rows(dh: int, ptr: int, data_type: int, nrow: int,
+                      ncol: int, start_row: int) -> None:
+    ds = _get(dh)
+    if ds._inner is not None:
+        raise RuntimeError("cannot push rows after construction")
+    block = _mat_from_ptr(ptr, data_type, nrow, ncol, 1)
+    ds.data[start_row:start_row + nrow, :] = block
+    ds._pushed[start_row:start_row + nrow] = True
+    if bool(ds._pushed.all()):
+        # every allocated row arrived: the dataset may construct (the
+        # reference's FinishLoad moment)
+        ds._pushed_complete = True
+
+
+def dataset_dump_text(dh: int, filename: str) -> None:
+    """Debug text dump: header plus per-row label and binned values
+    (reference LGBM_DatasetDumpText, c_api.h:316)."""
+    ds = _get(dh)
+    ds.construct()
+    inner = ds._inner
+    with open(filename, "w") as f:
+        f.write(f"num_data: {inner.num_data}\n")
+        f.write(f"num_features: {inner.num_features}\n")
+        f.write("feature_names: " + "\t".join(inner.feature_names) + "\n")
+        label = inner.metadata.label
+        if label is None:
+            label = np.zeros(inner.num_data, np.float64)
+        for i in range(inner.num_data):
+            row = "\t".join(str(int(b)) for b in inner.bins[i])
+            f.write(f"{label[i]:g}\t{row}\n")

@@ -846,12 +846,14 @@ LGBM_EXPORT int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
 }
 
 LGBM_EXPORT int LGBM_BoosterGetFeatureNames(BoosterHandle handle,
-                                            char** feature_names,
-                                            int* num_feature_names) {
+                                            int* out_len,
+                                            char** out_strs) {
+  // NOTE the reference v2.3.2 argument order differs from the Dataset
+  // variant: (handle, int* out_len, char** out_strs) — c_api.h:573
   PyObject* r = call_support("booster_get_feature_names", "(L)",
                              from_handle(handle));
   if (!r) return -1;
-  return split_names_result(r, feature_names, num_feature_names);
+  return split_names_result(r, out_strs, out_len);
 }
 
 LGBM_EXPORT int LGBM_BoosterPredictForMatSingleRow(
@@ -902,5 +904,77 @@ LGBM_EXPORT int LGBM_DatasetCreateFromMats(
   drop(r);
   if (!ok) return -1;
   *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                                          int64_t* out_len) {
+  PyObject* r = call_support("booster_get_num_predict", "(Li)",
+                             from_handle(handle), data_idx);
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                                       int64_t* out_len, double* out_result) {
+  PyObject* r = call_support("booster_get_predict", "(LiL)",
+                             from_handle(handle), data_idx,
+                             reinterpret_cast<long long>(out_result));
+  if (!r) return -1;
+  bool ok;
+  long long v = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = v;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetUpdateParam(DatasetHandle handle,
+                                        const char* parameters) {
+  PyObject* r = call_support("dataset_update_param", "(Ls)",
+                             from_handle(handle), parameters);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateByReference(DatasetHandle reference,
+                                              int64_t num_total_row,
+                                              DatasetHandle* out) {
+  PyObject* r = call_support("dataset_create_by_reference", "(LL)",
+                             from_handle(reference),
+                             static_cast<long long>(num_total_row));
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRows(DatasetHandle handle, const void* data,
+                                     int data_type, int32_t nrow,
+                                     int32_t ncol, int32_t start_row) {
+  PyObject* r = call_support("dataset_push_rows", "(LLiiii)",
+                             from_handle(handle),
+                             reinterpret_cast<long long>(data), data_type,
+                             (int)nrow, (int)ncol, (int)start_row);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetDumpText(DatasetHandle handle,
+                                     const char* filename) {
+  PyObject* r = call_support("dataset_dump_text", "(Ls)",
+                             from_handle(handle), filename);
+  if (!r) return -1;
+  drop(r);
   return 0;
 }

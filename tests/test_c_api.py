@@ -353,8 +353,9 @@ class TestCAPIBreadth2:
         ptrs = (ctypes.c_char_p * 6)(
             *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
         cnt = ctypes.c_int32()
-        _check(lib, lib.LGBM_BoosterGetFeatureNames(bh, ptrs,
-                                                    ctypes.byref(cnt)))
+        # NOTE reference v2.3.2 order: (handle, out_len, out_strs)
+        _check(lib, lib.LGBM_BoosterGetFeatureNames(bh, ctypes.byref(cnt),
+                                                    ptrs))
         assert cnt.value == 6
         assert bufs[0].value == b"Column_0"
 
@@ -415,3 +416,88 @@ class TestCAPIBreadth2:
         n = ctypes.c_int32()
         _check(lib, lib.LGBM_DatasetGetNumData(dh, ctypes.byref(n)))
         assert n.value == len(X)
+
+
+class TestCAPIBreadth3:
+    """Third batch: maintained-score retrieval, param updates, streaming
+    row push, text dump."""
+
+    def test_get_predict_matches_scores(self, lib, data):
+        X, y = data
+        helper = TestCAPIBreadth()
+        dh, bh = helper._make_booster(lib, data)
+        n_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterGetNumPredict(bh, 0,
+                                                  ctypes.byref(n_len)))
+        assert n_len.value == len(y)
+        out = np.zeros(len(y), np.float64)
+        got = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterGetPredict(
+            bh, 0, ctypes.byref(got),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        assert got.value == len(y)
+        # maintained train scores == raw predictions on training data
+        pred = np.zeros(len(y), np.float64)
+        pl = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bh, np.ascontiguousarray(X).ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64, ctypes.c_int32(len(y)),
+            ctypes.c_int32(X.shape[1]), ctypes.c_int32(1),
+            C_API_PREDICT_NORMAL, -1, b"", ctypes.byref(pl),
+            pred.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        # GetPredict applies ConvertOutput (sigmoid here), like the
+        # reference GBDT::GetPredictAt
+        np.testing.assert_allclose(out, pred, rtol=1e-5, atol=1e-5)
+
+    def test_update_param_guards_frozen_keys(self, lib, data):
+        helper = TestCAPIBreadth()
+        dh, _ = helper._make_booster(lib, data)
+        _check(lib, lib.LGBM_DatasetUpdateParam(dh, b"learning_rate=0.2"))
+        assert lib.LGBM_DatasetUpdateParam(dh, b"max_bin=64") != 0
+        assert b"max_bin" in lib.LGBM_GetLastError()
+
+    def test_push_rows_roundtrip(self, lib, data):
+        X, y = data
+        helper = TestCAPIBreadth()
+        ref_dh, _ = helper._make_booster(lib, data)
+        out = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateByReference(
+            ref_dh, ctypes.c_int64(200), ctypes.byref(out)))
+        a = np.ascontiguousarray(X[:120])
+        b = np.ascontiguousarray(X[120:200])
+        _check(lib, lib.LGBM_DatasetPushRows(
+            out, a.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(120), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(0)))
+        _check(lib, lib.LGBM_DatasetPushRows(
+            out, b.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(80), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(120)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(out, ctypes.byref(n)))
+        assert n.value == 200
+
+    def test_push_rows_incomplete_rejected(self, lib, data):
+        X, y = data
+        helper = TestCAPIBreadth()
+        ref_dh, _ = helper._make_booster(lib, data)
+        out = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateByReference(
+            ref_dh, ctypes.c_int64(100), ctypes.byref(out)))
+        a = np.ascontiguousarray(X[:60])
+        _check(lib, lib.LGBM_DatasetPushRows(
+            out, a.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(60), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(0)))
+        n = ctypes.c_int32()
+        assert lib.LGBM_DatasetGetNumData(out, ctypes.byref(n)) != 0
+        assert b"never pushed" in lib.LGBM_GetLastError()
+
+    def test_dump_text(self, lib, data, tmp_path):
+        helper = TestCAPIBreadth()
+        dh, _ = helper._make_booster(lib, data)
+        path = str(tmp_path / "dump.txt")
+        _check(lib, lib.LGBM_DatasetDumpText(dh, path.encode()))
+        lines = open(path).read().splitlines()
+        assert lines[0].startswith("num_data: ")
+        assert len(lines) == 3 + 1200
