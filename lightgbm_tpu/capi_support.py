@@ -622,3 +622,76 @@ def dataset_dump_text(dh: int, filename: str) -> None:
         for i in range(inner.num_data):
             row = "\t".join(str(int(b)) for b in inner.bins[i])
             f.write(f"{label[i]:g}\t{row}\n")
+
+
+def _densify_csc(col_ptr_p: int, col_ptr_type: int, indices_ptr: int,
+                 data_ptr: int, data_type: int, ncol_ptr: int, nelem: int,
+                 num_row: int):
+    """CSC pointers -> dense [num_row, ncol] f64."""
+    col_ptr = _vec_from_ptr(col_ptr_p, col_ptr_type,
+                            ncol_ptr).astype(np.int64)
+    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int64)
+    vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
+    ncol = ncol_ptr - 1
+    X = np.zeros((num_row, ncol), np.float64)
+    col_of = np.repeat(np.arange(ncol), np.diff(col_ptr))
+    X[indices, col_of] = vals
+    return X
+
+
+def dataset_create_from_csc(col_ptr_p: int, col_ptr_type: int,
+                            indices_ptr: int, data_ptr: int, data_type: int,
+                            ncol_ptr: int, nelem: int, num_row: int,
+                            params: str, ref_handle: int) -> int:
+    X = _densify_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr,
+                     data_type, ncol_ptr, nelem, num_row)
+    ref = _get(ref_handle) if ref_handle else None
+    ds = Dataset(X, reference=ref, params=_params_dict(params))
+    ds.construct()
+    return _put(ds)
+
+
+def booster_predict_for_csc(bh: int, col_ptr_p: int, col_ptr_type: int,
+                            indices_ptr: int, data_ptr: int, data_type: int,
+                            ncol_ptr: int, nelem: int, num_row: int,
+                            predict_type: int, num_iteration: int,
+                            params: str, out_ptr: int) -> int:
+    X = _densify_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr,
+                     data_type, ncol_ptr, nelem, num_row)
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
+
+
+def dataset_add_features_from(dh: int, other_dh: int) -> None:
+    """Merge `other`'s features into `dh` column-wise (reference
+    Dataset::AddFeaturesFrom via LGBM_DatasetAddFeaturesFrom,
+    c_api.h:297): both must be constructed with equal row counts."""
+    a = _get(dh)
+    b = _get(other_dh)
+    a.construct()
+    b.construct()
+    ia, ib = a._inner, b._inner
+    if ia.num_data != ib.num_data:
+        raise ValueError("datasets have different row counts")
+    na = ia.num_total_features
+    n_used_a = len(ia.used_feature_idx)
+    n_used_b = len(ib.used_feature_idx)
+    ia.bins = np.concatenate([ia.bins, ib.bins], axis=1)
+    ia.used_feature_idx = list(ia.used_feature_idx) + \
+        [na + c for c in ib.used_feature_idx]
+    ia.mappers = list(ia.mappers) + list(ib.mappers)
+    ia.feature_names = list(ia.feature_names) + list(ib.feature_names)
+    ia.num_total_features = na + ib.num_total_features
+
+    def _merge_per_used(attr, dtype, fill):
+        va, vb = getattr(ia, attr), getattr(ib, attr)
+        if va is None and vb is None:
+            return
+        if va is None:
+            va = np.full(n_used_a, fill, dtype)
+        if vb is None:
+            vb = np.full(n_used_b, fill, dtype)
+        setattr(ia, attr, np.concatenate([va, vb]))
+
+    _merge_per_used("monotone_constraints", np.int32, 0)
+    _merge_per_used("feature_penalty", np.float32, 1.0)
+    ia._device_bins = None

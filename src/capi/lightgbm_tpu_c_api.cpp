@@ -978,3 +978,68 @@ LGBM_EXPORT int LGBM_DatasetDumpText(DatasetHandle handle,
   drop(r);
   return 0;
 }
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  return LGBM_BoosterPredictForCSR(handle, indptr, indptr_type, indices,
+                                   data, data_type, nindptr, nelem,
+                                   num_col, predict_type, num_iteration,
+                                   parameter, out_len, out_result);
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSC(
+    const void* col_ptr, int col_ptr_type, const int32_t* indices,
+    const void* data, int data_type, int64_t ncol_ptr, int64_t nelem,
+    int64_t num_row, const char* parameters, DatasetHandle reference,
+    DatasetHandle* out) {
+  PyObject* r = call_support(
+      "dataset_create_from_csc", "(LiLLiLLLsL)",
+      reinterpret_cast<long long>(col_ptr), col_ptr_type,
+      reinterpret_cast<long long>(indices),
+      reinterpret_cast<long long>(data), data_type,
+      static_cast<long long>(ncol_ptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_row), parameters, from_handle(reference));
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForCSC(
+    BoosterHandle handle, const void* col_ptr, int col_ptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t ncol_ptr, int64_t nelem, int64_t num_row, int predict_type,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  PyObject* r = call_support(
+      "booster_predict_for_csc", "(LLiLLiLLLiisL)", from_handle(handle),
+      reinterpret_cast<long long>(col_ptr), col_ptr_type,
+      reinterpret_cast<long long>(indices),
+      reinterpret_cast<long long>(data), data_type,
+      static_cast<long long>(ncol_ptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_row), predict_type, num_iteration,
+      parameter, reinterpret_cast<long long>(out_result));
+  if (!r) return -1;
+  bool ok;
+  long long n = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = n;
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
+                                            DatasetHandle source) {
+  PyObject* r = call_support("dataset_add_features_from", "(LL)",
+                             from_handle(target), from_handle(source));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}

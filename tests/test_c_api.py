@@ -501,3 +501,90 @@ class TestCAPIBreadth3:
         lines = open(path).read().splitlines()
         assert lines[0].startswith("num_data: ")
         assert len(lines) == 3 + 1200
+
+
+class TestCAPIBreadth4:
+    """Fourth batch: CSC create/predict, single-row CSR, AddFeaturesFrom."""
+
+    def test_csc_create_and_predict(self, lib, data):
+        import scipy.sparse as sp
+        X, y = data
+        helper = TestCAPIBreadth()
+        _, bh = helper._make_booster(lib, data)
+        Xc = sp.csc_matrix(X[:50])
+        out = np.zeros(50, np.float64)
+        n = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForCSC(
+            bh, Xc.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_INT32),
+            Xc.indices.astype(np.int32).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)),
+            Xc.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_FLOAT64),
+            ctypes.c_int64(len(Xc.indptr)), ctypes.c_int64(Xc.nnz),
+            ctypes.c_int64(50), C_API_PREDICT_NORMAL, -1, b"",
+            ctypes.byref(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        assert n.value == 50
+        dense = np.zeros(50, np.float64)
+        dl = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bh, np.ascontiguousarray(X[:50]).ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64, ctypes.c_int32(50),
+            ctypes.c_int32(X.shape[1]), ctypes.c_int32(1),
+            C_API_PREDICT_NORMAL, -1, b"", ctypes.byref(dl),
+            dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        np.testing.assert_allclose(out, dense, rtol=1e-12)
+        # dataset creation from the same CSC must match the mat dataset size
+        dh = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromCSC(
+            Xc.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_INT32),
+            Xc.indices.astype(np.int32).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)),
+            Xc.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_FLOAT64),
+            ctypes.c_int64(len(Xc.indptr)), ctypes.c_int64(Xc.nnz),
+            ctypes.c_int64(50), b"max_bin=16", None, ctypes.byref(dh)))
+        nd = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(dh, ctypes.byref(nd)))
+        assert nd.value == 50
+
+    def test_csr_single_row(self, lib, data):
+        import scipy.sparse as sp
+        X, y = data
+        helper = TestCAPIBreadth()
+        _, bh = helper._make_booster(lib, data)
+        row = sp.csr_matrix(X[:1])
+        out = np.zeros(1, np.float64)
+        n = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForCSRSingleRow(
+            bh, row.indptr.astype(np.int32).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_INT32),
+            row.indices.astype(np.int32).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)),
+            row.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_FLOAT64),
+            ctypes.c_int64(2), ctypes.c_int64(row.nnz),
+            ctypes.c_int64(X.shape[1]), C_API_PREDICT_NORMAL, -1, b"",
+            ctypes.byref(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        assert n.value == 1 and 0.0 <= out[0] <= 1.0
+
+    def test_add_features_from(self, lib, data):
+        X, y = data
+        a1 = np.ascontiguousarray(X[:, :3])
+        a2 = np.ascontiguousarray(X[:, 3:])
+        handles = []
+        for arr in (a1, a2):
+            h = ctypes.c_void_p()
+            _check(lib, lib.LGBM_DatasetCreateFromMat(
+                arr.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+                ctypes.c_int32(arr.shape[0]), ctypes.c_int32(arr.shape[1]),
+                ctypes.c_int32(1), b"max_bin=32", None, ctypes.byref(h)))
+            handles.append(h)
+        _check(lib, lib.LGBM_DatasetAddFeaturesFrom(handles[0], handles[1]))
+        nf = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumFeature(handles[0],
+                                                  ctypes.byref(nf)))
+        assert nf.value == X.shape[1]
