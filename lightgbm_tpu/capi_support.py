@@ -695,3 +695,27 @@ def dataset_add_features_from(dh: int, other_dh: int) -> None:
     _merge_per_used("monotone_constraints", np.int32, 0)
     _merge_per_used("feature_penalty", np.float32, 1.0)
     ia._device_bins = None
+
+
+def booster_reset_training_data(bh: int, dh: int) -> None:
+    bst = _get(bh)
+    ds = _get(dh)
+    ds.construct()
+    bst._driver.reset_training_data(ds._inner)
+    bst._train_set = ds
+
+
+def booster_predict_for_mats(bh: int, ptrs_ptr: int, data_type: int,
+                             nrows_ptr: int, nmat: int, ncol: int,
+                             predict_type: int, num_iteration: int,
+                             params: str, out_ptr: int) -> int:
+    ptrs = np.ctypeslib.as_array(
+        ctypes.cast(ptrs_ptr, ctypes.POINTER(ctypes.c_uint64)),
+        shape=(nmat,))
+    nrows = np.ctypeslib.as_array(
+        ctypes.cast(nrows_ptr, ctypes.POINTER(ctypes.c_int32)),
+        shape=(nmat,))
+    X = np.vstack([_mat_from_ptr(int(ptrs[i]), data_type, int(nrows[i]),
+                                 ncol, 1)
+                   for i in range(nmat)])
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)

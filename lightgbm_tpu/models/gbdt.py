@@ -200,6 +200,62 @@ class GBDT:
             out["is_pos"] = is_pos
         return out
 
+    def reset_training_data(self, data: TrainingData) -> None:
+        """Swap the training dataset, replaying the existing model onto the
+        new rows (reference GBDT::ResetTrainingData via
+        LGBM_BoosterResetTrainingData, c_api.h:436): bins must come from
+        the same mappers (created with reference=old dataset)."""
+        if self.train_data is None or self.config is None:
+            # file-loaded boosters carry no training context, and their
+            # trees are not bound to bin space — a clear error beats a
+            # late AttributeError (continuation uses init_model instead)
+            raise ValueError(
+                "reset_training_data needs a booster constructed with a "
+                "training dataset; load continuation goes through "
+                "init_model")
+        if data.mappers is not self.train_data.mappers:
+            raise ValueError("new training data must be created with "
+                             "reference=the original dataset")
+        self._materialize()
+        self.train_data = data
+        self.learner = TPUTreeLearner(self.config, data)
+        if self.objective is not None:
+            self.objective.init(data.metadata, data.num_data)
+        self.metrics = create_metrics(
+            self.config, self.objective.name if self.objective else "")
+        for m in self.metrics:
+            m.init(data.metadata, data.num_data)
+        self.train_scores = _ScoreState(self.num_tree_per_iteration,
+                                        data.num_data,
+                                        data.metadata.init_score)
+        K = max(self.num_tree_per_iteration, 1)
+        for k in range(K):
+            trees = [t for i, t in enumerate(self.models)
+                     if i % K == k and t.num_leaves >= 1]
+            if trees:
+                self.train_scores.add(k, jnp.asarray(
+                    (self._replay_scale() * self._score_trees_binned(
+                        data.bins, trees, [1.0] * len(trees)))
+                    .astype(np.float32)))
+        # stale per-dataset state: bagging mask and the fused step close
+        # over the old row count (reference ResetTrainingData rebuilds its
+        # bagging buffers too)
+        self._cached_bag_mask = None
+        self._pending = []
+        self._stopped = False
+        self._bag_cfg = self._bagging_config()
+        self._train_step = None
+        if (self.objective is not None and not self.objective.needs_renew
+                and not self.objective.host_only):
+            self._train_step = self.learner.make_train_step(
+                self.objective.get_gradients, self.shrinkage_rate,
+                self._bag_cfg, self._goss_cfg)
+
+    def _replay_scale(self) -> float:
+        """Scale applied when replaying stored trees onto new data
+        (RF overrides: scores are a running AVERAGE of tree outputs)."""
+        return 1.0
+
     def add_valid(self, data: TrainingData, name: str) -> None:
         if data.mappers is not self.train_data.mappers:
             raise ValueError("validation set must be created with "

@@ -47,6 +47,26 @@ class RF(GBDT):
         self._rf_grad = np.asarray(jax.device_get(g), np.float32)
         self._rf_hess = np.asarray(jax.device_get(h), np.float32)
 
+    def _replay_scale(self) -> float:
+        it = max(self.iter_ + self.num_init_iteration, 1)
+        return 1.0 / it
+
+    def reset_training_data(self, data) -> None:
+        super().reset_training_data(data)
+        # RF keeps FIXED gradients from the constant init scores; they are
+        # per-row and must be re-derived for the new rows (rf.hpp
+        # ResetTrainingData -> Boosting)
+        K = self.num_tree_per_iteration
+        tmp = jnp.asarray(
+            np.repeat(self._rf_init_scores[:, None],
+                      self.train_data.num_data, axis=1).astype(np.float32))
+        g, h = self.objective.get_gradients(tmp)
+        if g.ndim == 1:
+            g, h = g[None, :], h[None, :]
+        self._rf_grad = np.asarray(jax.device_get(g), np.float32)
+        self._rf_hess = np.asarray(jax.device_get(h), np.float32)
+        self._train_step = None  # running-average updates: sync path
+
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if grad is not None or hess is not None:
             raise ValueError("RF mode does not support custom gradients")

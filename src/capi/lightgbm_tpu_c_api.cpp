@@ -1043,3 +1043,38 @@ LGBM_EXPORT int LGBM_DatasetAddFeaturesFrom(DatasetHandle target,
   drop(r);
   return 0;
 }
+
+LGBM_EXPORT int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                              DatasetHandle train_data) {
+  PyObject* r = call_support("booster_reset_training_data", "(LL)",
+                             from_handle(handle), from_handle(train_data));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_BoosterPredictForMats(
+    BoosterHandle handle, const void** data, int data_type, int32_t nrow,
+    int32_t* nrows_per_mat, int32_t nmat, int32_t ncol, int predict_type,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  int64_t total = 0;
+  for (int32_t i = 0; i < nmat; ++i) total += nrows_per_mat[i];
+  if (total != nrow) {
+    set_error("sum of nrows_per_mat does not match nrow");
+    return -1;
+  }
+  PyObject* r = call_support(
+      "booster_predict_for_mats", "(LLiLiiiisL)", from_handle(handle),
+      reinterpret_cast<long long>(data), data_type,
+      reinterpret_cast<long long>(nrows_per_mat), (int)nmat, (int)ncol,
+      predict_type, num_iteration, parameter,
+      reinterpret_cast<long long>(out_result));
+  if (!r) return -1;
+  bool ok;
+  long long n = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out_len = n;
+  return 0;
+}

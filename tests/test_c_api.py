@@ -588,3 +588,63 @@ class TestCAPIBreadth4:
         _check(lib, lib.LGBM_DatasetGetNumFeature(handles[0],
                                                   ctypes.byref(nf)))
         assert nf.value == X.shape[1]
+
+
+class TestCAPIBreadth5:
+    """Fifth batch: reset training data (continued training on new rows),
+    multi-matrix predict."""
+
+    def test_reset_training_data_continues(self, lib, data):
+        X, y = data
+        helper = TestCAPIBreadth()
+        dh, bh = helper._make_booster(lib, data, rounds=3)
+        # new dataset aligned with the old one's mappers
+        new = ctypes.c_void_p()
+        half = np.ascontiguousarray(X[:600])
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            half.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(600), ctypes.c_int32(X.shape[1]),
+            ctypes.c_int32(1), b"max_bin=32", dh, ctypes.byref(new)))
+        yh = np.ascontiguousarray(y[:600])
+        _check(lib, lib.LGBM_DatasetSetField(
+            new, b"label", yh.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(600), C_API_DTYPE_FLOAT32))
+        _check(lib, lib.LGBM_BoosterResetTrainingData(bh, new))
+        fin = ctypes.c_int32()
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bh, ctypes.byref(fin)))
+        total = ctypes.c_int32()
+        _check(lib, lib.LGBM_BoosterNumberOfTotalModel(bh,
+                                                       ctypes.byref(total)))
+        assert total.value == 4
+        n_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterGetNumPredict(bh, 0,
+                                                  ctypes.byref(n_len)))
+        assert n_len.value == 600
+
+    def test_predict_for_mats(self, lib, data):
+        X, y = data
+        helper = TestCAPIBreadth()
+        _, bh = helper._make_booster(lib, data)
+        a = np.ascontiguousarray(X[:30])
+        b = np.ascontiguousarray(X[30:80])
+        ptrs = (ctypes.c_void_p * 2)(a.ctypes.data_as(ctypes.c_void_p),
+                                     b.ctypes.data_as(ctypes.c_void_p))
+        nrows = np.asarray([30, 50], np.int32)
+        out = np.zeros(80, np.float64)
+        n = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMats(
+            bh, ptrs, C_API_DTYPE_FLOAT64, ctypes.c_int32(80),
+            nrows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(2), ctypes.c_int32(X.shape[1]),
+            C_API_PREDICT_NORMAL, -1, b"", ctypes.byref(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        assert n.value == 80
+        dense = np.zeros(80, np.float64)
+        dl = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bh, np.ascontiguousarray(X[:80]).ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64, ctypes.c_int32(80),
+            ctypes.c_int32(X.shape[1]), ctypes.c_int32(1),
+            C_API_PREDICT_NORMAL, -1, b"", ctypes.byref(dl),
+            dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        np.testing.assert_allclose(out, dense, rtol=1e-12)
