@@ -568,17 +568,15 @@ def dataset_update_param(dh: int, params: str) -> None:
     ds.params.update(new)
 
 
-def dataset_create_by_reference(ref_handle: int, num_total_row: int) -> int:
-    """Allocate an empty row buffer aligned with `ref` for streaming
-    construction via PushRows (reference c_api.h:266-311)."""
-    ref = _get(ref_handle)
-    ref.construct()
-    ncol = ref._inner.num_total_features
+def _make_streaming_dataset(reference, num_total_row: int, ncol: int,
+                            params: dict) -> "Dataset":
+    """NaN-filled pending buffer whose rows arrive via PushRows; refuses
+    to construct until every allocated row was pushed (the reference's
+    FinishLoad contract — unpushed rows would silently train as NaN)."""
     buf = np.full((int(num_total_row), ncol), np.nan, np.float64)
-    ds = Dataset(buf, reference=ref, params=dict(ref.params))
+    ds = Dataset(buf, reference=reference, params=params)
     ds._pushed = np.zeros(int(num_total_row), bool)
     ds._pushed_complete = False
-    # constructing with unpushed rows would silently train on NaN rows
     orig_construct = ds.construct
 
     def _guarded_construct():
@@ -589,6 +587,17 @@ def dataset_create_by_reference(ref_handle: int, num_total_row: int) -> int:
         return orig_construct()
 
     ds.construct = _guarded_construct
+    return ds
+
+
+def dataset_create_by_reference(ref_handle: int, num_total_row: int) -> int:
+    """Allocate an empty row buffer aligned with `ref` for streaming
+    construction via PushRows (reference c_api.h:266-311)."""
+    ref = _get(ref_handle)
+    ref.construct()
+    ds = _make_streaming_dataset(ref, num_total_row,
+                                 ref._inner.num_total_features,
+                                 dict(ref.params))
     return _put(ds)
 
 
@@ -719,3 +728,110 @@ def booster_predict_for_mats(bh: int, ptrs_ptr: int, data_type: int,
                                  ncol, 1)
                    for i in range(nmat)])
     return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
+
+
+def booster_refit(bh: int, leaf_preds_ptr: int, nrow: int,
+                  ncol: int) -> None:
+    """Reference LGBM_BoosterRefit (c_api.h:493 -> GBDT::RefitTree):
+    re-fit leaf values on the CURRENT training data given a [nrow, ncol]
+    leaf-assignment matrix (one column per model)."""
+    drv = _get(bh)._driver
+    drv._materialize()
+    if drv.train_data is None:
+        raise ValueError("refit by leaf predictions needs a booster with "
+                         "training data attached")
+    if nrow != drv.train_data.num_data:
+        raise ValueError(f"leaf_preds has {nrow} rows for "
+                         f"{drv.train_data.num_data} training rows")
+    if ncol != len(drv.models):
+        raise ValueError(f"leaf_preds has {ncol} columns for "
+                         f"{len(drv.models)} models")
+    leaf_preds = np.ctypeslib.as_array(
+        ctypes.cast(leaf_preds_ptr, ctypes.POINTER(ctypes.c_int32)),
+        shape=(nrow, ncol)).copy()
+    cfg = drv.config or Config({})
+    obj = drv.objective
+    if obj is None:
+        from .models.objectives import create_objective_from_model_string
+
+        obj = create_objective_from_model_string(
+            drv.loaded_params.get("objective", ""))
+    if obj is None:
+        raise ValueError("cannot refit without an objective")
+    if getattr(obj, "metadata", None) is None:
+        obj.init(drv.train_data.metadata, drv.train_data.num_data)
+    drv._refit_by_leaf_preds(leaf_preds, obj,
+                             float(cfg.refit_decay_rate), cfg)
+
+
+def dataset_push_rows_by_csr(dh: int, indptr_ptr: int, indptr_type: int,
+                             indices_ptr: int, data_ptr: int,
+                             data_type: int, nindptr: int, nelem: int,
+                             num_col: int, start_row: int) -> None:
+    ds = _get(dh)
+    if ds._inner is not None:
+        raise RuntimeError("cannot push rows after construction")
+    block = _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                         data_type, nindptr, nelem, num_col)
+    nrow = block.shape[0]
+    ds.data[start_row:start_row + nrow, :] = block
+    ds._pushed[start_row:start_row + nrow] = True
+    if bool(ds._pushed.all()):
+        ds._pushed_complete = True
+
+
+def dataset_create_from_sampled_column(sample_ptrs: int, indices_ptrs: int,
+                                       ncol: int, num_per_col_ptr: int,
+                                       num_sample_row: int,
+                                       num_total_row: int,
+                                       params: str) -> int:
+    """Reference LGBM_DatasetCreateFromSampledColumn (c_api.h:69):
+    mappers from per-column value samples, rows pushed afterwards.
+    Unsampled entries are zero, like the reference's sparse sampling."""
+    sp = np.ctypeslib.as_array(
+        ctypes.cast(sample_ptrs, ctypes.POINTER(ctypes.c_uint64)),
+        shape=(ncol,))
+    ip = np.ctypeslib.as_array(
+        ctypes.cast(indices_ptrs, ctypes.POINTER(ctypes.c_uint64)),
+        shape=(ncol,))
+    counts = np.ctypeslib.as_array(
+        ctypes.cast(num_per_col_ptr, ctypes.POINTER(ctypes.c_int32)),
+        shape=(ncol,))
+    sample = np.zeros((int(num_sample_row), int(ncol)), np.float64)
+    for c in range(int(ncol)):
+        m = int(counts[c])
+        if m == 0:
+            continue
+        vals = _vec_from_ptr(int(sp[c]), DTYPE_FLOAT64, m)
+        rows = _vec_from_ptr(int(ip[c]), DTYPE_INT32, m).astype(np.int64)
+        sample[rows, c] = vals
+    p = _params_dict(params)
+    # mapper donor found ONCE on the sample, the near-unsplittable filter
+    # scaled against the FULL row count; constraints derive from the
+    # donor's own used-feature set, so nothing is swapped post-hoc
+    from .io.dataset import Metadata, TrainingData, _parse_column_spec
+
+    donor_td = TrainingData()
+    donor_td.config = Config(p)
+    donor_td.num_data = int(num_sample_row)
+    donor_td.num_total_features = int(ncol)
+    donor_td.feature_names = [f"Column_{i}" for i in range(int(ncol))]
+    cat = _parse_column_spec(donor_td.config.categorical_feature,
+                             donor_td.feature_names)
+    donor_td._find_mappers(sample, donor_td.config, cat or [], {},
+                           total_rows=int(num_total_row))
+    donor_td._set_constraints(donor_td.config)
+    donor_td.metadata = Metadata(int(num_sample_row))
+    donor = Dataset.__new__(Dataset)
+    donor.data = None
+    donor.label = None
+    donor.reference = None
+    donor.weight = donor.group = donor.init_score = None
+    donor.feature_name = "auto"
+    donor.categorical_feature = p.get("categorical_feature", "auto")
+    donor.params = dict(p)
+    donor.free_raw_data = True
+    donor.used_indices = None
+    donor._inner = donor_td
+    ds = _make_streaming_dataset(donor, int(num_total_row), int(ncol), p)
+    return _put(ds)

@@ -678,7 +678,6 @@ class GBDT:
             X = X[None, :]
         n = X.shape[0]
         leaf_preds = self.predict(X, pred_leaf=True)       # [n, T]
-        k = self.num_tree_per_iteration
 
         from ..io.dataset import Metadata
 
@@ -693,6 +692,16 @@ class GBDT:
             raise ValueError("cannot refit without an objective")
         obj.init(md, n)
 
+        self._refit_by_leaf_preds(leaf_preds, obj, decay_rate, cfg)
+
+    def _refit_by_leaf_preds(self, leaf_preds: np.ndarray, obj,
+                             decay_rate: float, cfg: Config) -> None:
+        """Shared RefitTree core: per iteration take gradients at the
+        running refit scores and re-fit each tree's leaf values from the
+        given [n, T] leaf assignment (reference gbdt.cpp:298 +
+        FitByExistingTree)."""
+        n = leaf_preds.shape[0]
+        k = self.num_tree_per_iteration
         l1 = float(cfg.lambda_l1)
         l2 = float(cfg.lambda_l2)
         mds = float(cfg.max_delta_step)

@@ -1078,3 +1078,106 @@ LGBM_EXPORT int LGBM_BoosterPredictForMats(
   *out_len = n;
   return 0;
 }
+
+LGBM_EXPORT int LGBM_BoosterRefit(BoosterHandle handle,
+                                  const int32_t* leaf_preds, int32_t nrow,
+                                  int32_t ncol) {
+  PyObject* r = call_support("booster_refit", "(LLii)", from_handle(handle),
+                             reinterpret_cast<long long>(leaf_preds),
+                             (int)nrow, (int)ncol);
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetPushRowsByCSR(
+    DatasetHandle dataset, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int64_t start_row) {
+  PyObject* r = call_support(
+      "dataset_push_rows_by_csr", "(LLiLLiLLLL)", from_handle(dataset),
+      reinterpret_cast<long long>(indptr), indptr_type,
+      reinterpret_cast<long long>(indices),
+      reinterpret_cast<long long>(data), data_type,
+      static_cast<long long>(nindptr), static_cast<long long>(nelem),
+      static_cast<long long>(num_col), static_cast<long long>(start_row));
+  if (!r) return -1;
+  drop(r);
+  return 0;
+}
+
+LGBM_EXPORT int LGBM_DatasetCreateFromSampledColumn(
+    double** sample_data, int** sample_indices, int32_t ncol,
+    const int* num_per_col, int32_t num_sample_row, int32_t num_total_row,
+    const char* parameters, DatasetHandle* out) {
+  PyObject* r = call_support(
+      "dataset_create_from_sampled_column", "(LLiLiis)",
+      reinterpret_cast<long long>(sample_data),
+      reinterpret_cast<long long>(sample_indices), (int)ncol,
+      reinterpret_cast<long long>(num_per_col), (int)num_sample_row,
+      (int)num_total_row, parameters);
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}
+
+// The reference's CSRFunc contract passes a pointer to a C++
+// std::function<void(int idx, std::vector<std::pair<int, double>>&)>
+// (reference src/c_api.cpp:768) — a C++-ABI-only entry used by the SWIG
+// wrapper.  Drive the callback row by row into a CSR buffer, then share
+// the CSR creation path.
+#include <functional>
+#include <utility>
+#include <vector>
+
+LGBM_EXPORT int LGBM_DatasetCreateFromCSRFunc(void* get_row_funptr,
+                                              int num_rows, int64_t num_col,
+                                              const char* parameters,
+                                              const DatasetHandle reference,
+                                              DatasetHandle* out) {
+  if (num_col <= 0) {
+    set_error("the number of columns should be greater than zero");
+    return -1;
+  }
+  auto& get_row = *static_cast<
+      std::function<void(int, std::vector<std::pair<int, double>>&)>*>(
+      get_row_funptr);
+  std::vector<int32_t> indptr{0};
+  std::vector<int32_t> indices;
+  std::vector<double> values;
+  std::vector<std::pair<int, double>> row;
+  for (int i = 0; i < num_rows; ++i) {
+    row.clear();
+    get_row(i, row);
+    for (const auto& kv : row) {
+      indices.push_back(static_cast<int32_t>(kv.first));
+      values.push_back(kv.second);
+    }
+    indptr.push_back(static_cast<int32_t>(indices.size()));
+  }
+  // numpy rejects NULL even for zero-length views: keep the pointers
+  // non-null when the callback produced no pairs at all
+  static int32_t dummy_idx = 0;
+  static double dummy_val = 0.0;
+  const int32_t* idx_p = indices.empty() ? &dummy_idx : indices.data();
+  const double* val_p = values.empty() ? &dummy_val : values.data();
+  PyObject* r = call_support(
+      "dataset_create_from_csr", "(LiLLiLLLsL)",
+      reinterpret_cast<long long>(indptr.data()), 2 /*int32*/,
+      reinterpret_cast<long long>(idx_p),
+      reinterpret_cast<long long>(val_p), 1 /*float64*/,
+      static_cast<long long>(indptr.size()),
+      static_cast<long long>(indices.size()),
+      static_cast<long long>(num_col), parameters, from_handle(reference));
+  if (!r) return -1;
+  bool ok;
+  long long h = as_int(r, &ok);
+  drop(r);
+  if (!ok) return -1;
+  *out = to_handle(h);
+  return 0;
+}

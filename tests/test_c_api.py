@@ -648,3 +648,84 @@ class TestCAPIBreadth5:
             C_API_PREDICT_NORMAL, -1, b"", ctypes.byref(dl),
             dense.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
         np.testing.assert_allclose(out, dense, rtol=1e-12)
+
+
+class TestCAPIBreadth6:
+    """Final batch: leaf-pred refit, CSR row push, sampled-column
+    creation, std::function CSR callback."""
+
+    def test_refit_by_leaf_preds(self, lib, data):
+        X, y = data
+        helper = TestCAPIBreadth()
+        dh, bh = helper._make_booster(lib, data, rounds=4)
+        total = ctypes.c_int32()
+        _check(lib, lib.LGBM_BoosterNumberOfTotalModel(bh,
+                                                       ctypes.byref(total)))
+        # leaf assignment of the training rows under the current model
+        leaves = np.zeros((len(y), total.value), np.float64)
+        ll = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bh, np.ascontiguousarray(X).ctypes.data_as(ctypes.c_void_p),
+            C_API_DTYPE_FLOAT64, ctypes.c_int32(len(y)),
+            ctypes.c_int32(X.shape[1]), ctypes.c_int32(1),
+            2, -1, b"", ctypes.byref(ll),  # 2 = leaf-index predict
+            leaves.ctypes.data_as(ctypes.POINTER(ctypes.c_double))))
+        lp = np.ascontiguousarray(leaves.astype(np.int32))
+        v0 = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(bh, 0, 0,
+                                                 ctypes.byref(v0)))
+        _check(lib, lib.LGBM_BoosterRefit(
+            bh, lp.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(len(y)), ctypes.c_int32(total.value)))
+        v1 = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(bh, 0, 0,
+                                                 ctypes.byref(v1)))
+        assert v0.value != v1.value  # decay-blended toward the refit value
+
+    def test_push_rows_by_csr(self, lib, data):
+        import scipy.sparse as sp
+        X, y = data
+        helper = TestCAPIBreadth()
+        ref_dh, _ = helper._make_booster(lib, data)
+        out = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateByReference(
+            ref_dh, ctypes.c_int64(90), ctypes.byref(out)))
+        blk = sp.csr_matrix(X[:90])
+        _check(lib, lib.LGBM_DatasetPushRowsByCSR(
+            out, blk.indptr.astype(np.int32).ctypes.data_as(
+                ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_INT32),
+            blk.indices.astype(np.int32).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_int32)),
+            blk.data.astype(np.float64).ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int32(C_API_DTYPE_FLOAT64),
+            ctypes.c_int64(len(blk.indptr)), ctypes.c_int64(blk.nnz),
+            ctypes.c_int64(X.shape[1]), ctypes.c_int64(0)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(out, ctypes.byref(n)))
+        assert n.value == 90
+
+    def test_create_from_sampled_column(self, lib, data):
+        X, y = data
+        ncol = X.shape[1]
+        nsample = 300
+        cols = [np.ascontiguousarray(X[:nsample, c]) for c in range(ncol)]
+        idxs = [np.arange(nsample, dtype=np.int32) for _ in range(ncol)]
+        col_ptrs = (ctypes.c_void_p * ncol)(
+            *[c.ctypes.data_as(ctypes.c_void_p) for c in cols])
+        idx_ptrs = (ctypes.c_void_p * ncol)(
+            *[i.ctypes.data_as(ctypes.c_void_p) for i in idxs])
+        counts = np.full(ncol, nsample, np.int32)
+        out = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromSampledColumn(
+            col_ptrs, idx_ptrs, ctypes.c_int32(ncol),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(nsample), ctypes.c_int32(500), b"max_bin=32",
+            ctypes.byref(out)))
+        blk = np.ascontiguousarray(X[:500])
+        _check(lib, lib.LGBM_DatasetPushRows(
+            out, blk.ctypes.data_as(ctypes.c_void_p), C_API_DTYPE_FLOAT64,
+            ctypes.c_int32(500), ctypes.c_int32(ncol), ctypes.c_int32(0)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(out, ctypes.byref(n)))
+        assert n.value == 500
