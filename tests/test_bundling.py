@@ -71,7 +71,8 @@ class TestBundledTraining:
         params = {"objective": "binary", "num_leaves": 31,
                   "min_data_in_leaf": 10, "max_bin": 63}
         ds1 = lgb.Dataset(X, label=y)
-        b1 = lgb.train(params, ds1, num_boost_round=15, verbose_eval=False)
+        b1 = lgb.train(params, ds1, num_boost_round=15, verbose_eval=False,
+                       keep_training_booster=True)
         ds2 = lgb.Dataset(X, label=y)
         b2 = lgb.train({**params, "enable_bundle": False}, ds2,
                        num_boost_round=15, verbose_eval=False)
@@ -99,6 +100,7 @@ class TestBundledTraining:
         y = (X[:, 0] > 0).astype(float)
         ds = lgb.Dataset(X, label=y)
         bst = lgb.train({"objective": "binary", "num_leaves": 15},
-                        ds, num_boost_round=2, verbose_eval=False)
+                        ds, num_boost_round=2, verbose_eval=False,
+                        keep_training_booster=True)
         lrn = bst._driver.learner
         assert lrn.num_columns == lrn.num_features
