@@ -164,17 +164,22 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     #   "bf16"   - single bf16 stats pass (fastest, lossy)
     #   "f32"    - full f32 dots (XLA 'highest' precision)
     "tpu_hist_precision": ("str", "hilo", ("hist_precision",)),
-    # rows per histogram scan block (device-side); tuned for VMEM/HBM balance
-    "tpu_block_rows": ("int", 16384, ()),
+    # rows per histogram scan block (device-side); 0 = auto (256 for the
+    # pallas backend — its VMEM-resident accumulator wants short blocks —
+    # 16384 for the xla scan, tuned for HBM streaming)
+    "tpu_block_rows": ("int", 0, ()),
     # leaves split per grower round: >1 batches histogram work onto the MXU
     # (K*5 stat lanes -> 128-lane systolic tiles); 1 = strict reference
-    # best-first split order for parity runs; 0 = auto (num_leaves/16,
-    # capped at 25 so K*5 fills exactly one 128-lane tile): batching stays
-    # a small fraction of the frontier, so the split order tracks strict
-    # best-first closely even while histogramming K leaves per pass
+    # best-first split order for parity runs; 0 = auto (1 below 32 leaves,
+    # num_leaves/16 up to 192, then 25 so K*5 fills one 128-lane tile):
+    # batching stays a small fraction of the frontier, so the split order
+    # tracks strict best-first closely even while histogramming K leaves
+    # per pass
     "tpu_split_batch": ("int", 0, ()),
-    # batched-histogram backend: xla | pallas
-    "tpu_hist_impl": ("str", "xla", ()),
+    # batched-histogram backend: auto | xla | pallas.  auto picks pallas on
+    # TPU when the kernel's VMEM working set fits (measured 1.9x over the
+    # xla scan on Higgs-1M: the one-hot never round-trips to HBM), else xla
+    "tpu_hist_impl": ("str", "auto", ()),
     # f64 histogram accumulation everywhere (requires x64): serial and
     # data-parallel split decisions become reduction-order independent,
     # like the reference f64 HistogramBinEntry (bin.h:33-40)

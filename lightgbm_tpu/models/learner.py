@@ -95,18 +95,12 @@ class TPUTreeLearner:
         self.n_shards = n_shards if strategy != "serial" else 1
 
         for key, allowed in (("tpu_partition_impl", ("select", "gather")),
-                             ("tpu_hist_impl", ("xla", "pallas"))):
+                             ("tpu_hist_impl", ("auto", "xla", "pallas"))):
             if str(getattr(config, key)) not in allowed:
                 raise ValueError(f"{key}={getattr(config, key)!r}; "
                                  f"expected one of {allowed}")
 
-        block = int(config.tpu_block_rows)
-        if strategy in ("data", "voting"):
-            # every shard holds an equal, whole number of histogram blocks
-            shard = pad_rows((n + self.n_shards - 1) // self.n_shards, block)
-            self.n_pad = shard * self.n_shards
-        else:
-            self.n_pad = pad_rows(n, block)
+        precision = self._resolve_precision(config)
 
         # feature axis padded to a multiple of the shard count; padding
         # features are trivial (num_bin=1) and can never split
@@ -154,6 +148,22 @@ class TPUTreeLearner:
         self.num_columns = cols_src.shape[1]
         self.g_pad = self.num_columns if strategy != "feature" else self.f_pad
 
+        # impl/block resolution happens HERE, once, with the final
+        # histogram shape: bundling above only needs the host bin matrix,
+        # while the padded row count below depends on the resolved block.
+        # Feature-parallel shards the histogram feature axis, so the VMEM
+        # fit is judged per shard
+        g_fit = (self.g_pad // self.n_shards if strategy == "feature"
+                 else self.g_pad)
+        hist_impl, block = self._resolve_hist_impl(config, B, g_fit,
+                                                   precision)
+        if strategy in ("data", "voting"):
+            # every shard holds an equal, whole number of histogram blocks
+            shard = pad_rows((n + self.n_shards - 1) // self.n_shards, block)
+            self.n_pad = shard * self.n_shards
+        else:
+            self.n_pad = pad_rows(n, block)
+
         # transposed [G, n] bin matrix: rows ride the 128-lane minor axis
         # for the histogram contraction (see ops/histogram.py).  Stored
         # uint8 when bins fit (the reference's narrow dense bins,
@@ -200,7 +210,7 @@ class TPUTreeLearner:
             num_bins=B,
             block_rows=min(block, self.n_pad // self.n_shards
                            if strategy in ("data", "voting") else self.n_pad),
-            precision=self._resolve_precision(config),
+            precision=precision,
             l1=float(config.lambda_l1),
             l2=float(config.lambda_l2),
             max_delta_step=float(config.max_delta_step),
@@ -222,7 +232,7 @@ class TPUTreeLearner:
             cegb_tradeoff=float(config.cegb_tradeoff),
             cegb_penalty_split=float(config.cegb_penalty_split),
             forced=forced,
-            hist_impl=str(config.tpu_hist_impl),
+            hist_impl=hist_impl,
             partition_impl=str(config.tpu_partition_impl),
             has_bundles=plan is not None,
         )
@@ -232,6 +242,47 @@ class TPUTreeLearner:
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_hist_impl(config: Config, num_bins: int, num_features: int,
+                           precision: str) -> Tuple[str, int]:
+        """Resolve (tpu_hist_impl, tpu_block_rows), honoring "auto"/0.
+
+        The pallas kernel keeps the [F*B, block] one-hot and the
+        [F*B, K*S] f32 accumulator resident in VMEM (~16 MB usable), so
+        auto picks it on TPU only when that working set fits at its short
+        256-row block; everywhere else (CPU tests, f64 deterministic mode,
+        very wide F*B) the xla scan at streaming-sized blocks wins.
+        Measured on v5e Higgs-1M (docs/PERF_NOTES.md): pallas/256 1.93
+        it/s vs xla/16384 1.23 it/s at K=25.
+        """
+        impl = str(config.tpu_hist_impl)
+        block = int(config.tpu_block_rows)
+        if impl == "auto":
+            pl_block = block if block > 0 else 256
+            leaves = max(int(config.num_leaves), 2)
+            k = min(resolve_split_batch(int(config.tpu_split_batch), leaves),
+                    leaves - 1)  # the grower's own clamp (make_grower)
+            s = 5 if precision == "hilo" else 3
+            fb = num_features * num_bins
+            ks_pad = -(-(k * s) // 128) * 128
+            # one-hot [fb, block] in the dot dtype + f32 accumulator/out
+            oh_bytes = 4 if precision == "f32" else 2
+            vmem = fb * pl_block * oh_bytes + 2 * fb * ks_pad * 4
+            # Mosaic constraints: lane-aligned blocks only, and blocks
+            # beyond 256 rows are unvalidated compile territory
+            # (docs/PERF_NOTES.md: block=512 never finished compiling)
+            block_ok = pl_block <= 256 and pl_block % 128 == 0
+            on_tpu = jax.devices()[0].platform == "tpu"
+            fits = vmem <= 12 * 1024 * 1024
+            # f32/f64 stay on xla: auto only picks the validated bf16/hilo
+            # kernel shape (explicit tpu_hist_impl=pallas still honors f32
+            # via Precision.HIGHEST in _hist_pallas)
+            impl = ("pallas" if on_tpu and fits and block_ok
+                    and precision in ("hilo", "bf16") else "xla")
+        if block <= 0:
+            block = 256 if impl == "pallas" else 16384
+        return impl, block
+
     @staticmethod
     def _resolve_precision(config: Config) -> str:
         """Histogram precision, honoring deterministic mode.

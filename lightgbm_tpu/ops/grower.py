@@ -145,14 +145,17 @@ def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
     K trades MXU utilization (bigger contraction N axis) against split-order
     fidelity: each round splits the top-K frontier leaves at once, so
     keeping K a small fraction of num_leaves means only the very top of the
-    frontier is batched and the order stays close to strict best-first
-    (measured: K=3 at 31 leaves already costs ~0.05 multiclass logloss).
-    Capped at 25: 25 slots x 5 hilo stat rows = 125 -> one padded 128-lane
-    MXU tile.
+    frontier is batched and the order stays close to strict best-first.
+    Measured anchors: K=3 at 31 leaves already costs ~0.05 multiclass
+    logloss (small trees cannot absorb batching), while at 255 leaves K=15
+    and K=25 train to identical Higgs AUC (0.8268/0.8269,
+    docs/PERF_NOTES.md) and K=25 is 1.3x faster — so small trees stay
+    strictly sequential and only wide trees ride the full 128-lane MXU
+    tile (25 slots x 5 hilo stat rows = 125).
     """
     if split_batch > 0:
         return split_batch
-    return max(1, min(25, num_leaves // 16))
+    return max(1, num_leaves // 16) if num_leaves < 192 else 25
 
 
 def make_grower(params: GrowerParams, num_features: int,
@@ -450,8 +453,18 @@ def make_grower(params: GrowerParams, num_features: int,
         S = stats.shape[0]
         bins_blocks = jnp.moveaxis(bins_hist_t.reshape(G, nb, block), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
-        root_hist = preduce_hist(
-            build_histogram_t(bins_blocks, stats_blocks, B, precision))
+        if params.hist_impl == "pallas":
+            # reuse the batched VMEM kernel (slot 0 = the all-zero root
+            # leaf ids): the xla scan at pallas-sized short blocks would
+            # round-trip a materialized one-hot per block through HBM
+            root_slots = jnp.full(K, -1, jnp.int32).at[0].set(0)
+            root_hist = preduce_hist(build_histogram_batched_t(
+                bins_blocks, stats_blocks,
+                jnp.zeros((nb, block), jnp.int32), root_slots, B,
+                precision, impl="pallas")[0])
+        else:
+            root_hist = preduce_hist(
+                build_histogram_t(bins_blocks, stats_blocks, B, precision))
         big = jnp.float32(1e30)
         if bynode:
             key, k_root = jax.random.split(key)

@@ -200,6 +200,8 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
     K = slot_leaf_ids.shape[0]
     B = num_bins
     dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+    dot_prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+                else jax.lax.Precision.DEFAULT)
 
     def kernel(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
         i = pl.program_id(0)
@@ -217,7 +219,7 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
         sexp = sexp.reshape(K * S, block)
         acc = jax.lax.dot_general(
             onehot, sexp, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            precision=dot_prec, preferred_element_type=jnp.float32)
 
         @pl.when(i == 0)
         def _():

@@ -234,6 +234,26 @@ class TestBatchedHistogramImpls:
     """xla and pallas backends of the batched kernel must agree bit-for-bit
     (pallas runs in interpret mode on CPU)."""
 
+    def test_grower_pallas_matches_xla_end_to_end(self):
+        """Whole-tree growth (root pass + every batched round) through the
+        pallas backend must reproduce the xla backend's model exactly."""
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(1024, 5))
+        y = X[:, 0] - 2 * X[:, 1] + 0.1 * rng.normal(size=1024)
+
+        def dump(impl):
+            params = {"objective": "regression", "num_leaves": 15,
+                      "min_data_in_leaf": 5, "max_bin": 32,
+                      "tpu_hist_impl": impl, "tpu_block_rows": 256,
+                      "verbosity": -1}
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+            bst = lgb.train(params, ds, num_boost_round=3,
+                            verbose_eval=False)
+            return bst.model_to_string().split("parameters", 1)[0]
+
+        assert dump("pallas") == dump("xla")
+
     def test_pallas_matches_xla(self):
         from lightgbm_tpu.ops.histogram import (build_histogram_batched_t,
                                                 pack_stats)
