@@ -283,3 +283,52 @@ class TestBatchedHistogramImpls:
                                        slots, B, "hilo", impl="pallas")
         np.testing.assert_array_equal(np.asarray(a), np.asarray(a8))
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b8))
+
+
+class TestAutoHistResolution:
+    """tpu_hist_impl=auto / tpu_block_rows=0 resolution (models/learner.py
+    _resolve_hist_impl): platform- and VMEM-aware backend choice."""
+
+    def _resolve(self, **params):
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.models.learner import TPUTreeLearner
+        cfg = Config({"objective": "binary", **params})
+        prec = params.get("tpu_hist_precision", "hilo")
+        return TPUTreeLearner._resolve_hist_impl(
+            cfg, params.get("_bins", 255), params.get("_features", 28), prec)
+
+    def test_cpu_auto_is_xla_streaming(self):
+        # tests pin the cpu backend -> auto must never pick pallas here
+        impl, block = self._resolve(num_leaves=255)
+        assert impl == "xla"
+        assert block == 16384
+
+    def test_explicit_impl_and_block_pass_through(self):
+        impl, block = self._resolve(tpu_hist_impl="pallas",
+                                    tpu_block_rows=128)
+        assert (impl, block) == ("pallas", 128)
+        impl, block = self._resolve(tpu_hist_impl="xla")
+        assert (impl, block) == ("xla", 16384)
+
+    def test_pallas_auto_block_defaults_to_256(self):
+        impl, block = self._resolve(tpu_hist_impl="pallas")
+        assert (impl, block) == ("pallas", 256)
+
+    def test_auto_vmem_branch_on_faked_tpu(self, monkeypatch):
+        # exercise the auto branch's VMEM arithmetic by faking the platform
+        class _Dev:
+            platform = "tpu"
+        monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
+        # Higgs shape fits -> pallas at the 256-row block
+        impl, block = self._resolve(num_leaves=255)
+        assert (impl, block) == ("pallas", 256)
+        # a huge F*B working set must fall back to the xla scan
+        impl, block = self._resolve(num_leaves=255, _features=4096)
+        assert (impl, block) == ("xla", 16384)
+        # f32 stays on the xla Precision.HIGHEST path in auto mode
+        impl, block = self._resolve(num_leaves=255,
+                                    tpu_hist_precision="f32")
+        assert impl == "xla"
+        # explicit non-lane-aligned block disables the pallas auto pick
+        impl, block = self._resolve(num_leaves=255, tpu_block_rows=192)
+        assert (impl, block) == ("xla", 192)

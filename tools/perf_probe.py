@@ -31,7 +31,7 @@ _DS_CACHE = {}
 
 
 def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
-            partition="select"):
+            partition="select", precision="hilo"):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.backend import host_sync
     from sklearn.metrics import roc_auc_score
@@ -45,7 +45,8 @@ def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
         "objective": "binary", "num_leaves": leaves, "learning_rate": 0.1,
         "min_data_in_leaf": 20, "max_bin": bins, "tpu_split_batch": k,
         "tpu_block_rows": block, "tpu_hist_impl": impl,
-        "tpu_partition_impl": partition}, train_set=ds)
+        "tpu_partition_impl": partition,
+        "tpu_hist_precision": precision}, train_set=ds)
     t0 = time.time()
     bst.update()
     host_sync(bst._driver.train_scores.scores)
@@ -59,56 +60,68 @@ def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
     return ms, compile_s, auc
 
 
+def sweep(X, y, configs, iters=6):
+    """Run a list of config dicts through run_one, printing one line each."""
+    for cfg in configs:
+        label = " ".join(f"{k}={v}" for k, v in cfg.items())
+        try:
+            ms, cs, auc = run_one(X, y, cfg.get("k", 25),
+                                  cfg.get("block", 16384),
+                                  cfg.get("impl", "xla"), iters=iters,
+                                  partition=cfg.get("part", "select"),
+                                  precision=cfg.get("prec", "hilo"))
+            print(f"{label}: {ms:6.0f} ms/tree ({1000/ms:5.2f} it/s) "
+                  f"compile {cs:5.0f}s auc {auc:.4f}", flush=True)
+        except Exception as exc:
+            print(f"{label}: FAILED {type(exc).__name__}: {str(exc)[:150]}",
+                  flush=True)
+
+
 def main():
     n = int(os.environ.get("N", 1_000_000))
     X, y = make_data(n)
-    if len(sys.argv) > 1 and sys.argv[1] == "one":
-        k = int(os.environ.get("K", 25))
-        block = int(os.environ.get("BLOCK", 16384))
-        impl = os.environ.get("IMPL", "xla")
-        part = os.environ.get("PARTITION", "select")
-        ms, cs, auc = run_one(X, y, k, block, impl, partition=part)
-        print(f"K={k} block={block} impl={impl} part={part}: "
-              f"{ms:.0f} ms/tree ({1000/ms:.2f} it/s) compile {cs:.0f}s "
-              f"auc {auc:.4f}")
+    arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "one":
+        sweep(X, y, [dict(k=int(os.environ.get("K", 25)),
+                          block=int(os.environ.get("BLOCK", 16384)),
+                          impl=os.environ.get("IMPL", "xla"),
+                          part=os.environ.get("PARTITION", "select"),
+                          prec=os.environ.get("PRECISION", "hilo"))],
+              iters=8)
         return
-    if len(sys.argv) > 1 and sys.argv[1] == "decide":
+    if arg == "round2":
+        # post-pallas leverage sweep (docs/PERF_NOTES.md "next
+        # experiments"): S=3 bf16 stats widen K at the same tile width;
+        # bigger K cuts rounds per tree
+        sweep(X, y, [
+            dict(k=25, block=256, impl="pallas", prec="hilo"),  # re-baseline
+            dict(k=42, block=256, impl="pallas", prec="bf16"),  # 1 tile, S=3
+            dict(k=25, block=256, impl="pallas", prec="bf16"),
+            dict(k=50, block=256, impl="pallas", prec="hilo"),  # 2 tiles
+            dict(k=50, block=256, impl="pallas", prec="bf16"),
+            dict(k=84, block=256, impl="pallas", prec="bf16"),  # ~6 rounds
+            dict(k=25, block=128, impl="pallas", prec="hilo"),
+        ])
+        return
+    if arg == "decide":
         # the post-outage decision sweep: partition A/B at default K, then
         # K scaling, then the pallas backend at a VMEM-sized block
-        for part, k, block, impl in (
-                ("gather", 15, 16384, "xla"),
-                ("select", 15, 16384, "xla"),
-                ("select", 25, 16384, "xla"),
-                ("select", 50, 16384, "xla"),
-                ("select", 25, 65536, "xla"),
-                # pallas: [F*B, block] bf16 one-hot + [F*B, K*S] f32
-                # accumulator must fit ~16MB VMEM -> block <= 512 at K=25
-                ("select", 25, 256, "pallas"),
-                ("select", 25, 512, "pallas"),
-                ("select", 12, 512, "pallas")):
-            try:
-                ms, cs, auc = run_one(X, y, k, block, impl, iters=6,
-                                      partition=part)
-                print(f"part={part:6s} K={k:2d} block={block:6d} "
-                      f"impl={impl:6s}: {ms:6.0f} ms/tree "
-                      f"({1000/ms:5.2f} it/s) compile {cs:5.0f}s "
-                      f"auc {auc:.4f}", flush=True)
-            except Exception as exc:
-                print(f"part={part} K={k} block={block} impl={impl}: "
-                      f"FAILED {type(exc).__name__}: {str(exc)[:150]}",
-                      flush=True)
+        sweep(X, y, [
+            dict(part="gather", k=15, block=16384, impl="xla"),
+            dict(part="select", k=15, block=16384, impl="xla"),
+            dict(part="select", k=25, block=16384, impl="xla"),
+            dict(part="select", k=50, block=16384, impl="xla"),
+            dict(part="select", k=25, block=65536, impl="xla"),
+            # pallas: [F*B, block] bf16 one-hot + [F*B, K*S] f32
+            # accumulator must fit ~16MB VMEM -> block <= 512 at K=25
+            dict(part="select", k=25, block=256, impl="pallas"),
+            dict(part="select", k=25, block=512, impl="pallas"),
+            dict(part="select", k=12, block=512, impl="pallas"),
+        ])
         return
-    for impl in ("xla", "pallas"):
-        for k in (16, 25):
-            for block in (16384, 65536):
-                try:
-                    ms, cs, auc = run_one(X, y, k, block, impl, iters=5)
-                    print(f"impl={impl:6s} K={k:2d} block={block:6d}: "
-                          f"{ms:6.0f} ms/tree ({1000/ms:5.2f} it/s) "
-                          f"compile {cs:5.0f}s auc {auc:.4f}", flush=True)
-                except Exception as exc:
-                    print(f"impl={impl} K={k} block={block}: FAILED {exc}",
-                          flush=True)
+    sweep(X, y, [dict(impl=i, k=k, block=b)
+                 for i in ("xla", "pallas") for k in (16, 25)
+                 for b in (16384, 65536)], iters=5)
 
 
 if __name__ == "__main__":
