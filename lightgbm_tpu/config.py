@@ -176,9 +176,11 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # tracks strict best-first closely even while histogramming K leaves
     # per pass
     "tpu_split_batch": ("int", 0, ()),
-    # batched-histogram backend: auto | xla | pallas.  auto picks pallas on
-    # TPU when the kernel's VMEM working set fits (measured 1.9x over the
-    # xla scan on Higgs-1M: the one-hot never round-trips to HBM), else xla
+    # batched-histogram backend: auto | xla | pallas | pallas2.  auto picks
+    # the hardware-validated pallas kernel on TPU when its VMEM working set
+    # fits (measured 1.9x over the xla scan on Higgs-1M: the one-hot never
+    # round-trips to HBM), else xla.  pallas2 = per-feature one-hot variant
+    # running 2-8k-row blocks (experimental until timed on hardware)
     "tpu_hist_impl": ("str", "auto", ()),
     # f64 histogram accumulation everywhere (requires x64): serial and
     # data-parallel split decisions become reduction-order independent,

@@ -95,7 +95,7 @@ class TPUTreeLearner:
         self.n_shards = n_shards if strategy != "serial" else 1
 
         for key, allowed in (("tpu_partition_impl", ("select", "gather")),
-                             ("tpu_hist_impl", ("auto", "xla", "pallas"))):
+                             ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2"))):
             if str(getattr(config, key)) not in allowed:
                 raise ValueError(f"{key}={getattr(config, key)!r}; "
                                  f"expected one of {allowed}")
@@ -275,12 +275,12 @@ class TPUTreeLearner:
             on_tpu = jax.devices()[0].platform == "tpu"
             fits = vmem <= 12 * 1024 * 1024
             # f32/f64 stay on xla: auto only picks the validated bf16/hilo
-            # kernel shape (explicit tpu_hist_impl=pallas still honors f32
-            # via Precision.HIGHEST in _hist_pallas)
+            # kernel shape (an explicit tpu_hist_impl=pallas/pallas2 still
+            # honors f32 via Precision.HIGHEST inside _hist_pallas)
             impl = ("pallas" if on_tpu and fits and block_ok
                     and precision in ("hilo", "bf16") else "xla")
         if block <= 0:
-            block = 256 if impl == "pallas" else 16384
+            block = {"pallas": 256, "pallas2": 4096}.get(impl, 16384)
         return impl, block
 
     @staticmethod
@@ -294,7 +294,7 @@ class TPUTreeLearner:
         if not bool(config.deterministic):
             return str(config.tpu_hist_precision)
         jax.config.update("jax_enable_x64", True)
-        if str(config.tpu_hist_impl) == "pallas":
+        if str(config.tpu_hist_impl).startswith("pallas"):
             raise ValueError(
                 "deterministic=true requires tpu_hist_impl=xla")
         return "f64"

@@ -123,8 +123,9 @@ class GrowerParams(NamedTuple):
     # 607-769): static BFS-ordered tuple of (parent_leaf, feature, thr_bin)
     # applied as unrolled rounds before best-gain growth
     forced: tuple = ()
-    # batched-histogram backend: "xla" (scan + dot_general) or "pallas"
-    # (fused VMEM kernel, ops/histogram.py _hist_pallas)
+    # batched-histogram backend: "xla" (scan + dot_general), "pallas"
+    # (fused VMEM kernel, ops/histogram.py _hist_pallas_flat) or "pallas2"
+    # (per-feature one-hot variant, _hist_pallas)
     hist_impl: str = "xla"
     # row-partition lowering: "select" unrolls K scalar-broadcast passes
     # (one dynamic row slice + elementwise compare per split — no per-row
@@ -453,7 +454,7 @@ def make_grower(params: GrowerParams, num_features: int,
         S = stats.shape[0]
         bins_blocks = jnp.moveaxis(bins_hist_t.reshape(G, nb, block), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
-        if params.hist_impl == "pallas":
+        if params.hist_impl.startswith("pallas"):
             # reuse the batched VMEM kernel (slot 0 = the all-zero root
             # leaf ids): the xla scan at pallas-sized short blocks would
             # round-trip a materialized one-hot per block through HBM
@@ -461,7 +462,7 @@ def make_grower(params: GrowerParams, num_features: int,
             root_hist = preduce_hist(build_histogram_batched_t(
                 bins_blocks, stats_blocks,
                 jnp.zeros((nb, block), jnp.int32), root_slots, B,
-                precision, impl="pallas")[0])
+                precision, impl=params.hist_impl)[0])
         else:
             root_hist = preduce_hist(
                 build_histogram_t(bins_blocks, stats_blocks, B, precision))

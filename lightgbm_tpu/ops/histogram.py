@@ -144,9 +144,11 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
     impl: "xla" (lax.scan + dot_general) or "pallas" (fused VMEM kernel)
     Returns [K, F, B, 3] f32.
     """
-    if impl == "pallas":
-        return _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks,
-                            slot_leaf_ids, num_bins, precision)
+    if impl in ("pallas", "pallas2"):
+        return _hist_pallas(
+            bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
+            num_bins, precision,
+            variant="flat" if impl == "pallas" else "perfeature")
     nb, num_features, block = bins_t_blocks.shape
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
@@ -182,53 +184,87 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
 
 
 def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
-                 num_bins: int, precision: str) -> jnp.ndarray:
+                 num_bins: int, precision: str, variant: str) -> jnp.ndarray:
     """Pallas kernel: fused one-hot + slot-expansion + MXU contraction.
 
     The TPU answer to the reference GPU kernel's workgroup-local
     sub-histograms (reference src/treelearner/ocl/histogram256.cl:78-120):
-    each grid step keeps the full [F*B, K*S] accumulator resident in VMEM
-    and feeds the MXU straight from the in-register one-hot, so neither
-    the one-hot nor the expanded stats ever round-trip to HBM.
-    """
-    import functools as _ft
+    the accumulator stays resident in VMEM across the row-block grid, and
+    neither the one-hot nor the expanded stats ever round-trip to HBM.
 
+    Two kernel-body variants share this scaffolding:
+
+    * "flat" (impl "pallas"): one [F*B, blk] one-hot dot per grid step.
+      Hardware-validated at 256-row blocks (1.93 it/s on the Higgs-1M
+      bench shape, docs/PERF_NOTES.md); the monolithic one-hot costs a
+      multi-MB VMEM retiling copy per step (merging the [F, B, blk]
+      iota-compare into dot operand layout) and caps the block at 256
+      rows before VMEM overflows, putting ~4k grid steps of accumulator
+      read-modify-write on the critical path.
+    * "perfeature" (impl "pallas2", experimental until timed on
+      hardware): the one-hot is generated per feature ([Bp, blk], F
+      statically-unrolled dots), so the largest temporary shrinks from
+      [F*B, blk] to [Bp, blk], blocks of 2-8k rows fit, and the grid
+      shrinks ~16x.  Each feature's bin rows live at a sublane-aligned
+      Bp = ceil(B/8)*8 offset in the [F*Bp, K*S] accumulator.
+    """
     from jax.experimental import pallas as pl
 
     nb, F, block = bins_t_blocks.shape
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
     B = num_bins
+    # sublane-aligned per-feature row offset (perfeature variant only)
+    Bp = -(-B // 8) * 8 if variant == "perfeature" else B
     dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
     dot_prec = (jax.lax.Precision.HIGHEST if precision == "f32"
                 else jax.lax.Precision.DEFAULT)
 
-    def kernel(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
+    def expand_slots(stats_ref, leaf_ref, slots_ref):
+        """[K*S, blk] per-slot stats: slot one-hot x packed stat rows."""
+        s = stats_ref[0]                        # [S, blk]
+        l = leaf_ref[0]                         # [1, blk] i32
+        slots = slots_ref[:]                    # [K, 1] i32
+        slot_oh = (slots == l).astype(dot_dtype)            # [K, blk]
+        sexp = (slot_oh[:, None, :] * s[None, :, :].astype(dot_dtype))
+        return sexp.reshape(K * S, block)
+
+    def accumulate(i, out_ref, rows, acc):
+        @pl.when(i == 0)
+        def _():
+            out_ref[rows, :] = acc
+
+        @pl.when(i > 0)
+        def _():
+            out_ref[rows, :] += acc
+
+    def kernel_flat(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
         i = pl.program_id(0)
         # explicit upcast: bins may arrive uint8 (narrow dense storage) and
         # Mosaic's compare wants a full-width integer operand
         b_t = bins_ref[0].astype(jnp.int32)     # [F, blk]
-        s = stats_ref[0]                        # [S, blk]
-        l = leaf_ref[0]                         # [1, blk] i32
-        slots = slots_ref[:]                    # [K, 1] i32
+        sexp = expand_slots(stats_ref, leaf_ref, slots_ref)
         iota = jax.lax.broadcasted_iota(jnp.int32, (F, B, block), 1)
         onehot = (b_t[:, None, :] == iota).astype(dot_dtype)
         onehot = onehot.reshape(F * B, block)
-        slot_oh = (slots == l).astype(dot_dtype)            # [K, blk]
-        sexp = (slot_oh[:, None, :] * s[None, :, :].astype(dot_dtype))
-        sexp = sexp.reshape(K * S, block)
         acc = jax.lax.dot_general(
             onehot, sexp, (((1,), (1,)), ((), ())),
             precision=dot_prec, preferred_element_type=jnp.float32)
+        accumulate(i, out_ref, slice(None), acc)
 
-        @pl.when(i == 0)
-        def _():
-            out_ref[:] = acc
+    def kernel_perfeature(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
+        i = pl.program_id(0)
+        sexp = expand_slots(stats_ref, leaf_ref, slots_ref)
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Bp, block), 0)
+        for f in range(F):
+            b_f = bins_ref[0, f].astype(jnp.int32)          # [blk]
+            onehot = (b_f[None, :] == iota_b).astype(dot_dtype)
+            acc = jax.lax.dot_general(
+                onehot, sexp, (((1,), (1,)), ((), ())),
+                precision=dot_prec, preferred_element_type=jnp.float32)
+            accumulate(i, out_ref, slice(f * Bp, (f + 1) * Bp), acc)
 
-        @pl.when(i > 0)
-        def _():
-            out_ref[:] += acc
-
+    kernel = kernel_flat if variant == "flat" else kernel_perfeature
     # Mosaic block-shape rule: the last two dims of every block must be
     # (8k, 128k)-aligned or equal the array's dims.  All operands are laid
     # out [nb, ..., block] so each grid step's block matches the trailing
@@ -243,14 +279,19 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             pl.BlockSpec((1, 1, block), lambda i: (i, 0, 0)),
             pl.BlockSpec((K, 1), lambda i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((F * B, K * S), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F * B, K * S), jnp.float32),
+        out_specs=pl.BlockSpec((F * Bp, K * S), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((F * Bp, K * S), jnp.float32),
         # the Mosaic TPU backend is the target; interpret on CPU (tests)
         interpret=jax.devices()[0].platform not in ("tpu",),
     )(bins_t_blocks, stats_nb, leaf_blocks.reshape(nb, 1, block),
       slot_leaf_ids.reshape(K, 1))
-    raw = jnp.transpose(raw.reshape(F * B, K, S), (1, 2, 0))
-    hist = jax.vmap(lambda r: _unpack_hist(r, precision))(raw)
+    if variant == "perfeature":
+        raw = jnp.transpose(raw.reshape(F, Bp, K, S)[:, :B], (2, 3, 0, 1))
+        raw = raw.reshape(K, S, F * B)
+    else:
+        raw = jnp.transpose(raw.reshape(F * B, K, S), (1, 2, 0))
+    hist = jax.vmap(lambda r: _unpack_hist(r.reshape(S, F * B), precision))(
+        raw)
     return hist.reshape(K, F, B, 3)
 
 

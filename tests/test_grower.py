@@ -285,6 +285,70 @@ class TestBatchedHistogramImpls:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b8))
 
 
+    def test_pallas_bp_padding_parity(self):
+        # B=15 pads Bp->16 inside the kernel: the padded bin rows must not
+        # leak into the returned [K, F, B, 3] histograms
+        from lightgbm_tpu.ops.histogram import (build_histogram_batched_t,
+                                                pack_stats)
+        rng = np.random.default_rng(5)
+        nb, F, block, B, K = 2, 3, 128, 15, 4
+        n = nb * block
+        bins_t = jnp.asarray(
+            rng.integers(0, B, size=(nb, F, block)), dtype=jnp.uint8)
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        stats = pack_stats(g, jnp.abs(g) + 0.5, jnp.ones(n, jnp.float32),
+                           "hilo")
+        stats_blocks = stats.reshape(stats.shape[0], nb, block)
+        leaf_blocks = jnp.asarray(
+            rng.integers(0, K, size=(nb, block)), dtype=jnp.int32)
+        slots = jnp.asarray([1, 0, -1, 3], dtype=jnp.int32)
+        a = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                      slots, B, "hilo", impl="xla")
+        b = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                      slots, B, "hilo", impl="pallas")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_pallas2_matches_xla(self):
+        # per-feature one-hot variant at its bigger native blocks
+        from lightgbm_tpu.ops.histogram import (build_histogram_batched_t,
+                                                pack_stats)
+        rng = np.random.default_rng(6)
+        nb, F, block, B, K = 2, 4, 512, 31, 6
+        n = nb * block
+        bins_t = jnp.asarray(
+            rng.integers(0, B, size=(nb, F, block)), dtype=jnp.uint8)
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        stats = pack_stats(g, jnp.abs(g) + 0.2, jnp.ones(n, jnp.float32),
+                           "hilo")
+        stats_blocks = stats.reshape(stats.shape[0], nb, block)
+        leaf_blocks = jnp.asarray(
+            rng.integers(0, K + 1, size=(nb, block)), dtype=jnp.int32)
+        slots = jnp.asarray([2, 0, -1, 5, 1, 4], dtype=jnp.int32)
+        a = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                      slots, B, "hilo", impl="xla")
+        b = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                      slots, B, "hilo", impl="pallas2")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_grower_pallas2_matches_xla_end_to_end(self):
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(12)
+        X = rng.normal(size=(1536, 4))
+        y = np.sin(2 * X[:, 0]) + X[:, 1] + 0.1 * rng.normal(size=1536)
+
+        def dump(impl):
+            params = {"objective": "regression", "num_leaves": 15,
+                      "min_data_in_leaf": 5, "max_bin": 32,
+                      "tpu_hist_impl": impl, "tpu_block_rows": 512,
+                      "verbosity": -1}
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+            bst = lgb.train(params, ds, num_boost_round=3,
+                            verbose_eval=False)
+            return bst.model_to_string().split("parameters", 1)[0]
+
+        assert dump("pallas2") == dump("xla")
+
+
 class TestAutoHistResolution:
     """tpu_hist_impl=auto / tpu_block_rows=0 resolution (models/learner.py
     _resolve_hist_impl): platform- and VMEM-aware backend choice."""

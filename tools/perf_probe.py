@@ -60,8 +60,12 @@ def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
     return ms, compile_s, auc
 
 
-def sweep(X, y, configs, iters=6):
-    """Run a list of config dicts through run_one, printing one line each."""
+def sweep(X, y, configs, iters=6, reraise=False):
+    """Run a list of config dicts through run_one, printing one line each.
+
+    reraise=True (the single-config "one" mode) propagates failures with
+    the full traceback instead of the sweep's keep-going truncation.
+    """
     for cfg in configs:
         label = " ".join(f"{k}={v}" for k, v in cfg.items())
         try:
@@ -73,6 +77,8 @@ def sweep(X, y, configs, iters=6):
             print(f"{label}: {ms:6.0f} ms/tree ({1000/ms:5.2f} it/s) "
                   f"compile {cs:5.0f}s auc {auc:.4f}", flush=True)
         except Exception as exc:
+            if reraise:
+                raise
             print(f"{label}: FAILED {type(exc).__name__}: {str(exc)[:150]}",
                   flush=True)
 
@@ -87,7 +93,7 @@ def main():
                           impl=os.environ.get("IMPL", "xla"),
                           part=os.environ.get("PARTITION", "select"),
                           prec=os.environ.get("PRECISION", "hilo"))],
-              iters=8)
+              iters=8, reraise=True)
         return
     if arg == "round2":
         # post-pallas leverage sweep (docs/PERF_NOTES.md "next
@@ -95,12 +101,14 @@ def main():
         # bigger K cuts rounds per tree
         sweep(X, y, [
             dict(k=25, block=256, impl="pallas", prec="hilo"),  # re-baseline
-            dict(k=42, block=256, impl="pallas", prec="bf16"),  # 1 tile, S=3
-            dict(k=25, block=256, impl="pallas", prec="bf16"),
+            # pallas2: per-feature one-hot, 16x fewer grid steps
+            dict(k=25, block=4096, impl="pallas2", prec="hilo"),
+            dict(k=25, block=8192, impl="pallas2", prec="hilo"),
+            # S=3 bf16 stats widen K at the same tile width
+            dict(k=42, block=4096, impl="pallas2", prec="bf16"),
+            dict(k=84, block=4096, impl="pallas2", prec="bf16"),  # ~6 rounds
+            dict(k=42, block=256, impl="pallas", prec="bf16"),
             dict(k=50, block=256, impl="pallas", prec="hilo"),  # 2 tiles
-            dict(k=50, block=256, impl="pallas", prec="bf16"),
-            dict(k=84, block=256, impl="pallas", prec="bf16"),  # ~6 rounds
-            dict(k=25, block=128, impl="pallas", prec="hilo"),
         ])
         return
     if arg == "decide":
