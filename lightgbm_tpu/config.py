@@ -192,6 +192,10 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # row-partition lowering: select | gather (ops/grower.py GrowerParams.
     # partition_impl; honored by every tree learner)
     "tpu_partition_impl": ("str", "select", ()),
+    # frontier ramp: unrolled K'=1,2,4,... pre-rounds before the full-K
+    # loop (bit-identical trees, removes early rounds' dead-slot MXU
+    # work; see GrowerParams.ramp).  Off until timed on hardware
+    "tpu_ramp": ("bool", False, ()),
 }
 
 _ALIAS: Dict[str, str] = {}

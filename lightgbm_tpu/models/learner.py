@@ -235,6 +235,7 @@ class TPUTreeLearner:
             hist_impl=hist_impl,
             partition_impl=str(config.tpu_partition_impl),
             has_bundles=plan is not None,
+            ramp=bool(config.tpu_ramp),
         )
         self.grow = make_strategy_grower(
             self.params, self.f_pad, strategy, self.mesh,

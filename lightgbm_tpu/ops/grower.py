@@ -124,8 +124,8 @@ class GrowerParams(NamedTuple):
     # applied as unrolled rounds before best-gain growth
     forced: tuple = ()
     # batched-histogram backend: "xla" (scan + dot_general), "pallas"
-    # (fused VMEM kernel, ops/histogram.py _hist_pallas_flat) or "pallas2"
-    # (per-feature one-hot variant, _hist_pallas)
+    # or "pallas2" (fused VMEM kernels — ops/histogram.py _hist_pallas
+    # with variant="flat" / "perfeature")
     hist_impl: str = "xla"
     # row-partition lowering: "select" unrolls K scalar-broadcast passes
     # (one dynamic row slice + elementwise compare per split — no per-row
@@ -138,6 +138,15 @@ class GrowerParams(NamedTuple):
     # histograms back to feature space, reconstructing each bundled
     # feature's bin 0 from leaf totals (FixHistogram, dataset.cpp:1044)
     has_bundles: bool = False
+    # frontier ramp: statically-unrolled pre-rounds at K' = 1, 2, 4, ...
+    # before the full-K while_loop.  After r rounds the frontier holds at
+    # most 2^r leaves, so each pre-round's K' covers every possible
+    # positive-gain leaf and the grown tree is BIT-IDENTICAL to the plain
+    # loop — the ramp only removes the dead-slot contraction work of the
+    # first log2(K) rounds (at K=84 that waste is ~half the tree's MXU
+    # time).  Disabled automatically when forced splits pre-grow the
+    # frontier beyond the 2^r bound.
+    ramp: bool = False
 
 
 def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
@@ -551,12 +560,15 @@ def make_grower(params: GrowerParams, num_features: int,
 
         def exec_round(state, sel, vals, do_k, sel_feat, sel_thr, sel_dleft,
                        sel_iscat, cmask_sel, lg, lh, lc, lo, ro):
-            """Execute up to K splits (slot k: leaf sel[k] on feature
+            """Execute up to Kr splits (slot k: leaf sel[k] on feature
             sel_feat[k]) — partition, batched child histograms, child
             search, state/record updates.  Shared by the best-gain round
-            body and the unrolled forced-split rounds."""
+            body (Kr=K), the ramp pre-rounds (Kr = 1, 2, 4, ...) and the
+            unrolled forced-split rounds; the round width is the static
+            shape of the slot operands."""
             leaf_ids = state["leaf_ids"]
-            kar = jnp.arange(K, dtype=jnp.int32)
+            Kr = sel.shape[0]
+            kar = jnp.arange(Kr, dtype=jnp.int32)
             # dtype pinned: under x64 (deterministic mode) jnp.sum would
             # promote to int64 and break the while_loop carry contract
             num_do = jnp.sum(do_k, dtype=jnp.int32)
@@ -578,7 +590,7 @@ def make_grower(params: GrowerParams, num_features: int,
                 # TPU gather for tiny tables serializes per element, and at
                 # ~8 gathers/round x ~20 rounds it dominated tree time.
                 new_leaf = leaf_ids
-                for k in range(K):
+                for k in range(Kr):
                     f_k = sel_feat[k]
                     if params.has_bundles:
                         raw_k = jax.lax.dynamic_index_in_dim(
@@ -672,13 +684,13 @@ def make_grower(params: GrowerParams, num_features: int,
             new_state = dict(state)
             if bynode:
                 nkey, k_nodes = jax.random.split(state["key"])
-                child_masks = bynode_masks(k_nodes, (2 * K,))
+                child_masks = bynode_masks(k_nodes, (2 * Kr,))
                 new_state["key"] = nkey
             else:
                 child_masks = feature_mask
             if params.has_cegb:
                 used = scatter_set(state["used"], sel_feat,
-                                   jnp.ones(K, jnp.float32), do_k)
+                                   jnp.ones(Kr, jnp.float32), do_k)
                 new_state["used"] = used
                 delta = cegb_delta(used)
             else:
@@ -710,8 +722,8 @@ def make_grower(params: GrowerParams, num_features: int,
                             ("bs_ro", ch.right_output),
                             ("bs_iscat", ch.is_cat),
                             ("bs_catmask", ch.cat_mask)):
-                arr = scatter_set(new_state[key], sel, cv[:K], do_k)
-                new_state[key] = scatter_set(arr, new_ids, cv[K:], do_k)
+                arr = scatter_set(new_state[key], sel, cv[:Kr], do_k)
+                new_state[key] = scatter_set(arr, new_ids, cv[Kr:], do_k)
 
             # ---- records: contiguous [K, W] block at row n_splits -------
             rec = jnp.stack([
@@ -728,10 +740,11 @@ def make_grower(params: GrowerParams, num_features: int,
             new_state["n_splits"] = state["n_splits"] + num_do
             return new_state
 
-        def body(state):
-            vals, sel = jax.lax.top_k(cand_gains(state), K)
+        def body(state, round_k=None):
+            Kr = K if round_k is None else round_k
+            vals, sel = jax.lax.top_k(cand_gains(state), Kr)
             sel = sel.astype(jnp.int32)
-            kar = jnp.arange(K, dtype=jnp.int32)
+            kar = jnp.arange(Kr, dtype=jnp.int32)
             budget = (L - 1) - state["n_splits"]
             # vals is sorted descending, so do_k is a prefix mask: records
             # written this round are contiguous
@@ -825,6 +838,18 @@ def make_grower(params: GrowerParams, num_features: int,
         for parent, feat, thr in params.forced:
             state, forced_ok = forced_round(state, forced_ok,
                                             int(parent), int(feat), int(thr))
+
+        if params.ramp and not params.forced and not bynode and K > 1:
+            # frontier ramp (see GrowerParams.ramp): after r rounds the
+            # frontier holds <= 2^r leaves, so pre-rounds at K' = 2^r
+            # split exactly the leaves the full-K loop would and the tree
+            # is bit-identical — only the dead-slot contraction work goes.
+            # bynode is excluded: its per-child RNG draw shapes follow the
+            # round width, which would change the sampled masks.
+            kr = 1
+            while kr < K:
+                state = body(state, round_k=kr)
+                kr *= 2
 
         state = jax.lax.while_loop(cond, body, state)
         return {

@@ -303,9 +303,9 @@ class TestBatchedHistogramImpls:
             rng.integers(0, K, size=(nb, block)), dtype=jnp.int32)
         slots = jnp.asarray([1, 0, -1, 3], dtype=jnp.int32)
         a = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
-                                      slots, B, "hilo", impl="xla")
+                                      slots, B, "hilo", impl="pallas2")
         b = build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
-                                      slots, B, "hilo", impl="pallas")
+                                      slots, B, "hilo", impl="xla")
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
     def test_pallas2_matches_xla(self):
@@ -347,6 +347,39 @@ class TestBatchedHistogramImpls:
             return bst.model_to_string().split("parameters", 1)[0]
 
         assert dump("pallas2") == dump("xla")
+
+
+class TestFrontierRamp:
+    """tpu_ramp pre-rounds must grow BIT-IDENTICAL trees (the frontier
+    after r rounds never exceeds 2^r, so every ramp pre-round covers all
+    splittable leaves the full-K loop would take — see GrowerParams.ramp)."""
+
+    def _dump(self, X, y, **extra):
+        import lightgbm_tpu as lgb
+        params = {"objective": "regression", "num_leaves": 63,
+                  "min_data_in_leaf": 5, "max_bin": 64,
+                  "tpu_split_batch": 8, "verbosity": -1, **extra}
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 64})
+        bst = lgb.train(params, ds, num_boost_round=4, verbose_eval=False)
+        return bst.model_to_string().split("parameters", 1)[0]
+
+    def test_bit_identical_trees(self):
+        rng = np.random.default_rng(13)
+        X = rng.normal(size=(4096, 6))
+        y = X[:, 0] ** 2 - X[:, 1] + 0.3 * np.sin(4 * X[:, 2]) \
+            + 0.1 * rng.normal(size=4096)
+        assert self._dump(X, y, tpu_ramp=True) == self._dump(X, y)
+
+    def test_bit_identical_with_categoricals(self):
+        rng = np.random.default_rng(14)
+        n = 3000
+        Xc = rng.integers(0, 9, size=n).astype(np.float64)
+        Xn = rng.normal(size=(n, 3))
+        X = np.column_stack([Xc, Xn])
+        y = (Xc % 2) * 1.5 + Xn[:, 0] + 0.1 * rng.normal(size=n)
+        extra = {"categorical_feature": [0]}
+        assert (self._dump(X, y, tpu_ramp=True, **extra)
+                == self._dump(X, y, **extra))
 
 
 class TestAutoHistResolution:

@@ -31,7 +31,7 @@ _DS_CACHE = {}
 
 
 def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
-            partition="select", precision="hilo"):
+            partition="select", precision="hilo", ramp=False):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.backend import host_sync
     from sklearn.metrics import roc_auc_score
@@ -46,7 +46,8 @@ def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
         "min_data_in_leaf": 20, "max_bin": bins, "tpu_split_batch": k,
         "tpu_block_rows": block, "tpu_hist_impl": impl,
         "tpu_partition_impl": partition,
-        "tpu_hist_precision": precision}, train_set=ds)
+        "tpu_hist_precision": precision,
+        "tpu_ramp": ramp}, train_set=ds)
     t0 = time.time()
     bst.update()
     host_sync(bst._driver.train_scores.scores)
@@ -73,7 +74,8 @@ def sweep(X, y, configs, iters=6, reraise=False):
                                   cfg.get("block", 16384),
                                   cfg.get("impl", "xla"), iters=iters,
                                   partition=cfg.get("part", "select"),
-                                  precision=cfg.get("prec", "hilo"))
+                                  precision=cfg.get("prec", "hilo"),
+                                  ramp=cfg.get("ramp", False))
             print(f"{label}: {ms:6.0f} ms/tree ({1000/ms:5.2f} it/s) "
                   f"compile {cs:5.0f}s auc {auc:.4f}", flush=True)
         except Exception as exc:
@@ -107,6 +109,8 @@ def main():
             # S=3 bf16 stats widen K at the same tile width
             dict(k=42, block=4096, impl="pallas2", prec="bf16"),
             dict(k=84, block=4096, impl="pallas2", prec="bf16"),  # ~6 rounds
+            dict(k=84, block=4096, impl="pallas2", prec="bf16", ramp=True),
+            dict(k=25, block=4096, impl="pallas2", prec="hilo", ramp=True),
             dict(k=42, block=256, impl="pallas", prec="bf16"),
             dict(k=50, block=256, impl="pallas", prec="hilo"),  # 2 tiles
         ])
