@@ -183,6 +183,12 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
     return hist.reshape(K, num_features, num_bins, 3)
 
 
+# VMEM budget for one feature chunk's accumulator block in the perfeature
+# pallas kernel; the remaining ~10 MB of VMEM holds the [Bp, blk] one-hot,
+# the [K*S, blk] expanded stats, and the double-buffered input DMAs
+_PERFEATURE_OUT_BUDGET = 6 * 1024 * 1024
+
+
 def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
                  num_bins: int, precision: str, variant: str) -> jnp.ndarray:
     """Pallas kernel: fused one-hot + slot-expansion + MXU contraction.
@@ -202,11 +208,16 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
       rows before VMEM overflows, putting ~4k grid steps of accumulator
       read-modify-write on the critical path.
     * "perfeature" (impl "pallas2", experimental until timed on
-      hardware): the one-hot is generated per feature ([Bp, blk], F
+      hardware): the one-hot is generated per feature ([Bp, blk],
       statically-unrolled dots), so the largest temporary shrinks from
       [F*B, blk] to [Bp, blk], blocks of 2-8k rows fit, and the grid
       shrinks ~16x.  Each feature's bin rows live at a sublane-aligned
-      Bp = ceil(B/8)*8 offset in the [F*Bp, K*S] accumulator.
+      Bp = ceil(B/8)*8 offset in the accumulator.  When the full [F*Bp,
+      K*S] accumulator would overflow VMEM (wide data: Epsilon/Bosch
+      F*B shapes), the grid gains a FEATURE axis: features are processed
+      in the largest divisor-of-F chunk whose [fblk*Bp, K*S] out block
+      fits, and the row-block axis iterates innermost so each feature
+      chunk's accumulator stays VMEM-resident across its row sweep.
     """
     from jax.experimental import pallas as pl
 
@@ -252,39 +263,83 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             precision=dot_prec, preferred_element_type=jnp.float32)
         accumulate(i, out_ref, slice(None), acc)
 
-    def kernel_perfeature(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
-        i = pl.program_id(0)
-        sexp = expand_slots(stats_ref, leaf_ref, slots_ref)
-        iota_b = jax.lax.broadcasted_iota(jnp.int32, (Bp, block), 0)
-        for f in range(F):
-            b_f = bins_ref[0, f].astype(jnp.int32)          # [blk]
-            onehot = (b_f[None, :] == iota_b).astype(dot_dtype)
-            acc = jax.lax.dot_general(
-                onehot, sexp, (((1,), (1,)), ((), ())),
-                precision=dot_prec, preferred_element_type=jnp.float32)
-            accumulate(i, out_ref, slice(f * Bp, (f + 1) * Bp), acc)
+    def kernel_perfeature_chunk(fblk):
+        def kernel(bins_ref, stats_ref, leaf_ref, slots_ref, out_ref):
+            i = pl.program_id(1)  # row-block axis (innermost)
+            sexp = expand_slots(stats_ref, leaf_ref, slots_ref)
+            iota_b = jax.lax.broadcasted_iota(jnp.int32, (Bp, block), 0)
+            for f in range(fblk):
+                b_f = bins_ref[0, f].astype(jnp.int32)      # [blk]
+                onehot = (b_f[None, :] == iota_b).astype(dot_dtype)
+                acc = jax.lax.dot_general(
+                    onehot, sexp, (((1,), (1,)), ((), ())),
+                    precision=dot_prec,
+                    preferred_element_type=jnp.float32)
+                accumulate(i, out_ref, slice(f * Bp, (f + 1) * Bp), acc)
+        return kernel
 
-    kernel = kernel_flat if variant == "flat" else kernel_perfeature
     # Mosaic block-shape rule: the last two dims of every block must be
     # (8k, 128k)-aligned or equal the array's dims.  All operands are laid
     # out [nb, ..., block] so each grid step's block matches the trailing
     # dims exactly; the S/leaf axes ride along whole.
     stats_nb = jnp.moveaxis(stats_blocks, 1, 0)             # [nb, S, blk]
-    raw = pl.pallas_call(
-        kernel,
-        grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((1, F, block), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, S, block), lambda i: (i, 0, 0)),
-            pl.BlockSpec((1, 1, block), lambda i: (i, 0, 0)),
-            pl.BlockSpec((K, 1), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((F * Bp, K * S), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((F * Bp, K * S), jnp.float32),
-        # the Mosaic TPU backend is the target; interpret on CPU (tests)
-        interpret=jax.devices()[0].platform not in ("tpu",),
-    )(bins_t_blocks, stats_nb, leaf_blocks.reshape(nb, 1, block),
-      slot_leaf_ids.reshape(K, 1))
+    interpret = jax.devices()[0].platform not in ("tpu",)
+    if variant == "flat":
+        raw = pl.pallas_call(
+            kernel_flat,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((1, F, block), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, S, block), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, 1, block), lambda i: (i, 0, 0)),
+                pl.BlockSpec((K, 1), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((F * B, K * S), lambda i: (0, 0)),
+            out_shape=jax.ShapeDtypeStruct((F * B, K * S), jnp.float32),
+            interpret=interpret,
+        )(bins_t_blocks, stats_nb, leaf_blocks.reshape(nb, 1, block),
+          slot_leaf_ids.reshape(K, 1))
+    else:
+        # feature chunking: largest divisor of F whose out block fits the
+        # VMEM budget.  Mosaic block-shape rules constrain the candidates:
+        # the bins block's second-minor dim (fblk) must be sublane-aligned
+        # (32 for the uint8 bins worst case) unless it equals the array
+        # dim F, and the accumulator's lane width pads to 128.  When F has
+        # no 32-multiple divisor that fits (e.g. F = 2000 = 2^4 * 5^3),
+        # the kernel stays single-chunk — identical to the pre-chunking
+        # behavior; pad the feature axis host-side to unlock chunking for
+        # such shapes.
+        ks_pad = -(-(K * S) // 128) * 128
+        budget = _PERFEATURE_OUT_BUDGET
+
+        def fits(c):
+            return c * Bp * ks_pad * 4 <= budget
+
+        fblk = F
+        if not fits(F):
+            cands = [c for c in range(32, F, 32)
+                     if F % c == 0 and fits(c)]
+            if cands:
+                fblk = max(cands)
+        nf = F // fblk
+        # grid order: the row-block axis is LAST (innermost), so each
+        # feature chunk's accumulator block stays resident while the row
+        # sweep accumulates into it
+        raw = pl.pallas_call(
+            kernel_perfeature_chunk(fblk),
+            grid=(nf, nb),
+            in_specs=[
+                pl.BlockSpec((1, fblk, block), lambda fi, i: (i, fi, 0)),
+                pl.BlockSpec((1, S, block), lambda fi, i: (i, 0, 0)),
+                pl.BlockSpec((1, 1, block), lambda fi, i: (i, 0, 0)),
+                pl.BlockSpec((K, 1), lambda fi, i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((fblk * Bp, K * S),
+                                   lambda fi, i: (fi, 0)),
+            out_shape=jax.ShapeDtypeStruct((F * Bp, K * S), jnp.float32),
+            interpret=interpret,
+        )(bins_t_blocks, stats_nb, leaf_blocks.reshape(nb, 1, block),
+          slot_leaf_ids.reshape(K, 1))
     if variant == "perfeature":
         raw = jnp.transpose(raw.reshape(F, Bp, K, S)[:, :B], (2, 3, 0, 1))
         raw = raw.reshape(K, S, F * B)

@@ -330,6 +330,34 @@ class TestBatchedHistogramImpls:
                                       slots, B, "hilo", impl="pallas2")
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
+    def test_pallas2_feature_chunked_grid(self, monkeypatch):
+        # shrink the out-block VMEM budget so F=64 features are processed
+        # in sublane-aligned divisor chunks (fblk=32 -> 2-chunk feature
+        # grid axis), and the 2D (feature, row-block) grid must still
+        # accumulate exactly
+        from lightgbm_tpu.ops import histogram as H
+        rng = np.random.default_rng(7)
+        nb, F, block, B, K = 3, 64, 256, 16, 5
+        Bp = 16
+        ks_pad = 128
+        monkeypatch.setattr(H, "_PERFEATURE_OUT_BUDGET",
+                            32 * Bp * ks_pad * 4)  # fits fblk=32, not 64
+        n = nb * block
+        bins_t = jnp.asarray(
+            rng.integers(0, B, size=(nb, F, block)), dtype=jnp.uint8)
+        g = jnp.asarray(rng.normal(size=n).astype(np.float32))
+        stats = H.pack_stats(g, jnp.abs(g) + 0.3, jnp.ones(n, jnp.float32),
+                             "hilo")
+        stats_blocks = stats.reshape(stats.shape[0], nb, block)
+        leaf_blocks = jnp.asarray(
+            rng.integers(0, K + 2, size=(nb, block)), dtype=jnp.int32)
+        slots = jnp.asarray([0, 3, -1, 2, 6], dtype=jnp.int32)
+        a = H.build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                        slots, B, "hilo", impl="xla")
+        b = H.build_histogram_batched_t(bins_t, stats_blocks, leaf_blocks,
+                                        slots, B, "hilo", impl="pallas2")
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_grower_pallas2_matches_xla_end_to_end(self):
         import lightgbm_tpu as lgb
         rng = np.random.default_rng(12)
