@@ -157,6 +157,22 @@ class TPUTreeLearner:
                  else self.g_pad)
         hist_impl, block = self._resolve_hist_impl(config, B, g_fit,
                                                    precision)
+        if hist_impl == "pallas2":
+            # the perfeature kernel chunks its feature grid in
+            # sublane-aligned (multiple-of-32) divisors (ops/histogram.py
+            # _hist_pallas); pad the histogram column axis so every width
+            # admits aligned chunks.  Padding columns hold constant bin 0
+            # (num_bin=1 features) and can never split.  Feature-parallel
+            # pads to 32 * n_shards so each shard's slice stays aligned
+            if strategy == "feature":
+                a = 32 * self.n_shards
+                self.f_pad = -(-self.f_pad // a) * a
+                self.g_pad = self.f_pad
+            elif plan is None:
+                self.f_pad = -(-self.f_pad // 32) * 32
+                self.g_pad = self.f_pad
+            else:
+                self.g_pad = -(-self.g_pad // 32) * 32
         if strategy in ("data", "voting"):
             # every shard holds an equal, whole number of histogram blocks
             shard = pad_rows((n + self.n_shards - 1) // self.n_shards, block)

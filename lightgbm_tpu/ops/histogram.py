@@ -302,22 +302,25 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
     else:
         # feature chunking: largest divisor of F whose out block fits the
         # VMEM budget.  Mosaic block-shape rules constrain the candidates:
-        # the bins block's second-minor dim (fblk) must be sublane-aligned
-        # (32 for the uint8 bins worst case) unless it equals the array
-        # dim F, and the accumulator's lane width pads to 128.  When F has
-        # no 32-multiple divisor that fits (e.g. F = 2000 = 2^4 * 5^3),
-        # the kernel stays single-chunk — identical to the pre-chunking
-        # behavior; pad the feature axis host-side to unlock chunking for
-        # such shapes.
+        # the bins block's second-minor dim (fblk) must be sublane-tile-
+        # aligned for the bins dtype unless it equals the array dim F, and
+        # the accumulator's lane width pads to 128.  When F has no
+        # aligned divisor that fits (e.g. F = 2000 = 2^4 * 5^3 for uint8
+        # bins), the kernel stays single-chunk — identical to the
+        # pre-chunking behavior; the learner pads the column axis to a
+        # 32-multiple for pallas2 precisely to unlock chunking.
         ks_pad = -(-(K * S) // 128) * 128
         budget = _PERFEATURE_OUT_BUDGET
+        # sublane tile of the bins dtype: 32 rows for uint8, 16 for
+        # 2-byte, 8 for int32 — the chunk width must stay tile-aligned
+        step = {1: 32, 2: 16, 4: 8}[bins_t_blocks.dtype.itemsize]
 
         def fits(c):
             return c * Bp * ks_pad * 4 <= budget
 
         fblk = F
         if not fits(F):
-            cands = [c for c in range(32, F, 32)
+            cands = [c for c in range(step, F, step)
                      if F % c == 0 and fits(c)]
             if cands:
                 fblk = max(cands)

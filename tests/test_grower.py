@@ -410,6 +410,36 @@ class TestFrontierRamp:
                 == self._dump(X, y, **extra))
 
 
+class TestPallas2Bundled:
+    """EFB bundles + the perfeature kernel: the padded column axis and the
+    bundle-histogram expansion must compose (learner pads g_pad to a
+    32-multiple for pallas2; padding columns are all-zero and unused)."""
+
+    def test_bundled_pallas2_matches_xla(self):
+        import lightgbm_tpu as lgb
+        rng = np.random.default_rng(15)
+        n = 4000
+        X = np.zeros((n, 6))
+        grp = rng.integers(0, 3, size=n)
+        for g in range(3):
+            X[grp == g, g] = rng.uniform(1, 2, size=(grp == g).sum())
+        X[:, 3:] = rng.normal(size=(n, 3))
+        y = X[:, 0] + 2 * X[:, 1] - X[:, 2] + X[:, 3] + \
+            0.1 * rng.normal(size=n)
+
+        def dump(impl):
+            params = {"objective": "regression", "num_leaves": 15,
+                      "min_data_in_leaf": 5, "max_bin": 32,
+                      "enable_bundle": True, "tpu_hist_impl": impl,
+                      "tpu_block_rows": 512, "verbosity": -1}
+            ds = lgb.Dataset(X, label=y, params={"max_bin": 32})
+            bst = lgb.train(params, ds, num_boost_round=3,
+                            verbose_eval=False)
+            return bst.model_to_string().split("parameters", 1)[0]
+
+        assert dump("pallas2") == dump("xla")
+
+
 class TestAutoHistResolution:
     """tpu_hist_impl=auto / tpu_block_rows=0 resolution (models/learner.py
     _resolve_hist_impl): platform- and VMEM-aware backend choice."""
