@@ -37,6 +37,9 @@ class Dataset:
         self.free_raw_data = free_raw_data
         self._inner: Optional[TrainingData] = None
         self.used_indices: Optional[np.ndarray] = None
+        # per-categorical-column category tables captured from a pandas
+        # train frame (None = data was not pandas / had no category cols)
+        self.pandas_categorical: Optional[List[List]] = None
 
     # ------------------------------------------------------------------
     def construct(self) -> "Dataset":
@@ -63,14 +66,28 @@ class Dataset:
             if self.label is not None:
                 self._inner.metadata.set_field("label", self.label)
         else:
-            X = _to_2d_array(self.data)
             feature_names = None if self.feature_name == "auto" else list(self.feature_name)
+            pd_cat_idx: Sequence[int] = []
+            if _is_pandas_df(self.data):
+                # valid sets re-use the train frame's category tables so
+                # codes line up with the reference dataset's bins
+                ref_pc = (self.reference.pandas_categorical
+                          if self.reference is not None else None)
+                X, pd_names, pd_cat_idx, self.pandas_categorical = \
+                    _pandas_to_matrix(self.data, ref_pc)
+                if feature_names is None:
+                    feature_names = pd_names
+            else:
+                X = _to_2d_array(self.data)
             cat: Sequence[int] = []
             if isinstance(self.categorical_feature, (list, tuple)):
                 if all(isinstance(c, (int, np.integer)) for c in self.categorical_feature):
                     cat = [int(c) for c in self.categorical_feature]
                 elif feature_names:
                     cat = [feature_names.index(c) for c in self.categorical_feature]
+            # pandas category-dtype columns are categorical regardless of
+            # the (default "auto") categorical_feature setting
+            cat = sorted(set(cat) | set(pd_cat_idx))
             self._inner = TrainingData.from_matrix(
                 X, None if self.label is None else np.asarray(self.label),
                 cfg, weight=self.weight, group_sizes=self.group,
@@ -200,6 +217,7 @@ class Dataset:
         sub.init_score = None
         sub.feature_name = self.feature_name
         sub.categorical_feature = self.categorical_feature
+        sub.pandas_categorical = self.pandas_categorical
         sub.params = dict(params) if params else dict(self.params)
         sub.free_raw_data = True
         sub.used_indices = idx
@@ -243,9 +261,73 @@ def _subset_init_score(md: Metadata, idx: np.ndarray):
     return s.reshape(md.num_data, -1)[idx].reshape(-1)
 
 
-def _to_2d_array(data) -> np.ndarray:
-    if hasattr(data, "values") and hasattr(data, "columns"):  # pandas
-        return data.values.astype(np.float64)
+def _is_pandas_df(data) -> bool:
+    import sys
+
+    pd = sys.modules.get("pandas")
+    return pd is not None and isinstance(data, pd.DataFrame)
+
+
+def _pandas_to_matrix(df, pandas_categorical=None, training=True):
+    """DataFrame -> (X float64, feature_names, cat_idx, pandas_categorical).
+
+    Columns with pandas `category` dtype become their integer codes
+    (missing/unseen -> -1, which the categorical bin path routes like NaN).
+    At training time (pandas_categorical=None) the observed category lists
+    are captured per categorical column; at prediction time the stored
+    lists re-index the incoming values so codes line up with training even
+    when the new frame's categories differ in order or content.  This is
+    the role of the reference package's pandas ingestion
+    (reference python-package/lightgbm/basic.py:313-354), re-derived.
+    """
+    from pandas.api.types import is_numeric_dtype
+
+    names = [str(c) for c in df.columns]
+    cat_cols = [i for i, c in enumerate(df.columns)
+                if str(df.dtypes.iloc[i]) == "category"]
+    bad = [f"{names[i]} ({df.dtypes.iloc[i]})" for i in range(len(names))
+           if i not in cat_cols and not is_numeric_dtype(df.iloc[:, i])]
+    if bad:
+        raise ValueError(
+            f"DataFrame columns [{', '.join(bad)}] have non-numeric "
+            "(object/string/...) dtype; cast them to 'category' or "
+            "numeric before constructing a Dataset")
+    if pandas_categorical is None:
+        if cat_cols and not training:
+            raise ValueError(
+                "this model has no stored pandas category tables "
+                "(trained on non-pandas data or an old model file); "
+                "cannot map the DataFrame's category-dtype columns "
+                f"{[names[i] for i in cat_cols]} onto trained bins — "
+                "pass integer codes instead")
+        pandas_categorical = [list(df.iloc[:, i].cat.categories)
+                              for i in cat_cols]
+    elif len(pandas_categorical) != len(cat_cols):
+        raise ValueError(
+            f"train/predict DataFrames disagree on categorical columns: "
+            f"model has {len(pandas_categorical)}, data has {len(cat_cols)}")
+    X = np.empty((len(df), len(names)), dtype=np.float64)
+    ci = 0
+    for i in range(len(names)):
+        col = df.iloc[:, i]
+        if i in cat_cols:
+            cats = pandas_categorical[ci]
+            ci += 1
+            if list(col.cat.categories) != cats:
+                # re-index onto the training category table BY VALUE
+                # (codes follow the stored order; unseen values -> -1)
+                col = col.cat.set_categories(cats)
+            X[:, i] = col.cat.codes.to_numpy(dtype=np.float64)
+        else:
+            X[:, i] = col.to_numpy(dtype=np.float64, na_value=np.nan)
+    return X, names, cat_cols, pandas_categorical
+
+
+def _to_2d_array(data, pandas_categorical=None) -> np.ndarray:
+    # prediction-side conversion: category columns need the stored tables
+    if _is_pandas_df(data):
+        return _pandas_to_matrix(data, pandas_categorical,
+                                 training=False)[0]
     if hasattr(data, "toarray"):  # scipy sparse
         return np.asarray(data.toarray(), dtype=np.float64)
     return np.asarray(data, dtype=np.float64)

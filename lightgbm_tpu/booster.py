@@ -14,6 +14,29 @@ import numpy as np
 from .config import Config
 
 
+def _split_pandas_categorical(text: str):
+    """Split a model string into (model_text, pandas_categorical).
+
+    The Python layer appends one `pandas_categorical:<json>` line to saved
+    models (the reference package does the same at the end of its files,
+    python-package/lightgbm/basic.py _dump_pandas_categorical), so both
+    packages' files interchange."""
+    import json
+
+    marker = "\npandas_categorical:"
+    pos = text.rfind(marker)
+    if pos < 0:
+        return text, None
+    payload = text[pos + len(marker):].split("\n", 1)[0].strip()
+    try:
+        pc = json.loads(payload) if payload else None
+    except json.JSONDecodeError as exc:
+        raise ValueError(
+            f"corrupt pandas_categorical line in model: {payload[:80]!r}"
+        ) from exc
+    return text[:pos] + "\n", pc
+
+
 class Booster:
     def __init__(self, params: Optional[Dict[str, Any]] = None,
                  train_set: Optional["Dataset"] = None,
@@ -29,6 +52,7 @@ class Booster:
         self._valid_names: List[str] = []
         self._train_set: Optional[Dataset] = None
         self._driver = None
+        self.pandas_categorical = None
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -44,12 +68,16 @@ class Booster:
             cfg = Config(self.params)
             self._driver = create_boosting(cfg)
             self._driver.init(cfg, train_set._inner)
+            self.pandas_categorical = train_set.pandas_categorical
         elif model_file is not None:
             with open(model_file) as f:
                 text = f.read()
+            text, self.pandas_categorical = _split_pandas_categorical(text)
             self._driver = GBDT.from_model_string(text)
             self.params = dict(self._driver.loaded_params)
         elif model_str is not None:
+            model_str, self.pandas_categorical = \
+                _split_pandas_categorical(model_str)
             self._driver = GBDT.from_model_string(model_str)
             self.params = dict(self._driver.loaded_params)
         else:
@@ -111,7 +139,7 @@ class Booster:
             if X.shape[1] == self.num_feature() - 1:
                 X = load_text_file(data, label_column="", header=None)[0]
         else:
-            X = _to_2d_array(data)
+            X = _to_2d_array(data, self.pandas_categorical)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
         return self._driver.predict(
@@ -128,9 +156,10 @@ class Booster:
         from .basic import _to_2d_array
         from .config import Config
 
-        X = _to_2d_array(data)
+        X = _to_2d_array(data, self.pandas_categorical)
         out = Booster(model_str=self._driver.save_model_to_string())
         out.params = dict(self.params)
+        out.pandas_categorical = self.pandas_categorical
         out._driver.refit(X, np.asarray(label), decay_rate,
                           config=Config(self.params) if self.params else None)
         return out
@@ -143,14 +172,32 @@ class Booster:
         with open(filename, "w") as f:
             f.write(self._driver.save_model_to_string(
                 num_iteration=num_iteration, start_iteration=start_iteration))
+            f.write(self._pandas_categorical_line())
         return self
 
     def model_to_string(self, num_iteration: Optional[int] = None,
                         start_iteration: int = 0) -> str:
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
-        return self._driver.save_model_to_string(
+        return (self._driver.save_model_to_string(
             num_iteration=num_iteration, start_iteration=start_iteration)
+            + self._pandas_categorical_line())
+
+    def _pandas_categorical_line(self) -> str:
+        import json
+
+        def np_default(o):
+            if isinstance(o, np.integer):
+                return int(o)
+            if isinstance(o, np.floating):
+                return float(o)
+            if isinstance(o, np.bool_):
+                return bool(o)
+            return str(o)  # e.g. pd.Timestamp categories
+
+        return ("\npandas_categorical:"
+                + json.dumps(self.pandas_categorical, default=np_default)
+                + "\n")
 
     def dump_model(self, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> Dict:

@@ -839,6 +839,12 @@ class GBDT:
     @classmethod
     def from_model_string(cls, text: str) -> "GBDT":
         self = cls()
+        # Python-layer files end with one `pandas_categorical:<json>` line
+        # (both here and in the reference package); the model parser
+        # ignores it — Booster extracts its value separately
+        pos = text.rfind("\npandas_categorical:")
+        if pos >= 0:
+            text = text[:pos]
         lines = text.split("\n")
         kv: Dict[str, str] = {}
         tree_blocks: List[str] = []
