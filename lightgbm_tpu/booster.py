@@ -53,6 +53,7 @@ class Booster:
         self._train_set: Optional[Dataset] = None
         self._driver = None
         self.pandas_categorical = None
+        self._attr: Dict[str, str] = {}
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -82,6 +83,52 @@ class Booster:
             self.params = dict(self._driver.loaded_params)
         else:
             raise ValueError("need train_set, model_file or model_str")
+
+    # -- copy / pickling (reference basic.py Booster round-trips its
+    # C handle through the model string; the driver plays that role) ----
+    def __copy__(self) -> "Booster":
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _memo) -> "Booster":
+        out = Booster(model_str=self.model_to_string(num_iteration=-1))
+        out.params = dict(self.params)
+        out.best_iteration = self.best_iteration
+        out._attr = dict(self._attr)
+        return out
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_train_set", None)
+        state.pop("_driver", None)
+        state["_model_str"] = self.model_to_string(num_iteration=-1)
+        return state
+
+    def __setstate__(self, state):
+        from .models.gbdt import GBDT
+
+        model_str = state.pop("_model_str", None)
+        self.__dict__.update(state)
+        self._train_set = None
+        self._driver = None
+        if model_str is not None:
+            model_str, pc = _split_pandas_categorical(model_str)
+            self._driver = GBDT.from_model_string(model_str)
+            if self.pandas_categorical is None:
+                self.pandas_categorical = pc
+
+    # -- attributes (reference basic.py Booster.attr/set_attr) ---------
+    def attr(self, key: str) -> Optional[str]:
+        return self._attr.get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        for key, value in kwargs.items():
+            if value is None:
+                self._attr.pop(key, None)
+            elif isinstance(value, str):
+                self._attr[key] = value
+            else:
+                raise ValueError("Only string values are accepted")
+        return self
 
     # ------------------------------------------------------------------
     def add_valid(self, data, name: str) -> "Booster":
@@ -150,6 +197,134 @@ class Booster:
             pred_early_stop_margin=float(
                 kwargs.get("pred_early_stop_margin", 10.0)))
 
+    def model_from_string(self, model_str: str, verbose: bool = True
+                          ) -> "Booster":
+        """Replace this Booster's model in place from a model string
+        (reference basic.py Booster.model_from_string)."""
+        from .models.gbdt import GBDT
+
+        model_str, self.pandas_categorical = \
+            _split_pandas_categorical(model_str)
+        self._driver = GBDT.from_model_string(model_str)
+        self.params = dict(self._driver.loaded_params)
+        self._train_set = None
+        return self
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """Value of one leaf (reference Booster.get_leaf_output ->
+        LGBM_BoosterGetLeafValue)."""
+        self._driver._materialize()
+        return float(self._driver.models[tree_id].leaf_value[leaf_id])
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of this feature's used split thresholds across all
+        trees (reference basic.py Booster.get_split_value_histogram)."""
+        model = self.dump_model()
+        feature_names = model["feature_names"]
+
+        def want(split_feature) -> bool:
+            if isinstance(feature, str):
+                return (feature_names is not None
+                        and feature_names[split_feature] == feature)
+            return split_feature == feature
+
+        values: List[float] = []
+
+        def walk(node):
+            if "split_index" in node:
+                if want(node["split_feature"]):
+                    if node["decision_type"] == "==":
+                        raise ValueError(
+                            "cannot compute a split value histogram for a "
+                            "categorical feature")
+                    values.append(float(node["threshold"]))
+                walk(node["left_child"])
+                walk(node["right_child"])
+
+        for t in model["tree_info"]:
+            walk(t["tree_structure"])
+        if bins is None or (isinstance(bins, int)
+                            and bins > len(set(values))
+                            and xgboost_style):
+            bins = max(len(set(values)), 1)
+        hist, edges = np.histogram(values, bins=bins)
+        if not xgboost_style:
+            return hist, edges
+        mask = hist != 0
+        out = np.column_stack([edges[1:][mask], hist[mask]])
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(out, columns=["SplitValue", "Count"])
+        except ImportError:
+            return out
+
+    def trees_to_dataframe(self):
+        """All trees' nodes as one pandas DataFrame (reference basic.py
+        Booster.trees_to_dataframe; same column contract)."""
+        import pandas as pd
+
+        if self.num_trees() == 0:
+            raise ValueError("no trees to parse")
+        model = self.dump_model()
+        feature_names = model["feature_names"]
+        rows: List[Dict[str, Any]] = []
+
+        def node_index(node, ti):
+            if "split_index" in node:
+                return f"{ti}-S{node['split_index']}"
+            return f"{ti}-L{node.get('leaf_index', 0)}"
+
+        def walk(node, ti, depth, parent):
+            is_split = "split_index" in node
+            row = {
+                "tree_index": ti,
+                "node_depth": depth,
+                "node_index": node_index(node, ti),
+                "left_child": None,
+                "right_child": None,
+                "parent_index": parent,
+                "split_feature": None,
+                "split_gain": None,
+                "threshold": None,
+                "decision_type": None,
+                "missing_direction": None,
+                "missing_type": None,
+                "value": None,
+                "weight": None,
+                "count": None,
+            }
+            if is_split:
+                f = node["split_feature"]
+                row.update(
+                    left_child=node_index(node["left_child"], ti),
+                    right_child=node_index(node["right_child"], ti),
+                    split_feature=(feature_names[f] if feature_names
+                                   else f),
+                    split_gain=node["split_gain"],
+                    threshold=node["threshold"],
+                    decision_type=node["decision_type"],
+                    missing_direction=("left" if node["default_left"]
+                                       else "right"),
+                    missing_type=node["missing_type"],
+                    value=node["internal_value"],
+                    weight=node["internal_weight"],
+                    count=node["internal_count"])
+            else:
+                row.update(value=node["leaf_value"],
+                           weight=node.get("leaf_weight"),
+                           count=node.get("leaf_count"))
+            rows.append(row)
+            if is_split:
+                me = row["node_index"]
+                walk(node["left_child"], ti, depth + 1, me)
+                walk(node["right_child"], ti, depth + 1, me)
+
+        for t in model["tree_info"]:
+            walk(t["tree_structure"], t["tree_index"], 1, None)
+        return pd.DataFrame(rows)
+
     def refit(self, data, label, decay_rate: float = 0.9) -> "Booster":
         """New Booster with every tree's leaf values re-fit on `data`
         (reference basic.py Booster.refit -> GBDT::RefitTree)."""
@@ -193,7 +368,12 @@ class Booster:
                 return float(o)
             if isinstance(o, np.bool_):
                 return bool(o)
-            return str(o)  # e.g. pd.Timestamp categories
+            # a str() fallback would save a table whose values no longer
+            # match the frame's at predict time (everything -> missing);
+            # fail at save time instead
+            raise TypeError(
+                f"cannot persist pandas category value {o!r} "
+                f"({type(o).__name__}); use str/int/float categories")
 
         return ("\npandas_categorical:"
                 + json.dumps(self.pandas_categorical, default=np_default)

@@ -982,11 +982,23 @@ class GBDT:
                     "leaf_count": int(tree.leaf_count[leaf]),
                 }
             dt = int(tree.decision_type[i])
+            if dt & 1:
+                # categorical: the reference dump emits the bitset's raw
+                # categories joined by "||" (reference src/io/tree.cpp
+                # ToJSON categorical branch), not the internal set index
+                ci = int(tree.threshold[i])
+                lo, hi = tree.cat_boundaries[ci], tree.cat_boundaries[ci + 1]
+                cats = [32 * (w - lo) + b
+                        for w in range(lo, hi) for b in range(32)
+                        if (tree.cat_threshold[w] >> b) & 1]
+                thr = "||".join(str(c) for c in cats)
+            else:
+                thr = float(tree.threshold[i])
             d = {
                 "split_index": int(i),
                 "split_feature": int(tree.split_feature[i]),
                 "split_gain": float(tree.split_gain[i]),
-                "threshold": float(tree.threshold[i]),
+                "threshold": thr,
                 "decision_type": "==" if dt & 1 else "<=",
                 "default_left": bool(dt & 2),
                 "missing_type": ["None", "Zero", "NaN"][(dt >> 2) & 3],

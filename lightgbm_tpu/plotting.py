@@ -164,7 +164,10 @@ def _node_label(node: Dict[str, Any], feature_names: List[str],
         name = (feature_names[f] if f < len(feature_names)
                 else f"Column_{f}")
         op = "==" if node.get("decision_type") == "==" else "<="
-        return (f"{name} {op} {round(node['threshold'], precision)}\n"
+        thr = node["threshold"]
+        if not isinstance(thr, str):  # categorical dumps "c1||c2||..."
+            thr = round(thr, precision)
+        return (f"{name} {op} {thr}\n"
                 f"gain: {round(node.get('split_gain', 0.0), precision)}\n"
                 f"count: {node.get('internal_count', 0)}")
     return (f"leaf {node.get('leaf_index', 0)}: "

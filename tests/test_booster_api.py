@@ -1,0 +1,88 @@
+"""Booster API parity extras: attributes, pickling/copy, leaf access,
+split-value histograms, trees_to_dataframe, model_from_string
+(reference python-package/lightgbm/basic.py Booster surface)."""
+
+import copy
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.default_rng(17)
+    X = rng.normal(size=(2000, 5))
+    y = X[:, 0] * 2 - X[:, 2] + 0.1 * rng.normal(size=2000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=8)
+    return bst, X
+
+
+class TestBoosterExtras:
+    def test_attr_roundtrip(self, trained):
+        bst, _ = trained
+        assert bst.attr("note") is None
+        bst.set_attr(note="hello", other="x")
+        assert bst.attr("note") == "hello"
+        bst.set_attr(other=None)
+        assert bst.attr("other") is None
+        with pytest.raises(ValueError):
+            bst.set_attr(bad=3)
+
+    def test_pickle_and_copy(self, trained):
+        bst, X = trained
+        base = bst.predict(X)
+        clone = pickle.loads(pickle.dumps(bst))
+        np.testing.assert_allclose(clone.predict(X), base)
+        dup = copy.deepcopy(bst)
+        np.testing.assert_allclose(dup.predict(X), base)
+
+    def test_get_leaf_output_matches_dump(self, trained):
+        bst, _ = trained
+        d = bst.dump_model()
+
+        def first_leaf(node):
+            while "leaf_index" not in node:
+                node = node["left_child"]
+            return node
+        leaf = first_leaf(d["tree_info"][0]["tree_structure"])
+        got = bst.get_leaf_output(0, leaf["leaf_index"])
+        assert got == pytest.approx(leaf["leaf_value"])
+
+    def test_split_value_histogram(self, trained):
+        bst, _ = trained
+        hist, edges = bst.get_split_value_histogram(0, bins=8)
+        assert hist.sum() > 0 and len(edges) == len(hist) + 1
+        xgb = bst.get_split_value_histogram(0, bins=8, xgboost_style=True)
+        assert np.asarray(xgb).shape[1] == 2
+
+    def test_trees_to_dataframe(self, trained):
+        bst, _ = trained
+        df = bst.trees_to_dataframe()
+        assert list(df.columns) == [
+            "tree_index", "node_depth", "node_index", "left_child",
+            "right_child", "parent_index", "split_feature", "split_gain",
+            "threshold", "decision_type", "missing_direction",
+            "missing_type", "value", "weight", "count"]
+        splits = df[df.split_feature.notna()]
+        leaves = df[df.split_feature.isna()]
+        assert len(splits) and len(leaves)
+        # every non-root node's parent exists
+        kids = df[df.parent_index.notna()]
+        assert set(kids.parent_index) <= set(df.node_index)
+
+    def test_model_from_string_replaces(self, trained):
+        bst, X = trained
+        other_text = bst.model_to_string()
+        rng = np.random.default_rng(3)
+        X2 = rng.normal(size=(500, 5))
+        y2 = -X2[:, 1] + 0.1 * rng.normal(size=500)
+        b2 = lgb.train({"objective": "regression", "num_leaves": 7,
+                        "verbosity": -1},
+                       lgb.Dataset(X2, label=y2), num_boost_round=2)
+        b2.model_from_string(other_text)
+        np.testing.assert_allclose(b2.predict(X), bst.predict(X))
