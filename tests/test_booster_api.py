@@ -86,3 +86,14 @@ class TestBoosterExtras:
                        lgb.Dataset(X2, label=y2), num_boost_round=2)
         b2.model_from_string(other_text)
         np.testing.assert_allclose(b2.predict(X), bst.predict(X))
+
+    def test_sklearn_estimator_pickles(self):
+        import pickle
+        from lightgbm_tpu.sklearn import LGBMRegressor
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(800, 4))
+        y = X[:, 0] + 0.1 * rng.normal(size=800)
+        m = LGBMRegressor(n_estimators=5, num_leaves=7,
+                          verbosity=-1).fit(X, y)
+        m2 = pickle.loads(pickle.dumps(m))
+        np.testing.assert_allclose(m2.predict(X), m.predict(X))
