@@ -194,8 +194,10 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "tpu_partition_impl": ("str", "select", ()),
     # frontier ramp: unrolled K'=1,2,4,... pre-rounds before the full-K
     # loop (bit-identical trees, removes early rounds' dead-slot MXU
-    # work; see GrowerParams.ramp).  Off until timed on hardware
-    "tpu_ramp": ("bool", False, ()),
+    # work; see GrowerParams.ramp).  On v5e Higgs-1M it is worth ~10%
+    # (docs/PERF_NOTES.md round-3 sweep: 3.14 vs 2.84 it/s at
+    # pallas2/8192/K=25)
+    "tpu_ramp": ("bool", True, ()),
 }
 
 _ALIAS: Dict[str, str] = {}

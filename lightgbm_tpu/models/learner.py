@@ -151,12 +151,9 @@ class TPUTreeLearner:
         # impl/block resolution happens HERE, once, with the final
         # histogram shape: bundling above only needs the host bin matrix,
         # while the padded row count below depends on the resolved block.
-        # Feature-parallel shards the histogram feature axis, so the VMEM
-        # fit is judged per shard
-        g_fit = (self.g_pad // self.n_shards if strategy == "feature"
-                 else self.g_pad)
-        hist_impl, block = self._resolve_hist_impl(config, B, g_fit,
-                                                   precision)
+        # (The perfeature kernel chunks the feature axis itself, so the
+        # VMEM fit depends only on the bin count, not the feature width.)
+        hist_impl, block = self._resolve_hist_impl(config, B, precision)
         if hist_impl == "pallas2":
             # the perfeature kernel chunks its feature grid in
             # sublane-aligned (multiple-of-32) divisors (ops/histogram.py
@@ -260,44 +257,52 @@ class TPUTreeLearner:
 
     # ------------------------------------------------------------------
     @staticmethod
-    def _resolve_hist_impl(config: Config, num_bins: int, num_features: int,
+    def _resolve_hist_impl(config: Config, num_bins: int,
                            precision: str) -> Tuple[str, int]:
         """Resolve (tpu_hist_impl, tpu_block_rows), honoring "auto"/0.
 
-        The pallas kernel keeps the [F*B, block] one-hot and the
-        [F*B, K*S] f32 accumulator resident in VMEM (~16 MB usable), so
-        auto picks it on TPU only when that working set fits at its short
-        256-row block; everywhere else (CPU tests, f64 deterministic mode,
-        very wide F*B) the xla scan at streaming-sized blocks wins.
-        Measured on v5e Higgs-1M (docs/PERF_NOTES.md): pallas/256 1.93
-        it/s vs xla/16384 1.23 it/s at K=25.
+        Auto picks the perfeature pallas kernel ("pallas2") on TPU: its
+        largest VMEM temporary is a [Bp, block] one-hot (not the flat
+        kernel's [F*B, block]), so multi-k-row blocks fit and the kernel
+        self-chunks the feature axis when the accumulator would overflow.
+        Measured on v5e Higgs-1M (docs/PERF_NOTES.md round-3 sweep, K=25
+        hilo + ramp): pallas2/8192 3.14 it/s vs pallas/256 1.82 it/s vs
+        xla/16384 1.23 it/s, identical train AUC.  Everywhere else (CPU
+        tests, f64 deterministic mode, bin counts too tall for even the
+        minimum 32-feature chunk) the xla scan at streaming-sized blocks
+        wins.
         """
         impl = str(config.tpu_hist_impl)
         block = int(config.tpu_block_rows)
         if impl == "auto":
-            pl_block = block if block > 0 else 256
+            from ..ops.histogram import _PERFEATURE_OUT_BUDGET
+
             leaves = max(int(config.num_leaves), 2)
             k = min(resolve_split_batch(int(config.tpu_split_batch), leaves),
                     leaves - 1)  # the grower's own clamp (make_grower)
             s = 5 if precision == "hilo" else 3
-            fb = num_features * num_bins
             ks_pad = -(-(k * s) // 128) * 128
-            # one-hot [fb, block] in the dot dtype + f32 accumulator/out
-            oh_bytes = 4 if precision == "f32" else 2
-            vmem = fb * pl_block * oh_bytes + 2 * fb * ks_pad * 4
-            # Mosaic constraints: lane-aligned blocks only, and blocks
-            # beyond 256 rows are unvalidated compile territory
-            # (docs/PERF_NOTES.md: block=512 never finished compiling)
-            block_ok = pl_block <= 256 and pl_block % 128 == 0
+            bp = -(-num_bins // 8) * 8
+            # smallest feature chunk the kernel can retreat to: the
+            # learner pads the column axis to a 32-multiple for pallas2,
+            # and 32 is sublane-tile-aligned for every bin dtype — so a
+            # 32-wide [32*Bp, K*S] accumulator block must fit the budget
+            chunk_fits = 32 * bp * ks_pad * 4 <= _PERFEATURE_OUT_BUDGET
+            # an explicit row block must stay Mosaic-lane-aligned for the
+            # kernel's [.., block] grid specs, and within the
+            # hardware-validated range — the [Bp, block] one-hot and
+            # [K*S, block] expanded stats scale with the block, so huge
+            # blocks overflow VMEM (the sweep validated up to 16384);
+            # out-of-range blocks ride the xla scan
+            block_ok = block <= 0 or (block % 128 == 0 and block <= 16384)
             on_tpu = jax.devices()[0].platform == "tpu"
-            fits = vmem <= 12 * 1024 * 1024
             # f32/f64 stay on xla: auto only picks the validated bf16/hilo
             # kernel shape (an explicit tpu_hist_impl=pallas/pallas2 still
             # honors f32 via Precision.HIGHEST inside _hist_pallas)
-            impl = ("pallas" if on_tpu and fits and block_ok
+            impl = ("pallas2" if on_tpu and chunk_fits and block_ok
                     and precision in ("hilo", "bf16") else "xla")
         if block <= 0:
-            block = {"pallas": 256, "pallas2": 4096}.get(impl, 16384)
+            block = {"pallas": 256, "pallas2": 8192}.get(impl, 16384)
         return impl, block
 
     @staticmethod

@@ -396,7 +396,8 @@ class TestFrontierRamp:
         X = rng.normal(size=(4096, 6))
         y = X[:, 0] ** 2 - X[:, 1] + 0.3 * np.sin(4 * X[:, 2]) \
             + 0.1 * rng.normal(size=4096)
-        assert self._dump(X, y, tpu_ramp=True) == self._dump(X, y)
+        assert (self._dump(X, y, tpu_ramp=True)
+                == self._dump(X, y, tpu_ramp=False))
 
     def test_bit_identical_with_categoricals(self):
         rng = np.random.default_rng(14)
@@ -407,7 +408,7 @@ class TestFrontierRamp:
         y = (Xc % 2) * 1.5 + Xn[:, 0] + 0.1 * rng.normal(size=n)
         extra = {"categorical_feature": [0]}
         assert (self._dump(X, y, tpu_ramp=True, **extra)
-                == self._dump(X, y, **extra))
+                == self._dump(X, y, tpu_ramp=False, **extra))
 
 
 class TestPallas2Bundled:
@@ -447,10 +448,11 @@ class TestAutoHistResolution:
     def _resolve(self, **params):
         from lightgbm_tpu.config import Config
         from lightgbm_tpu.models.learner import TPUTreeLearner
-        cfg = Config({"objective": "binary", **params})
+        cfg = Config({"objective": "binary",
+                      **{k: v for k, v in params.items() if k != "_bins"}})
         prec = params.get("tpu_hist_precision", "hilo")
         return TPUTreeLearner._resolve_hist_impl(
-            cfg, params.get("_bins", 255), params.get("_features", 28), prec)
+            cfg, params.get("_bins", 255), prec)
 
     def test_cpu_auto_is_xla_streaming(self):
         # tests pin the cpu backend -> auto must never pick pallas here
@@ -474,12 +476,21 @@ class TestAutoHistResolution:
         class _Dev:
             platform = "tpu"
         monkeypatch.setattr(jax, "devices", lambda *a: [_Dev()])
-        # Higgs shape fits -> pallas at the 256-row block
+        # Higgs shape -> the perfeature kernel at multi-k-row blocks
+        # (docs/PERF_NOTES.md round-3 sweep winner)
         impl, block = self._resolve(num_leaves=255)
-        assert (impl, block) == ("pallas", 256)
-        # a huge F*B working set must fall back to the xla scan
-        impl, block = self._resolve(num_leaves=255, _features=4096)
+        assert (impl, block) == ("pallas2", 8192)
+        # feature width never gates the choice (the kernel chunks the
+        # feature axis itself), but a bin axis too tall for even the
+        # minimum 32-feature chunk's VMEM accumulator block must fall
+        # back to the xla scan
+        impl, block = self._resolve(num_leaves=255, _bins=1024,
+                                    max_bin=1024)
         assert (impl, block) == ("xla", 16384)
+        # explicit blocks beyond the hardware-validated range also fall
+        # back (the [Bp, block]/[K*S, block] temporaries scale with block)
+        impl, block = self._resolve(num_leaves=255, tpu_block_rows=32768)
+        assert (impl, block) == ("xla", 32768)
         # f32 stays on the xla Precision.HIGHEST path in auto mode
         impl, block = self._resolve(num_leaves=255,
                                     tpu_hist_precision="f32")
