@@ -31,7 +31,7 @@ _DS_CACHE = {}
 
 
 def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
-            partition="select", precision="hilo", ramp=False):
+            partition="select", precision="hilo", ramp=False, alpha=0.0):
     import lightgbm_tpu as lgb
     from lightgbm_tpu.utils.backend import host_sync
     from sklearn.metrics import roc_auc_score
@@ -47,6 +47,7 @@ def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
         "tpu_block_rows": block, "tpu_hist_impl": impl,
         "tpu_partition_impl": partition,
         "tpu_hist_precision": precision,
+        "tpu_split_batch_alpha": alpha,
         "tpu_ramp": ramp}, train_set=ds)
     t0 = time.time()
     bst.update()
@@ -75,7 +76,8 @@ def sweep(X, y, configs, iters=6, reraise=False):
                                   cfg.get("impl", "xla"), iters=iters,
                                   partition=cfg.get("part", "select"),
                                   precision=cfg.get("prec", "hilo"),
-                                  ramp=cfg.get("ramp", False))
+                                  ramp=cfg.get("ramp", False),
+                                  alpha=cfg.get("alpha", 0.0))
             print(f"{label}: {ms:6.0f} ms/tree ({1000/ms:5.2f} it/s) "
                   f"compile {cs:5.0f}s auc {auc:.4f}", flush=True)
         except Exception as exc:
@@ -94,7 +96,9 @@ def main():
                           block=int(os.environ.get("BLOCK", 16384)),
                           impl=os.environ.get("IMPL", "xla"),
                           part=os.environ.get("PARTITION", "select"),
-                          prec=os.environ.get("PRECISION", "hilo"))],
+                          prec=os.environ.get("PRECISION", "hilo"),
+                          ramp=os.environ.get("RAMP", "") == "1",
+                          alpha=float(os.environ.get("ALPHA", 0.0)))],
               iters=8, reraise=True)
         return
     if arg == "round2":
@@ -113,6 +117,22 @@ def main():
             dict(k=25, block=4096, impl="pallas2", prec="hilo", ramp=True),
             dict(k=42, block=256, impl="pallas", prec="bf16"),
             dict(k=50, block=256, impl="pallas", prec="hilo"),  # 2 tiles
+        ])
+        return
+    if arg == "round3":
+        # post-default-flip sweep: can the near-tie guard (alpha) buy the
+        # K=50 round count without K=50's split-order AUC loss?  Guard
+        # rounds split only leaves with gain >= alpha * round-max, so
+        # high alpha approaches strict best-first at more rounds/tree
+        sweep(X, y, [
+            dict(k=25, block=8192, impl="pallas2", prec="hilo",
+                 ramp=True),  # current default, re-baseline
+            dict(k=50, block=8192, impl="pallas2", prec="hilo", ramp=True,
+                 alpha=0.2),
+            dict(k=50, block=8192, impl="pallas2", prec="hilo", ramp=True,
+                 alpha=0.5),
+            dict(k=84, block=8192, impl="pallas2", prec="hilo", ramp=True,
+                 alpha=0.5),
         ])
         return
     if arg == "decide":
