@@ -113,11 +113,29 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
 
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from lightgbm_tpu.utils.backend import (pin_cpu_backend,
+    from lightgbm_tpu.utils.backend import (has_tunneled_backend,
+                                            pin_cpu_backend,
                                             probe_default_backend)
 
-    platform = probe_default_backend(
-        timeout_s=float(os.environ.get("BENCH_PROBE_TIMEOUT", 180)))
+    # the tunneled backend has intermittent multi-minute outages (observed
+    # twice in round 3); one failed probe must not condemn the round's
+    # headline number to the degraded CPU path.  When (and only when) a
+    # tunneled backend is registered, keep re-probing inside a bounded
+    # wall-clock window — bounded so a genuinely-dead tunnel still leaves
+    # time to print the degraded number before any outer harness deadline
+    # (the round-1 rc=124 lesson), with retries=0 so the helper's own
+    # retry layer doesn't compound the count.
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
+    window_s = float(os.environ.get("BENCH_PROBE_WINDOW", 420))
+    retry_sleep_s = float(os.environ.get("BENCH_PROBE_RETRY_SLEEP", 30))
+    deadline = time.time() + window_s
+    platform = probe_default_backend(timeout_s=timeout_s, retries=0)
+    while (platform in (None, "cpu") and has_tunneled_backend()
+           and time.time() + retry_sleep_s + timeout_s <= deadline):
+        print("# backend probe failed with a tunneled backend registered; "
+              f"retrying in {retry_sleep_s:.0f}s", file=sys.stderr)
+        time.sleep(retry_sleep_s)
+        platform = probe_default_backend(timeout_s=timeout_s, retries=0)
     degraded = platform is None or platform == "cpu"
     if degraded:
         pin_cpu_backend()
