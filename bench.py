@@ -113,7 +113,7 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
 
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from lightgbm_tpu.utils.backend import (has_tunneled_backend,
+    from lightgbm_tpu.utils.backend import (backend_health,
                                             pin_cpu_backend,
                                             probe_default_backend)
 
@@ -130,7 +130,10 @@ def main():
     retry_sleep_s = float(os.environ.get("BENCH_PROBE_RETRY_SLEEP", 30))
     deadline = time.time() + window_s
     platform = probe_default_backend(timeout_s=timeout_s, retries=0)
-    while (platform in (None, "cpu") and has_tunneled_backend()
+    # only 'probe' (tunneled factory registered, init may hang) is worth
+    # re-probing: 'broken' fails deterministically and 'ok' means no
+    # tunnel exists, so retries there just burn the outer deadline
+    while (platform in (None, "cpu") and backend_health() == "probe"
            and time.time() + retry_sleep_s + timeout_s <= deadline):
         print("# backend probe failed with a tunneled backend registered; "
               f"retrying in {retry_sleep_s:.0f}s", file=sys.stderr)

@@ -204,6 +204,49 @@ class Dataset:
         self.construct()
         return self._inner.num_total_features
 
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        """Merge `other`'s features into this dataset column-wise
+        (reference Dataset::AddFeaturesFrom, c_api.h:297 /
+        python-package Dataset.add_features_from): both datasets are
+        constructed, must hold the same number of rows, and `other`'s
+        binned columns, mappers, names and per-feature metadata are
+        appended after this dataset's."""
+        self.construct()
+        other.construct()
+        ia, ib = self._inner, other._inner
+        if ia.num_data != ib.num_data:
+            raise ValueError("datasets have different row counts")
+        na = ia.num_total_features
+        n_used_a = len(ia.used_feature_idx)
+        n_used_b = len(ib.used_feature_idx)
+        ia.bins = np.concatenate([ia.bins, ib.bins], axis=1)
+        ia.used_feature_idx = list(ia.used_feature_idx) + \
+            [na + c for c in ib.used_feature_idx]
+        ia.mappers = list(ia.mappers) + list(ib.mappers)
+        ia.feature_names = list(ia.feature_names) + list(ib.feature_names)
+        ia.num_total_features = na + ib.num_total_features
+
+        def _merge_per_used(attr, dtype, fill):
+            va, vb = getattr(ia, attr), getattr(ib, attr)
+            if va is None and vb is None:
+                return
+            if va is None:
+                va = np.full(n_used_a, fill, dtype)
+            if vb is None:
+                vb = np.full(n_used_b, fill, dtype)
+            setattr(ia, attr, np.concatenate([va, vb]))
+
+        _merge_per_used("monotone_constraints", np.int32, 0)
+        _merge_per_used("feature_penalty", np.float32, 1.0)
+        # pandas category tables are keyed by category-column order of
+        # appearance; self's columns all precede other's, so the merged
+        # table list is the concatenation (mirrors subset()'s propagation)
+        if self.pandas_categorical or other.pandas_categorical:
+            self.pandas_categorical = ((self.pandas_categorical or [])
+                                       + (other.pandas_categorical or []))
+        ia._device_bins = None
+        return self
+
     def subset(self, used_indices, params=None) -> "Dataset":
         """Row subset sharing this dataset's bin mappers (for cv / bagging)."""
         self.construct()

@@ -673,37 +673,8 @@ def booster_predict_for_csc(bh: int, col_ptr_p: int, col_ptr_type: int,
 def dataset_add_features_from(dh: int, other_dh: int) -> None:
     """Merge `other`'s features into `dh` column-wise (reference
     Dataset::AddFeaturesFrom via LGBM_DatasetAddFeaturesFrom,
-    c_api.h:297): both must be constructed with equal row counts."""
-    a = _get(dh)
-    b = _get(other_dh)
-    a.construct()
-    b.construct()
-    ia, ib = a._inner, b._inner
-    if ia.num_data != ib.num_data:
-        raise ValueError("datasets have different row counts")
-    na = ia.num_total_features
-    n_used_a = len(ia.used_feature_idx)
-    n_used_b = len(ib.used_feature_idx)
-    ia.bins = np.concatenate([ia.bins, ib.bins], axis=1)
-    ia.used_feature_idx = list(ia.used_feature_idx) + \
-        [na + c for c in ib.used_feature_idx]
-    ia.mappers = list(ia.mappers) + list(ib.mappers)
-    ia.feature_names = list(ia.feature_names) + list(ib.feature_names)
-    ia.num_total_features = na + ib.num_total_features
-
-    def _merge_per_used(attr, dtype, fill):
-        va, vb = getattr(ia, attr), getattr(ib, attr)
-        if va is None and vb is None:
-            return
-        if va is None:
-            va = np.full(n_used_a, fill, dtype)
-        if vb is None:
-            vb = np.full(n_used_b, fill, dtype)
-        setattr(ia, attr, np.concatenate([va, vb]))
-
-    _merge_per_used("monotone_constraints", np.int32, 0)
-    _merge_per_used("feature_penalty", np.float32, 1.0)
-    ia._device_bins = None
+    c_api.h:297): delegates to Dataset.add_features_from (basic.py)."""
+    _get(dh).add_features_from(_get(other_dh))
 
 
 def booster_reset_training_data(bh: int, dh: int) -> None:

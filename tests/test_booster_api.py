@@ -97,3 +97,35 @@ class TestBoosterExtras:
                           verbosity=-1).fit(X, y)
         m2 = pickle.loads(pickle.dumps(m))
         np.testing.assert_allclose(m2.predict(X), m.predict(X))
+
+
+class TestAddFeaturesFrom:
+    """Dataset.add_features_from (reference basic.py add_features_from /
+    tests/python_package_test/test_basic.py equivalence check): training
+    on A.add_features_from(B) must match training on the columns stacked
+    up front."""
+
+    def test_merged_training_matches_stacked(self):
+        rng = np.random.default_rng(23)
+        n = 1500
+        Xa = rng.normal(size=(n, 3))
+        Xb = rng.normal(size=(n, 2))
+        y = Xa[:, 0] - 2 * Xb[:, 1] + 0.1 * rng.normal(size=n)
+        params = {"objective": "regression", "num_leaves": 15,
+                  "verbosity": -1}
+
+        da = lgb.Dataset(Xa, label=y)
+        da.add_features_from(lgb.Dataset(Xb, label=None))
+        merged = lgb.train(params, da, num_boost_round=5)
+
+        stacked = lgb.train(params, lgb.Dataset(
+            np.column_stack([Xa, Xb]), label=y), num_boost_round=5)
+        X = np.column_stack([Xa, Xb])
+        np.testing.assert_allclose(merged.predict(X), stacked.predict(X))
+
+    def test_row_count_mismatch_raises(self):
+        rng = np.random.default_rng(24)
+        da = lgb.Dataset(rng.normal(size=(100, 2)), label=rng.normal(size=100))
+        db = lgb.Dataset(rng.normal(size=(101, 2)))
+        with pytest.raises(ValueError, match="row counts"):
+            da.add_features_from(db)
