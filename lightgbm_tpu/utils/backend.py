@@ -60,10 +60,6 @@ def backend_health() -> str:
         return "probe"  # be conservative
 
 
-def has_tunneled_backend() -> bool:
-    """True when default-backend init needs either probing or pinning."""
-    return backend_health() != "ok"
-
 _PROBE_SRC = r"""
 import jax, sys
 import jax.numpy as jnp

@@ -1,0 +1,21 @@
+"""docs/Parameters.md is generated from the config registry
+(tools/gen_params_doc.py, the analog of the reference's
+helpers/parameter_generator.py pipeline); it must stay in sync."""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parameters_doc_in_sync(tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "gen_params_doc", os.path.join(REPO, "tools", "gen_params_doc.py"))
+    gen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gen)
+
+    out = tmp_path / "Parameters.md"
+    gen.main(out_path=str(out))
+    committed = open(os.path.join(REPO, "docs", "Parameters.md")).read()
+    assert committed == out.read_text(), (
+        "docs/Parameters.md is stale; run python tools/gen_params_doc.py")

@@ -1,0 +1,98 @@
+"""Generate docs/Parameters.md from the config registry.
+
+The reference generates docs/Parameters.rst from config.h doc comments via
+helpers/parameter_generator.py (reference src/io/config_auto.cpp:1-9); the
+equivalent here reads `lightgbm_tpu/config.py`'s registry source — section
+markers (`# --- name ---`) and the comment block directly above each entry
+become the doc's sections and notes.
+
+Usage: python tools/gen_params_doc.py   (rewrites docs/Parameters.md)
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from lightgbm_tpu.config import _P  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_sections_and_notes():
+    """registry source -> ({param: section}, {param: note})."""
+    src = open(os.path.join(REPO, "lightgbm_tpu", "config.py")).read()
+    body = src[src.index("_P:"):]
+    section, sections, notes = "", {}, {}
+    pending = []
+    for line in body.splitlines():
+        stripped = line.strip()
+        m = re.match(r"# --- (.+?) ---", stripped)
+        if m:
+            section = m.group(1)
+            pending = []
+            continue
+        if stripped.startswith("#"):
+            pending.append(stripped.lstrip("# ").rstrip())
+            continue
+        m = re.match(r'"(\w+)":\s*\(', stripped)
+        if m:
+            name = m.group(1)
+            sections[name] = section
+            if pending:
+                notes[name] = " ".join(pending)
+            pending = []
+        elif not stripped:
+            pending = []
+        if stripped.startswith("}"):
+            break
+    return sections, notes
+
+
+def fmt_default(v):
+    if isinstance(v, str):
+        return f'`"{v}"`' if v else "`\"\"`"
+    if isinstance(v, list):
+        return "`[]`" if not v else f"`{v}`"
+    return f"`{v}`"
+
+
+def main(out_path=None):
+    sections, notes = parse_sections_and_notes()
+    order = []  # section order of first appearance
+    for name in _P:
+        sec = sections.get(name, "other")
+        if sec not in order:
+            order.append(sec)
+
+    out = [
+        "# Parameters",
+        "",
+        "Generated from the `lightgbm_tpu/config.py` registry by "
+        "`tools/gen_params_doc.py` — do not edit by hand.  Parameter names "
+        "and aliases match the reference (LightGBM v2.3.2) parameter "
+        "system; `tpu_*` entries are this framework's device knobs.",
+        "",
+    ]
+    for sec in order:
+        out.append(f"## {sec}")
+        out.append("")
+        out.append("| parameter | type | default | aliases | notes |")
+        out.append("|---|---|---|---|---|")
+        for name, (typ, default, aliases) in _P.items():
+            if sections.get(name, "other") != sec:
+                continue
+            alias_s = ", ".join(f"`{a}`" for a in aliases) or "—"
+            note = notes.get(name, "").replace("|", "\\|")
+            out.append(f"| `{name}` | {typ} | {fmt_default(default)} | "
+                       f"{alias_s} | {note} |")
+        out.append("")
+    path = out_path or os.path.join(REPO, "docs", "Parameters.md")
+    with open(path, "w") as f:
+        f.write("\n".join(out))
+    print(f"wrote {path}: {len(_P)} parameters, {len(order)} sections")
+
+
+if __name__ == "__main__":
+    main()
