@@ -373,6 +373,12 @@ def _to_2d_array(data, pandas_categorical=None) -> np.ndarray:
                                  training=False)[0]
     if hasattr(data, "toarray"):  # scipy sparse
         return np.asarray(data.toarray(), dtype=np.float64)
+    if isinstance(data, (list, tuple)) and data and all(
+            isinstance(c, np.ndarray) and c.ndim == 2 for c in data):
+        # reference basic.py accepts a list of 2-D ndarray row chunks;
+        # DataFrame/sparse chunks deliberately fall through (categorical
+        # code mapping and densification only exist for whole objects)
+        return np.concatenate(list(data), axis=0, dtype=np.float64)
     return np.asarray(data, dtype=np.float64)
 
 

@@ -129,3 +129,18 @@ class TestAddFeaturesFrom:
         db = lgb.Dataset(rng.normal(size=(101, 2)))
         with pytest.raises(ValueError, match="row counts"):
             da.add_features_from(db)
+
+
+def test_dataset_accepts_list_of_row_chunks():
+    """Reference basic.py accepts `data` as a list of 2-D row chunks;
+    training on the chunk list must equal training on the stacked matrix."""
+    rng = np.random.default_rng(29)
+    chunks = [rng.normal(size=(100, 4)) for _ in range(3)]
+    y = rng.normal(size=300)
+    params = {"objective": "regression", "num_leaves": 7, "verbosity": -1}
+    a = lgb.train(params, lgb.Dataset(chunks, label=y), num_boost_round=3)
+    b = lgb.train(params, lgb.Dataset(np.vstack(chunks), label=y),
+                  num_boost_round=3)
+    X = np.vstack(chunks)
+    np.testing.assert_allclose(a.predict(X), b.predict(X))
+    np.testing.assert_allclose(a.predict(chunks), b.predict(X))
