@@ -83,6 +83,16 @@ class Booster:
             self.params = dict(self._driver.loaded_params)
         else:
             raise ValueError("need train_set, model_file or model_str")
+        if train_set is None and params:
+            # loaded-model boosters skip GBDT.init (which applies the cap
+            # on the train path) and overwrite self.params with the
+            # model's stored params, so honor the USER-supplied
+            # num_threads (and aliases, via Config) here
+            n_threads = int(Config(dict(params)).num_threads)
+            if n_threads > 0:
+                from .native import set_num_threads
+
+                set_num_threads(n_threads)
 
     # -- copy / pickling (reference basic.py Booster round-trips its
     # C handle through the model string; the driver plays that role) ----

@@ -147,6 +147,12 @@ class GBDT:
     def init(self, config: Config, train_data: TrainingData) -> None:
         self.config = config
         self.train_data = train_data
+        if int(config.num_threads) > 0:
+            # cap the native walker's OpenMP pool (reference honors
+            # num_threads process-wide via omp_set_num_threads)
+            from ..native import set_num_threads
+
+            set_num_threads(int(config.num_threads))
         self.num_class = int(config.num_class)
         self.shrinkage_rate = float(config.learning_rate)
         self.objective = create_objective(config)

@@ -252,3 +252,11 @@ class BinnedForestTables:
             self.num_bin.ctypes, self.default_bin.ctypes,
             self.missing_type.ctypes, out.ctypes)
         return out
+
+
+def set_num_threads(n: int) -> None:
+    """Cap the native walker's OpenMP threads (reference `num_threads`
+    config); 0/negative restores the OpenMP default of all cores."""
+    lib = native_lib()
+    if lib is not None:
+        lib.LGBMTPU_SetNumThreads(ctypes.c_int32(int(n)))

@@ -16,8 +16,19 @@
 
 #include <cmath>
 #include <cstdint>
+#include <omp.h>
 
 #define LGBM_EXPORT extern "C" __attribute__((visibility("default")))
+
+// num_threads config plumbing (reference honors it via OpenMP everywhere,
+// e.g. src/c_api.cpp omp_set_num_threads on num_threads>0); n<=0 restores
+// the pre-override default (which respects the user's OMP_NUM_THREADS),
+// captured on the first call — every override goes through here, so the
+// first-call value is the genuine startup default
+LGBM_EXPORT void LGBMTPU_SetNumThreads(int32_t n) {
+  static const int startup_default = omp_get_max_threads();
+  omp_set_num_threads(n > 0 ? n : startup_default);
+}
 
 namespace {
 

@@ -125,3 +125,29 @@ class TestBinnedForestWalker:
         recomputed = bst.predict(X, raw_score=True)
         np.testing.assert_allclose(maintained, recomputed,
                                    rtol=2e-5, atol=2e-5)
+
+
+def test_num_threads_plumbing():
+    """num_threads (and aliases) caps the native walker's OpenMP pool
+    (reference honors it via omp_set_num_threads); smoke: the export
+    exists and threaded predictions are unchanged."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.native import native_lib, set_num_threads
+
+    lib = native_lib()
+    assert hasattr(lib, "LGBMTPU_SetNumThreads")
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(1000, 4))
+    y = rng.normal(size=1000)
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, ds, num_boost_round=5)
+    base = bst.predict(X)
+    set_num_threads(1)
+    try:
+        np.testing.assert_allclose(bst.predict(X), base)
+        loaded = lgb.Booster(params={"nthread": 2},
+                             model_str=bst.model_to_string())
+        np.testing.assert_allclose(loaded.predict(X), base)
+    finally:
+        set_num_threads(0)  # restore the OpenMP default
