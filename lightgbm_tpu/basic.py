@@ -196,6 +196,49 @@ class Dataset:
     def get_init_score(self):
         return self.get_field("init_score")
 
+    def get_data(self):
+        """The raw data this dataset was built from (reference basic.py
+        get_data; None once freed).  Subsets built with subset() slice
+        the parent's raw rows by used_indices, as the reference does."""
+        if self.data is None and getattr(self, "used_indices", None) is not None \
+                and self.reference is not None and self.reference.data is not None:
+            parent = self.reference.data
+            idx = np.asarray(self.used_indices)
+            if _is_pandas_df(parent):
+                return parent.iloc[idx]
+            if isinstance(parent, (list, tuple)):
+                parent = _to_2d_array(parent)
+            return parent[idx]
+        return self.data
+
+    def get_feature_penalty(self):
+        """Per-used-feature split penalty array, or None (reference
+        basic.py get_feature_penalty)."""
+        self.construct()
+        return self._inner.feature_penalty
+
+    def get_monotone_constraints(self):
+        """Per-used-feature monotone constraint array, or None (reference
+        basic.py get_monotone_constraints)."""
+        self.construct()
+        return self._inner.monotone_constraints
+
+    def get_ref_chain(self, ref_limit: int = 100):
+        """The set of datasets reachable through `reference` links
+        (reference basic.py get_ref_chain)."""
+        head = self
+        ref_chain = set()
+        while len(ref_chain) < ref_limit:
+            if isinstance(head, Dataset):
+                ref_chain.add(head)
+                if head.reference is not None and head.reference not in ref_chain:
+                    head = head.reference
+                else:
+                    break
+            else:
+                break
+        return ref_chain
+
     def num_data(self) -> int:
         self.construct()
         return self._inner.num_data

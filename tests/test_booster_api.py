@@ -144,3 +144,20 @@ def test_dataset_accepts_list_of_row_chunks():
     X = np.vstack(chunks)
     np.testing.assert_allclose(a.predict(X), b.predict(X))
     np.testing.assert_allclose(a.predict(chunks), b.predict(X))
+
+
+def test_dataset_getters():
+    """Reference Dataset getters: get_data (incl. subset slicing),
+    get_monotone_constraints, get_feature_penalty, get_ref_chain."""
+    rng = np.random.default_rng(41)
+    X = rng.normal(size=(200, 3))
+    y = rng.normal(size=200)
+    ds = lgb.Dataset(X, label=y,
+                     params={"monotone_constraints": [1, 0, -1]})
+    vs = ds.create_valid(X, label=y)
+    assert ds.get_data() is X
+    np.testing.assert_array_equal(ds.get_monotone_constraints(), [1, 0, -1])
+    assert ds.get_feature_penalty() is None
+    assert {d for d in vs.get_ref_chain()} == {vs, ds}
+    sub = ds.subset([0, 2, 5])
+    np.testing.assert_allclose(sub.get_data(), X[[0, 2, 5]])
