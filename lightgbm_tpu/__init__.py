@@ -23,6 +23,7 @@ if __import__("os").environ.get("LIGHTGBM_TPU_CACHE", "") != "off":
 from .version import __version__
 from .config import Config
 from .basic import Dataset, Booster
+from .utils.log import LightGBMError
 from .engine import train, cv, CVBooster
 from .callback import (
     early_stopping,
@@ -45,6 +46,7 @@ __all__ = [
     "Config",
     "Dataset",
     "Booster",
+    "LightGBMError",
     "train",
     "cv",
     "CVBooster",

@@ -148,7 +148,8 @@ class Application:
             pred_contrib=bool(cfg.predict_contrib),
             pred_early_stop=bool(cfg.pred_early_stop),
             pred_early_stop_freq=int(cfg.pred_early_stop_freq),
-            pred_early_stop_margin=float(cfg.pred_early_stop_margin))
+            pred_early_stop_margin=float(cfg.pred_early_stop_margin),
+            predict_disable_shape_check=bool(cfg.predict_disable_shape_check))
         out = np.asarray(result)
         with open(cfg.output_result, "w") as f:
             if out.ndim == 1:

@@ -14,6 +14,7 @@ import numpy as np
 
 from .config import Config
 from .io.dataset import TrainingData, Metadata
+from .utils.log import LightGBMError  # noqa: F401 (reference basic.py export)
 
 
 class Dataset:
@@ -198,18 +199,36 @@ class Dataset:
 
     def get_data(self):
         """The raw data this dataset was built from (reference basic.py
-        get_data; None once freed).  Subsets built with subset() slice
-        the parent's raw rows by used_indices, as the reference does."""
-        if self.data is None and getattr(self, "used_indices", None) is not None \
-                and self.reference is not None and self.reference.data is not None:
-            parent = self.reference.data
-            idx = np.asarray(self.used_indices)
-            if _is_pandas_df(parent):
-                return parent.iloc[idx]
-            if isinstance(parent, (list, tuple)):
-                parent = _to_2d_array(parent)
-            return parent[idx]
-        return self.data
+        get_data).  Subsets built with subset() slice the parent's raw
+        rows by used_indices — composing indices through subset-of-subset
+        chains — and a freed chain raises, as the reference does."""
+        if self.data is not None or getattr(self, "used_indices", None) is None:
+            if self.data is None and self._inner is not None:
+                raise LightGBMError(
+                    "Cannot call `get_data` after freed raw data, "
+                    "set free_raw_data=False when construct Dataset to "
+                    "avoid this.")
+            return self.data
+        # walk the reference chain, composing used_indices, until a
+        # parent still holding raw rows is found
+        idx = np.asarray(self.used_indices)
+        parent = self.reference
+        while parent is not None and parent.data is None \
+                and getattr(parent, "used_indices", None) is not None \
+                and parent.reference is not None:
+            idx = np.asarray(parent.used_indices)[idx]
+            parent = parent.reference
+        if parent is None or parent.data is None:
+            raise LightGBMError(
+                "Cannot call `get_data` after freed raw data, "
+                "set free_raw_data=False when construct Dataset to "
+                "avoid this.")
+        pdata = parent.data
+        if _is_pandas_df(pdata):
+            return pdata.iloc[idx]
+        if isinstance(pdata, (list, tuple)):
+            pdata = _to_2d_array(pdata)
+        return pdata[idx]
 
     def get_feature_penalty(self):
         """Per-used-feature split penalty array, or None (reference

@@ -84,10 +84,12 @@ class Booster:
         else:
             raise ValueError("need train_set, model_file or model_str")
         if train_set is None and params:
+            # reference basic.py merges user-supplied params over the
+            # loaded model's stored ones, so introspection reflects them
+            self.params.update(params)
             # loaded-model boosters skip GBDT.init (which applies the cap
-            # on the train path) and overwrite self.params with the
-            # model's stored params, so honor the USER-supplied
-            # num_threads (and aliases, via Config) here
+            # on the train path), so honor the USER-supplied num_threads
+            # (and aliases, via Config) here
             n_threads = int(Config(dict(params)).num_threads)
             if n_threads > 0:
                 from .native import set_num_threads
@@ -197,6 +199,29 @@ class Booster:
                 X = load_text_file(data, label_column="", header=None)[0]
         else:
             X = _to_2d_array(data, self.pandas_categorical)
+        n_feat = self.num_feature()
+        if X.shape[1] != n_feat:
+            from .config import _parse_bool
+
+            disable = _parse_bool(kwargs.get(
+                "predict_disable_shape_check",
+                Config(self.params).predict_disable_shape_check))
+            if not disable:
+                from .utils.log import LightGBMError
+
+                raise LightGBMError(
+                    f"The number of features in data ({X.shape[1]}) is not "
+                    f"the same as it was in training data ({n_feat}).\n"
+                    "You can set ``predict_disable_shape_check=true`` to "
+                    "discard this error, but please be aware what you are "
+                    "doing.")
+            if X.shape[1] < n_feat:
+                # absent trailing features predict as missing, like the
+                # reference C predictor reading past ncol
+                pad = np.full((X.shape[0], n_feat - X.shape[1]), np.nan)
+                X = np.concatenate([np.asarray(X, np.float64), pad], axis=1)
+            else:
+                X = np.asarray(X, np.float64)[:, :n_feat]
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
         return self._driver.predict(

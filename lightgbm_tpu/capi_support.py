@@ -386,7 +386,7 @@ def booster_predict_for_file(bh: int, data_filename: str, has_header: int,
         kw["pred_contrib"] = True
     pcfg = Config({**bst.params, **p})
     for key in ("pred_early_stop", "pred_early_stop_freq",
-                "pred_early_stop_margin"):
+                "pred_early_stop_margin", "predict_disable_shape_check"):
         kw[key] = getattr(pcfg, key)
     X = load_text_file(data_filename,
                        label_column=str(pcfg.label_column or ""),

@@ -122,6 +122,7 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "predict_leaf_index": ("bool", False, ("is_predict_leaf_index", "leaf_index")),
     "predict_contrib": ("bool", False, ("is_predict_contrib", "contrib")),
     "num_iteration_predict": ("int", -1, ()),
+    "predict_disable_shape_check": ("bool", False, ()),
     "pred_early_stop": ("bool", False, ()),
     "pred_early_stop_freq": ("int", 10, ()),
     "pred_early_stop_margin": ("float", 10.0, ()),

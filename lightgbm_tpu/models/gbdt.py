@@ -147,12 +147,14 @@ class GBDT:
     def init(self, config: Config, train_data: TrainingData) -> None:
         self.config = config
         self.train_data = train_data
-        if int(config.num_threads) > 0:
-            # cap the native walker's OpenMP pool (reference honors
-            # num_threads process-wide via omp_set_num_threads)
-            from ..native import set_num_threads
+        # cap (or restore: the native side maps n<=0 back to the captured
+        # startup default) the walker's OpenMP pool unconditionally, so a
+        # cap from a previous Booster never leaks into this training
+        # (reference honors num_threads process-wide via
+        # omp_set_num_threads)
+        from ..native import set_num_threads
 
-            set_num_threads(int(config.num_threads))
+        set_num_threads(int(config.num_threads))
         self.num_class = int(config.num_class)
         self.shrinkage_rate = float(config.learning_rate)
         self.objective = create_objective(config)

@@ -269,8 +269,8 @@ class TPUTreeLearner:
         hilo + ramp): pallas2/8192 3.14 it/s vs pallas/256 1.82 it/s vs
         xla/16384 1.23 it/s, identical train AUC.  Everywhere else (CPU
         tests, f64 deterministic mode, bin counts too tall for even the
-        minimum 32-feature chunk) the xla scan at streaming-sized blocks
-        wins.
+        minimum dtype-tile-wide feature chunk — 32 features for uint8
+        bins, 8 for int32) the xla scan at streaming-sized blocks wins.
         """
         impl = str(config.tpu_hist_impl)
         block = int(config.tpu_block_rows)
@@ -284,10 +284,12 @@ class TPUTreeLearner:
             ks_pad = -(-(k * s) // 128) * 128
             bp = -(-num_bins // 8) * 8
             # smallest feature chunk the kernel can retreat to: the
-            # learner pads the column axis to a 32-multiple for pallas2,
-            # and 32 is sublane-tile-aligned for every bin dtype — so a
-            # 32-wide [32*Bp, K*S] accumulator block must fit the budget
-            chunk_fits = 32 * bp * ks_pad * 4 <= _PERFEATURE_OUT_BUDGET
+            # sublane tile of the bins dtype (uint8 for <=256 bins, else
+            # int32 — learner.py bin_dtype / _hist_pallas's step table),
+            # so a [step*Bp, K*S] accumulator block must fit the budget;
+            # the learner's 32-multiple column pad keeps either divisible
+            step = 32 if num_bins <= 256 else 8
+            chunk_fits = step * bp * ks_pad * 4 <= _PERFEATURE_OUT_BUDGET
             # an explicit row block must stay Mosaic-lane-aligned for the
             # kernel's [.., block] grid specs, and within the
             # hardware-validated range — the [Bp, block] one-hot and

@@ -207,8 +207,10 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
       iota-compare into dot operand layout) and caps the block at 256
       rows before VMEM overflows, putting ~4k grid steps of accumulator
       read-modify-write on the critical path.
-    * "perfeature" (impl "pallas2", experimental until timed on
-      hardware): the one-hot is generated per feature ([Bp, blk],
+    * "perfeature" (impl "pallas2", the hardware-validated auto default:
+      3.14 it/s on the Higgs-1M bench shape at 8192-row blocks with
+      hilo precision + frontier ramp, round-3 sweep in
+      docs/PERF_NOTES.md): the one-hot is generated per feature ([Bp, blk],
       statically-unrolled dots), so the largest temporary shrinks from
       [F*B, blk] to [Bp, blk], blocks of 2-8k rows fit, and the grid
       shrinks ~16x.  Each feature's bin rows live at a sublane-aligned

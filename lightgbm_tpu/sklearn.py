@@ -254,7 +254,7 @@ class LGBMModel:
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration,
                                      pred_leaf=pred_leaf,
-                                     pred_contrib=pred_contrib)
+                                     pred_contrib=pred_contrib, **kwargs)
 
     # -- attributes ----------------------------------------------------
     @property

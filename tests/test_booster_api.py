@@ -161,3 +161,60 @@ def test_dataset_getters():
     assert {d for d in vs.get_ref_chain()} == {vs, ds}
     sub = ds.subset([0, 2, 5])
     np.testing.assert_allclose(sub.get_data(), X[[0, 2, 5]])
+    # subset-of-subset composes used_indices through the chain
+    sub2 = sub.subset([1, 2])
+    np.testing.assert_allclose(sub2.get_data(), X[[2, 5]])
+    # a freed chain raises instead of silently returning None
+    ds.data = None
+    ds.construct()
+    with pytest.raises(lgb.LightGBMError, match="freed raw data"):
+        sub2.get_data()
+    with pytest.raises(lgb.LightGBMError, match="freed raw data"):
+        ds.get_data()
+    ds.data = X
+
+
+def test_predict_shape_check(trained):
+    """predict raises on feature-count mismatch unless
+    predict_disable_shape_check (reference Parameters.rst)."""
+    bst, X = trained
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(X[:, :3])
+    # disabled: absent features predict as missing, extras are dropped
+    p_full = bst.predict(X)
+    p_short = bst.predict(X[:, :3], predict_disable_shape_check=True)
+    assert p_short.shape == p_full.shape
+    Xw = np.concatenate([X, X[:, :1]], axis=1)
+    np.testing.assert_allclose(
+        bst.predict(Xw, predict_disable_shape_check=True), p_full)
+    # reference-style string values coerce through the config bool parser
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        bst.predict(X[:, :3], predict_disable_shape_check="false")
+    assert bst.predict(X[:, :3],
+                       predict_disable_shape_check="true").shape == p_full.shape
+
+
+def test_sklearn_predict_forwards_kwargs(trained):
+    """sklearn predict forwards **kwargs to Booster.predict (reference
+    sklearn.py), so predict_disable_shape_check works through it."""
+    from lightgbm_tpu.sklearn import LGBMRegressor
+
+    bst, X = trained
+    rng = np.random.default_rng(3)
+    y = X[:, 0] - X[:, 1]
+    est = LGBMRegressor(n_estimators=4, num_leaves=7).fit(X, y)
+    with pytest.raises(lgb.LightGBMError, match="number of features"):
+        est.predict(X[:, :3])
+    out = est.predict(X[:, :3], predict_disable_shape_check=True)
+    assert out.shape == (X.shape[0],)
+
+
+def test_loaded_booster_merges_user_params(trained, tmp_path):
+    """User params merge over a loaded model's stored params
+    (reference basic.py Booster __init__ model_file path)."""
+    bst, _ = trained
+    f = tmp_path / "m.txt"
+    bst.save_model(str(f))
+    loaded = lgb.Booster(params={"num_threads": 2}, model_file=str(f))
+    assert loaded.params["num_threads"] == 2
+    assert loaded.params["objective"] == "regression"

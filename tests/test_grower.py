@@ -481,11 +481,16 @@ class TestAutoHistResolution:
         impl, block = self._resolve(num_leaves=255)
         assert (impl, block) == ("pallas2", 8192)
         # feature width never gates the choice (the kernel chunks the
-        # feature axis itself), but a bin axis too tall for even the
-        # minimum 32-feature chunk's VMEM accumulator block must fall
-        # back to the xla scan
+        # feature axis itself); >256-bin data stores int32 bins whose
+        # sublane tile is 8, so the kernel can retreat to 8-wide feature
+        # chunks and 1024 bins still fits the VMEM accumulator budget
         impl, block = self._resolve(num_leaves=255, _bins=1024,
                                     max_bin=1024)
+        assert (impl, block) == ("pallas2", 8192)
+        # but a bin axis too tall for even the minimum 8-feature chunk
+        # must fall back to the xla scan
+        impl, block = self._resolve(num_leaves=255, _bins=2048,
+                                    max_bin=2048)
         assert (impl, block) == ("xla", 16384)
         # explicit blocks beyond the hardware-validated range also fall
         # back (the [Bp, block]/[K*S, block] temporaries scale with block)
