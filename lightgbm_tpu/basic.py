@@ -13,7 +13,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 import numpy as np
 
 from .config import Config
-from .io.dataset import TrainingData, Metadata
+from .io.dataset import TrainingData, Metadata, _is_scipy_sparse
 from .utils.log import LightGBMError  # noqa: F401 (reference basic.py export)
 
 
@@ -79,7 +79,9 @@ class Dataset:
                 if feature_names is None:
                     feature_names = pd_names
             else:
-                X = _to_2d_array(self.data)
+                X = self.data
+                if not _is_scipy_sparse(X):
+                    X = _to_2d_array(X)
             cat: Sequence[int] = []
             if isinstance(self.categorical_feature, (list, tuple)):
                 if all(isinstance(c, (int, np.integer)) for c in self.categorical_feature):
@@ -89,7 +91,10 @@ class Dataset:
             # pandas category-dtype columns are categorical regardless of
             # the (default "auto") categorical_feature setting
             cat = sorted(set(cat) | set(pd_cat_idx))
-            self._inner = TrainingData.from_matrix(
+            # sparse input bins in O(nnz) without the [n, F] f64 blow-up
+            factory = (TrainingData.from_sparse if _is_scipy_sparse(X)
+                       else TrainingData.from_matrix)
+            self._inner = factory(
                 X, None if self.label is None else np.asarray(self.label),
                 cfg, weight=self.weight, group_sizes=self.group,
                 init_score=self.init_score, reference=ref_inner,

@@ -198,6 +198,15 @@ class Booster:
             if X.shape[1] == self.num_feature() - 1:
                 X = load_text_file(data, label_column="", header=None)[0]
         else:
+            from .io.dataset import _is_scipy_sparse
+
+            if _is_scipy_sparse(data):
+                # densify in bounded row chunks for the native walker —
+                # never the whole [n, F] f64 (reference PredictForCSR
+                # walks rows sparse; chunking keeps peak memory O(chunk))
+                return self._predict_sparse_chunked(
+                    data, num_iteration, raw_score, pred_leaf, pred_contrib,
+                    kwargs)
             X = _to_2d_array(data, self.pandas_categorical)
         n_feat = self.num_feature()
         if X.shape[1] != n_feat:
@@ -231,6 +240,53 @@ class Booster:
             pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq", 10)),
             pred_early_stop_margin=float(
                 kwargs.get("pred_early_stop_margin", 10.0)))
+
+    def _predict_sparse_chunked(self, data, num_iteration, raw_score,
+                                pred_leaf, pred_contrib, kwargs,
+                                chunk_rows: int = 65536) -> np.ndarray:
+        """Predict a scipy sparse matrix in dense row chunks.
+
+        Every driver output is n-first ([n], [n, k], [n, T], [n, k*(F+1)])
+        so chunks concatenate on axis 0; peak host memory is one
+        [chunk_rows, F] f64 block instead of the full densified matrix."""
+        n_feat = self.num_feature()
+        if data.shape[1] != n_feat:
+            from .config import _parse_bool
+            from .utils.log import LightGBMError
+
+            if not _parse_bool(kwargs.get(
+                    "predict_disable_shape_check",
+                    Config(self.params).predict_disable_shape_check)):
+                raise LightGBMError(
+                    f"The number of features in data ({data.shape[1]}) is "
+                    f"not the same as it was in training data ({n_feat}).\n"
+                    "You can set ``predict_disable_shape_check=true`` to "
+                    "discard this error, but please be aware what you are "
+                    "doing.")
+        if num_iteration is None:
+            num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
+        Xr = data.tocsr()
+        if Xr.shape[1] > n_feat:
+            # drop extra columns while still sparse (O(nnz)) — densifying
+            # at full width would defeat the bounded-memory chunking
+            Xr = Xr[:, :n_feat]
+        outs = []
+        for lo in range(0, max(Xr.shape[0], 1), chunk_rows):
+            chunk = np.asarray(
+                Xr[lo:lo + chunk_rows].todense(), dtype=np.float64)
+            if chunk.shape[1] < n_feat:
+                pad = np.full((chunk.shape[0], n_feat - chunk.shape[1]),
+                              np.nan)
+                chunk = np.concatenate([chunk, pad], axis=1)
+            outs.append(self._driver.predict(
+                chunk, num_iteration=num_iteration, raw_score=raw_score,
+                pred_leaf=pred_leaf, pred_contrib=pred_contrib,
+                pred_early_stop=bool(kwargs.get("pred_early_stop", False)),
+                pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq",
+                                                    10)),
+                pred_early_stop_margin=float(
+                    kwargs.get("pred_early_stop_margin", 10.0))))
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 
     def model_from_string(self, model_str: str, verbose: bool = True
                           ) -> "Booster":

@@ -118,8 +118,8 @@ def dataset_create_from_csr(indptr_ptr: int, indptr_type: int, indices_ptr: int,
                             data_ptr: int, data_type: int, nindptr: int,
                             nelem: int, num_col: int, params: str,
                             ref_handle: int) -> int:
-    X = _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
-                     data_type, nindptr, nelem, num_col)
+    X = _scipy_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                   data_type, nindptr, nelem, num_col)
     ref = _get(ref_handle) if ref_handle else None
     ds = Dataset(X, reference=ref, params=_params_dict(params))
     ds.construct()
@@ -443,8 +443,9 @@ def booster_get_feature_names(bh: int) -> str:
 
 def _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
                  data_type, nindptr, nelem, num_col):
-    """CSR pointers -> dense [nrow, num_col] f64 (the binned core is
-    dense; EFB re-compresses at bin time)."""
+    """CSR pointers -> dense [nrow, num_col] f64 (block-bounded callers
+    only: the streaming push path; whole-matrix ingest goes through
+    _scipy_csr)."""
     indptr = _vec_from_ptr(indptr_ptr, indptr_type, nindptr).astype(np.int64)
     indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int64)
     vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
@@ -453,6 +454,18 @@ def _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
     row_of = np.repeat(np.arange(nrow), np.diff(indptr))
     X[row_of, indices] = vals
     return X
+
+
+def _scipy_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+               data_type, nindptr, nelem, num_col):
+    """CSR pointers -> scipy.sparse.csr_matrix, O(nnz), no densify."""
+    from scipy import sparse as sps
+
+    indptr = _vec_from_ptr(indptr_ptr, indptr_type, nindptr).astype(np.int64)
+    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int32)
+    vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
+    return sps.csr_matrix((vals, indices, indptr),
+                          shape=(nindptr - 1, num_col))
 
 
 def _predict_kwargs(predict_type: int) -> dict:
@@ -483,10 +496,10 @@ def booster_predict_for_csr(bh: int, indptr_ptr: int, indptr_type: int,
                             nindptr: int, nelem: int, num_col: int,
                             predict_type: int, num_iteration: int,
                             params: str, out_ptr: int) -> int:
-    """Densify the CSR rows then share the matrix predict path
+    """Sparse rows ride Booster.predict's chunked-densify path
     (reference c_api.h:644 PredictForCSR)."""
-    X = _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
-                     data_type, nindptr, nelem, num_col)
+    X = _scipy_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                   data_type, nindptr, nelem, num_col)
     return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
 
 
@@ -633,27 +646,26 @@ def dataset_dump_text(dh: int, filename: str) -> None:
             f.write(f"{label[i]:g}\t{row}\n")
 
 
-def _densify_csc(col_ptr_p: int, col_ptr_type: int, indices_ptr: int,
-                 data_ptr: int, data_type: int, ncol_ptr: int, nelem: int,
-                 num_row: int):
-    """CSC pointers -> dense [num_row, ncol] f64."""
-    col_ptr = _vec_from_ptr(col_ptr_p, col_ptr_type,
-                            ncol_ptr).astype(np.int64)
-    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int64)
+def _scipy_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr, data_type,
+               ncol_ptr, nelem, num_row):
+    """CSC pointers -> scipy.sparse.csc_matrix, O(nnz), no densify
+    (reference LGBM_DatasetCreateFromCSC keeps columns sparse,
+    c_api.cpp CSC path / src/io/sparse_bin.hpp:73)."""
+    from scipy import sparse as sps
+
+    col_ptr = _vec_from_ptr(col_ptr_p, col_ptr_type, ncol_ptr).astype(np.int64)
+    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int32)
     vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
-    ncol = ncol_ptr - 1
-    X = np.zeros((num_row, ncol), np.float64)
-    col_of = np.repeat(np.arange(ncol), np.diff(col_ptr))
-    X[indices, col_of] = vals
-    return X
+    return sps.csc_matrix((vals, indices, col_ptr),
+                          shape=(num_row, ncol_ptr - 1))
 
 
 def dataset_create_from_csc(col_ptr_p: int, col_ptr_type: int,
                             indices_ptr: int, data_ptr: int, data_type: int,
                             ncol_ptr: int, nelem: int, num_row: int,
                             params: str, ref_handle: int) -> int:
-    X = _densify_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr,
-                     data_type, ncol_ptr, nelem, num_row)
+    X = _scipy_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr,
+                   data_type, ncol_ptr, nelem, num_row)
     ref = _get(ref_handle) if ref_handle else None
     ds = Dataset(X, reference=ref, params=_params_dict(params))
     ds.construct()
@@ -665,8 +677,8 @@ def booster_predict_for_csc(bh: int, col_ptr_p: int, col_ptr_type: int,
                             ncol_ptr: int, nelem: int, num_row: int,
                             predict_type: int, num_iteration: int,
                             params: str, out_ptr: int) -> int:
-    X = _densify_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr,
-                     data_type, ncol_ptr, nelem, num_row)
+    X = _scipy_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr,
+                   data_type, ncol_ptr, nelem, num_row)
     return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
 
 

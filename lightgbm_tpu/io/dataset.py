@@ -29,6 +29,11 @@ from .bin_mapper import BinMapper, BinType, MissingType, K_ZERO_THRESHOLD
 from .parser import load_text_file
 
 
+def _is_scipy_sparse(data) -> bool:
+    """scipy.sparse matrix/array, detected without importing scipy."""
+    return hasattr(data, "tocsc") and hasattr(data, "nnz")
+
+
 class Metadata:
     """Labels, weights, query boundaries, init scores (reference dataset.h:87)."""
 
@@ -200,12 +205,7 @@ class TrainingData:
                               else [f"Column_{i}" for i in range(nf)])
 
         if reference is not None:
-            self.mappers = reference.mappers
-            self.used_feature_idx = list(reference.used_feature_idx)
-            self.monotone_constraints = reference.monotone_constraints
-            self.feature_penalty = reference.feature_penalty
-            if reference.num_total_features != nf:
-                raise ValueError("validation data feature count mismatch")
+            self._adopt_reference_mappers(reference)
         else:
             self._find_mappers_maybe_distributed(
                 X, config, categorical_features or [], forced_bins or {})
@@ -219,6 +219,78 @@ class TrainingData:
             for j, col in enumerate(self.used_feature_idx):
                 bins[:, j] = self.mappers[col].values_to_bins(
                     X[:, col]).astype(dtype)
+            self.bins = bins
+
+        self.metadata = Metadata(n, label, weight, group_sizes, init_score)
+        self._set_constraints(config)
+        return self
+
+    @classmethod
+    def from_sparse(cls, sp, label: Optional[np.ndarray] = None,
+                    config: Optional[Config] = None,
+                    weight: Optional[np.ndarray] = None,
+                    group_sizes: Optional[np.ndarray] = None,
+                    init_score: Optional[np.ndarray] = None,
+                    reference: Optional["TrainingData"] = None,
+                    feature_names: Optional[List[str]] = None,
+                    categorical_features: Optional[Sequence[int]] = None,
+                    forced_bins: Optional[Dict[int, List[float]]] = None,
+                    ) -> "TrainingData":
+        """Bin a scipy CSR/CSC matrix in O(nnz) host memory.
+
+        The reference keeps sparse features delta-encoded end to end
+        (src/io/sparse_bin.hpp:73, include/LightGBM/bin.h:472-508); the
+        TPU core is a dense `[n, F]` int8/16 matrix (the histogram
+        kernel's one-hot contraction wants fixed shape), so the sparse
+        path's job is to reach that matrix WITHOUT ever materializing the
+        `[n, F]` f64 intermediate: bin finding reads stored values off
+        the CSC arrays, and binning fills each column with its zero bin
+        then scatters the O(nnz) stored-value bins.
+        """
+        config = config or Config()
+        sp = sp.tocsc()
+        n, nf = sp.shape
+        self = cls()
+        self.config = config
+        self.num_data = n
+        self.num_total_features = nf
+        self.feature_names = (list(feature_names) if feature_names
+                              else [f"Column_{i}" for i in range(nf)])
+
+        if reference is not None:
+            self._adopt_reference_mappers(reference)
+        else:
+            from .distributed_binning import config_wants_distributed
+
+            if config_wants_distributed(config):
+                # a host silently densifying while its peers shard
+                # features would change sample semantics mid-collective;
+                # reject loudly until the sharded path learns CSC
+                raise NotImplementedError(
+                    "sparse input with distributed (pre_partition) bin "
+                    "finding is not supported yet; densify or load from "
+                    "file")
+            self._find_mappers(sp, config, categorical_features or [],
+                               forced_bins or {})
+
+        from ..utils import timer
+
+        with timer.PHASE("binning"):
+            dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+            bins = np.empty((n, self.num_features), dtype=dtype)
+            indptr, indices, data = sp.indptr, sp.indices, sp.data
+            for j, col in enumerate(self.used_feature_idx):
+                m = self.mappers[col]
+                lo, hi = int(indptr[col]), int(indptr[col + 1])
+                # implicit zeros take the column's zero-value bin
+                # (most_freq_bin semantics fall out of value_to_bin(0))
+                zero_bin = int(m.values_to_bins(np.zeros(1))[0])
+                colbins = np.full(n, zero_bin, dtype=dtype)
+                if hi > lo:
+                    vals = np.asarray(data[lo:hi], dtype=np.float64)
+                    colbins[indices[lo:hi]] = \
+                        m.values_to_bins(vals).astype(dtype)
+                bins[:, j] = colbins
             self.bins = bins
 
         self.metadata = Metadata(n, label, weight, group_sizes, init_score)
@@ -324,12 +396,7 @@ class TrainingData:
         self.num_total_features = ncols
         self.feature_names = list(names)
         if reference is not None:
-            self.mappers = reference.mappers
-            self.used_feature_idx = list(reference.used_feature_idx)
-            self.monotone_constraints = reference.monotone_constraints
-            self.feature_penalty = reference.feature_penalty
-            if reference.num_total_features != self.num_total_features:
-                raise ValueError("validation data feature count mismatch")
+            self._adopt_reference_mappers(reference)
         else:
             cat = _parse_column_spec(config.categorical_feature, names)
             self._find_mappers_maybe_distributed(
@@ -426,6 +493,16 @@ class TrainingData:
         return self
 
     # ------------------------------------------------------------------
+    def _adopt_reference_mappers(self, reference: "TrainingData") -> None:
+        """Share the reference's BinMappers for validation-set alignment
+        (reference dataset.h:501 CreateValid)."""
+        self.mappers = reference.mappers
+        self.used_feature_idx = list(reference.used_feature_idx)
+        self.monotone_constraints = reference.monotone_constraints
+        self.feature_penalty = reference.feature_penalty
+        if reference.num_total_features != self.num_total_features:
+            raise ValueError("validation data feature count mismatch")
+
     def _find_mappers_maybe_distributed(self, X, config, categorical,
                                         forced_bins,
                                         total_rows: Optional[int] = None
@@ -473,6 +550,13 @@ class TrainingData:
             Xs = X[sample_idx]
         else:
             Xs = X
+        # sparse input: per-column stored values come straight off the
+        # CSC arrays — the f64 matrix is never densified (reference
+        # sparse-aware sampling, dataset_loader.cpp:959-1042 /
+        # src/io/sparse_bin.hpp:73)
+        sp_csc = None
+        if _is_scipy_sparse(Xs):
+            sp_csc = Xs.tocsc()
         total = Xs.shape[0]
 
         ignore = set(_parse_column_spec(config.ignore_column, self.feature_names))
@@ -492,9 +576,14 @@ class TrainingData:
                 m.is_trivial = True
                 self.mappers.append(m)
                 continue
-            colv = Xs[:, col]
+            if sp_csc is not None:
+                colv = sp_csc.data[sp_csc.indptr[col]:sp_csc.indptr[col + 1]]
+                colv = np.asarray(colv, dtype=np.float64)
+            else:
+                colv = Xs[:, col]
             # drop (near-)zeros: implied by total_sample_cnt (reference
-            # dataset_loader.cpp sparse-aware sampling)
+            # dataset_loader.cpp sparse-aware sampling; stored sparse
+            # zeros drop identically to dense explicit zeros)
             nonzero = colv[~((np.abs(colv) <= K_ZERO_THRESHOLD) & ~np.isnan(colv))]
             mb = int(config.max_bin)
             if max_bin_by_feature and gcol < len(max_bin_by_feature):
@@ -524,9 +613,11 @@ class TrainingData:
                 dtype=np.float32)
 
     # ------------------------------------------------------------------
-    def create_valid(self, X: np.ndarray, label: Optional[np.ndarray] = None,
+    def create_valid(self, X, label: Optional[np.ndarray] = None,
                      **kw) -> "TrainingData":
-        return TrainingData.from_matrix(X, label, self.config, reference=self, **kw)
+        factory = (TrainingData.from_sparse if _is_scipy_sparse(X)
+                   else TrainingData.from_matrix)
+        return factory(X, label, self.config, reference=self, **kw)
 
     def real_threshold(self, feature: int, bin_threshold: int) -> float:
         """Bin threshold -> raw-value threshold for model serialization.
