@@ -210,20 +210,7 @@ class Booster:
             X = _to_2d_array(data, self.pandas_categorical)
         n_feat = self.num_feature()
         if X.shape[1] != n_feat:
-            from .config import _parse_bool
-
-            disable = _parse_bool(kwargs.get(
-                "predict_disable_shape_check",
-                Config(self.params).predict_disable_shape_check))
-            if not disable:
-                from .utils.log import LightGBMError
-
-                raise LightGBMError(
-                    f"The number of features in data ({X.shape[1]}) is not "
-                    f"the same as it was in training data ({n_feat}).\n"
-                    "You can set ``predict_disable_shape_check=true`` to "
-                    "discard this error, but please be aware what you are "
-                    "doing.")
+            self._check_predict_shape(X.shape[1], kwargs)
             if X.shape[1] < n_feat:
                 # absent trailing features predict as missing, like the
                 # reference C predictor reading past ncol
@@ -241,6 +228,24 @@ class Booster:
             pred_early_stop_margin=float(
                 kwargs.get("pred_early_stop_margin", 10.0)))
 
+    def _check_predict_shape(self, ncols: int, kwargs) -> None:
+        """Raise on a predict feature-count mismatch unless
+        predict_disable_shape_check (kwargs over stored params) is set —
+        reference Parameters.rst semantics, string values accepted."""
+        from .config import _parse_bool
+
+        if _parse_bool(kwargs.get(
+                "predict_disable_shape_check",
+                Config(self.params).predict_disable_shape_check)):
+            return
+        from .utils.log import LightGBMError
+
+        raise LightGBMError(
+            f"The number of features in data ({ncols}) is not the same as "
+            f"it was in training data ({self.num_feature()}).\n"
+            "You can set ``predict_disable_shape_check=true`` to discard "
+            "this error, but please be aware what you are doing.")
+
     def _predict_sparse_chunked(self, data, num_iteration, raw_score,
                                 pred_leaf, pred_contrib, kwargs,
                                 chunk_rows: int = 65536) -> np.ndarray:
@@ -251,18 +256,7 @@ class Booster:
         [chunk_rows, F] f64 block instead of the full densified matrix."""
         n_feat = self.num_feature()
         if data.shape[1] != n_feat:
-            from .config import _parse_bool
-            from .utils.log import LightGBMError
-
-            if not _parse_bool(kwargs.get(
-                    "predict_disable_shape_check",
-                    Config(self.params).predict_disable_shape_check)):
-                raise LightGBMError(
-                    f"The number of features in data ({data.shape[1]}) is "
-                    f"not the same as it was in training data ({n_feat}).\n"
-                    "You can set ``predict_disable_shape_check=true`` to "
-                    "discard this error, but please be aware what you are "
-                    "doing.")
+            self._check_predict_shape(data.shape[1], kwargs)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
         Xr = data.tocsr()

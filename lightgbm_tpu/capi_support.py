@@ -266,7 +266,8 @@ def booster_predict_for_mat(bh: int, ptr: int, data_type: int, nrow: int,
                             num_iteration: int, params: str,
                             out_ptr: int) -> int:
     X = _mat_from_ptr(ptr, data_type, nrow, ncol, is_row_major)
-    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr,
+                         params)
 
 
 def booster_calc_num_predict(bh: int, nrow: int, predict_type: int,
@@ -479,10 +480,18 @@ def _predict_kwargs(predict_type: int) -> dict:
 
 
 def _predict_into(bst, X, predict_type: int, num_iteration: int,
-                  out_ptr: int) -> int:
+                  out_ptr: int, params: str = "") -> int:
     ni = num_iteration if num_iteration > 0 else None
+    kw = _predict_kwargs(predict_type)
+    if params:
+        # forward the predict-time keys from the C params string
+        # (reference c_api.cpp predict paths parse the full Config)
+        pcfg = Config({**bst.params, **_params_dict(params)})
+        for key in ("pred_early_stop", "pred_early_stop_freq",
+                    "pred_early_stop_margin", "predict_disable_shape_check"):
+            kw[key] = getattr(pcfg, key)
     pred = np.asarray(
-        bst.predict(X, num_iteration=ni, **_predict_kwargs(predict_type)),
+        bst.predict(X, num_iteration=ni, **kw),
         dtype=np.float64).reshape(-1)
     out = np.ctypeslib.as_array(
         ctypes.cast(out_ptr, ctypes.POINTER(ctypes.c_double)),
@@ -500,7 +509,8 @@ def booster_predict_for_csr(bh: int, indptr_ptr: int, indptr_type: int,
     (reference c_api.h:644 PredictForCSR)."""
     X = _scipy_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
                    data_type, nindptr, nelem, num_col)
-    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr,
+                         params)
 
 
 def dataset_create_from_mats(ptrs_ptr: int, data_type: int, nrows_ptr: int,
@@ -679,7 +689,8 @@ def booster_predict_for_csc(bh: int, col_ptr_p: int, col_ptr_type: int,
                             params: str, out_ptr: int) -> int:
     X = _scipy_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr,
                    data_type, ncol_ptr, nelem, num_row)
-    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr,
+                         params)
 
 
 def dataset_add_features_from(dh: int, other_dh: int) -> None:
@@ -710,7 +721,8 @@ def booster_predict_for_mats(bh: int, ptrs_ptr: int, data_type: int,
     X = np.vstack([_mat_from_ptr(int(ptrs[i]), data_type, int(nrows[i]),
                                  ncol, 1)
                    for i in range(nmat)])
-    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr)
+    return _predict_into(_get(bh), X, predict_type, num_iteration, out_ptr,
+                         params)
 
 
 def booster_refit(bh: int, leaf_preds_ptr: int, nrow: int,

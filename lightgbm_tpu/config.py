@@ -199,6 +199,11 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # (docs/PERF_NOTES.md round-3 sweep: 3.14 vs 2.84 it/s at
     # pallas2/8192/K=25)
     "tpu_ramp": ("bool", True, ()),
+    # feature shards in the 2-D tree_learner=data_feature mesh: the
+    # num_machines devices factor as (num_machines/f, f) over
+    # ('data', 'feature'); 0 = auto (2).  The analog of the reference's
+    # device x parallel template nesting (parallel_tree_learner.h:25-187)
+    "tpu_feature_shards": ("int", 0, ()),
 }
 
 _ALIAS: Dict[str, str] = {}
@@ -325,7 +330,9 @@ class Config:
         p = self.params
         learner = p["tree_learner"]
         if learner not in ("serial", "feature", "data", "voting",
-                           "feature_parallel", "data_parallel", "voting_parallel"):
+                           "feature_parallel", "data_parallel",
+                           "voting_parallel", "data_feature", "feature_data",
+                           "data_feature_parallel"):
             raise ValueError(f"unknown tree_learner {learner!r}")
 
         # multiclass objective <-> num_class <-> metric consistency

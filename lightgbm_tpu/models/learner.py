@@ -93,6 +93,28 @@ class TPUTreeLearner:
                     f"devices ({jax.devices()[0].platform})")
         self.strategy = strategy
         self.n_shards = n_shards if strategy != "serial" else 1
+        # 2-D factorization: rows on 'data' x features on 'feature'
+        # (reference parallel_tree_learner.h:25-187 template nesting)
+        if strategy == "data_feature":
+            fs = int(config.tpu_feature_shards)
+            if fs <= 0:
+                # auto: 2 feature shards when the device count factors,
+                # else degrade to a (n, 1) mesh — 1-sized axes are valid
+                # (the collectives become no-ops) so odd/prime counts
+                # still train instead of crashing on a value the user
+                # never set
+                fs = 2 if (self.n_shards % 2 == 0 and self.n_shards > 2) \
+                    else 1
+            if self.n_shards % fs != 0:
+                raise ValueError(
+                    f"tpu_feature_shards={fs} must divide "
+                    f"num_machines={self.n_shards}")
+            self.f_shards = fs
+            self.d_shards = self.n_shards // fs
+        elif strategy == "feature":
+            self.f_shards, self.d_shards = self.n_shards, 1
+        else:
+            self.f_shards, self.d_shards = 1, self.n_shards
 
         for key, allowed in (("tpu_partition_impl", ("select", "gather")),
                              ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2"))):
@@ -105,9 +127,9 @@ class TPUTreeLearner:
         # feature axis padded to a multiple of the shard count; padding
         # features are trivial (num_bin=1) and can never split
         self.f_pad = self.num_features
-        if strategy == "feature":
-            self.f_pad = (-(-self.num_features // self.n_shards)
-                          * self.n_shards)
+        if self.f_shards > 1:
+            self.f_pad = (-(-self.num_features // self.f_shards)
+                          * self.f_shards)
 
         # ---- EFB bundling (reference FindGroups/FastFeatureBundling,
         # dataset.cpp:91-263): sparse zero-default features share columns,
@@ -146,7 +168,7 @@ class TPUTreeLearner:
             meta_np["bin_offset"] = np.zeros(F_, np.int32)
             meta_np["needs_fix"] = np.zeros(F_, np.int32)
         self.num_columns = cols_src.shape[1]
-        self.g_pad = self.num_columns if strategy != "feature" else self.f_pad
+        self.g_pad = (self.f_pad if self.f_shards > 1 else self.num_columns)
 
         # impl/block resolution happens HERE, once, with the final
         # histogram shape: bundling above only needs the host bin matrix,
@@ -161,8 +183,8 @@ class TPUTreeLearner:
             # admits aligned chunks.  Padding columns hold constant bin 0
             # (num_bin=1 features) and can never split.  Feature-parallel
             # pads to 32 * n_shards so each shard's slice stays aligned
-            if strategy == "feature":
-                a = 32 * self.n_shards
+            if self.f_shards > 1:
+                a = 32 * self.f_shards
                 self.f_pad = -(-self.f_pad // a) * a
                 self.g_pad = self.f_pad
             elif plan is None:
@@ -170,10 +192,10 @@ class TPUTreeLearner:
                 self.g_pad = self.f_pad
             else:
                 self.g_pad = -(-self.g_pad // 32) * 32
-        if strategy in ("data", "voting"):
+        if self.d_shards > 1:
             # every shard holds an equal, whole number of histogram blocks
-            shard = pad_rows((n + self.n_shards - 1) // self.n_shards, block)
-            self.n_pad = shard * self.n_shards
+            shard = pad_rows((n + self.d_shards - 1) // self.d_shards, block)
+            self.n_pad = shard * self.d_shards
         else:
             self.n_pad = pad_rows(n, block)
 
@@ -202,10 +224,8 @@ class TPUTreeLearner:
             ones = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
             self._ones_mask = ones
         else:
-            if strategy == "feature":
-                self.mesh = make_mesh(num_feature_shards=self.n_shards)
-            else:
-                self.mesh = make_mesh(num_data_shards=self.n_shards)
+            self.mesh = make_mesh(num_data_shards=self.d_shards,
+                                  num_feature_shards=self.f_shards)
             self.bins_t = jax.device_put(
                 bins_t, bins_sharding(self.mesh, strategy))
             ones = np.ones(self.n_pad, np.float32)
@@ -221,8 +241,8 @@ class TPUTreeLearner:
         self.params = GrowerParams(
             num_leaves=max(int(config.num_leaves), 2),
             num_bins=B,
-            block_rows=min(block, self.n_pad // self.n_shards
-                           if strategy in ("data", "voting") else self.n_pad),
+            block_rows=min(block, self.n_pad // self.d_shards
+                           if self.d_shards > 1 else self.n_pad),
             precision=precision,
             l1=float(config.lambda_l1),
             l2=float(config.lambda_l2),

@@ -172,7 +172,8 @@ def make_grower(params: GrowerParams, num_features: int,
                 data_axis: Optional[str] = None,
                 feature_axis: Optional[str] = None,
                 voting_k: int = 0, num_shards: int = 1, jit: bool = True,
-                num_columns: Optional[int] = None):
+                num_columns: Optional[int] = None,
+                debug_hist: bool = False):
     """Build the whole-tree grower for fixed shapes/params.
 
     num_features is the LOCAL feature count: with `feature_axis` set it is
@@ -180,11 +181,22 @@ def make_grower(params: GrowerParams, num_features: int,
     the GLOBAL [F_local * num_shards] versions (sliced per shard inside).
     num_columns is the bin-matrix column count: G < F when EFB bundling is
     active (has_bundles), otherwise F.
+
+    `data_axis` and `feature_axis` COMPOSE (the reference's parallel
+    learners are templates over the device learner so device x
+    {feature,data} compose, parallel_tree_learner.h:25-187): rows shard
+    over `data`, the histogram/search feature slice over `feature`;
+    histograms psum over `data`, per-shard bests all_gather+argmax over
+    `feature`, and the scalar leaf sums reduce over `data` only (rows are
+    replicated across feature shards).
     """
     if voting_k and not data_axis:
         raise ValueError("voting requires a data axis")
-    if data_axis and feature_axis:
-        raise ValueError("2-D (data x feature) growers not supported yet")
+    if voting_k and feature_axis:
+        # the reference's voting learner is a data-parallel variant
+        # (voting_parallel_tree_learner.cpp); it does not compose with
+        # feature sharding there either
+        raise ValueError("voting does not compose with a feature axis")
     L = params.num_leaves
     B = params.num_bins
     F = num_features
@@ -852,13 +864,23 @@ def make_grower(params: GrowerParams, num_features: int,
                 kr *= 2
 
         state = jax.lax.while_loop(cond, body, state)
-        return {
+        out = {
             "records": state["records"][:L - 1],  # [L-1, W], REC_* indices
             "leaf_ids": state["leaf_ids"],
             "leaf_output": state["leaf_output"],
             "leaf_cnt": state["leaf_cnt"],
             "leaf_sum_h": state["leaf_sum_h"],
         }
+        if debug_hist:
+            # the GPU_DEBUG_COMPARE analog (reference gpu_tree_learner.
+            # cpp:995-1020): expose the pre-aggregation root histogram so
+            # callers can assert the collective math against an
+            # independently computed full histogram.  In voting mode this
+            # is the LOCAL shard histogram (the pool is local by design);
+            # in data mode the psum'd one; in feature mode the shard's
+            # feature slice.
+            out["root_hist"] = root_hist
+        return out
 
     return jax.jit(grow) if jit else grow
 

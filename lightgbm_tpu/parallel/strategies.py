@@ -40,6 +40,11 @@ _CANON = {
     "data": "data", "data_parallel": "data",
     "feature": "feature", "feature_parallel": "feature",
     "voting": "voting", "voting_parallel": "voting",
+    # 2-D composition (the reference's device x parallel template nesting,
+    # parallel_tree_learner.h:25-187): rows on 'data' x features on
+    # 'feature' in one mesh
+    "data_feature": "data_feature", "feature_data": "data_feature",
+    "data_feature_parallel": "data_feature",
 }
 
 
@@ -55,26 +60,41 @@ def resolve_tree_learner(name: str) -> str:
 def make_strategy_grower(params: GrowerParams, num_features: int,
                          strategy: str, mesh: Optional[Mesh] = None,
                          voting_k: int = 20,
-                         num_columns: Optional[int] = None):
+                         num_columns: Optional[int] = None,
+                         debug_hist: bool = False):
     """Grower for `strategy`; num_features is the GLOBAL (padded) count;
-    num_columns the bin-matrix column count (< num_features under EFB)."""
+    num_columns the bin-matrix column count (< num_features under EFB).
+
+    debug_hist adds a "root_hist" output (the GPU_DEBUG_COMPARE analog,
+    reference gpu_tree_learner.cpp:995-1020): per-shard LOCAL in voting
+    mode (out axis 0 stacks shards), psum'd/replicated in data mode, the
+    feature slice stacked to global width in feature modes."""
     if strategy == "serial" or mesh is None:
-        return make_grower(params, num_features, num_columns=num_columns)
+        return make_grower(params, num_features, num_columns=num_columns,
+                           debug_hist=debug_hist)
 
     meta_spec = {k: P() for k in META_KEYS}
+    base_out = {"records": P(), "leaf_output": P(), "leaf_cnt": P(),
+                "leaf_sum_h": P()}
     if strategy in ("data", "voting"):
         nshards = mesh.shape["data"]
         grow = make_grower(
             params, num_features, data_axis="data",
             voting_k=(voting_k if strategy == "voting" else 0),
-            num_shards=nshards, jit=False, num_columns=num_columns)
+            num_shards=nshards, jit=False, num_columns=num_columns,
+            debug_hist=debug_hist)
+        out_specs = {**base_out, "leaf_ids": P("data")}
+        if debug_hist:
+            # voting keeps pools local -> stack shards on axis 0; plain
+            # data mode psums before the pool, so every shard holds the
+            # same full histogram
+            out_specs["root_hist"] = (P("data") if strategy == "voting"
+                                      else P())
         fn = shard_map(
             grow, mesh=mesh,
             in_specs=(P(None, "data"), P("data"), P("data"), P("data"),
                       P(), meta_spec, P()),
-            out_specs={"records": P(), "leaf_ids": P("data"),
-                       "leaf_output": P(), "leaf_cnt": P(),
-                       "leaf_sum_h": P()},
+            out_specs=out_specs,
             check_vma=False)
         return jax.jit(fn)
     if strategy == "feature":
@@ -84,19 +104,47 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
                 f"feature count {num_features} must be padded to a multiple "
                 f"of the feature-shard count {nshards}")
         f_local = num_features // nshards
-        grow = make_grower(params, f_local, feature_axis="feature", jit=False)
+        grow = make_grower(params, f_local, feature_axis="feature",
+                           jit=False, debug_hist=debug_hist)
         # bins REPLICATED (P()), like the reference feature-parallel mode
         # where every machine holds all data (feature_parallel_tree_
         # learner.cpp:55-71): each shard histograms only its own feature
         # slice but partitions rows from the full local matrix, so no
         # per-split column broadcast is needed — the only collective left
         # is the all_gather of per-shard best gains
+        out_specs = {**base_out, "leaf_ids": P()}
+        if debug_hist:
+            out_specs["root_hist"] = P("feature")
         fn = shard_map(
             grow, mesh=mesh,
             in_specs=(P(), P(), P(), P(), P(), meta_spec, P()),
-            out_specs={"records": P(), "leaf_ids": P(),
-                       "leaf_output": P(), "leaf_cnt": P(),
-                       "leaf_sum_h": P()},
+            out_specs=out_specs,
+            check_vma=False)
+        return jax.jit(fn)
+    if strategy == "data_feature":
+        f_shards = mesh.shape["feature"]
+        d_shards = mesh.shape["data"]
+        if num_features % f_shards != 0:
+            raise ValueError(
+                f"feature count {num_features} must be padded to a multiple "
+                f"of the feature-shard count {f_shards}")
+        f_local = num_features // f_shards
+        grow = make_grower(params, f_local, data_axis="data",
+                           feature_axis="feature", num_shards=d_shards,
+                           jit=False, debug_hist=debug_hist)
+        # rows shard over 'data'; the bin matrix is [F_global, n_local]
+        # per device (features replicated within a data shard so the
+        # partition reads the full matrix, like the 1-D feature mode);
+        # histograms psum over 'data', bests all_gather over 'feature'
+        out_specs = {**base_out, "leaf_ids": P("data")}
+        if debug_hist:
+            # psum'd over data already; stack feature slices to global
+            out_specs["root_hist"] = P("feature")
+        fn = shard_map(
+            grow, mesh=mesh,
+            in_specs=(P(None, "data"), P("data"), P("data"), P("data"),
+                      P(), meta_spec, P()),
+            out_specs=out_specs,
             check_vma=False)
         return jax.jit(fn)
     raise ValueError(f"unknown strategy {strategy!r}")
@@ -104,7 +152,7 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
 
 def bins_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
     """Sharding for the transposed [F, n_pad] bin matrix under `strategy`."""
-    if strategy in ("data", "voting"):
+    if strategy in ("data", "voting", "data_feature"):
         return NamedSharding(mesh, P(None, "data"))
     if strategy == "feature":
         # replicated: every shard partitions rows from the full matrix
@@ -115,6 +163,6 @@ def bins_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
 
 def rows_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
     """Sharding for [n_pad] per-row vectors under `strategy`."""
-    if strategy in ("data", "voting"):
+    if strategy in ("data", "voting", "data_feature"):
         return NamedSharding(mesh, P("data"))
     return NamedSharding(mesh, P())

@@ -86,6 +86,48 @@ class TestStrategyParity:
         np.testing.assert_allclose(rec_s[:, G.REC_GAIN],
                                    rec_f[:, G.REC_GAIN], rtol=1e-5)
 
+    def test_data_feature_2d_matches_serial(self, serial_run):
+        """2-D (4 data x 2 feature) mesh: rows shard over 'data' with
+        histogram psum, features over 'feature' with all_gather+argmax
+        (reference parallel_tree_learner.h:25-187 composition)."""
+        (rec_s, leaf_s, _), td = serial_run
+        config, _, _ = _problem(tree_learner="data_feature", num_machines=8,
+                                tpu_feature_shards=2)
+        rec_2d, leaf_2d, _ = _grow_records(config, td)
+        # the data-psum reassociation noise dominates, like 1-D data mode
+        _assert_decisions_close(rec_s, rec_2d, 0.85)
+
+    def test_deterministic_data_feature_2d_exact(self, _x64_reset):
+        """f64 accumulation makes the 2-D composition EXACTLY serial:
+        psum order stops mattering on the data axis and the feature-axis
+        gather/argmax is already deterministic."""
+        config_s, td, _ = _problem(deterministic=True)
+        rec_s, leaf_s, _ = _grow_records(config_s, td)
+        config_2, _, _ = _problem(tree_learner="data_feature",
+                                  num_machines=8, tpu_feature_shards=2,
+                                  deterministic=True)
+        rec_2, leaf_2, _ = _grow_records(config_2, td)
+        _assert_decisions_close(rec_s, rec_2, 1.0)
+        np.testing.assert_array_equal(leaf_s, leaf_2)
+        np.testing.assert_allclose(rec_s[:, G.REC_GAIN],
+                                   rec_2[:, G.REC_GAIN], rtol=1e-12)
+
+    def test_data_feature_bad_factorization_raises(self):
+        config, td, _ = _problem(tree_learner="data_feature",
+                                 num_machines=8, tpu_feature_shards=3)
+        with pytest.raises(ValueError, match="tpu_feature_shards"):
+            TPUTreeLearner(config, td)
+
+    def test_data_feature_auto_degrades_on_two_machines(self, serial_run):
+        # auto (tpu_feature_shards=0) on an unfactorable device count
+        # degrades to a (n, 1) mesh instead of crashing
+        (rec_s, _, _), td = serial_run
+        config, _, _ = _problem(tree_learner="data_feature", num_machines=2)
+        learner = TPUTreeLearner(config, td)
+        assert (learner.d_shards, learner.f_shards) == (2, 1)
+        rec_2, _, _ = _grow_records(config, td)
+        _assert_decisions_close(rec_s, rec_2, 0.85)
+
     def test_deterministic_data_parallel_exact(self, _x64_reset):
         """deterministic=true (f64 accumulation end-to-end, the reference
         HistogramBinEntry representation, bin.h:33-40) makes data-parallel
@@ -107,6 +149,43 @@ class TestStrategyParity:
                                 top_k=12)
         rec_v, _, _ = _grow_records(config, td)
         _assert_decisions_close(rec_s, rec_v, 0.85)
+
+    def test_voting_shard_histograms_sum_to_serial(self):
+        """Histogram-level GPU_DEBUG_COMPARE (reference gpu_tree_learner.
+        cpp:995-1020): the voting learner's per-shard LOCAL root
+        histograms must psum to exactly the serial full histogram — a
+        mis-aggregated voting path could still pass root-decision parity,
+        this cannot."""
+        import jax.numpy as jnp
+        from lightgbm_tpu.parallel.strategies import make_strategy_grower
+
+        config, td, rng = _problem(tree_learner="voting", num_machines=8,
+                                   top_k=4)
+        lv = TPUTreeLearner(config, td)
+        ls = TPUTreeLearner(_problem()[0], td)
+        grad = jnp.asarray(rng.normal(size=lv.n).astype(np.float32))
+        hess = jnp.asarray(
+            np.abs(rng.normal(size=lv.n)).astype(np.float32) + 0.1)
+        fmask = jnp.ones(lv.f_pad, jnp.float32)
+        key = jax.random.PRNGKey(0)
+
+        gv = make_strategy_grower(lv.params, lv.f_pad, "voting", lv.mesh,
+                                  voting_k=4, num_columns=lv.g_pad,
+                                  debug_hist=True)
+        gs = make_strategy_grower(ls.params, ls.f_pad, "serial", None,
+                                  num_columns=ls.g_pad, debug_hist=True)
+        gm = lv._ones_mask
+        out_v = gv(lv.bins_t, lv.pad_vector(grad), lv.pad_vector(hess), gm,
+                   fmask, lv.meta, key)
+        out_s = gs(ls.bins_t, ls.pad_vector(grad), ls.pad_vector(hess),
+                   ls._ones_mask, fmask, ls.meta, key)
+        hv = np.asarray(jax.device_get(out_v["root_hist"]))
+        hs = np.asarray(jax.device_get(out_s["root_hist"]))
+        G_, B_, _ = hs.shape
+        summed = hv.reshape(8, G_, B_, 3).sum(axis=0)
+        # counts are integer-exact; grad/hess sums see f32 reassociation
+        np.testing.assert_array_equal(summed[..., 2], hs[..., 2])
+        np.testing.assert_allclose(summed, hs, rtol=2e-4, atol=2e-4)
 
     def test_voting_small_k_learns(self):
         config, td, _ = _problem(tree_learner="voting", num_machines=8,
