@@ -20,7 +20,7 @@ from ..config import Config
 from ..io.bin_mapper import MissingType
 from ..io.dataset import TrainingData
 from ..ops.grower import GrowerParams, pad_rows, resolve_split_batch
-from ..parallel.mesh import make_mesh
+from ..parallel.mesh import make_mesh, put_global
 from ..parallel.strategies import (bins_sharding, make_strategy_grower,
                                    resolve_tree_learner, rows_sharding)
 from ..utils.log import Log
@@ -226,17 +226,29 @@ class TPUTreeLearner:
         else:
             self.mesh = make_mesh(num_data_shards=self.d_shards,
                                   num_feature_shards=self.f_shards)
-            self.bins_t = jax.device_put(
+            self.bins_t = put_global(
                 bins_t, bins_sharding(self.mesh, strategy))
             ones = np.ones(self.n_pad, np.float32)
             ones[n:] = 0.0
-            self._ones_mask = jax.device_put(
+            self._ones_host = ones
+            self._ones_mask = put_global(
                 ones, rows_sharding(self.mesh, strategy))
         self.n = n
 
-        self.meta = {k: jnp.asarray(v.astype(np.int32) if v.dtype != np.float32
-                                    else v)
+        meta_cast = {k: (v.astype(np.int32) if v.dtype != np.float32 else v)
                      for k, v in meta_host.items()}
+        # multi-host mesh: every array entering the sharded grower must be
+        # a GLOBAL jax.Array; cache the shardings train() re-uses per tree
+        self._multiproc = self.mesh is not None and jax.process_count() > 1
+        if self._multiproc:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            self._rep_sharding = NamedSharding(self.mesh, P())
+            self._rows_shard = rows_sharding(self.mesh, strategy)
+            self.meta = {k: put_global(v, self._rep_sharding)
+                         for k, v in meta_cast.items()}
+        else:
+            self.meta = {k: jnp.asarray(v) for k, v in meta_cast.items()}
 
         self.params = GrowerParams(
             num_leaves=max(int(config.num_leaves), 2),
@@ -510,13 +522,34 @@ class TPUTreeLearner:
               row_mask: Optional[jnp.ndarray] = None
               ) -> Tuple[Tree, jnp.ndarray, Dict]:
         """Grow one tree. Returns (tree, leaf_ids[n] device, raw grower out)."""
-        mask = self._ones_mask if row_mask is None else \
-            self.pad_vector(row_mask) * self._ones_mask
-        out = self.grow(self.bins_t, self.pad_vector(grad),
-                        self.pad_vector(hess), mask,
-                        self.sample_features(), self.meta,
-                        jax.random.PRNGKey(
-                            int(self._feature_rng.integers(2 ** 31))))
+        # RNG consumption order must stay sample_features() THEN the key
+        # draw — the order the serial call has always used — or seeded
+        # runs change trees
+        fmask = self.sample_features()
+        key = jax.random.PRNGKey(int(self._feature_rng.integers(2 ** 31)))
+        if self._multiproc:
+            # shard the per-row vectors globally, replicate the small ones
+            def pad_host(v):
+                out_v = np.zeros(self.n_pad, np.float32)
+                out_v[:np.shape(v)[0]] = np.asarray(v, np.float32)
+                return out_v
+
+            mask_np = self._ones_host if row_mask is None else \
+                self._ones_host * pad_host(row_mask)
+            out = self.grow(self.bins_t,
+                            put_global(pad_host(grad), self._rows_shard),
+                            put_global(pad_host(hess), self._rows_shard),
+                            put_global(mask_np, self._rows_shard),
+                            put_global(np.asarray(fmask),
+                                       self._rep_sharding),
+                            self.meta,
+                            put_global(np.asarray(key), self._rep_sharding))
+        else:
+            mask = self._ones_mask if row_mask is None else \
+                self.pad_vector(row_mask) * self._ones_mask
+            out = self.grow(self.bins_t, self.pad_vector(grad),
+                            self.pad_vector(hess), mask, fmask, self.meta,
+                            key)
         tree = self.build_tree(out)
         return tree, out["leaf_ids"][:self.n], out
 

@@ -81,6 +81,23 @@ def available_devices() -> int:
     return len(jax.devices())
 
 
+def put_global(arr, sharding: NamedSharding):
+    """device_put that also works when the mesh spans PROCESSES.
+
+    Single-process: plain `jax.device_put`.  Multi-process (after
+    `init_multihost`): `jax.device_put` rejects non-fully-addressable
+    shardings, so build the global array from a callback — every process
+    holds the same FULL host array (the reference's all-data-on-all-
+    machines ingest; pre-partitioned loading shards earlier, at bin time)
+    and contributes the shards its local devices own.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    arr = np.asarray(arr)
+    return jax.make_array_from_callback(arr.shape, sharding,
+                                        lambda idx: arr[idx])
+
+
 def make_mesh(num_data_shards: int = 1, num_feature_shards: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

@@ -1,12 +1,21 @@
 """Multi-host mesh mapping (the Linkers rendezvous role,
 reference src/network/linkers_socket.cpp:165-220 -> jax.distributed).
 
-Real multi-process initialization cannot run in a single-process CI; these
-tests cover the config-mapping logic and the single-process skip path.
-The in-process 8-device mesh tests (test_parallel.py) exercise the same
-sharded growers that a global mesh would run.
+TestMultihostMapping covers the config-mapping logic in-process; the
+TestTwoProcessRendezvous smoke test spawns a REAL 2-process
+jax.distributed group (gloo CPU collectives) that runs init_multihost ->
+global 8-device mesh -> one data-parallel tree, asserting identical
+split records on both ranks — the automated stand-in for the reference's
+manual parallel_learning runbook (linkers_socket.cpp:165-220).
 """
 
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
 import pytest
 
 from lightgbm_tpu.parallel import mesh
@@ -44,3 +53,91 @@ class TestMultihostMapping:
                              "pid": 1}
         finally:
             mesh._distributed_initialized = False
+
+
+_WORKER_SRC = """
+import os, sys, importlib.util
+root = {root!r}
+sys.path.insert(0, root)
+spec = importlib.util.spec_from_file_location(
+    "_boot", os.path.join(root, "lightgbm_tpu", "utils", "backend.py"))
+_b = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(_b)
+_b.pin_cpu_backend(force_device_count=4)
+import jax
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+import numpy as np
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.models.learner import TPUTreeLearner
+
+pid = int(os.environ["LIGHTGBM_TPU_PROCESS_ID"])
+# every rank loads the SAME data (the reference's all-data-on-all-machines
+# mode; pre-partitioned loading is a separate path)
+rng = np.random.default_rng(7)
+X = rng.normal(size=(2048, 10))
+y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+cfg = Config({{"objective": "binary", "max_bin": 16, "num_leaves": 7,
+              "min_data_in_leaf": 5, "tpu_block_rows": 256,
+              "tree_learner": "data", "num_machines": 8,
+              "machines": {machines!r}}})
+td = TrainingData.from_matrix(X, y, cfg)
+learner = TPUTreeLearner(cfg, td)   # init_multihost runs in here
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 8
+grad = rng.normal(size=2048).astype(np.float32)
+hess = np.abs(rng.normal(size=2048)).astype(np.float32) + 0.1
+tree, _, out = learner.train(grad, hess)
+rec = np.asarray(jax.device_get(out["records"]))
+assert rec[0, 14] > 0.5, "no split grown"
+np.save({outfile!r}, rec)
+print(f"rank {{pid}}: {{int(rec[:, 14].sum())}} splits", flush=True)
+"""
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.mark.slow
+class TestTwoProcessRendezvous:
+    def test_two_process_data_parallel_tree(self, tmp_path):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        machines = f"127.0.0.1:{_free_port()},127.0.0.1:{_free_port()}"
+        procs, outs = [], []
+        for pid in range(2):
+            outfile = str(tmp_path / f"rec_{pid}.npy")
+            outs.append(outfile)
+            src = _WORKER_SRC.format(root=root, machines=machines,
+                                     outfile=outfile)
+            env = dict(os.environ,
+                       LIGHTGBM_TPU_PROCESS_ID=str(pid))
+            # the workers pin their own backend; drop the parent's
+            # virtual-device flags so they don't fight the pin
+            env.pop("XLA_FLAGS", None)
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c", src], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+        logs = []
+        for p in procs:
+            try:
+                log, _ = p.communicate(timeout=600)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                raise
+            logs.append(log)
+        for pid, (p, log) in enumerate(zip(procs, logs)):
+            assert p.returncode == 0, f"rank {pid} failed:\n{log[-4000:]}"
+        rec0 = np.load(outs[0])
+        rec1 = np.load(outs[1])
+        # both ranks must materialize IDENTICAL split records: the grower
+        # output is replicated, so any divergence means the collective
+        # ran inconsistently
+        np.testing.assert_array_equal(rec0, rec1)
+        assert rec0[:, 14].sum() >= 3
