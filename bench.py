@@ -57,7 +57,12 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
 
     params = {"objective": "binary", "num_leaves": num_leaves,
               "learning_rate": 0.1, "min_data_in_leaf": 20,
-              "max_bin": max_bin}
+              "max_bin": max_bin,
+              # the benchmark pins its exact shape: no bucket padding
+              # (tpu_shape_buckets trades ~1/buckets throughput for
+              # compile-cache hits across DIFFERENT datasets, which a
+              # fixed-shape benchmark never needs)
+              "tpu_shape_buckets": 0}
     bst = Booster(params=params, train_set=ds)
     from lightgbm_tpu.utils.backend import host_sync
 

@@ -204,6 +204,13 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # ('data', 'feature'); 0 = auto (2).  The analog of the reference's
     # device x parallel template nesting (parallel_tree_learner.h:25-187)
     "tpu_feature_shards": ("int", 0, ()),
+    # compile-cache shape policy: quantize the padded (rows, features)
+    # axes so at most this many distinct shapes exist per power-of-2
+    # octave — new datasets of similar size reuse cached XLA programs
+    # instead of paying the cold remote compile.  Worst-case pad waste
+    # is 2/buckets (~6% at the default 32).  0 = exact block-multiple
+    # padding (maximum throughput; bench.py pins this)
+    "tpu_shape_buckets": ("int", 32, ()),
 }
 
 _ALIAS: Dict[str, str] = {}

@@ -192,12 +192,62 @@ class TPUTreeLearner:
                 self.g_pad = self.f_pad
             else:
                 self.g_pad = -(-self.g_pad // 32) * 32
+        # ---- shape bucketing (compile-cache policy): quantize the padded
+        # axes so at most `tpu_shape_buckets` distinct shapes exist per
+        # power-of-2 octave — a new dataset of similar size then hits the
+        # persistent compilation cache instead of paying the 70-150 s
+        # cold remote compile (SURVEY §7 "dispatch overhead is the #1
+        # wall-clock risk").  Worst-case pad waste is 2/buckets (~6% at
+        # the default 32); 0 disables (exact block-multiple padding,
+        # maximum throughput — bench.py pins this).
+        buckets = int(config.tpu_shape_buckets)
+
+        def bucket_up(count: int, quantum: int) -> int:
+            padded = -(-count // quantum) * quantum
+            if buckets <= 0:
+                return padded
+            q = quantum
+            while q * buckets < padded:
+                q *= 2
+            return -(-count // q) * q
+
+        def bucket_rows(count: int) -> int:
+            # supra-block: quantize the BLOCK COUNT (pad_rows clamps the
+            # block to the row count, so derive the effective block the
+            # same way).  Sub-block (count < tpu_block_rows, the common
+            # case on TPU where the resolved block is 8-16k): quantize
+            # the row count itself from the 128-lane tile upward, capped
+            # at one block — without this, every sub-block n is its own
+            # XLA program
+            eff = min(block, max(count, 1))
+            base = pad_rows(count, block)
+            if buckets <= 0:
+                return base
+            if base >= block:
+                return bucket_up(base // eff, 1) * eff
+            return min(bucket_up(count, 128), block)
+
         if self.d_shards > 1:
             # every shard holds an equal, whole number of histogram blocks
-            shard = pad_rows((n + self.d_shards - 1) // self.d_shards, block)
-            self.n_pad = shard * self.d_shards
+            self.n_pad = bucket_rows(
+                (n + self.d_shards - 1) // self.d_shards) * self.d_shards
         else:
-            self.n_pad = pad_rows(n, block)
+            self.n_pad = bucket_rows(n)
+        # feature axis: bucket above the alignment the padding code above
+        # already established (32-multiples for pallas2, shard-count
+        # multiples for feature sharding); padding features are trivial
+        # (num_bin=1) and can never split
+        if buckets > 0:
+            if hist_impl == "pallas2":
+                align = 32 * self.f_shards if self.f_shards > 1 else 32
+            else:
+                align = self.f_shards if self.f_shards > 1 else 8
+            if self.g_pad == self.f_pad:
+                self.f_pad = bucket_up(self.f_pad, align)
+                self.g_pad = self.f_pad
+            else:
+                # EFB keeps g_pad (bundle columns) separate from f_pad
+                self.g_pad = bucket_up(self.g_pad, align)
 
         # transposed [G, n] bin matrix: rows ride the 128-lane minor axis
         # for the histogram contraction (see ops/histogram.py).  Stored
@@ -454,8 +504,8 @@ class TPUTreeLearner:
             goss_top_k = max(1, int(n * float(goss["top_rate"])))
             goss_other_k = max(1, int(n * float(goss["other_rate"])))
 
-        def step(grad_scores, scores, key, bag_key, class_id, refresh_bag,
-                 goss_on=False):
+        def _pre(grad_scores, key, bag_key, class_id, refresh_bag,
+                 goss_on):
             # grad_scores = scores at ITERATION start: all classes' gradients
             # come from the same snapshot, like the reference's single
             # Boosting() call per iteration (gbdt.cpp:150-158); `scores`
@@ -507,15 +557,45 @@ class TPUTreeLearner:
                 fmask = jnp.zeros(f_pad, jnp.float32).at[perm[:k_used]].set(1.0)
 
             key, k_node = jax.random.split(key)
-            out = grow(bins_t, g, h, mask, fmask, meta, k_node)
-            any_split = out["records"][0, 14] > 0.5  # REC_DID_SPLIT
-            delta = out["leaf_output"][out["leaf_ids"]] * learning_rate
+            return g, h, mask, fmask, k_node, key, bag_key
+
+        def _post(scores, records, leaf_ids, leaf_output, class_id):
+            any_split = records[0, 14] > 0.5  # REC_DID_SPLIT
+            delta = leaf_output[leaf_ids] * learning_rate
             delta = jnp.where(any_split, delta, 0.0)
             new_scores = scores.at[class_id, :].add(delta[:n])
-            return (out["records"], new_scores, out["leaf_ids"][:n],
-                    out["leaf_output"], key, bag_key)
+            return new_scores, leaf_ids[:n]
 
-        return jax.jit(step,
+        def make_step(pre_fn, post_fn):
+            # ONE step body shared by both modes: pre -> grow -> post
+            def step(grad_scores, scores, key, bag_key, class_id,
+                     refresh_bag, goss_on=False):
+                g, h, mask, fmask, k_node, key, bag_key = pre_fn(
+                    grad_scores, key, bag_key, class_id=class_id,
+                    refresh_bag=refresh_bag, goss_on=goss_on)
+                out = grow(bins_t, g, h, mask, fmask, meta, k_node)
+                new_scores, lids = post_fn(scores, out["records"],
+                                           out["leaf_ids"],
+                                           out["leaf_output"],
+                                           class_id=class_id)
+                return (out["records"], new_scores, lids,
+                        out["leaf_output"], key, bag_key)
+            return step
+
+        if int(self.config.tpu_shape_buckets) > 0:
+            # shape-bucketed pipeline: keep the n-shaped grad/score glue
+            # in SMALL separate programs (seconds to compile) so the big
+            # bucketed grower program is the only expensive compile — a
+            # new dataset in the same bucket reuses it from the
+            # persistent cache.  All three dispatches stay async; no
+            # host sync is introduced.
+            pre_j = jax.jit(_pre, static_argnames=("class_id",
+                                                   "refresh_bag", "goss_on"))
+            post_j = jax.jit(_post, static_argnames=("class_id",))
+            return make_step(pre_j, post_j)
+        # exact-shape mode (tpu_shape_buckets=0): ONE fused program —
+        # the round-3 hardware-validated hot path, bit-identical
+        return jax.jit(make_step(_pre, _post),
                        static_argnames=("class_id", "refresh_bag", "goss_on"))
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
