@@ -182,8 +182,15 @@ class GBDT:
         self._bag_key = jax.random.PRNGKey(int(config.bagging_seed))
         self._train_step = None
         self._bag_cfg = self._bagging_config()
+        if self.learner.params.has_cegb and self._goss_cfg is not None:
+            raise NotImplementedError(
+                "CEGB penalties do not compose with GOSS yet")
         if (self.objective is not None and not self.objective.needs_renew
                 and not self.objective.host_only
+                # CEGB threads cross-tree used/paid state through
+                # learner.train (the sync path); the fused step's meta is
+                # closure-captured and cannot carry it
+                and not self.learner.params.has_cegb
                 and all(self.objective.class_need_train(k)
                         for k in range(self.num_tree_per_iteration))):
             self._train_step = self.learner.make_train_step(

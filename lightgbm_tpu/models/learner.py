@@ -49,20 +49,25 @@ class TPUTreeLearner:
             raise ValueError("no usable features in training data")
 
         meta_np = dict(train_data.feature_arrays())
-        # CEGB coupled feature-acquisition penalties, mapped onto used
-        # features (reference config.h cegb_penalty_feature_coupled; lazy
-        # penalties need per-row paid-cost tracking and are rejected)
-        if list(config.cegb_penalty_feature_lazy):
-            raise NotImplementedError(
-                "cegb_penalty_feature_lazy is not supported; use "
-                "cegb_penalty_feature_coupled")
+        # CEGB feature-acquisition penalties, mapped onto used features
+        # (reference config.h cegb_penalty_feature_coupled/_lazy)
+        def _per_feature(raw):
+            vals = np.zeros(train_data.num_features, np.float32)
+            for j, col in enumerate(train_data.used_feature_idx):
+                if col < len(raw):
+                    vals[j] = raw[col]
+            return vals
+
         coupled_raw = [float(v) for v in config.cegb_penalty_feature_coupled]
-        coupled = np.zeros(train_data.num_features, np.float32)
-        for j, col in enumerate(train_data.used_feature_idx):
-            if col < len(coupled_raw):
-                coupled[j] = coupled_raw[col]
-        meta_np["cegb_coupled"] = coupled
-        has_cegb = bool(coupled_raw) or float(config.cegb_penalty_split) != 0.0
+        lazy_raw = [float(v) for v in config.cegb_penalty_feature_lazy]
+        meta_np["cegb_coupled"] = _per_feature(coupled_raw)
+        meta_np["cegb_lazy"] = _per_feature(lazy_raw)
+        # all-zero penalty lists are no-ops in the reference (IsEnable,
+        # cost_effective_gradient_boosting.hpp:25-31 checks emptiness, but
+        # zeros charge nothing) — don't pay for the machinery
+        has_cegb_lazy = any(v != 0.0 for v in lazy_raw)
+        has_cegb = (any(v != 0.0 for v in coupled_raw) or has_cegb_lazy
+                    or float(config.cegb_penalty_split) != 0.0)
         self.meta_np = meta_np
         forced = self._parse_forced_splits(config, train_data)
         B = int(meta_np["num_bin"].max())
@@ -324,6 +329,7 @@ class TPUTreeLearner:
             split_batch_alpha=float(config.tpu_split_batch_alpha),
             feature_fraction_bynode=float(config.feature_fraction_bynode),
             has_cegb=has_cegb,
+            has_cegb_lazy=has_cegb_lazy,
             cegb_tradeoff=float(config.cegb_tradeoff),
             cegb_penalty_split=float(config.cegb_penalty_split),
             forced=forced,
@@ -332,6 +338,27 @@ class TPUTreeLearner:
             has_bundles=plan is not None,
             ramp=bool(config.tpu_ramp),
         )
+        if has_cegb_lazy and strategy != "serial":
+            # the reference's lazy bitset is learner-local over the full
+            # data; under row sharding the paid matrix would need its own
+            # collective — reject loudly until that exists
+            raise NotImplementedError(
+                "cegb_penalty_feature_lazy requires tree_learner=serial")
+        # cross-tree CEGB state (reference is_feature_used_in_split_ /
+        # feature_used_in_data_ live for the learner's lifetime,
+        # cost_effective_gradient_boosting.hpp:33-48)
+        if has_cegb:
+            zeros_f = np.zeros(self.f_pad, np.float32)
+            self._cegb_used = (put_global(zeros_f, self._rep_sharding)
+                               if self._multiproc else jnp.asarray(zeros_f))
+            self.meta["cegb_used"] = self._cegb_used
+            if has_cegb_lazy:
+                # bool storage: the reference's bitset is n*F/8 bytes;
+                # bool is 8x that but 4x smaller than f32, and the einsum
+                # casts per round transiently
+                self._cegb_paid = jnp.zeros((self.f_pad, self.n_pad),
+                                            jnp.bool_)
+                self.meta["cegb_paid"] = self._cegb_paid
         self.grow = make_strategy_grower(
             self.params, self.f_pad, strategy, self.mesh,
             voting_k=int(config.top_k), num_columns=self.g_pad)
@@ -582,13 +609,18 @@ class TPUTreeLearner:
                         out["leaf_output"], key, bag_key)
             return step
 
-        if int(self.config.tpu_shape_buckets) > 0:
-            # shape-bucketed pipeline: keep the n-shaped grad/score glue
-            # in SMALL separate programs (seconds to compile) so the big
-            # bucketed grower program is the only expensive compile — a
-            # new dataset in the same bucket reuses it from the
-            # persistent cache.  All three dispatches stay async; no
-            # host sync is introduced.
+        if int(self.config.tpu_shape_buckets) > 0 \
+                and self.strategy == "serial":
+            # shape-bucketed pipeline (serial strategy only): keep the
+            # n-shaped grad/score glue in SMALL separate programs
+            # (seconds to compile) so the big bucketed grower program is
+            # the only expensive compile — a new dataset in the same
+            # bucket reuses it from the persistent cache.  All three
+            # dispatches stay async; no host sync is introduced.
+            # Parallel strategies keep the fused program: their sharded
+            # outputs (leaf_ids on the 'data' axis) would reshard across
+            # the program boundary, which the CPU-collectives test
+            # backend aborts on — and multi-chip wants the fusion anyway.
             pre_j = jax.jit(_pre, static_argnames=("class_id",
                                                    "refresh_bag", "goss_on"))
             post_j = jax.jit(_post, static_argnames=("class_id",))
@@ -607,6 +639,12 @@ class TPUTreeLearner:
         # runs change trees
         fmask = self.sample_features()
         key = jax.random.PRNGKey(int(self._feature_rng.integers(2 ** 31)))
+        if self.params.has_cegb:
+            # thread the cross-tree CEGB state through this tree's meta
+            self.meta = dict(self.meta)
+            self.meta["cegb_used"] = self._cegb_used
+            if self.params.has_cegb_lazy:
+                self.meta["cegb_paid"] = self._cegb_paid
         if self._multiproc:
             # shard the per-row vectors globally, replicate the small ones
             def pad_host(v):
@@ -630,6 +668,12 @@ class TPUTreeLearner:
             out = self.grow(self.bins_t, self.pad_vector(grad),
                             self.pad_vector(hess), mask, fmask, self.meta,
                             key)
+        if self.params.has_cegb:
+            # harvest the updated state for the NEXT tree (async device
+            # arrays; no host sync)
+            self._cegb_used = out["cegb_used"]
+            if self.params.has_cegb_lazy:
+                self._cegb_paid = out["cegb_paid"]
         tree = self.build_tree(out)
         return tree, out["leaf_ids"][:self.n], out
 

@@ -117,6 +117,10 @@ class GrowerParams(NamedTuple):
     # are charged tradeoff * (split penalty + coupled per-feature penalty
     # for features not yet used anywhere in the model)
     has_cegb: bool = False
+    # lazy per-row acquisition costs: meta carries a [FG, n_pad] paid
+    # matrix threaded across trees (feature_used_in_data_ bitset,
+    # cost_effective_gradient_boosting.hpp:46-48,88-107)
+    has_cegb_lazy: bool = False
     cegb_tradeoff: float = 1.0
     cegb_penalty_split: float = 0.0
     # forced splits (reference ForceSplits, serial_tree_learner.cpp:
@@ -369,14 +373,18 @@ def make_grower(params: GrowerParams, num_features: int,
                 jnp.where(fix[:, None], bin0, hist_f[:, 0, :]))
             return hist_f
 
-        def cegb_delta(used):
-            """Per-feature gain charge (DetlaGain,
-            cost_effective_gradient_boosting.hpp:50): the split penalty
-            plus the coupled feature-acquisition penalty for features the
-            model has not used yet."""
-            return params.cegb_tradeoff * (
-                params.cegb_penalty_split
-                + meta["cegb_coupled"] * (1.0 - used))
+        def cegb_delta(used, cnt, unpaid=None):
+            """[M, FG] per-leaf gain charge (DetlaGain,
+            cost_effective_gradient_boosting.hpp:50-62): the split
+            penalty scaled by the leaf's row count, the coupled
+            acquisition penalty for features the model has not used yet,
+            and (lazy mode) the per-row on-demand cost for rows that
+            have not paid for the feature."""
+            d = (params.cegb_penalty_split * cnt[:, None]
+                 + meta["cegb_coupled"][None, :] * (1.0 - used)[None, :])
+            if unpaid is not None:
+                d = d + meta["cegb_lazy"][None, :] * unpaid
+            return params.cegb_tradeoff * d
 
         def apply_delta(gain_vec, delta):
             return jnp.where(gain_vec > K_MIN_SCORE / 2, gain_vec - delta,
@@ -457,7 +465,8 @@ def make_grower(params: GrowerParams, num_features: int,
 
         vselect = jax.vmap(select,
                            in_axes=(0, 0, 0, 0, 0, 0,
-                                    0 if bynode else None, None))
+                                    0 if bynode else None,
+                                    0 if params.has_cegb else None))
 
         # ---- root ----------------------------------------------------
         g = grad * row_mask
@@ -493,8 +502,26 @@ def make_grower(params: GrowerParams, num_features: int,
             root_fmask = bynode_masks(k_root, ())
         else:
             root_fmask = feature_mask
-        used0 = jnp.zeros(FG, jnp.float32)
-        delta0 = cegb_delta(used0) if params.has_cegb else None
+        # CEGB state persists ACROSS trees (the reference's
+        # is_feature_used_in_split_ / feature_used_in_data_ live on the
+        # learner, cost_effective_gradient_boosting.hpp:33-48): seeded
+        # from meta, returned in the out dict
+        if params.has_cegb:
+            used0 = meta["cegb_used"]
+            if params.has_cegb_lazy:
+                paid0 = meta["cegb_paid"]                     # [FG, n_pad] bool
+                unpaid_root = jnp.maximum(
+                    cnt - jnp.einsum(
+                        "fn,n->f", paid0.astype(jnp.float32), row_mask,
+                        precision=jax.lax.Precision.HIGHEST),
+                    0.0)[None, :]                             # [1, FG]
+            else:
+                unpaid_root = None
+            delta0 = cegb_delta(used0, jnp.reshape(cnt, (1,)),
+                                unpaid_root)[0]
+        else:
+            used0 = jnp.zeros(FG, jnp.float32)
+            delta0 = None
         root_split = select(root_hist, sum_g, sum_h, cnt, -big, big,
                             root_fmask, delta0)
 
@@ -535,6 +562,8 @@ def make_grower(params: GrowerParams, num_features: int,
             state["key"] = key
         if params.has_cegb:
             state["used"] = used0
+            if params.has_cegb_lazy:
+                state["paid"] = paid0
 
         def cand_gains(state):
             depth_ok = jnp.logical_or(
@@ -701,10 +730,52 @@ def make_grower(params: GrowerParams, num_features: int,
             else:
                 child_masks = feature_mask
             if params.has_cegb:
-                used = scatter_set(state["used"], sel_feat,
+                prev_used = state["used"]
+                used = scatter_set(prev_used, sel_feat,
                                    jnp.ones(Kr, jnp.float32), do_k)
                 new_state["used"] = used
-                delta = cegb_delta(used)
+                cnt_children = jnp.concatenate([lc, rc])      # [2K]
+                unpaid = None
+                if params.has_cegb_lazy:
+                    paid = state["paid"]                  # [FG, n_pad] bool
+                    # pay the applied splits' costs FIRST: all parent-leaf
+                    # rows (pre-partition membership, like the reference
+                    # marking bits before DataPartition::Split,
+                    # serial_tree_learner.cpp:775-797)
+                    pre_memb = ((state["leaf_ids"][None, :] == sel[:, None])
+                                & (row_mask[None, :] > 0)
+                                & do_k[:, None])
+                    pay = jnp.zeros_like(paid).at[sel_feat].max(
+                        pre_memb, mode="drop")
+                    paid = paid | pay
+                    new_state["paid"] = paid
+                    # per-child unpaid-row counts for the lazy charge
+                    child_ids = jnp.concatenate([sel, new_ids])
+                    memb = ((leaf_ids[None, :] == child_ids[:, None])
+                            .astype(jnp.float32) * row_mask[None, :])
+                    paid_sum = jnp.einsum("kn,fn->kf", memb,
+                                          paid.astype(jnp.float32),
+                                          precision=jax.lax.Precision.HIGHEST)
+                    unpaid = jnp.maximum(
+                        cnt_children[:, None] - paid_sum, 0.0)
+                delta = cegb_delta(used, cnt_children, unpaid)  # [2K, FG]
+                # newly-used features re-credit other leaves' STORED best
+                # gains (UpdateLeafBestSplits,
+                # cost_effective_gradient_boosting.hpp:64-77); children
+                # slots are overwritten by the fresh uncharged search
+                # below.  Known bounded approximation vs the reference:
+                # only the stored BEST split per leaf is re-credited — a
+                # runner-up split on the newly-freed feature cannot be
+                # promoted, because per-(leaf, feature) candidate storage
+                # ([L, F] SplitInfo, splits_per_leaf_) does not exist in
+                # the batched-frontier design
+                newly = used - prev_used
+                credit = (params.cegb_tradeoff
+                          * meta["cegb_coupled"][state["bs_feat"]]
+                          * newly[state["bs_feat"]])
+                live = state["bs_gain"] > K_MIN_SCORE / 2
+                new_state["bs_gain"] = state["bs_gain"] + \
+                    jnp.where(live, credit, 0.0)
             else:
                 delta = None
             ch = vselect(
@@ -871,6 +942,13 @@ def make_grower(params: GrowerParams, num_features: int,
             "leaf_cnt": state["leaf_cnt"],
             "leaf_sum_h": state["leaf_sum_h"],
         }
+        if params.has_cegb:
+            # cross-tree CEGB state (the learner threads it into the next
+            # tree's meta, matching the reference's learner-lifetime
+            # is_feature_used_in_split_ / feature_used_in_data_)
+            out["cegb_used"] = state["used"]
+            if params.has_cegb_lazy:
+                out["cegb_paid"] = state["paid"]
         if debug_hist:
             # the GPU_DEBUG_COMPARE analog (reference gpu_tree_learner.
             # cpp:995-1020): expose the pre-aggregation root histogram so

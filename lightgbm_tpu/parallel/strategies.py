@@ -32,8 +32,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.grower import GrowerParams, make_grower
 
 META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty",
-             "is_categorical", "cegb_coupled", "bundle_idx", "bin_offset",
-             "needs_fix")
+             "is_categorical", "cegb_coupled", "cegb_lazy", "bundle_idx",
+             "bin_offset", "needs_fix")
 
 _CANON = {
     "serial": "serial",
@@ -76,6 +76,12 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
     meta_spec = {k: P() for k in META_KEYS}
     base_out = {"records": P(), "leaf_output": P(), "leaf_cnt": P(),
                 "leaf_sum_h": P()}
+    if params.has_cegb:
+        # coupled CEGB composes with the parallel learners (the split
+        # decisions are globally identical, so `used` stays replicated);
+        # lazy CEGB is serial-only and never reaches here
+        meta_spec["cegb_used"] = P()
+        base_out["cegb_used"] = P()
     if strategy in ("data", "voting"):
         nshards = mesh.shape["data"]
         grow = make_grower(

@@ -152,6 +152,14 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
             "LIGHTGBM_TPU_CACHE_DIR",
             os.path.join(os.path.dirname(os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    if os.environ.get("LIGHTGBM_TPU_CPU_PINNED") or _cpu_is_only_backend():
+        # CPU-destined processes get a host-fingerprinted subdir: XLA:CPU
+        # cache keys do NOT include the host's CPU features, so an AOT
+        # entry compiled on a machine with different vector extensions
+        # deserializes and ABORTS (SIGILL) — observed when the checkout's
+        # .jax_cache travels between build hosts.  TPU entries target the
+        # device and stay shared at the cache root.
+        cache_dir = os.path.join(cache_dir, f"cpu-{_host_fingerprint()}")
     try:
         import jax
 
@@ -162,11 +170,52 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
         pass
 
 
+def _cpu_is_only_backend() -> bool:
+    """True when only the cpu backend factory is registered — i.e. the
+    default backend will be CPU even without an explicit pin.  Inspects
+    the factory table WITHOUT initializing any backend (a dead tunnel
+    hangs initialization; see probe_default_backend)."""
+    try:
+        import jax._src.xla_bridge as _xb
+
+        return set(_xb._backend_factories) <= {"cpu"}
+    except Exception:  # pragma: no cover - jax internals moved
+        return False
+
+
+def _host_fingerprint() -> str:
+    """Short stable id for this host's CPU feature set."""
+    import hashlib
+
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next((ln for ln in f if ln.startswith("flags")), "")
+    except OSError:  # pragma: no cover - non-linux
+        import platform
+
+        flags = platform.processor() or platform.machine()
+    return hashlib.sha1(flags.encode()).hexdigest()[:12]
+
+
 def pin_cpu_backend(force_device_count: Optional[int] = None) -> None:
     """Pin this process to the CPU backend; optionally force N virtual
     devices (must run before the first backend initialization)."""
     os.environ["JAX_PLATFORM_NAME"] = "cpu"
     os.environ.pop("JAX_PLATFORMS", None)
+    # route any (later-enabled) persistent compilation cache to a
+    # host-fingerprinted CPU subdir — see enable_compilation_cache
+    os.environ["LIGHTGBM_TPU_CPU_PINNED"] = "1"
+    try:
+        import jax
+
+        cur = jax.config.jax_compilation_cache_dir
+        if cur and f"{os.sep}cpu-" not in cur:
+            # cache was enabled before the pin: re-point it
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(cur, f"cpu-{_host_fingerprint()}"))
+    except Exception:  # pragma: no cover
+        pass
     if force_device_count is not None:
         flag = f"--xla_force_host_platform_device_count={force_device_count}"
         flags = os.environ.get("XLA_FLAGS", "")

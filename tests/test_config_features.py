@@ -119,12 +119,102 @@ class TestCEGB:
         n_taxed = sum(t["num_leaves"] for t in taxed.dump_model()["tree_info"])
         assert n_taxed < n_free
 
-    def test_lazy_penalty_raises(self, xy):
+    def test_lazy_penalty_avoids_feature(self, xy):
+        """cegb_penalty_feature_lazy charges per UNPAID ROW (reference
+        CalculateOndemandCosts, cost_effective_gradient_boosting.hpp:
+        88-107): a huge lazy cost on feature 0 prices it out."""
+        X, y = xy
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "cegb_penalty_feature_lazy":
+                             [1e6] + [0.0] * 7},
+                        ds, num_boost_round=5, verbose_eval=False)
+        assert 0 not in _tree_features(bst)
+        from sklearn.metrics import roc_auc_score
+        assert roc_auc_score(y, bst.predict(X)) > 0.7
+
+    def test_lazy_rows_pay_once(self, xy):
+        """Once rows pay a feature's lazy cost, later trees re-split it
+        freely — the paid matrix persists across trees (reference
+        feature_used_in_data_ lives for the learner's lifetime)."""
+        X, y = xy
+        # moderate uniform lazy cost: the learner should concentrate on
+        # few features (re-splitting paid rows is free) instead of
+        # spreading across all 8
+        ds1 = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        taxed = lgb.train({"objective": "binary", "num_leaves": 15,
+                           "cegb_tradeoff": 1.0,
+                           "cegb_penalty_feature_lazy": [0.01] * 8},
+                          ds1, num_boost_round=8, verbose_eval=False,
+                          keep_training_booster=True)
+        ds2 = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        free = lgb.train({"objective": "binary", "num_leaves": 15},
+                         ds2, num_boost_round=8, verbose_eval=False)
+        assert len(_tree_features(taxed)) <= len(_tree_features(free))
+        # white box: the paid matrix is nonzero and bounded by F x n
+        learner = taxed._driver.learner
+        paid = np.asarray(learner._cegb_paid)
+        assert paid.max() == 1.0 and paid.min() == 0.0
+
+    def test_coupled_used_state_persists_across_trees(self, xy):
+        """is_feature_used_in_split_ persists for the learner's lifetime
+        (reference Init() runs once): features paid for by tree 1 are
+        free for every later tree."""
+        X, y = xy
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "cegb_penalty_feature_coupled": [5.0] * 8},
+                        ds, num_boost_round=5, verbose_eval=False,
+                        keep_training_booster=True)
+        learner = bst._driver.learner
+        used_state = set(np.nonzero(np.asarray(learner._cegb_used))[0])
+        assert used_state == _tree_features(bst)
+
+    def test_cegb_matches_oracle(self, xy, tmp_path):
+        """Split-penalty CEGB parity vs the compiled reference: identical
+        tree SIZE trajectory under strict best-first order (the penalty
+        is cnt-scaled — DetlaGain, cost_effective_gradient_boosting.
+        hpp:50 — so a mis-scaled charge prunes at different depths)."""
+        from .conftest import ORACLE_BIN, has_oracle
+        if not has_oracle():
+            pytest.skip("reference oracle not built")
+        import subprocess
+        X, y = xy
+        data = tmp_path / "train.csv"
+        np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+        subprocess.run(
+            [ORACLE_BIN, "task=train", f"data={data}", "objective=binary",
+             "num_trees=3", "num_leaves=63", "min_data_in_leaf=20",
+             "cegb_tradeoff=1.0", "cegb_penalty_split=0.05",
+             "verbosity=-1", f"output_model={tmp_path}/ref.txt"],
+            check=True, capture_output=True, cwd=str(tmp_path))
+        ref_kv = [l for l in (tmp_path / "ref.txt").read_text().splitlines()
+                  if l.startswith("num_leaves=")]
+        ref_leaves = [int(l.split("=")[1]) for l in ref_kv]
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 255})
+        bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                         "min_data_in_leaf": 20, "tpu_split_batch": 1,
+                         "cegb_tradeoff": 1.0, "cegb_penalty_split": 0.05},
+                        ds, num_boost_round=3, verbose_eval=False)
+        my_leaves = [t["num_leaves"]
+                     for t in bst.dump_model()["tree_info"]]
+        assert my_leaves == ref_leaves, (my_leaves, ref_leaves)
+
+    def test_lazy_parallel_rejected(self, xy):
         X, y = xy
         ds = lgb.Dataset(X, label=y)
-        with pytest.raises(NotImplementedError):
-            lgb.train({"objective": "binary",
+        with pytest.raises(NotImplementedError, match="serial"):
+            lgb.train({"objective": "binary", "tree_learner": "data",
+                       "num_machines": 8,
                        "cegb_penalty_feature_lazy": [1.0] * 8},
+                      ds, num_boost_round=1, verbose_eval=False)
+
+    def test_cegb_goss_rejected(self, xy):
+        X, y = xy
+        ds = lgb.Dataset(X, label=y)
+        with pytest.raises(NotImplementedError, match="GOSS"):
+            lgb.train({"objective": "binary", "boosting": "goss",
+                       "cegb_penalty_split": 1.0},
                       ds, num_boost_round=1, verbose_eval=False)
 
 
