@@ -211,6 +211,11 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # is 2/buckets (~6% at the default 32).  0 = exact block-multiple
     # padding (maximum throughput; bench.py pins this)
     "tpu_shape_buckets": ("int", 32, ()),
+    # pack two 4-bit bins per byte when max_bin<=16 (reference
+    # dense_nbits_bin.hpp): halves the pallas histogram row sweep's DMA
+    # traffic; automatically skipped when the layout can't support it
+    # (EFB bundles, gather partition, xla hist impl)
+    "tpu_pack_bins": ("bool", True, ()),
 }
 
 _ALIAS: Dict[str, str] = {}

@@ -264,6 +264,28 @@ class TPUTreeLearner:
         bins_t = np.zeros((self.g_pad, self.n_pad), dtype=bin_dtype)
         bins_t[:self.num_columns, :n] = cols_src.T
 
+        # 4-bit packing (reference dense_nbits_bin.hpp): two rows per
+        # byte in a per-block stride layout (row j low nibble, row
+        # j + block/2 high nibble) so the pallas kernel unpacks with a
+        # nibble mask + lane concat.  Halves the row sweep's DMA traffic.
+        # the pack layout's blocks must coincide with the GROWER's blocks,
+        # which are derived from the PER-SHARD row count under data
+        # sharding — a global-block layout split across shards would
+        # decode the wrong rows silently
+        local_rows = self.n_pad // self.d_shards
+        eff_block = min(block, local_rows)
+        self.packed_bins = (
+            bool(config.tpu_pack_bins) and B <= 16
+            and hist_impl in ("pallas", "pallas2") and plan is None
+            and str(config.tpu_partition_impl) == "select"
+            and eff_block % 256 == 0 and local_rows % eff_block == 0)
+        if self.packed_bins:
+            x = bins_t.reshape(self.g_pad, self.n_pad // eff_block, 2,
+                               eff_block // 2)
+            bins_t = np.ascontiguousarray(
+                (x[:, :, 0, :] | (x[:, :, 1, :] << 4)).reshape(
+                    self.g_pad, self.n_pad // 2))
+
         meta_host = {}
         for k, v in meta_np.items():
             pad_val = 1 if k == "num_bin" else (1.0 if k == "penalty" else 0)
@@ -336,6 +358,7 @@ class TPUTreeLearner:
             hist_impl=hist_impl,
             partition_impl=str(config.tpu_partition_impl),
             has_bundles=plan is not None,
+            packed_bins=self.packed_bins,
             ramp=bool(config.tpu_ramp),
         )
         if has_cegb_lazy and strategy != "serial":

@@ -75,7 +75,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .histogram import (build_histogram_batched_t, build_histogram_t,
-                        pack_stats)
+                        pack_stats, unpack2d)
 from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
                     leaf_split_gain, per_feature_best_split,
                     per_feature_best_split_categorical,
@@ -116,6 +116,9 @@ class GrowerParams(NamedTuple):
     # CEGB (reference cost_effective_gradient_boosting.hpp:21-80): gains
     # are charged tradeoff * (split penalty + coupled per-feature penalty
     # for features not yet used anywhere in the model)
+    # bins stored packed two-rows-per-byte (reference dense_nbits_bin.hpp,
+    # max_bin<=16): halves the histogram row sweep's DMA traffic
+    packed_bins: bool = False
     has_cegb: bool = False
     # lazy per-row acquisition costs: meta carries a [FG, n_pad] paid
     # matrix threaded across trees (feature_used_in_data_ bitset,
@@ -211,6 +214,12 @@ def make_grower(params: GrowerParams, num_features: int,
     if params.has_bundles and params.forced:
         raise ValueError("EFB bundling does not compose with forced splits; "
                          "set enable_bundle=false")
+    if params.packed_bins and (
+            params.has_bundles or params.partition_impl != "select"
+            or not params.hist_impl.startswith("pallas")):
+        raise ValueError(
+            "packed 4-bit bins require the pallas histogram impl, the "
+            "select partition lowering, and no EFB bundling")
     precision = params.precision
     K = max(1, min(int(params.split_batch), L - 1))
 
@@ -312,10 +321,13 @@ def make_grower(params: GrowerParams, num_features: int,
              feature_mask: jnp.ndarray,  # [F] f32 ([F_global] w/ feature_axis)
              meta: Dict[str, jnp.ndarray],
              key: jnp.ndarray):         # PRNG key (per-node sampling)
-        n_pad = bins_t.shape[1]
+        # rows come from grad, NOT bins_t: with packed (4-bit) storage the
+        # bin matrix holds two rows per byte
+        n_pad = grad.shape[0]
         block = min(params.block_rows, n_pad)
         nb = max(n_pad // block, 1)
         block = n_pad // nb
+        bcols = block // 2 if params.packed_bins else block
 
         if feature_axis:
             ax = jax.lax.axis_index(feature_axis)
@@ -482,7 +494,7 @@ def make_grower(params: GrowerParams, num_features: int,
         # per-tree packed stats, reused by every round's contraction
         stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
         S = stats.shape[0]
-        bins_blocks = jnp.moveaxis(bins_hist_t.reshape(G, nb, block), 1, 0)
+        bins_blocks = jnp.moveaxis(bins_hist_t.reshape(G, nb, bcols), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
         if params.hist_impl.startswith("pallas"):
             # reuse the batched VMEM kernel (slot 0 = the all-zero root
@@ -492,7 +504,8 @@ def make_grower(params: GrowerParams, num_features: int,
             root_hist = preduce_hist(build_histogram_batched_t(
                 bins_blocks, stats_blocks,
                 jnp.zeros((nb, block), jnp.int32), root_slots, B,
-                precision, impl=params.hist_impl)[0])
+                precision, impl=params.hist_impl,
+                packed_rows=params.packed_bins)[0])
         else:
             root_hist = preduce_hist(
                 build_histogram_t(bins_blocks, stats_blocks, B, precision))
@@ -630,6 +643,11 @@ def make_grower(params: GrowerParams, num_features: int,
                 # elementwise compares.  No per-row table gathers — XLA's
                 # TPU gather for tiny tables serializes per element, and at
                 # ~8 gathers/round x ~20 rounds it dominated tree time.
+                def unpack_feature_row(pr):
+                    # packed 4-bit row [n_pad/2] -> [n_pad]; unpack2d is
+                    # the single definition of the stride layout
+                    return unpack2d(pr.reshape(nb, bcols)).reshape(-1)
+
                 new_leaf = leaf_ids
                 for k in range(Kr):
                     f_k = sel_feat[k]
@@ -644,6 +662,8 @@ def make_grower(params: GrowerParams, num_features: int,
                     else:
                         col_k = jax.lax.dynamic_index_in_dim(
                             bins_t, f_k, 0, keepdims=False)
+                        if params.packed_bins:
+                            col_k = unpack_feature_row(col_k)
                     go_left_k = numeric_go_left(
                         col_k, meta["missing_type"][f_k],
                         meta["num_bin"][f_k], meta["default_bin"][f_k],
@@ -700,7 +720,8 @@ def make_grower(params: GrowerParams, num_features: int,
             hist_small = preduce_hist(build_histogram_batched_t(
                 bins_blocks, stats_blocks, leaf_ids.reshape(nb, block),
                 smaller_ids, B, precision,
-                impl=params.hist_impl))                      # [K, F, B, 3]
+                impl=params.hist_impl,
+                packed_rows=params.packed_bins))             # [K, F, B, 3]
             parent_hist = state["pool"][sel]                 # [K, F, B, 3]
             hist_large = parent_hist - hist_small
             sl = smaller_is_left[:, None, None, None]

@@ -127,7 +127,8 @@ def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
 def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
                               slot_leaf_ids, num_bins: int,
                               precision: str = "hilo",
-                              impl: str = "xla") -> jnp.ndarray:
+                              impl: str = "xla",
+                              packed_rows: bool = False) -> jnp.ndarray:
     """Transposed-layout batched histogram: rows on the lane axis.
 
     Same contraction as `build_histogram_batched_inline` but with the bin
@@ -148,7 +149,10 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
         return _hist_pallas(
             bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             num_bins, precision,
-            variant="flat" if impl == "pallas" else "perfeature")
+            variant="flat" if impl == "pallas" else "perfeature",
+            packed_rows=packed_rows)
+    if packed_rows:
+        raise ValueError("packed (4-bit) bins require a pallas impl")
     nb, num_features, block = bins_t_blocks.shape
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
@@ -189,8 +193,21 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
 _PERFEATURE_OUT_BUDGET = 6 * 1024 * 1024
 
 
+def unpack2d(b2):
+    """[.., blk/2] packed two-rows-per-byte uint8 -> [.., blk] int32.
+
+    The SINGLE definition of the 4-bit stride layout (low nibbles are a
+    block's first half of rows, high nibbles the second): the pallas
+    kernels and the grower's partition unpack must agree or packed
+    histograms and packed partitions silently diverge."""
+    return jnp.concatenate(
+        [(b2 & 0xF).astype(jnp.int32), (b2 >> 4).astype(jnp.int32)],
+        axis=-1)
+
+
 def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
-                 num_bins: int, precision: str, variant: str) -> jnp.ndarray:
+                 num_bins: int, precision: str, variant: str,
+                 packed_rows: bool = False) -> jnp.ndarray:
     """Pallas kernel: fused one-hot + slot-expansion + MXU contraction.
 
     The TPU answer to the reference GPU kernel's workgroup-local
@@ -223,7 +240,14 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
     """
     from jax.experimental import pallas as pl
 
-    nb, F, block = bins_t_blocks.shape
+    nb, F, bins_block = bins_t_blocks.shape
+    # packed 4-bit storage (the reference dense_nbits_bin.hpp analog,
+    # max_bin<=16): each uint8 byte holds TWO rows of one block — row j in
+    # the low nibble, row j + block/2 in the high nibble — so the kernel's
+    # row-sweep DMA traffic halves.  Unpacking is a nibble mask/shift plus
+    # a lane-axis concat of two half-blocks (the stride layout exists so
+    # the concat IS the row order).
+    block = bins_block * 2 if packed_rows else bins_block
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
     B = num_bins
@@ -255,7 +279,8 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
         i = pl.program_id(0)
         # explicit upcast: bins may arrive uint8 (narrow dense storage) and
         # Mosaic's compare wants a full-width integer operand
-        b_t = bins_ref[0].astype(jnp.int32)     # [F, blk]
+        b_t = (unpack2d(bins_ref[0]) if packed_rows
+               else bins_ref[0].astype(jnp.int32))   # [F, blk]
         sexp = expand_slots(stats_ref, leaf_ref, slots_ref)
         iota = jax.lax.broadcasted_iota(jnp.int32, (F, B, block), 1)
         onehot = (b_t[:, None, :] == iota).astype(dot_dtype)
@@ -271,7 +296,10 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             sexp = expand_slots(stats_ref, leaf_ref, slots_ref)
             iota_b = jax.lax.broadcasted_iota(jnp.int32, (Bp, block), 0)
             for f in range(fblk):
-                b_f = bins_ref[0, f].astype(jnp.int32)      # [blk]
+                if packed_rows:
+                    b_f = unpack2d(bins_ref[0, f])          # [blk]
+                else:
+                    b_f = bins_ref[0, f].astype(jnp.int32)  # [blk]
                 onehot = (b_f[None, :] == iota_b).astype(dot_dtype)
                 acc = jax.lax.dot_general(
                     onehot, sexp, (((1,), (1,)), ((), ())),
@@ -291,7 +319,7 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             kernel_flat,
             grid=(nb,),
             in_specs=[
-                pl.BlockSpec((1, F, block), lambda i: (i, 0, 0)),
+                pl.BlockSpec((1, F, bins_block), lambda i: (i, 0, 0)),
                 pl.BlockSpec((1, S, block), lambda i: (i, 0, 0)),
                 pl.BlockSpec((1, 1, block), lambda i: (i, 0, 0)),
                 pl.BlockSpec((K, 1), lambda i: (0, 0)),
@@ -334,7 +362,7 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             kernel_perfeature_chunk(fblk),
             grid=(nf, nb),
             in_specs=[
-                pl.BlockSpec((1, fblk, block), lambda fi, i: (i, fi, 0)),
+                pl.BlockSpec((1, fblk, bins_block), lambda fi, i: (i, fi, 0)),
                 pl.BlockSpec((1, S, block), lambda fi, i: (i, 0, 0)),
                 pl.BlockSpec((1, 1, block), lambda fi, i: (i, 0, 0)),
                 pl.BlockSpec((K, 1), lambda fi, i: (0, 0)),
