@@ -30,6 +30,16 @@ BASELINE_ITERS_PER_SEC = 500.0 / 238.5  # reference Higgs CPU (BASELINE.md)
 
 
 def make_data(n, f, seed=42):
+    # real data preferred when present: LIGHTGBM_TPU_BENCH_DATA points at
+    # a labels-first CSV/TSV (e.g. the real HIGGS.csv) — both frameworks
+    # then train on identical rows and the AUC half of the north-star
+    # metric becomes directly comparable (tools/auc_parity.py)
+    real = os.environ.get("LIGHTGBM_TPU_BENCH_DATA", "")
+    if real and os.path.exists(real):
+        raw = np.loadtxt(real, delimiter="," if real.endswith(".csv")
+                         else None, max_rows=n)
+        y, X = raw[:, 0].astype(np.float64), raw[:, 1:1 + f]
+        return np.ascontiguousarray(X, np.float64), y
     rng = np.random.default_rng(seed)
     X = rng.normal(size=(n, f)).astype(np.float32)
     w = rng.normal(size=(f,))
