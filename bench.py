@@ -35,9 +35,17 @@ def make_data(n, f, seed=42):
     # then train on identical rows and the AUC half of the north-star
     # metric becomes directly comparable (tools/auc_parity.py)
     real = os.environ.get("LIGHTGBM_TPU_BENCH_DATA", "")
-    if real and os.path.exists(real):
+    if real:
+        if not os.path.exists(real):
+            raise FileNotFoundError(
+                f"LIGHTGBM_TPU_BENCH_DATA={real!r} does not exist — "
+                "refusing to silently fall back to synthetic data")
         raw = np.loadtxt(real, delimiter="," if real.endswith(".csv")
-                         else None, max_rows=n)
+                         else None, max_rows=n, ndmin=2)
+        if raw.shape[1] < f + 1:
+            raise ValueError(
+                f"{real}: {raw.shape[1]} columns, need label + {f} "
+                "features")
         y, X = raw[:, 0].astype(np.float64), raw[:, 1:1 + f]
         return np.ascontiguousarray(X, np.float64), y
     rng = np.random.default_rng(seed)

@@ -185,12 +185,24 @@ class GBDT:
         if self.learner.params.has_cegb and self._goss_cfg is not None:
             raise NotImplementedError(
                 "CEGB penalties do not compose with GOSS yet")
+        self._maybe_make_train_step()
+
+    def _maybe_make_train_step(self) -> None:
+        """(Re)build the fused async step when the configuration supports
+        it — the ONE place that owns the eligibility rule, so every
+        rebuild site (init / reset_training_data / reset_config) applies
+        identical conditions."""
+        self._train_step = None
         if (self.objective is not None and not self.objective.needs_renew
                 and not self.objective.host_only
                 # CEGB threads cross-tree used/paid state through
                 # learner.train (the sync path); the fused step's meta is
                 # closure-captured and cannot carry it
                 and not self.learner.params.has_cegb
+                # multi-host meshes need learner.train's global array
+                # placement (put_global); the fused step mixes local
+                # score state into the global-mesh program
+                and not self.learner._multiproc
                 and all(self.objective.class_need_train(k)
                         for k in range(self.num_tree_per_iteration))):
             self._train_step = self.learner.make_train_step(
@@ -259,12 +271,7 @@ class GBDT:
         self._pending = []
         self._stopped = False
         self._bag_cfg = self._bagging_config()
-        self._train_step = None
-        if (self.objective is not None and not self.objective.needs_renew
-                and not self.objective.host_only):
-            self._train_step = self.learner.make_train_step(
-                self.objective.get_gradients, self.shrinkage_rate,
-                self._bag_cfg, self._goss_cfg)
+        self._maybe_make_train_step()
 
     def _replay_scale(self) -> float:
         """Scale applied when replaying stored trees onto new data
@@ -752,11 +759,7 @@ class GBDT:
         if self.learner is not None:
             self.learner = TPUTreeLearner(config, self.train_data)
             self._bag_cfg = self._bagging_config()
-            if (self.objective is not None and not self.objective.needs_renew
-                    and not self.objective.host_only):
-                self._train_step = self.learner.make_train_step(
-                    self.objective.get_gradients, self.shrinkage_rate,
-                    self._bag_cfg, self._goss_cfg)
+            self._maybe_make_train_step()
 
     def shuffle_models(self, start: int = 0, end: int = -1) -> None:
         self._materialize()

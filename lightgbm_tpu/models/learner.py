@@ -698,6 +698,16 @@ class TPUTreeLearner:
             if self.params.has_cegb_lazy:
                 self._cegb_paid = out["cegb_paid"]
         tree = self.build_tree(out)
+        if self._multiproc:
+            # reassemble the row-sharded leaf ids on every host: the GBDT
+            # driver's score updates and renew paths operate on LOCAL
+            # arrays (identical on all ranks), and a non-addressable
+            # global array cannot be device_get there
+            from jax.experimental import multihost_utils
+
+            lids = multihost_utils.process_allgather(
+                out["leaf_ids"], tiled=True)[:self.n]
+            return tree, jnp.asarray(lids), out
         return tree, out["leaf_ids"][:self.n], out
 
     def build_tree(self, out: Dict) -> Tree:

@@ -92,6 +92,18 @@ rec = np.asarray(jax.device_get(out["records"]))
 assert rec[0, 14] > 0.5, "no split grown"
 np.save({outfile!r}, rec)
 print(f"rank {{pid}}: {{int(rec[:, 14].sum())}} splits", flush=True)
+
+# FULL training through the public API on the same global mesh: the GBDT
+# driver routes multi-process learners through the sync path (local score
+# state, allgathered leaf ids) — every rank must produce the same model
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(X, label=y, params=dict(cfg.params))
+bst = lgb.train({{**dict(cfg.params), "verbosity": -1}}, ds,
+                num_boost_round=3)
+model = bst.model_to_string().split("\\nparameters:")[0]
+with open({outfile!r} + ".model", "w") as f:
+    f.write(model)
+print(f"rank {{pid}}: trained {{bst.num_trees()}} trees", flush=True)
 """
 
 
@@ -141,3 +153,7 @@ class TestTwoProcessRendezvous:
         # ran inconsistently
         np.testing.assert_array_equal(rec0, rec1)
         assert rec0[:, 14].sum() >= 3
+        # full lgb.train over the 2-process mesh: identical models
+        m0 = open(outs[0] + ".model").read()
+        m1 = open(outs[1] + ".model").read()
+        assert m0 == m1 and "tree" in m0

@@ -52,7 +52,8 @@ def main():
     ap.add_argument("--data", default=os.environ.get(
         "LIGHTGBM_TPU_BENCH_DATA", ""))
     ap.add_argument("--skip-ref", action="store_true",
-                    help="reuse the last reference result from the out file")
+                    help="skip the reference run (ours-only JSON; no "
+                         "parity table is written)")
     ap.add_argument("--out", default=os.path.join(ROOT, "docs",
                                                   "AUC_PARITY.md"))
     ap.add_argument("--workdir", default="/tmp/auc_parity")
@@ -63,14 +64,26 @@ def main():
     from bench import make_data  # bench's data rules (real-file override)
 
     if args.data:
+        if not os.path.exists(args.data):
+            raise FileNotFoundError(f"--data {args.data!r} does not exist")
         os.environ["LIGHTGBM_TPU_BENCH_DATA"] = args.data
     X, y = make_data(args.rows, 28)
     src = args.data if args.data else f"synthetic(seed=42, n={args.rows})"
 
-    data_file = os.path.join(args.workdir, f"train_{args.rows}.tsv")
+    # cache key includes the SOURCE so switching --data never reuses a
+    # stale file; both frameworks then train from the same tsv (full
+    # %.17g round-trip precision) so "identical data" is literal
+    import hashlib
+
+    tag = hashlib.sha1(src.encode()).hexdigest()[:10]
+    data_file = os.path.join(args.workdir, f"train_{args.rows}_{tag}.tsv")
     if not os.path.exists(data_file):
         np.savetxt(data_file, np.column_stack([y, X]), delimiter="\t",
-                   fmt="%.8g")
+                   fmt="%.17g")
+    del X, y
+    raw = np.loadtxt(data_file, ndmin=2)
+    y, X = raw[:, 0], np.ascontiguousarray(raw[:, 1:])
+    del raw
 
     results = {}
 
