@@ -190,8 +190,12 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # only batch leaves whose gain >= alpha * the round's best gain (near
     # ties); keeps batched split order close to strict best-first
     "tpu_split_batch_alpha": ("float", 0.0, ()),
-    # row-partition lowering: select | gather (ops/grower.py GrowerParams.
-    # partition_impl; honored by every tree learner)
+    # row-partition lowering: select | vselect | gather (ops/grower.py
+    # GrowerParams.partition_impl; honored by every tree learner).
+    # vselect fuses the K unrolled select passes into one [K, n] block —
+    # fewer program points, but its CATEGORICAL path gathers per-row from
+    # a tiny table (the pattern select avoids); prefer select on
+    # categorical-heavy data until vselect is hardware-timed there
     "tpu_partition_impl": ("str", "select", ()),
     # frontier ramp: unrolled K'=1,2,4,... pre-rounds before the full-K
     # loop (bit-identical trees, removes early rounds' dead-slot MXU

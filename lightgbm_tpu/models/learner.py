@@ -121,7 +121,7 @@ class TPUTreeLearner:
         else:
             self.f_shards, self.d_shards = 1, self.n_shards
 
-        for key, allowed in (("tpu_partition_impl", ("select", "gather")),
+        for key, allowed in (("tpu_partition_impl", ("select", "vselect", "gather")),
                              ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2"))):
             if str(getattr(config, key)) not in allowed:
                 raise ValueError(f"{key}={getattr(config, key)!r}; "
@@ -277,7 +277,7 @@ class TPUTreeLearner:
         self.packed_bins = (
             bool(config.tpu_pack_bins) and B <= 16
             and hist_impl in ("pallas", "pallas2") and plan is None
-            and str(config.tpu_partition_impl) == "select"
+            and str(config.tpu_partition_impl) in ("select", "vselect")
             and eff_block % 256 == 0 and local_rows % eff_block == 0)
         if self.packed_bins:
             x = bins_t.reshape(self.g_pad, self.n_pad // eff_block, 2,

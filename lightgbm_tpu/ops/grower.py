@@ -136,8 +136,11 @@ class GrowerParams(NamedTuple):
     hist_impl: str = "xla"
     # row-partition lowering: "select" unrolls K scalar-broadcast passes
     # (one dynamic row slice + elementwise compare per split — no per-row
-    # table gathers, which XLA serializes on TPU); "gather" resolves each
-    # row's slot through [L]/[K] table lookups (one pass, but gather-bound)
+    # table gathers, which XLA serializes on TPU); "vselect" fuses those
+    # K passes into one [K, n] block (fewer program points; NOTE its
+    # categorical path per-row-gathers from the [K, CB] mask table);
+    # "gather" resolves each row's slot through [L]/[K] table lookups
+    # (one pass, but gather-bound)
     partition_impl: str = "select"
     # EFB (reference FindGroups/FastFeatureBundling, dataset.cpp:91-263):
     # bins_t holds G <= F bundle columns; meta carries bundle_idx /
@@ -215,11 +218,12 @@ def make_grower(params: GrowerParams, num_features: int,
         raise ValueError("EFB bundling does not compose with forced splits; "
                          "set enable_bundle=false")
     if params.packed_bins and (
-            params.has_bundles or params.partition_impl != "select"
+            params.has_bundles
+            or params.partition_impl not in ("select", "vselect")
             or not params.hist_impl.startswith("pallas")):
         raise ValueError(
-            "packed 4-bit bins require the pallas histogram impl, the "
-            "select partition lowering, and no EFB bundling")
+            "packed 4-bit bins require the pallas histogram impl, a "
+            "select-family partition lowering, and no EFB bundling")
     precision = params.precision
     K = max(1, min(int(params.split_batch), L - 1))
 
@@ -676,6 +680,43 @@ def make_grower(params: GrowerParams, num_features: int,
                     new_leaf = jnp.where(in_k & (~go_left_k),
                                          new_ids[k], new_leaf)
                 leaf_ids = new_leaf
+            elif params.partition_impl == "vselect":
+                # vectorized single-block form of "select": ONE [K, n]
+                # row gather + one fused elementwise block instead of K
+                # unrolled passes — K fewer program points for launch
+                # overhead at ~3 [K, n] intermediates of HBM traffic.
+                # Candidate for the non-contraction time (PERF_NOTES
+                # round-4); same math as "select" bit-for-bit.
+                feat_rows = (meta["bundle_idx"][sel_feat]
+                             if params.has_bundles else sel_feat)
+                cols = bins_t[feat_rows]                     # [K, n_cols]
+                if params.packed_bins:
+                    cols = unpack2d(
+                        cols.reshape(Kr, nb, bcols)).reshape(Kr, -1)
+                if params.has_bundles:
+                    cols = fix_bundle_col(
+                        cols, meta["bin_offset"][sel_feat][:, None],
+                        meta["num_bin"][sel_feat][:, None],
+                        (meta["needs_fix"][sel_feat] > 0)[:, None])
+                go_left = numeric_go_left(
+                    cols, meta["missing_type"][sel_feat][:, None],
+                    meta["num_bin"][sel_feat][:, None],
+                    meta["default_bin"][sel_feat][:, None],
+                    sel_thr[:, None], sel_dleft[:, None])    # [K, n]
+                if params.has_cat:
+                    # per-row gather from the tiny [K, CB] mask table —
+                    # the pattern "select" exists to avoid on TPU; see
+                    # the config.py tpu_partition_impl caveat
+                    cm = jnp.take_along_axis(cmask_sel, cols, axis=1)
+                    go_left = jnp.where(sel_iscat[:, None], cm > 0.5,
+                                        go_left)
+                move = ((leaf_ids[None, :] == sel[:, None])
+                        & do_k[:, None] & (~go_left))        # [K, n]
+                # each row sits in at most one frontier leaf, so a max
+                # over slots recovers its (unique) new id; -1 = stay
+                moved_to = jnp.max(
+                    jnp.where(move, new_ids[:, None], -1), axis=0)
+                leaf_ids = jnp.where(moved_to >= 0, moved_to, leaf_ids)
             else:
                 # single-pass gather form: row->slot via an [L]-table
                 # lookup, then [K]-table lookups per row

@@ -504,6 +504,58 @@ class TestPackedBins:
             tpu_block_rows=128)._driver.learner.packed_bins
 
 
+class TestVselectPartition:
+    """tpu_partition_impl=vselect (one vectorized [K, n] pass) must
+    reproduce the unrolled "select" lowering bit-for-bit across plain,
+    categorical, EFB-bundled, and packed-bin configurations."""
+
+    def _model(self, seed, cat=False, **extra):
+        import lightgbm_tpu as lgb
+
+        rng = np.random.default_rng(seed)
+        X = rng.normal(size=(2500, 8))
+        cat_idx = []
+        if cat:
+            X[:, 3] = rng.integers(0, 7, size=2500)
+            cat_idx = [3]
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+        p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+             "max_bin": 31, "tpu_block_rows": 512, **extra}
+        ds = lgb.Dataset(X, label=y, params=p,
+                         categorical_feature=cat_idx or "auto")
+        return lgb.train(p, ds, num_boost_round=5) \
+            .model_to_string().split("\nparameters:")[0]
+
+    @pytest.mark.parametrize("cfg", [
+        {},
+        {"cat": True},
+        {"max_bin": 15, "tpu_hist_impl": "pallas2",
+         "tpu_block_rows": 512},  # packed bins active
+    ])
+    def test_vselect_matches_select(self, cfg):
+        cfg = dict(cfg)
+        cat = cfg.pop("cat", False)
+        a = self._model(9, cat=cat, tpu_partition_impl="select", **cfg)
+        b = self._model(9, cat=cat, tpu_partition_impl="vselect", **cfg)
+        assert a == b
+
+    def test_vselect_matches_select_with_bundles(self):
+        import lightgbm_tpu as lgb
+
+        rng = np.random.default_rng(11)
+        X = np.where(rng.random((3000, 10)) < 0.85, 0.0,
+                     rng.normal(size=(3000, 10)))
+        y = (X.sum(axis=1) > 0).astype(np.float64)
+        out = []
+        for impl in ("select", "vselect"):
+            p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                 "max_bin": 31, "tpu_partition_impl": impl}
+            ds = lgb.Dataset(X, label=y, params=p)
+            out.append(lgb.train(p, ds, num_boost_round=5)
+                       .model_to_string().split("\nparameters:")[0])
+        assert out[0] == out[1]
+
+
 class TestAutoHistResolution:
     """tpu_hist_impl=auto / tpu_block_rows=0 resolution (models/learner.py
     _resolve_hist_impl): platform- and VMEM-aware backend choice."""

@@ -135,6 +135,21 @@ def main():
                  alpha=0.5),
         ])
         return
+    if arg == "round4":
+        # partition-lowering A/B at the committed defaults: "vselect"
+        # replaces the K unrolled select passes with ONE [K, n] fused
+        # block (fewer program points; candidate for the ~170 ms/tree
+        # non-contraction time, PERF_NOTES round-4).  Bit-parity with
+        # "select" is CPU-proven (tests/test_grower.py TestVselectPartition)
+        sweep(X, y, [
+            dict(k=25, block=8192, impl="pallas2", prec="hilo",
+                 ramp=True, part="select"),   # default, re-baseline
+            dict(k=25, block=8192, impl="pallas2", prec="hilo",
+                 ramp=True, part="vselect"),
+            dict(k=50, block=8192, impl="pallas2", prec="hilo",
+                 ramp=True, part="vselect", alpha=0.5),
+        ])
+        return
     if arg == "decide":
         # the post-outage decision sweep: partition A/B at default K, then
         # K scaling, then the pallas backend at a VMEM-sized block
