@@ -76,11 +76,14 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     params = {"objective": "binary", "num_leaves": num_leaves,
               "learning_rate": 0.1, "min_data_in_leaf": 20,
               "max_bin": max_bin,
-              # the benchmark pins its exact shape: no bucket padding
-              # (tpu_shape_buckets trades ~1/buckets throughput for
+              # the benchmark pins its exact shape by default: no bucket
+              # padding (tpu_shape_buckets trades ~1/buckets throughput for
               # compile-cache hits across DIFFERENT datasets, which a
-              # fixed-shape benchmark never needs)
-              "tpu_shape_buckets": 0}
+              # fixed-shape benchmark never needs).  BENCH_SHAPE_BUCKETS=32
+              # measures the shipping bucketed default instead, so the
+              # configuration users actually get also has a perf record.
+              "tpu_shape_buckets": int(os.environ.get(
+                  "BENCH_SHAPE_BUCKETS", 0))}
     bst = Booster(params=params, train_set=ds)
     from lightgbm_tpu.utils.backend import host_sync
 
@@ -128,6 +131,8 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "compile_s": round(compile_s, 1),
         "platform": jax.devices()[0].platform,
     }
+    if params["tpu_shape_buckets"]:
+        out["tpu_shape_buckets"] = params["tpu_shape_buckets"]
     if degraded:
         out["degraded"] = ("tpu backend probe failed; reduced-size run on "
                            "cpu fallback — value NOT comparable to baseline")

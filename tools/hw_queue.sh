@@ -1,0 +1,81 @@
+#!/bin/sh
+# Round-5 hardware queue: probe the tunneled TPU backend every ~5 min and,
+# the moment it answers, run the queued hardware jobs in priority order.
+# All results append to docs/HW_RESULTS_r5.log (durable, in-repo).
+# Priority order follows VERDICT.md "Next round": official bench record
+# first (the round's only non-negotiable), then fast default-validations,
+# then profile/sweeps, then the long full-scale AUC parity run.
+cd /root/repo
+LOG=/root/repo/docs/HW_RESULTS_r5.log
+while true; do
+  # probe must see the real chip: with the axon factory registered, jax
+  # init can "succeed" on the CPU fallback while the tunnel is down, so
+  # a bare matmul is not evidence.  probe_default_backend already encodes
+  # the throwaway-subprocess + timeout + platform-check logic — reuse it.
+  while true; do
+    timeout 130 python -c "
+import sys
+from lightgbm_tpu.utils.backend import probe_default_backend
+p = probe_default_backend(timeout_s=110, retries=0)
+print('probe ->', p)
+sys.exit(0 if p == 'tpu' else 1)" >> /tmp/tunnel_probe.log 2>&1 && break
+    sleep 300
+  done
+  # every job gets a hard timeout: a mid-run tunnel hang must not stall
+  # the queue forever (bench's own probe window only bounds startup)
+  timeout 5400 python -u bench.py > /tmp/bench_r1.json 2>&1
+  timeout 5400 python -u bench.py > /tmp/bench_r2.json 2>&1
+  if ! grep -q '"platform": "tpu"' /tmp/bench_r1.json \
+     && ! grep -q '"platform": "tpu"' /tmp/bench_r2.json; then
+    # nothing worth keeping — a one-line note, not two degraded records
+    echo "probe saw TPU but both bench runs degraded; re-arming $(date -u)" >> "$LOG"
+    sleep 300
+    continue
+  fi
+  echo "tunnel up at $(date -u)" >> "$LOG"
+  cat /tmp/bench_r1.json >> "$LOG"
+  echo "--- run2 $(date -u)" >> "$LOG"
+  cat /tmp/bench_r2.json >> "$LOG"
+  if ! grep -q '"platform": "tpu"' /tmp/bench_r2.json; then
+    # run1 reached TPU but the tunnel died mid-cycle; the extended queue
+    # needs a live tunnel, so keep run1's record and re-arm the probe
+    echo "run2 degraded after a TPU run1; re-arming probe loop $(date -u)" >> "$LOG"
+    sleep 300
+    continue
+  fi
+  if ! grep -q '"platform": "tpu"' /tmp/bench_r1.json; then
+    # run 1 raced a recovering tunnel and fell back to CPU; take one more
+    # TPU run so the log holds two on-chip records (cold-ish + warm)
+    echo "--- run3 (run1 was degraded) $(date -u)" >> "$LOG"
+    timeout 5400 python -u bench.py > /tmp/bench_r3.json 2>&1
+    cat /tmp/bench_r3.json >> "$LOG"
+    grep -q '"platform": "tpu"' /tmp/bench_r3.json \
+      || echo "run3 also degraded — only one on-chip record this cycle $(date -u)" >> "$LOG"
+  fi
+  # profile/sweep tools print no platform themselves; stamp the live
+  # platform immediately before each so a mid-queue tunnel drop cannot
+  # contaminate the log with CPU timings posing as hardware records
+  stamp() {
+    timeout 130 python -c "
+from lightgbm_tpu.utils.backend import probe_default_backend
+print('platform-stamp:', probe_default_backend(timeout_s=110, retries=0))" \
+      >> "$LOG" 2>&1
+  }
+  echo "--- packed/vselect TPU validation $(date -u)" >> "$LOG"
+  timeout 1200 python -u tools/tpu_validate.py >> "$LOG" 2>&1
+  echo "--- bucketed-default bench (BENCH_SHAPE_BUCKETS=32) $(date -u)" >> "$LOG"
+  BENCH_SHAPE_BUCKETS=32 timeout 3600 python -u bench.py > /tmp/bench_bk.json 2>&1
+  cat /tmp/bench_bk.json >> "$LOG"
+  grep -q '"platform": "tpu"' /tmp/bench_bk.json \
+    || echo "bucketed bench degraded (not a hardware record)" >> "$LOG"
+  echo "--- profile $(date -u)" >> "$LOG"; stamp
+  timeout 1800 python -u tools/profile_step.py >> "$LOG" 2>&1
+  echo "--- round3 alpha sweep $(date -u)" >> "$LOG"; stamp
+  timeout 3600 python -u tools/perf_probe.py round3 >> "$LOG" 2>&1
+  echo "--- round4 partition sweep $(date -u)" >> "$LOG"; stamp
+  timeout 2400 python -u tools/perf_probe.py round4 >> "$LOG" 2>&1
+  echo "--- auc_parity full $(date -u)" >> "$LOG"; stamp
+  timeout 10800 python -u tools/auc_parity.py >> "$LOG" 2>&1
+  echo DONE >> "$LOG"
+  break
+done

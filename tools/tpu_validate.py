@@ -1,0 +1,60 @@
+"""Tiny TPU validation of hardware-unvalidated paths.
+
+Validates (by bit-matching full model text against the proven default
+lowering, on the real chip):
+  - 4-bit packed bins (``tpu_pack_bins``: Mosaic nibble ops + lane concat,
+    previously interpret-mode-verified only) against unpacked uint8 bins;
+  - the ``vselect`` partition lowering against the default ``select``.
+
+Decision rule (docs/PERF_NOTES.md): models must bit-match on hardware or
+the corresponding default flips OFF.  Mirrors the reference's n-bit dense
+bin validation posture (/root/reference/src/io/dense_nbits_bin.hpp).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def main():
+    import jax
+    platform = jax.devices()[0].platform
+    print("platform:", platform, flush=True)
+    # a silent CPU fallback would "pass" trivially (already proven there)
+    # and forge a hardware record — refuse to validate off-chip
+    assert platform == "tpu", f"not on TPU (platform={platform}); aborting"
+    rng = np.random.default_rng(4)
+    X = rng.normal(size=(20000, 10))
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(np.float64)
+    out = {}
+    for tag, extra in (("packed", {"max_bin": 15, "tpu_pack_bins": True}),
+                       ("unpacked", {"max_bin": 15, "tpu_pack_bins": False}),
+                       ("vselect", {"max_bin": 63,
+                                    "tpu_partition_impl": "vselect"}),
+                       ("select", {"max_bin": 63})):
+        p = {"objective": "binary", "num_leaves": 31, "verbosity": -1,
+             "tpu_hist_impl": "pallas2", "tpu_block_rows": 4096, **extra}
+        ds = lgb.Dataset(X, label=y, params=p)
+        bst = lgb.train(p, ds, num_boost_round=3)
+        # the learner silently disables packing when its alignment gates
+        # fail (learner.py packed_bins computation) — a vacuous bit-match
+        # of two unpacked runs must not forge the hardware record
+        learner = bst._driver.learner
+        if tag == "packed":
+            assert learner.packed_bins, \
+                "packed path did not engage (alignment gate failed)"
+        if tag == "vselect":
+            assert learner.params.partition_impl == "vselect", \
+                f"vselect not engaged: {learner.params.partition_impl}"
+        out[tag] = bst.model_to_string().split("\nparameters:")[0]
+    assert out["packed"] == out["unpacked"], "PACKED-BIN MISMATCH ON TPU"
+    assert out["vselect"] == out["select"], "VSELECT MISMATCH ON TPU"
+    print("TPU VALIDATION OK: packed bins + vselect bit-match on hardware")
+
+
+if __name__ == "__main__":
+    main()
