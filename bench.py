@@ -40,8 +40,15 @@ def make_data(n, f, seed=42):
             raise FileNotFoundError(
                 f"LIGHTGBM_TPU_BENCH_DATA={real!r} does not exist — "
                 "refusing to silently fall back to synthetic data")
-        raw = np.loadtxt(real, delimiter="," if real.endswith(".csv")
-                         else None, max_rows=n, ndmin=2)
+        # pandas' C parser is ~20x np.loadtxt and streams nrows — at
+        # HIGGS scale (11M rows) loadtxt would dominate bench startup
+        import pandas as pd
+
+        raw = pd.read_csv(real, header=None, nrows=n, comment="#",
+                          sep="," if real.endswith(".csv") else r"\s+",
+                          dtype=np.float64).to_numpy()
+        if raw.ndim != 2:
+            raw = raw.reshape(1, -1)
         if raw.shape[1] < f + 1:
             raise ValueError(
                 f"{real}: {raw.shape[1]} columns, need label + {f} "

@@ -453,14 +453,32 @@ def _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
     nrow = nindptr - 1
     X = np.zeros((nrow, num_col), np.float64)
     row_of = np.repeat(np.arange(nrow), np.diff(indptr))
-    X[row_of, indices] = vals
+    # duplicate coordinates must SUM like scipy toarray(), not
+    # last-write-win — the scipy and scipy-less paths must bin alike
+    np.add.at(X, (row_of, indices), vals)
     return X
+
+
+def _warn_no_scipy(kind: str) -> None:
+    from .utils.log import Log
+
+    Log.warning(f"scipy is unavailable; the {kind} C-API path densifies "
+                "the matrix on the host (O(nrow*ncol) memory instead of "
+                "O(nnz)) — install scipy for sparse ingest at scale")
 
 
 def _scipy_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
                data_type, nindptr, nelem, num_col):
-    """CSR pointers -> scipy.sparse.csr_matrix, O(nnz), no densify."""
-    from scipy import sparse as sps
+    """CSR pointers -> scipy.sparse.csr_matrix, O(nnz), no densify.
+    Without scipy the path falls back to the dense decode with a loud
+    warning rather than an ImportError — the C ABI caller cannot see a
+    Python traceback."""
+    try:
+        from scipy import sparse as sps
+    except ImportError:
+        _warn_no_scipy("CSR")
+        return _densify_csr(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                            data_type, nindptr, nelem, num_col)
 
     indptr = _vec_from_ptr(indptr_ptr, indptr_type, nindptr).astype(np.int64)
     indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int32)
@@ -660,13 +678,20 @@ def _scipy_csc(col_ptr_p, col_ptr_type, indices_ptr, data_ptr, data_type,
                ncol_ptr, nelem, num_row):
     """CSC pointers -> scipy.sparse.csc_matrix, O(nnz), no densify
     (reference LGBM_DatasetCreateFromCSC keeps columns sparse,
-    c_api.cpp CSC path / src/io/sparse_bin.hpp:73)."""
-    from scipy import sparse as sps
-
+    c_api.cpp CSC path / src/io/sparse_bin.hpp:73).  Falls back to a
+    dense decode with a warning when scipy is absent — see _scipy_csr."""
     col_ptr = _vec_from_ptr(col_ptr_p, col_ptr_type, ncol_ptr).astype(np.int64)
-    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int32)
+    indices = _vec_from_ptr(indices_ptr, DTYPE_INT32, nelem).astype(np.int64)
     vals = _vec_from_ptr(data_ptr, data_type, nelem).astype(np.float64)
-    return sps.csc_matrix((vals, indices, col_ptr),
+    try:
+        from scipy import sparse as sps
+    except ImportError:
+        _warn_no_scipy("CSC")
+        X = np.zeros((num_row, ncol_ptr - 1), np.float64)
+        col_of = np.repeat(np.arange(ncol_ptr - 1), np.diff(col_ptr))
+        np.add.at(X, (indices, col_of), vals)  # duplicates sum, as scipy
+        return X
+    return sps.csc_matrix((vals, indices.astype(np.int32), col_ptr),
                           shape=(num_row, ncol_ptr - 1))
 
 

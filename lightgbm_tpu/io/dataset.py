@@ -249,6 +249,9 @@ class TrainingData:
         """
         config = config or Config()
         sp = sp.tocsc()
+        # non-canonical inputs (duplicate coordinates) must SUM like
+        # scipy's own toarray(), not last-write-win in the bin scatter
+        sp.sum_duplicates()
         n, nf = sp.shape
         self = cls()
         self.config = config
@@ -500,6 +503,10 @@ class TrainingData:
         self.used_feature_idx = list(reference.used_feature_idx)
         self.monotone_constraints = reference.monotone_constraints
         self.feature_penalty = reference.feature_penalty
+        # eval_for_data on a freed booster (train_data dropped) can no
+        # longer compare mapper identity; this flag records that the bins
+        # came from SOME reference rather than a fresh find
+        self.adopted_reference = True
         if reference.num_total_features != self.num_total_features:
             raise ValueError("validation data feature count mismatch")
 
@@ -557,6 +564,9 @@ class TrainingData:
         sp_csc = None
         if _is_scipy_sparse(Xs):
             sp_csc = Xs.tocsc()
+            # duplicate coordinates sum under densification; match that
+            # before reading stored values per column
+            sp_csc.sum_duplicates()
         total = Xs.shape[0]
 
         ignore = set(_parse_column_spec(config.ignore_column, self.feature_names))

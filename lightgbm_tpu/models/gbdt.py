@@ -547,7 +547,57 @@ class GBDT:
         return out
 
     def eval_for_data(self, data: TrainingData, name: str, feval=None):
-        raise NotImplementedError("use add_valid before training")
+        """Metrics on an AD-HOC dataset without registering it as a valid
+        set (reference c_api.cpp:207-230's AddValidData + Eval pair, but
+        transient: nothing is appended to valid_sets, so repeated calls
+        do not accumulate score state).  The dataset must share the
+        training mappers (created with reference=the train set) — same
+        alignment contract as add_valid; scores replay through the binned
+        walker exactly like add_valid's model replay."""
+        self._materialize()
+        if self.config is None:
+            raise ValueError("eval on data needs a booster constructed "
+                             "with a training dataset (file-loaded "
+                             "boosters carry no metric config)")
+        # alignment contract: bin-space traversal silently produces
+        # garbage on foreign mappers.  train() frees train_data by
+        # default (free_dataset), so identity can only be checked while
+        # the training context is still alive; afterwards the
+        # adopted_reference flag (set by reference= construction) is the
+        # remaining guard — the reference keeps its C++ train set alive
+        # inside the handle and needs neither
+        ref_td = (self.train_data if self.train_data is not None
+                  else self.learner.td if self.learner is not None else None)
+        if ref_td is not None:
+            if data.mappers is not ref_td.mappers:
+                raise ValueError("eval data must be created with "
+                                 "reference=the training dataset")
+        elif not getattr(data, "adopted_reference", False):
+            raise ValueError("eval data must be created with "
+                             "reference=the training dataset")
+        ms = create_metrics(self.config,
+                            self.objective.name if self.objective else "")
+        for m in ms:
+            m.init(data.metadata, data.num_data)
+        state = _ScoreState(self.num_tree_per_iteration, data.num_data,
+                            data.metadata.init_score)
+        # per-feature bin metadata comes from the shared mappers, so the
+        # eval dataset's own arrays equal the training ones
+        meta = data.feature_arrays()
+        for i, tree in enumerate(self.models):
+            k = i % self.num_tree_per_iteration
+            state.add(k, jnp.asarray(
+                _predict_binned(tree, data.bins, meta).astype(np.float32)))
+        scores = state.numpy()
+        out = []
+        for m in ms:
+            for metric_name, val in m.eval_all(scores, self.objective):
+                out.append((name, metric_name, val, m.higher_is_better))
+        if feval is not None:
+            res = feval(scores.reshape(-1), _FevalData(data))
+            for item in (res if isinstance(res, list) else [res]):
+                out.append((name, item[0], item[1], item[2]))
+        return out
 
     # ------------------------------------------------------------------
     def _invalidate_tables(self) -> None:

@@ -3,6 +3,13 @@
 Metrics evaluate on host numpy arrays (scores come off-device once per
 `metric_freq` iterations, which is negligible next to histogram work).
 Each metric reports (name, value, higher_is_better).
+
+Distribution-aware (SURVEY §2.6; reference Network::GlobalSyncUp*,
+include/LightGBM/network.h:168-275): in a multi-process jax.distributed
+run every metric reduces its sufficient statistics across ranks via
+parallel.metric_sync, so all ranks report the GLOBAL value and early
+stopping fires at the same iteration everywhere.  Single-process runs
+pay nothing.
 """
 
 from __future__ import annotations
@@ -42,9 +49,14 @@ class Metric:
 
 
 def _avg(loss: np.ndarray, weight: Optional[np.ndarray], sum_w: float) -> float:
-    if weight is None:
-        return float(loss.sum() / sum_w)
-    return float((loss * weight).sum() / sum_w)
+    """Weighted average with the (numerator, denominator) pair summed
+    across processes — both stay LOCAL sums until here, so the division
+    happens on the global statistics on every rank."""
+    from ..parallel.metric_sync import sync_sums
+
+    num = float(loss.sum()) if weight is None else float((loss * weight).sum())
+    g_num, g_den = sync_sums([num, float(sum_w)])
+    return float(g_num / g_den)
 
 
 class L2Metric(Metric):
@@ -104,11 +116,23 @@ class AUCMetric(Metric):
     higher_is_better = True
 
     def eval(self, score, objective):
+        from ..parallel.metric_sync import process_count, sync_concat
+
         s = score[0]
+        label = self.label
+        weight = self.weight
+        if process_count() > 1:
+            # AUC is a pairwise rank statistic with no per-rank sufficient
+            # sum — merge the raw (score, label, weight) columns exactly
+            # across ranks, then rank globally (VERDICT r4 #4's "exact
+            # merge" option)
+            s, label, weight = sync_concat(
+                s, label,
+                weight if weight is not None else np.ones_like(s))
         order = np.argsort(s, kind="stable")
         sorted_score = s[order]
-        sorted_pos = (self.label[order] > 0).astype(np.float64)
-        w = (self.weight[order] if self.weight is not None
+        sorted_pos = (label[order] > 0).astype(np.float64)
+        w = (weight[order] if weight is not None
              else np.ones_like(sorted_pos))
         pos_w = sorted_pos * w
         neg_w = (1.0 - sorted_pos) * w

@@ -119,12 +119,16 @@ class GammaDevianceMetric(Metric):
     name = "gamma_deviance"
 
     def eval(self, score, objective):
+        from ..parallel.metric_sync import sync_sums
+
         pred = _convert(score[0], objective)
         tmp = self.label / (pred + 1e-9)
         loss = tmp - _safe_log(tmp) - 1.0
         if self.weight is not None:
             loss = loss * self.weight
-        return float(loss.sum() * 2.0)
+        # a global SUM (no denominator), so the cross-rank reduction is
+        # the one-element sum of the local sums
+        return float(sync_sums([loss.sum()])[0] * 2.0)
 
 
 @register_metric
@@ -209,8 +213,17 @@ class AucMuMetric(Metric):
         self.num_class = nc
 
     def eval(self, score, objective):
+        from ..parallel.metric_sync import process_count, sync_concat
+
         nc = self.num_class
-        lbl = self.label.astype(np.int64)
+        lbl = self.label
+        if process_count() > 1:
+            # pairwise rank statistic across class partitions — like AUC,
+            # merge the raw per-rank columns exactly before ranking
+            merged = sync_concat(lbl, *[score[k] for k in range(nc)])
+            lbl = merged[0]
+            score = np.stack(merged[1:])
+        lbl = lbl.astype(np.int64)
         sizes = np.bincount(lbl, minlength=nc)
         ans = 0.0
         for i in range(nc):
@@ -282,20 +295,35 @@ class KLDivergenceMetric(Metric):
                            -y * np.log(y) - (1 - y) * np.log(1 - y), 0.0)
         if self.weight is not None:
             ent = ent * self.weight
-        self.presum_label_entropy = float(ent.sum() / self.sum_weights)
+        # keep the LOCAL sum; the global average forms at eval time (init
+        # can run before the process group is the final word on rank
+        # membership, eval never does)
+        self._local_entropy_sum = float(ent.sum())
 
     def eval(self, score, objective):
+        from ..parallel.metric_sync import sync_sums
+
         if objective is not None:
             p = np.asarray(objective.convert_output(score[0]))
         else:
             p = score[0]
         xent = _avg(_xent_loss(self.label, p), self.weight, self.sum_weights)
-        return xent - self.presum_label_entropy
+        g_ent, g_w = sync_sums([self._local_entropy_sum, self.sum_weights])
+        return xent - float(g_ent / g_w)
 
 
 # ---------------------------------------------------------------------------
 # Ranking metrics (reference rank_metric.hpp / map_metric.hpp)
 # ---------------------------------------------------------------------------
+
+def _sync_rank_sums(results: np.ndarray, sum_qw: float):
+    """Queries live whole on one rank, so rank metrics reduce as plain
+    (per-position weighted sums, query-weight sum) across processes."""
+    from ..parallel.metric_sync import sync_sums
+
+    g = sync_sums(np.concatenate([results, [sum_qw]]))
+    return g[:-1], float(g[-1])
+
 
 class _RankMetric(Metric):
     higher_is_better = True
@@ -357,7 +385,8 @@ class NDCGMetric(_RankMetric):
             for ki, k in enumerate(self.eval_at):
                 kk = min(k, len(g))
                 results[ki] += cum[kk - 1] * self.inv_max_dcgs[q, ki] * qw
-        results /= self.sum_query_weights
+        results, sum_qw = _sync_rank_sums(results, self.sum_query_weights)
+        results /= sum_qw
         return [(f"ndcg@{k}", float(v)) for k, v in zip(self.eval_at, results)]
 
     def eval(self, score, objective):
@@ -395,7 +424,8 @@ class MapMetric(_RankMetric):
                     results[ki] += (cum_ap[kk - 1] / min(npos, kk)) * qw
                 else:
                     results[ki] += 1.0 * qw
-        results /= self.sum_query_weights
+        results, sum_qw = _sync_rank_sums(results, self.sum_query_weights)
+        results /= sum_qw
         return [(f"map@{k}", float(v)) for k, v in zip(self.eval_at, results)]
 
     def eval(self, score, objective):

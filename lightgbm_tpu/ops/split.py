@@ -211,13 +211,18 @@ def finalize_split(pf: PerFeatureBest, best_f, sum_g, sum_h,
                   min_constraint, max_constraint)
     ro = jnp.clip(leaf_output(sum_g - lg, sum_h - lh, l1, l2, max_delta_step),
                   min_constraint, max_constraint)
+    # the grower's stored-split state is f32; under deterministic f64 the
+    # candidate math above runs in f64 and must downcast HERE, at the one
+    # boundary, or every .at[].set into the state becomes a mixed-dtype
+    # scatter (a future-jax error)
+    f32 = lambda x: jnp.asarray(x).astype(jnp.float32)  # noqa: E731
     return SplitResult(
-        gain=g,
+        gain=f32(g),
         feature=best_f.astype(jnp.int32),
         threshold=thr,
         default_left=dleft,
-        left_sum_g=lg, left_sum_h=lh, left_count=lc,
-        left_output=lo, right_output=ro)
+        left_sum_g=f32(lg), left_sum_h=f32(lh), left_count=f32(lc),
+        left_output=f32(lo), right_output=f32(ro))
 
 
 class PerFeatureCatBest(NamedTuple):

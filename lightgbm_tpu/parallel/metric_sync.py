@@ -1,0 +1,111 @@
+"""Cross-process reduction of metric sufficient statistics.
+
+TPU-native analog of the reference's ``Network::GlobalSyncUpBySum``
+helpers (``/root/reference/include/LightGBM/network.h:168-275``) behind
+SURVEY §2.6's "metrics are distribution-aware" posture.  In a
+``jax.distributed`` run each process may hold only its local rows of a
+(pre-partitioned) train or validation set; a metric computed from the
+host-local score vector then disagrees across ranks, and early stopping
+can fire at different iterations on different ranks — which diverges the
+ensemble or deadlocks the next collective.  Metrics therefore reduce
+their SUFFICIENT STATISTICS across processes before the final division:
+
+  - averaged losses reduce the (weighted loss sum, weight sum) pair
+    (`sync_sums`);
+  - AUC / auc_mu need global rank statistics, reduced by an exact merge
+    of the per-rank (score, label, weight) arrays (`sync_concat` — the
+    ragged allgather below);
+  - rank metrics reduce (per-position weighted DCG sums, query-weight
+    sum), again plain sums.
+
+Every helper is an identity when ``jax.process_count() == 1`` — the
+single-process hot path pays one attribute read.  The reduction is also
+SAFE in the all-data-on-all-machines ingest mode (`put_global`'s
+replicated-host contract): duplicating a full sample P times changes
+neither a weighted average (numerator and denominator both scale by P)
+nor a pairwise/positional rank statistic, so ranks agree either way.
+
+Collective discipline: these are process-level collectives — every rank
+must call them in the same order.  The engine's eval cadence is
+config-driven and identical on all ranks; ad-hoc single-rank calls of
+``Booster.eval*`` inside a live multi-process group would deadlock, the
+same contract as the reference's ``Network::Allreduce``.  Custom
+``feval`` callables run host-local and are NOT reduced.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import numpy as np
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+def _allgather(arr: np.ndarray) -> np.ndarray:
+    """Stack a same-shaped host array from every process: [P, *shape].
+
+    Module-level indirection so tests can monkeypatch a fake world.
+    Transport detail: process_allgather rides jnp arrays, which demote
+    f64/i64 payloads to 32-bit whenever jax_enable_x64 is off (the
+    default outside deterministic mode) — that would silently break the
+    exact-merge contract.  64-bit payloads therefore travel as uint32
+    views/pairs (uint32 is never demoted) and are reassembled here.
+    """
+    from jax.experimental import multihost_utils
+
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype == np.float64:
+        out = np.asarray(multihost_utils.process_allgather(
+            arr.view(np.uint32)))
+        return np.ascontiguousarray(out).view(np.float64)
+    if arr.dtype == np.int64:
+        if (arr < 0).any() or (arr >= 2 ** 32).any():
+            raise ValueError("int64 allgather payload out of uint32 range")
+        out = np.asarray(multihost_utils.process_allgather(
+            arr.astype(np.uint32)))
+        return out.astype(np.int64)
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
+def sync_sums(vals: Sequence[float]) -> np.ndarray:
+    """Elementwise sum across processes of a small f64 vector."""
+    v = np.asarray(vals, np.float64)
+    if process_count() == 1:
+        return v
+    return _allgather(v).sum(axis=0)
+
+
+def sync_concat(*arrays: np.ndarray) -> Tuple[np.ndarray, ...]:
+    """Concatenate per-rank 1-D arrays across processes, rank order.
+
+    Ranks may hold DIFFERENT lengths (pre-partitioned shards are rarely
+    equal): lengths are allgathered first, every array is padded to the
+    max, and the pads are stripped after the gather — allgather itself
+    requires congruent shapes.  All inputs must share this rank's local
+    length (they are parallel columns of one local table).
+    """
+    if process_count() == 1:
+        return tuple(np.asarray(a, np.float64).ravel() for a in arrays)
+    arrs = [np.ascontiguousarray(np.asarray(a, np.float64).ravel())
+            for a in arrays]
+    n_local = arrs[0].shape[0]
+    for a in arrs[1:]:
+        if a.shape[0] != n_local:
+            raise ValueError("sync_concat inputs must share the local "
+                             f"length: {a.shape[0]} != {n_local}")
+    lens = _allgather(np.asarray([n_local], np.int64))[:, 0]
+    n_max = int(lens.max()) if len(lens) else 0
+    out = []
+    for a in arrs:
+        padded = np.zeros(n_max, np.float64)
+        padded[:n_local] = a
+        g = _allgather(padded)  # [P, n_max]
+        out.append(np.concatenate([g[p, :int(lens[p])]
+                                   for p in range(len(lens))])
+                   if n_max else np.zeros(0, np.float64))
+    return tuple(out)

@@ -180,21 +180,51 @@ def _cpu_is_only_backend() -> bool:
 
         return set(_xb._backend_factories) <= {"cpu"}
     except Exception:  # pragma: no cover - jax internals moved
-        return False
+        # the private table moved: the host-fingerprinted cache subdir
+        # (the cross-host SIGILL guard) would otherwise disengage
+        # SILENTLY.  Surface it and honor an explicit override — a wrong
+        # True would cold-start the TPU cache, a wrong False risks a
+        # SIGILL on CPU, so the decision goes to the operator rather
+        # than a guess.
+        import logging
+
+        logging.getLogger("lightgbm_tpu").debug(
+            "jax backend-factory introspection failed; set "
+            "LGBM_CPU_ONLY_BACKEND=1 if this process is CPU-only")
+        ov = os.environ.get("LGBM_CPU_ONLY_BACKEND")
+        if ov is None:
+            return False
+        return ov.strip().lower() not in ("", "0", "false", "no", "off")
 
 
 def _host_fingerprint() -> str:
-    """Short stable id for this host's CPU feature set."""
+    """Short stable id for this host's CPU feature set.
+
+    Hashes the model-identity lines TOO, not just `flags`: XLA:CPU keys
+    its AOT entries on LLVM's own feature detection, which distinguishes
+    hosts whose /proc/cpuinfo flags lines hash identically (observed as
+    "Compile machine features ... could lead to SIGILL" warnings loading
+    a same-flags-different-microarch cache).  Two hosts only share a
+    subdir when vendor/family/model/stepping AND flags all match —
+    close enough to LLVM's view that foreign entries no longer load."""
     import hashlib
 
+    keys = ("vendor_id", "cpu family", "model\t", "model name", "stepping",
+            "flags")
     try:
         with open("/proc/cpuinfo") as f:
-            flags = next((ln for ln in f if ln.startswith("flags")), "")
+            ident = []
+            for ln in f:
+                if not ln.strip():
+                    break  # first processor block only; all cores match
+                if any(ln.startswith(k) for k in keys):
+                    ident.append(ln)
+        ident = "".join(ident)
     except OSError:  # pragma: no cover - non-linux
         import platform
 
-        flags = platform.processor() or platform.machine()
-    return hashlib.sha1(flags.encode()).hexdigest()[:12]
+        ident = platform.processor() or platform.machine()
+    return hashlib.sha1(ident.encode()).hexdigest()[:12]
 
 
 def pin_cpu_backend(force_device_count: Optional[int] = None) -> None:

@@ -218,3 +218,63 @@ def test_loaded_booster_merges_user_params(trained, tmp_path):
     loaded = lgb.Booster(params={"num_threads": 2}, model_file=str(f))
     assert loaded.params["num_threads"] == 2
     assert loaded.params["objective"] == "regression"
+
+
+class TestEvalForData:
+    """Booster.eval on an AD-HOC dataset (reference c_api.cpp:207-230's
+    AddValidData + Eval pair, transient here: gbdt.eval_for_data)."""
+
+    def _setup(self):
+        rng = np.random.default_rng(23)
+        X = rng.normal(size=(1500, 6))
+        y = (X[:, 0] - 0.8 * X[:, 1] + 0.3 * rng.normal(size=1500) > 0
+             ).astype(np.float64)
+        Xe = rng.normal(size=(500, 6))
+        ye = (Xe[:, 0] - 0.8 * Xe[:, 1] > 0).astype(np.float64)
+        p = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+             "metric": ["binary_logloss", "auc"]}
+        return X, y, Xe, ye, p
+
+    def test_matches_registered_valid_set(self):
+        X, y, Xe, ye, p = self._setup()
+        ds = lgb.Dataset(X, label=y, params=p)
+        dv = lgb.Dataset(Xe, label=ye, reference=ds, params=p)
+        hist = {}
+        bst = lgb.train(p, ds, num_boost_round=6, valid_sets=[dv],
+                        valid_names=["holdout"],
+                        callbacks=[lgb.record_evaluation(hist)])
+        # a SECOND dataset over the same rows, evaluated ad hoc, must
+        # reproduce the registered valid set's final metrics exactly
+        dv2 = lgb.Dataset(Xe, label=ye, reference=ds, params=p)
+        out = bst.eval(dv2, "holdout")
+        got = {name: val for _, name, val, _ in out}
+        assert got["binary_logloss"] == pytest.approx(
+            hist["holdout"]["binary_logloss"][-1], rel=1e-6)
+        assert got["auc"] == pytest.approx(
+            hist["holdout"]["auc"][-1], rel=1e-6)
+        # tuple layout matches eval_valid's (name, metric, value, hib)
+        assert {t[0] for t in out} == {"holdout"}
+        assert any(t[3] for t in out)  # auc reports higher_is_better
+
+    def test_feval_and_repeat_calls(self):
+        X, y, Xe, ye, p = self._setup()
+        ds = lgb.Dataset(X, label=y, params=p)
+        bst = lgb.train(p, ds, num_boost_round=4)
+        dv = lgb.Dataset(Xe, label=ye, reference=ds, params=p)
+
+        def feval(preds, data):
+            return ("n_rows", float(len(preds)), True)
+
+        out1 = bst.eval(dv, "e", feval=feval)
+        out2 = bst.eval(dv, "e", feval=feval)
+        # transient: repeated calls do not accumulate score state
+        assert out1 == out2
+        assert ("e", "n_rows", 500.0, True) in out1
+
+    def test_unaligned_dataset_raises(self):
+        X, y, Xe, ye, p = self._setup()
+        ds = lgb.Dataset(X, label=y, params=p)
+        bst = lgb.train(p, ds, num_boost_round=2)
+        stray = lgb.Dataset(Xe, label=ye, params=p)  # no reference=
+        with pytest.raises(ValueError, match="reference"):
+            bst.eval(stray, "bad")

@@ -1,0 +1,252 @@
+"""Distributed metric reduction (parallel/metric_sync.py; reference
+Network::GlobalSyncUp* helpers, include/LightGBM/network.h:168-275).
+
+Single-process unit coverage of the cross-rank metric merge.  The fake
+2-rank world works by capture/replay on EQUAL halves: "rank 1" runs its
+eval with an allgather stub that records every payload it sends (with
+equal local lengths the padded payloads are identical to the real
+multi-process ones), then "rank 0" re-runs with allgather returning
+[local, recorded_peer] stacks.  The merged value must equal the plain
+single-process metric on the concatenated data — the exact property that
+keeps early stopping synchronized across ranks.  The REAL 2-process
+rendezvous version of the same assertion lives in test_multihost.py.
+"""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.models.metrics import create_metric
+from lightgbm_tpu.parallel import metric_sync
+
+
+class _Meta:
+    def __init__(self, label, weight=None, query_boundaries=None):
+        self.label = np.asarray(label, np.float64)
+        self.weight = weight
+        self.query_boundaries = query_boundaries
+        self.init_score = None
+
+    def query_weights(self):
+        return None
+
+
+class _FakeWorld:
+    """Replays rank-1's recorded allgather payloads into rank-0's calls."""
+
+    def __init__(self, monkeypatch):
+        self.monkeypatch = monkeypatch
+        self.recorded = []
+        self.call_idx = 0
+
+    def record(self, fn):
+        """Run `fn` as rank 1: every allgather payload is captured and the
+        stub returns [payload, payload] (self-peering — correct shapes
+        because both ranks hold equal-length halves)."""
+        self.monkeypatch.setattr(metric_sync, "process_count", lambda: 2)
+        self.monkeypatch.setattr(
+            metric_sync, "_allgather",
+            lambda a: (self.recorded.append(np.array(a, copy=True)),
+                       np.stack([a, a]))[1])
+        try:
+            return fn()
+        finally:
+            self.monkeypatch.setattr(metric_sync, "_allgather",
+                                     _no_allgather)
+
+    def replay(self, fn):
+        """Run `fn` as rank 0: call i returns [local_i, recorded_i]."""
+        self.call_idx = 0
+
+        def gather(a):
+            peer = self.recorded[self.call_idx]
+            self.call_idx += 1
+            assert peer.shape == np.shape(a), "rank call sequences diverged"
+            return np.stack([np.asarray(a, peer.dtype), peer])
+
+        self.monkeypatch.setattr(metric_sync, "process_count", lambda: 2)
+        self.monkeypatch.setattr(metric_sync, "_allgather", gather)
+        try:
+            return fn()
+        finally:
+            self.monkeypatch.setattr(metric_sync, "_allgather",
+                                     _no_allgather)
+            self.monkeypatch.setattr(metric_sync, "process_count",
+                                     lambda: 1)
+
+
+def _no_allgather(a):  # pragma: no cover - guard
+    raise AssertionError("allgather outside an armed fake world")
+
+
+def _eval_metric(name, cfg, label, score, weight=None, qb=None):
+    m = create_metric(name, cfg)
+    meta = _Meta(label, weight, qb)
+    m.init(meta, len(np.atleast_1d(label)))
+    s = np.asarray(score, np.float64)
+    if s.ndim == 1:
+        s = s[None, :]
+    return m.eval_all(s, None)
+
+
+def _merged_vs_full(monkeypatch, name, cfg, label, score, weight=None,
+                    qb=None, qb_split=None):
+    """Core property: rank-merged metric == single-process full metric."""
+    label = np.asarray(label, np.float64)
+    score = np.asarray(score, np.float64)
+    n = label.shape[0]
+    assert n % 2 == 0
+    h = n // 2
+    full = _eval_metric(name, cfg, label, score, weight, qb)
+
+    world = _FakeWorld(monkeypatch)
+    cols = (slice(None), slice(h, None))
+    qb1 = None if qb is None else \
+        [q - h for q in qb if q >= h]
+    world.record(lambda: _eval_metric(
+        name, cfg, label[h:], score[..., h:],
+        None if weight is None else weight[h:], qb1))
+    qb0 = None if qb is None else [q for q in qb if q <= h]
+    merged = world.replay(lambda: _eval_metric(
+        name, cfg, label[:h], score[..., :h],
+        None if weight is None else weight[:h], qb0))
+    del cols
+    for (n_full, v_full), (n_m, v_m) in zip(full, merged):
+        assert n_full == n_m
+        assert v_m == pytest.approx(v_full, rel=1e-12, abs=1e-12), name
+    return full, merged
+
+
+class TestSyncHelpers:
+    def test_identity_single_process(self):
+        assert metric_sync.process_count() == 1
+        np.testing.assert_array_equal(metric_sync.sync_sums([1.5, 2.0]),
+                                      [1.5, 2.0])
+        (a,) = metric_sync.sync_concat(np.array([3.0, 1.0]))
+        np.testing.assert_array_equal(a, [3.0, 1.0])
+
+    def test_sync_sums_reduces(self, monkeypatch):
+        monkeypatch.setattr(metric_sync, "process_count", lambda: 2)
+        monkeypatch.setattr(
+            metric_sync, "_allgather",
+            lambda a: np.stack([a, 10.0 * np.asarray(a, np.float64)]))
+        np.testing.assert_allclose(metric_sync.sync_sums([1.0, 2.0]),
+                                   [11.0, 22.0])
+
+    def test_sync_concat_ragged(self, monkeypatch):
+        """Ranks with DIFFERENT local lengths merge correctly: simulate
+        rank 0 (len 3) whose peer holds len 5 by scripted returns."""
+        monkeypatch.setattr(metric_sync, "process_count", lambda: 2)
+        calls = []
+
+        def gather(a):
+            calls.append(np.array(a, copy=True))
+            if len(calls) == 1:  # the length exchange
+                return np.array([[3], [5]], np.int64)
+            # padded payload exchange: peer's 5 values in a len-5 buffer
+            peer = np.array([10.0, 11.0, 12.0, 13.0, 14.0])
+            return np.stack([np.asarray(a, np.float64), peer])
+
+        monkeypatch.setattr(metric_sync, "_allgather", gather)
+        (merged,) = metric_sync.sync_concat(np.array([1.0, 2.0, 3.0]))
+        np.testing.assert_array_equal(
+            merged, [1.0, 2.0, 3.0, 10.0, 11.0, 12.0, 13.0, 14.0])
+        # the local payload was padded to the global max length
+        assert calls[1].shape == (5,)
+
+    def test_sync_concat_length_mismatch_raises(self, monkeypatch):
+        monkeypatch.setattr(metric_sync, "process_count", lambda: 2)
+        with pytest.raises(ValueError, match="local length"):
+            metric_sync.sync_concat(np.zeros(3), np.zeros(4))
+
+
+class TestMergedMetricsEqualFull:
+    """Partition → reduce == full-data metric, per metric family."""
+
+    def setup_method(self, _):
+        rng = np.random.default_rng(11)
+        self.n = 400
+        self.score = rng.normal(size=self.n)
+        self.label01 = (rng.random(self.n) < 0.4).astype(np.float64)
+        self.label_reg = rng.normal(size=self.n) + 1.5
+        self.weight = rng.random(self.n) + 0.25
+
+    def test_avg_family(self, monkeypatch):
+        cfg = Config()
+        for name, label in (("l2", self.label_reg), ("l1", self.label_reg),
+                            ("rmse", self.label_reg),
+                            ("binary_logloss", self.label01),
+                            ("binary_error", self.label01),
+                            ("quantile", self.label_reg),
+                            ("huber", self.label_reg)):
+            _merged_vs_full(monkeypatch, name, cfg, label, self.score,
+                            self.weight)
+
+    def test_gamma_deviance_global_sum(self, monkeypatch):
+        label = np.abs(self.label_reg) + 0.5
+        score = np.abs(self.score) + 0.5
+        _merged_vs_full(monkeypatch, "gamma_deviance", Config(), label,
+                        score, self.weight)
+
+    def test_kldiv(self, monkeypatch):
+        rng = np.random.default_rng(3)
+        label = rng.random(self.n)
+        prob = rng.random(self.n)
+        _merged_vs_full(monkeypatch, "kldiv", Config(), label, prob,
+                        self.weight)
+
+    def test_auc_exact_merge(self, monkeypatch):
+        # ties across the partition boundary exercise the global ranking
+        score = np.round(self.score, 1)
+        _merged_vs_full(monkeypatch, "auc", Config(), self.label01, score,
+                        self.weight)
+        _merged_vs_full(monkeypatch, "auc", Config(), self.label01, score)
+
+    def test_auc_mu_exact_merge(self, monkeypatch):
+        rng = np.random.default_rng(5)
+        nc = 3
+        label = rng.integers(0, nc, size=self.n).astype(np.float64)
+        score = rng.normal(size=(nc, self.n))
+        _merged_vs_full(monkeypatch, "auc_mu", Config({"num_class": nc,
+                                "objective": "multiclass"}), label, score)
+
+    def test_rank_metrics(self, monkeypatch):
+        # 40 queries of 10 docs: the halfway split lands on a query
+        # boundary (queries live whole on one rank)
+        rng = np.random.default_rng(7)
+        n = self.n
+        qb = list(range(0, n + 1, 10))
+        label = rng.integers(0, 4, size=n).astype(np.float64)
+        score = rng.normal(size=n)
+        for name in ("ndcg", "map"):
+            _merged_vs_full(monkeypatch, name, Config(), label, score,
+                            qb=qb, qb_split=True)
+
+    def test_multiclass(self, monkeypatch):
+        rng = np.random.default_rng(9)
+        nc = 3
+        label = rng.integers(0, nc, size=self.n).astype(np.float64)
+        prob = rng.random((nc, self.n)) + 1e-3
+        prob /= prob.sum(axis=0, keepdims=True)
+        mc = Config({"num_class": nc, "objective": "multiclass"})
+        _merged_vs_full(monkeypatch, "multi_logloss", mc, label, prob,
+                        self.weight)
+        _merged_vs_full(monkeypatch, "multi_error", mc, label, prob,
+                        self.weight)
+
+    def test_replicated_mode_invariant(self, monkeypatch):
+        """All-data-on-all-machines: both ranks hold the FULL sample; the
+        reduction must leave averages and AUC unchanged (sums cancel /
+        pairwise statistics are duplication-invariant)."""
+        cfg = Config()
+        for name, label in (("l2", self.label_reg),
+                            ("binary_logloss", self.label01),
+                            ("auc", self.label01)):
+            full = _eval_metric(name, cfg, label, self.score, self.weight)
+            world = _FakeWorld(monkeypatch)
+            world.record(lambda: _eval_metric(name, cfg, label, self.score,
+                                              self.weight))
+            rep = world.replay(lambda: _eval_metric(name, cfg, label,
+                                                    self.score, self.weight))
+            for (_, v_full), (_, v_rep) in zip(full, rep):
+                assert v_rep == pytest.approx(v_full, rel=1e-12), name

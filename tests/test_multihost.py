@@ -104,6 +104,56 @@ model = bst.model_to_string().split("\\nparameters:")[0]
 with open({outfile!r} + ".model", "w") as f:
     f.write(model)
 print(f"rank {{pid}}: trained {{bst.num_trees()}} trees", flush=True)
+
+# ---- distributed metrics + early stopping on a PARTITIONED valid set:
+# each rank holds only HALF the validation rows, so a host-local metric
+# would differ across ranks; the metric_sync reduction must make every
+# rank report the GLOBAL value and stop at the SAME iteration
+import json
+rngv = np.random.default_rng(21)
+Xv = rngv.normal(size=(1024, 10))
+yv = (Xv[:, 0] + 0.5 * Xv[:, 1]
+      + rngv.normal(scale=0.7, size=1024) > 0).astype(np.float64)
+half = 512
+lo, hi = pid * half, (pid + 1) * half
+p_es = dict(cfg.params)
+p_es["verbosity"] = -1
+p_es["metric"] = ["binary_logloss", "auc"]
+dtr = lgb.Dataset(X, label=y, params=p_es)
+dval = lgb.Dataset(Xv[lo:hi], label=yv[lo:hi], reference=dtr, params=p_es)
+hist = {{}}
+bst3 = lgb.train(p_es, dtr, num_boost_round=12,
+                 valid_sets=[dval], valid_names=["part"],
+                 callbacks=[lgb.early_stopping(2, verbose=False),
+                            lgb.record_evaluation(hist)])
+n_it = bst3.current_iteration()
+# independent expected values: plain numpy on the FULL valid set (no
+# collectives, identical on both ranks), predictions from the model
+margin = bst3.predict(Xv, num_iteration=n_it, raw_score=True)
+pm = np.clip(1.0 / (1.0 + np.exp(-margin)), 1e-15, 1.0 - 1e-15)
+exp_ll = float(-(yv * np.log(pm) + (1.0 - yv) * np.log(1.0 - pm)).mean())
+order = np.argsort(margin, kind="stable")
+ss = margin[order]
+pos = (yv[order] > 0).astype(np.float64)
+neg = 1.0 - pos
+bnd = np.flatnonzero(np.diff(ss)) + 1
+gid = np.zeros(len(ss), np.int64)
+gid[bnd] = 1
+gid = np.cumsum(gid)
+ng = int(gid[-1]) + 1
+posg = np.bincount(gid, weights=pos, minlength=ng)
+negg = np.bincount(gid, weights=neg, minlength=ng)
+negb = np.concatenate([[0.0], np.cumsum(negg)[:-1]])
+exp_auc = float((posg * (negb + 0.5 * negg)).sum()
+                / (pos.sum() * neg.sum()))
+rec2 = {{"best_iter": int(bst3.best_iteration),
+         "n_iter": int(n_it),
+         "curve_ll": hist["part"]["binary_logloss"],
+         "curve_auc": hist["part"]["auc"],
+         "expected_ll": exp_ll, "expected_auc": exp_auc}}
+with open({outfile!r} + ".esjson", "w") as f:
+    json.dump(rec2, f)
+print(f"rank {{pid}}: es best_iter={{bst3.best_iteration}}", flush=True)
 """
 
 
@@ -157,3 +207,21 @@ class TestTwoProcessRendezvous:
         m0 = open(outs[0] + ".model").read()
         m1 = open(outs[1] + ".model").read()
         assert m0 == m1 and "tree" in m0
+        # distributed metrics over the partitioned valid set: both ranks
+        # must report BITWISE-identical metric curves (same collective,
+        # same arithmetic order) and stop at the same iteration...
+        import json
+        es0 = json.load(open(outs[0] + ".esjson"))
+        es1 = json.load(open(outs[1] + ".esjson"))
+        assert es0 == es1, "ranks diverged on metrics/early stopping"
+        assert es0["best_iter"] == es1["best_iter"]
+        # ...and the reported value must be the GLOBAL metric: the last
+        # curve entry equals the numpy full-valid-set computation (f32
+        # score-state accumulation vs the predictor's f64 sum bounds the
+        # tolerance)
+        assert es0["curve_ll"][-1] == pytest.approx(es0["expected_ll"],
+                                                    abs=2e-4)
+        assert es0["curve_auc"][-1] == pytest.approx(es0["expected_auc"],
+                                                     abs=2e-4)
+        # early stopping actually engaged (12 rounds max, patience 2)
+        assert 1 <= es0["best_iter"] <= es0["n_iter"] <= 12
