@@ -220,6 +220,16 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # traffic; automatically skipped when the layout can't support it
     # (EFB bundles, gather partition, xla hist impl)
     "tpu_pack_bins": ("bool", True, ()),
+    # sparse train-time storage (reference OrderedSparseBin,
+    # src/io/ordered_sparse_bin.hpp / sparse_bin.hpp:73): features whose
+    # nonzero-bin row fraction is <= this threshold are stored as padded
+    # COO (row-id, bin) pairs instead of dense [n] columns — wide very-
+    # sparse datasets stop paying dense HBM for empty rows.  Histograms
+    # come from a gather contraction over the stored entries with the
+    # zero bin reconstructed from leaf totals (the FixHistogram trick,
+    # dataset.cpp:1044-1063).  0 disables.  Requires tree_learner=serial
+    # and enable_bundle=false (EFB is the alternative mitigation).
+    "tpu_sparse_threshold": ("float", 0.0, ()),
 }
 
 _ALIAS: Dict[str, str] = {}

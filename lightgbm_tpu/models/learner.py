@@ -175,6 +175,44 @@ class TPUTreeLearner:
         self.num_columns = cols_src.shape[1]
         self.g_pad = (self.f_pad if self.f_shards > 1 else self.num_columns)
 
+        # ---- sparse train-time storage (reference OrderedSparseBin,
+        # src/io/ordered_sparse_bin.hpp / sparse_bin.hpp:73): features
+        # whose nonzero-bin fraction is <= tpu_sparse_threshold keep only
+        # their O(nnz) (row, bin) pairs; the dense [Gd, n] matrix holds
+        # the rest.  Wide very-sparse data (Bosch-shaped 1M x 968 @ ~2%)
+        # stops paying dense HBM for rows sitting at the zero bin. ----
+        self._sparse_mask = None
+        sth = float(config.tpu_sparse_threshold)
+        if sth > 0.0:
+            if bool(config.enable_bundle):
+                # deterministic gate on the FLAG, not on whether a plan
+                # happened to form for this data — the error must not
+                # depend on bundle-ability
+                raise ValueError(
+                    "tpu_sparse_threshold requires enable_bundle=false "
+                    "(EFB already re-columns sparse features; pick one)")
+            if strategy != "serial":
+                raise NotImplementedError(
+                    "tpu_sparse_threshold requires tree_learner=serial "
+                    "(the COO row ids are learner-local)")
+            if forced:
+                raise ValueError("tpu_sparse_threshold does not compose "
+                                 "with forced splits")
+            zb_f = meta_np["default_bin"]
+            # per-column counting: a whole-matrix (cols_src != zb)
+            # boolean would materialize ~1 GB at Bosch scale
+            nz_frac = np.fromiter(
+                (np.count_nonzero(cols_src[:, c] != zb_f[c]) / max(n, 1)
+                 for c in range(self.num_features)),
+                np.float64, self.num_features)
+            sp_mask = nz_frac <= sth
+            if sp_mask.all():
+                # the dense kernel needs a nonempty matrix; keep the
+                # densest feature dense
+                sp_mask[int(np.argmax(nz_frac))] = False
+            if sp_mask.any():
+                self._sparse_mask = sp_mask
+
         # impl/block resolution happens HERE, once, with the final
         # histogram shape: bundling above only needs the host bin matrix,
         # while the padded row count below depends on the resolved block.
@@ -261,8 +299,59 @@ class TPUTreeLearner:
         # grower round, so width directly scales histogram HBM traffic;
         # the one-hot compare upcasts on the fly
         bin_dtype = np.uint8 if B <= 256 else np.int32
-        bins_t = np.zeros((self.g_pad, self.n_pad), dtype=bin_dtype)
-        bins_t[:self.num_columns, :n] = cols_src.T
+        if self._sparse_mask is not None:
+            dense_idx = np.flatnonzero(~self._sparse_mask)
+            sparse_idx_cols = np.flatnonzero(self._sparse_mask)
+            gd = len(dense_idx)
+            # the perfeature pallas kernel chunks its feature grid in
+            # 32-multiples — align the DENSE matrix width; the sparse
+            # groups never enter that kernel
+            gd_pad = -(-gd // 32) * 32 if hist_impl == "pallas2" else gd
+            bins_t = np.zeros((gd_pad, self.n_pad), dtype=bin_dtype)
+            bins_t[:gd, :n] = cols_src[:, dense_idx].T
+            zb_np = meta_np["default_bin"]
+            Gs = len(sparse_idx_cols)
+            # ONE scan per sparse column; counts and the COO fill both
+            # come from the same nonzero lists
+            nz_lists = [np.flatnonzero(cols_src[:, c] != zb_np[c])
+                        for c in sparse_idx_cols]
+            M = max(128, -(-max(len(z) for z in nz_lists) // 128) * 128)
+            # pad row-id = n_pad (out of range: partition scatter drops
+            # it); pad bin = B (its one-hot row is all-zero, so the
+            # clipped histogram gather contributes nothing)
+            sp_rows = np.full((Gs, M), self.n_pad, np.int32)
+            sp_bins = np.full((Gs, M), B, np.int32)
+            for s, (c, nz) in enumerate(zip(sparse_idx_cols, nz_lists)):
+                sp_rows[s, :len(nz)] = nz
+                sp_bins[s, :len(nz)] = cols_src[nz, c]
+            F_ = self.num_features
+            is_sparse = np.zeros(F_, np.int32)
+            is_sparse[sparse_idx_cols] = 1
+            sparse_slot = np.zeros(F_, np.int32)
+            sparse_slot[sparse_idx_cols] = np.arange(Gs)
+            dense_col = np.zeros(F_, np.int32)
+            dense_col[dense_idx] = np.arange(gd)
+            meta_np["is_sparse"] = is_sparse
+            meta_np["sparse_slot"] = sparse_slot
+            meta_np["dense_col"] = dense_col
+            # a known-dense feature id: expand_sparse reads this
+            # feature's histogram for exact leaf totals (padded by the
+            # meta loop; only element 0 is read)
+            meta_np["dense_ref"] = np.full(F_, dense_idx[0], np.int32)
+            # feature -> slot in concat(dense columns, sparse groups);
+            # padding features (g_pad > F) point at a dense padding
+            # column — trivial (num_bin=1), never searched or split
+            perm = np.full(self.g_pad, min(gd, gd_pad - 1), np.int32)
+            perm[dense_idx] = np.arange(gd)
+            perm[sparse_idx_cols] = gd_pad + np.arange(Gs)
+            self._sparse_arrays = (sp_rows, sp_bins, perm)
+            Log.info(f"sparse storage: {Gs} of {F_} features as COO "
+                     f"({M} entry slots), dense matrix "
+                     f"{gd_pad}x{self.n_pad}")
+        else:
+            self._sparse_arrays = None
+            bins_t = np.zeros((self.g_pad, self.n_pad), dtype=bin_dtype)
+            bins_t[:self.num_columns, :n] = cols_src.T
 
         # 4-bit packing (reference dense_nbits_bin.hpp): two rows per
         # byte in a per-block stride layout (row j low nibble, row
@@ -277,6 +366,7 @@ class TPUTreeLearner:
         self.packed_bins = (
             bool(config.tpu_pack_bins) and B <= 16
             and hist_impl in ("pallas", "pallas2") and plan is None
+            and self._sparse_arrays is None
             and str(config.tpu_partition_impl) in ("select", "vselect")
             and eff_block % 256 == 0 and local_rows % eff_block == 0)
         if self.packed_bins:
@@ -326,6 +416,13 @@ class TPUTreeLearner:
                          for k, v in meta_cast.items()}
         else:
             self.meta = {k: jnp.asarray(v) for k, v in meta_cast.items()}
+        if self._sparse_arrays is not None:
+            # 2-D COO tables ride meta like the CEGB state does (the pad
+            # loop above only handles per-feature vectors)
+            sp_rows, sp_bins, perm = self._sparse_arrays
+            self.meta["sparse_idx"] = jnp.asarray(sp_rows)
+            self.meta["sparse_bin"] = jnp.asarray(sp_bins)
+            self.meta["hist_perm"] = jnp.asarray(perm)
 
         self.params = GrowerParams(
             num_leaves=max(int(config.num_leaves), 2),
@@ -358,6 +455,7 @@ class TPUTreeLearner:
             hist_impl=hist_impl,
             partition_impl=str(config.tpu_partition_impl),
             has_bundles=plan is not None,
+            has_sparse=self._sparse_arrays is not None,
             packed_bins=self.packed_bins,
             ramp=bool(config.tpu_ramp),
         )

@@ -74,8 +74,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .histogram import (build_histogram_batched_t, build_histogram_t,
-                        pack_stats, unpack2d)
+from .histogram import (build_histogram_batched_t, build_histogram_sparse,
+                        build_histogram_t, pack_stats, unpack2d)
 from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
                     leaf_split_gain, per_feature_best_split,
                     per_feature_best_split_categorical,
@@ -119,6 +119,15 @@ class GrowerParams(NamedTuple):
     # bins stored packed two-rows-per-byte (reference dense_nbits_bin.hpp,
     # max_bin<=16): halves the histogram row sweep's DMA traffic
     packed_bins: bool = False
+    # very-sparse features stored as padded COO (row-id, bin) pairs in
+    # meta["sparse_idx"/"sparse_bin"] instead of dense bins_t columns
+    # (reference OrderedSparseBin, src/io/ordered_sparse_bin.hpp):
+    # histograms come from an O(nnz) gather contraction, the zero bin is
+    # reconstructed from leaf totals (FixHistogram, dataset.cpp:1044),
+    # and partitions materialize the chosen column on the fly.
+    # meta["hist_perm"] maps feature f to its slot in
+    # concat(dense columns, sparse groups).
+    has_sparse: bool = False
     has_cegb: bool = False
     # lazy per-row acquisition costs: meta carries a [FG, n_pad] paid
     # matrix threaded across trees (feature_used_in_data_ bitset,
@@ -224,6 +233,18 @@ def make_grower(params: GrowerParams, num_features: int,
         raise ValueError(
             "packed 4-bit bins require the pallas histogram impl, a "
             "select-family partition lowering, and no EFB bundling")
+    if params.has_sparse and (
+            data_axis or feature_axis or voting_k or params.has_bundles
+            or params.packed_bins
+            or params.partition_impl not in ("select", "vselect")):
+        # the COO row ids are learner-local; sharding them needs a
+        # per-shard re-pad (like cegb_lazy's paid matrix) — serial only
+        # until that exists, and EFB/packing already reshape the dense
+        # matrix the sparse split would have to compose with
+        raise ValueError(
+            "sparse train-time storage (tpu_sparse_threshold) requires "
+            "tree_learner=serial, a select-family partition lowering, "
+            "and no EFB bundling / 4-bit packing")
     precision = params.precision
     K = max(1, min(int(params.split_batch), L - 1))
 
@@ -389,6 +410,29 @@ def make_grower(params: GrowerParams, num_features: int,
                 jnp.where(fix[:, None], bin0, hist_f[:, 0, :]))
             return hist_f
 
+        def expand_sparse(hist, sg, sh, cnt):
+            """Reconstruct each sparse feature's zero bin from the leaf
+            totals: the stored COO entries cover only nonzero bins, so
+            hist[f, default_bin] = totals - sum(other bins) — the same
+            FixHistogram identity the bundle expansion uses (reference
+            dataset.cpp:1044-1063).  [F, B, 3] in and out.
+
+            The totals come from a known-DENSE feature's own histogram
+            (every row lands in exactly one bin per feature), not from
+            the f32 scalar leaf sums: the reconstruction then stays
+            entirely in the histogram accumulation dtype, so
+            deterministic f64 sparse storage bit-matches dense."""
+            if not params.has_sparse:
+                return hist
+            isp = meta_local["is_sparse"] > 0              # [F]
+            db = meta_local["default_bin"]                 # [F]
+            iota_b = jnp.arange(B, dtype=jnp.int32)
+            at_db = isp[:, None] & (iota_b[None, :] == db[:, None])
+            zeroed = jnp.where(at_db[:, :, None], 0.0, hist)
+            totals = jnp.sum(hist[meta_local["dense_ref"][0]], axis=0)
+            bin0 = totals[None, :] - jnp.sum(zeroed, axis=1)  # [F, 3]
+            return jnp.where(at_db[:, :, None], bin0[:, None, :], zeroed)
+
         def cegb_delta(used, cnt, unpaid=None):
             """[M, FG] per-leaf gain charge (DetlaGain,
             cost_effective_gradient_boosting.hpp:50-62): the split
@@ -443,6 +487,7 @@ def make_grower(params: GrowerParams, num_features: int,
                 return res._replace(feature=sel[bi], gain=gain_sel[bi])
 
             hist = expand_bundles(hist, sg, sh, cnt)
+            hist = expand_sparse(hist, sg, sh, cnt)
             gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_local,
                                             fmask_local, split_kw,
                                             min_c, max_c)
@@ -498,8 +543,23 @@ def make_grower(params: GrowerParams, num_features: int,
         # per-tree packed stats, reused by every round's contraction
         stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
         S = stats.shape[0]
-        bins_blocks = jnp.moveaxis(bins_hist_t.reshape(G, nb, bcols), 1, 0)
+        # dense column count from the matrix itself: with sparse storage
+        # bins_t holds only the dense groups (Gd < G = feature width)
+        Gd = bins_hist_t.shape[0]
+        bins_blocks = jnp.moveaxis(bins_hist_t.reshape(Gd, nb, bcols), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
+
+        def merge_sparse_hist(dense_h, leaf_vec, slot_ids):
+            """[.., Gd, B, 3] dense hist -> [.., G, B, 3] feature hist:
+            append the sparse groups' O(nnz) gather contraction and
+            reorder by the static feature->slot permutation."""
+            if not params.has_sparse:
+                return dense_h
+            sp = build_histogram_sparse(
+                meta["sparse_idx"], meta["sparse_bin"], stats, leaf_vec,
+                slot_ids, B, precision)           # [k, Gs, B, 3]
+            merged = jnp.concatenate([dense_h, sp], axis=-3)
+            return jnp.take(merged, meta["hist_perm"], axis=-3)
         if params.hist_impl.startswith("pallas"):
             # reuse the batched VMEM kernel (slot 0 = the all-zero root
             # leaf ids): the xla scan at pallas-sized short blocks would
@@ -513,6 +573,10 @@ def make_grower(params: GrowerParams, num_features: int,
         else:
             root_hist = preduce_hist(
                 build_histogram_t(bins_blocks, stats_blocks, B, precision))
+        if params.has_sparse:
+            root_hist = merge_sparse_hist(
+                root_hist[None], jnp.zeros(n_pad, jnp.int32),
+                jnp.zeros(1, jnp.int32))[0]
         big = jnp.float32(1e30)
         if bynode:
             key, k_root = jax.random.split(key)
@@ -543,9 +607,15 @@ def make_grower(params: GrowerParams, num_features: int,
                             root_fmask, delta0)
 
         RW = REC_WIDTH + (CB if params.has_cat else 0)
+        # the pool stores histograms in the ACCUMULATION dtype: an f32
+        # pool under deterministic f64 would silently round every stored
+        # leaf histogram back to f32 (and mixed-dtype scatters become
+        # errors in future jax) — the reference's deterministic analog
+        # keeps f64 HistogramBinEntry end to end (bin.h:33-40)
+        hist_t = jnp.float64 if precision == "f64" else jnp.float32
         state = {
             "leaf_ids": jnp.zeros(n_pad, jnp.int32),
-            "pool": jnp.zeros((L, G, B, 3), jnp.float32).at[0].set(root_hist),
+            "pool": jnp.zeros((L, G, B, 3), hist_t).at[0].set(root_hist),
             "leaf_sum_g": jnp.zeros(L, jnp.float32).at[0].set(sum_g),
             "leaf_sum_h": jnp.zeros(L, jnp.float32).at[0].set(sum_h),
             "leaf_cnt": jnp.zeros(L, jnp.float32).at[0].set(cnt),
@@ -663,6 +733,26 @@ def make_grower(params: GrowerParams, num_features: int,
                             raw_k, meta["bin_offset"][f_k],
                             meta["num_bin"][f_k],
                             meta["needs_fix"][f_k] > 0)
+                    elif params.has_sparse:
+                        # dense read via the feature->column map; sparse
+                        # features materialize their column on the fly:
+                        # every unstored row sits at the zero bin, the
+                        # O(nnz) stored entries scatter over it (pad
+                        # entries index n_pad -> dropped)
+                        col_k = jax.lax.dynamic_index_in_dim(
+                            bins_t, meta["dense_col"][f_k], 0,
+                            keepdims=False)
+                        slot_k = meta["sparse_slot"][f_k]
+                        si_k = jax.lax.dynamic_index_in_dim(
+                            meta["sparse_idx"], slot_k, 0, keepdims=False)
+                        sb_k = jax.lax.dynamic_index_in_dim(
+                            meta["sparse_bin"], slot_k, 0, keepdims=False)
+                        scol_k = jnp.full(
+                            n_pad, meta["default_bin"][f_k],
+                            col_k.dtype).at[si_k].set(
+                                sb_k.astype(col_k.dtype), mode="drop")
+                        col_k = jnp.where(meta["is_sparse"][f_k] > 0,
+                                          scol_k, col_k)
                     else:
                         col_k = jax.lax.dynamic_index_in_dim(
                             bins_t, f_k, 0, keepdims=False)
@@ -688,11 +778,28 @@ def make_grower(params: GrowerParams, num_features: int,
                 # Candidate for the non-contraction time (PERF_NOTES
                 # round-4); same math as "select" bit-for-bit.
                 feat_rows = (meta["bundle_idx"][sel_feat]
-                             if params.has_bundles else sel_feat)
+                             if params.has_bundles else
+                             meta["dense_col"][sel_feat]
+                             if params.has_sparse else sel_feat)
                 cols = bins_t[feat_rows]                     # [K, n_cols]
                 if params.packed_bins:
                     cols = unpack2d(
                         cols.reshape(Kr, nb, bcols)).reshape(Kr, -1)
+                if params.has_sparse:
+                    # vectorized on-the-fly materialization of the K
+                    # chosen columns' sparse variants (see the "select"
+                    # branch for the semantics)
+                    slots = meta["sparse_slot"][sel_feat]    # [K]
+                    si = meta["sparse_idx"][slots]           # [K, M]
+                    sb = meta["sparse_bin"][slots]
+                    scols = jnp.broadcast_to(
+                        meta["default_bin"][sel_feat][:, None].astype(
+                            cols.dtype), (Kr, n_pad)).at[
+                        jnp.arange(Kr, dtype=jnp.int32)[:, None], si].set(
+                        sb.astype(cols.dtype), mode="drop")
+                    cols = jnp.where(
+                        (meta["is_sparse"][sel_feat] > 0)[:, None],
+                        scols, cols)
                 if params.has_bundles:
                     cols = fix_bundle_col(
                         cols, meta["bin_offset"][sel_feat][:, None],
@@ -763,6 +870,8 @@ def make_grower(params: GrowerParams, num_features: int,
                 smaller_ids, B, precision,
                 impl=params.hist_impl,
                 packed_rows=params.packed_bins))             # [K, F, B, 3]
+            hist_small = merge_sparse_hist(hist_small, leaf_ids,
+                                           smaller_ids)
             parent_hist = state["pool"][sel]                 # [K, F, B, 3]
             hist_large = parent_hist - hist_small
             sl = smaller_is_left[:, None, None, None]

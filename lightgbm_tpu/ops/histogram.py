@@ -187,6 +187,76 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
     return hist.reshape(K, num_features, num_bins, 3)
 
 
+def build_histogram_sparse(sidx: jnp.ndarray, sbin: jnp.ndarray,
+                           stats: jnp.ndarray, leaf_ids: jnp.ndarray,
+                           slot_leaf_ids: jnp.ndarray, num_bins: int,
+                           precision: str = "hilo",
+                           block_entries: int = 2048) -> jnp.ndarray:
+    """Batched histograms for COO-stored sparse feature groups.
+
+    The dense contraction sweeps every row per group; sparse groups store
+    only their nonzero-bin entries (reference OrderedSparseBin,
+    src/io/ordered_sparse_bin.hpp — delta-encoded there, padded COO
+    here), so the sweep is O(nnz) per group: gather the stats and leaf
+    ids at the stored row ids, then run the SAME one-hot x slot-one-hot
+    contraction per group over the entry axis.
+
+    sidx: [Gs, M] int32 stored row ids; padding entries may hold any
+        value (e.g. n_pad) — their sbin must be num_bins, whose one-hot
+        row is all-zero, so they contribute nothing regardless of what
+        the (clipped) gather returns.
+    sbin: [Gs, M] int32 stored bins in [0, B); padding = num_bins.
+    stats: [S, n_pad] packed rows from `pack_stats`.
+    leaf_ids: [n_pad] int32 current leaf per row.
+    slot_leaf_ids: [K] int32 (-1 = dead slot).
+    Returns [K, Gs, B, 3] f32/f64 — WITHOUT the implicit zero-bin mass
+    (every unstored row); the grower reconstructs it from leaf totals
+    exactly like FixHistogram (reference dataset.cpp:1044-1063).
+    """
+    Gs, M = sidx.shape
+    S = stats.shape[0]
+    K = slot_leaf_ids.shape[0]
+    dot_dtype = {"f32": jnp.float32,
+                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
+    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
+            else jax.lax.Precision.DEFAULT)
+    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
+
+    mb = min(block_entries, M)
+    nmb = (M + mb - 1) // mb
+    if nmb * mb != M:  # static pad to whole blocks; pads contribute 0
+        padw = nmb * mb - M
+        sidx = jnp.pad(sidx, ((0, 0), (0, padw)))
+        sbin = jnp.pad(sbin, ((0, 0), (0, padw)),
+                       constant_values=num_bins)
+    sidx_b = jnp.moveaxis(sidx.reshape(Gs, nmb, mb), 1, 0)  # [nmb, Gs, mb]
+    sbin_b = jnp.moveaxis(sbin.reshape(Gs, nmb, mb), 1, 0)
+    iota_b = jnp.arange(num_bins, dtype=jnp.int32)
+
+    def body(acc, xs):
+        si, sb = xs                              # [Gs, mb] each
+        safe = jnp.clip(si, 0, stats.shape[1] - 1)
+        st = stats[:, safe]                      # [S, Gs, mb] gather
+        lf = leaf_ids[safe]                      # [Gs, mb]
+        slot_oh = (slot_leaf_ids[:, None, None] == lf[None]).astype(dot_dtype)
+        onehot = (sb[:, None, :] == iota_b[None, :, None]).astype(dot_dtype)
+        sexp = (slot_oh[:, None, :, :]                    # [K, 1, Gs, mb]
+                * st[None, :, :, :].astype(dot_dtype))    # [1, S, Gs, mb]
+        sexp = jnp.moveaxis(sexp.reshape(K * S, Gs, mb), 1, 0)  # [Gs, KS, mb]
+        acc = acc + jax.lax.dot_general(
+            onehot, sexp, (((2,), (2,)), ((0,), (0,))),
+            precision=prec, preferred_element_type=acc_dtype)  # [Gs, B, KS]
+        return acc, None
+
+    init = jnp.zeros((Gs, num_bins, K * S), acc_dtype)
+    raw, _ = jax.lax.scan(body, init, (sidx_b, sbin_b))
+    raw = jnp.transpose(raw.reshape(Gs, num_bins, K, S),
+                        (2, 3, 0, 1))            # [K, S, Gs, B]
+    raw = raw.reshape(K, S, Gs * num_bins)
+    hist = jax.vmap(lambda r: _unpack_hist(r, precision))(raw)
+    return hist.reshape(K, Gs, num_bins, 3)
+
+
 # VMEM budget for one feature chunk's accumulator block in the perfeature
 # pallas kernel; the remaining ~10 MB of VMEM holds the [Bp, blk] one-hot,
 # the [K*S, blk] expanded stats, and the double-buffered input DMAs
