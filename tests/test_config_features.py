@@ -218,6 +218,56 @@ class TestCEGB:
                       ds, num_boost_round=1, verbose_eval=False)
 
 
+
+    def test_cegb_coupled_recredit_drift(self, xy, tmp_path):
+        """Quantified bound for the documented coupled-penalty
+        divergence (ops/grower.py): on acquisition of a feature the TPU
+        learner re-credits only each leaf's single STORED best split,
+        while the reference re-evaluates per-(leaf, feature) candidates
+        (UpdateLeafBestSplits) — a runner-up split on the newly-freed
+        feature can be promoted there but not here.  The drift must stay
+        small: identical feature-acquisition SET and a per-tree leaf
+        trajectory within 20%."""
+        from .conftest import ORACLE_BIN, has_oracle
+        if not has_oracle():
+            pytest.skip("reference oracle not built")
+        import subprocess
+        X, y = xy
+        data = tmp_path / "train.csv"
+        np.savetxt(data, np.column_stack([y, X]), delimiter="\t", fmt="%.8g")
+        pen = ",".join(["0.5"] * X.shape[1])
+        subprocess.run(
+            [ORACLE_BIN, "task=train", f"data={data}", "objective=binary",
+             "num_trees=4", "num_leaves=31", "min_data_in_leaf=20",
+             "cegb_tradeoff=1.0",
+             f"cegb_penalty_feature_coupled={pen}",
+             "verbosity=-1", f"output_model={tmp_path}/ref.txt"],
+            check=True, capture_output=True, cwd=str(tmp_path))
+        ref_model = (tmp_path / "ref.txt").read_text()
+        ref_leaves = [int(l.split("=")[1])
+                      for l in ref_model.splitlines()
+                      if l.startswith("num_leaves=")]
+        ref_feats = set()
+        for l in ref_model.splitlines():
+            if l.startswith("split_feature="):
+                ref_feats.update(int(v) for v in l.split("=")[1].split())
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 255})
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "min_data_in_leaf": 20, "tpu_split_batch": 1,
+                         "cegb_tradeoff": 1.0,
+                         "cegb_penalty_feature_coupled": [0.5] * X.shape[1]},
+                        ds, num_boost_round=4, verbose_eval=False)
+        my_leaves = [t["num_leaves"] for t in bst.dump_model()["tree_info"]]
+        my_feats = _tree_features(bst)
+        assert my_feats == ref_feats, (my_feats, ref_feats)
+        # a short/empty parse must not pass the bound vacuously
+        assert len(my_leaves) == len(ref_leaves) == 4, \
+            (my_leaves, ref_leaves)
+        for mine, ref in zip(my_leaves, ref_leaves):
+            assert abs(mine - ref) <= max(2, 0.2 * ref), \
+                (my_leaves, ref_leaves)
+
+
 class TestSnapshots:
     def test_snapshot_files_written(self, xy, tmp_path):
         X, y = xy
