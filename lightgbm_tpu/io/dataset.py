@@ -263,8 +263,10 @@ class TrainingData:
         if reference is not None:
             self._adopt_reference_mappers(reference)
         else:
-            from .distributed_binning import config_wants_distributed
+            from .distributed_binning import (config_wants_distributed,
+                                              ensure_distributed)
 
+            ensure_distributed(config)
             if config_wants_distributed(config):
                 # a host silently densifying while its peers shard
                 # features would change sample semantics mid-collective;
@@ -309,8 +311,10 @@ class TrainingData:
         # parsing and re-binning entirely
         # per-host cache presence may diverge; every host must walk the
         # same (collective) bin-finding path or the group hangs
-        from .distributed_binning import config_wants_distributed
+        from .distributed_binning import (config_wants_distributed,
+                                          ensure_distributed)
 
+        ensure_distributed(config)
         skip_cache = config_wants_distributed(config)
         if reference is None and not skip_cache \
                 and os.path.exists(path + ".bin"):
@@ -522,8 +526,10 @@ class TrainingData:
         host that skipped the collective while its peers entered it would
         deadlock the group, so errors here must be loud."""
         from .distributed_binning import (config_wants_distributed,
+                                          ensure_distributed,
                                           find_mappers_multihost)
 
+        ensure_distributed(config)
         if config_wants_distributed(config):
             self.mappers = find_mappers_multihost(
                 X, config, categorical, forced_bins,

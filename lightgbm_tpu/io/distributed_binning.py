@@ -25,6 +25,25 @@ from ..config import Config
 from .bin_mapper import BinMapper
 
 
+def ensure_distributed(config: Config) -> None:
+    """Bootstrap the jax.distributed rendezvous when the config asks for
+    a machine group.  A pre-partitioned Dataset is often the FIRST jax
+    touch in the process (constructed before any learner); the
+    rendezvous must run before anything initializes the backend, or
+    jax.distributed.initialize becomes impossible for the whole process.
+    Explicitly a SIDE-EFFECTING entry-point call (it can block on peers
+    or raise on an unresolvable machine list) — the
+    config_wants_distributed predicate below stays pure.
+    init_multihost is idempotent."""
+    if (bool(config.pre_partition) and str(config.machines)
+            and int(config.num_machines) > 1):
+        from ..parallel.mesh import init_multihost
+
+        init_multihost(str(config.machines),
+                       int(config.local_listen_port),
+                       int(config.num_machines))
+
+
 def config_wants_distributed(config: Config) -> bool:
     """Single predicate for every site that must agree on whether this
     process joins the collective bin-finding path — the cache-skip in

@@ -185,6 +185,19 @@ class GBDT:
         if self.learner.params.has_cegb and self._goss_cfg is not None:
             raise NotImplementedError(
                 "CEGB penalties do not compose with GOSS yet")
+        if getattr(self.learner, "_partitioned", False):
+            # pre-partitioned rows: every statistic that must be GLOBAL
+            # either reduces (metrics, boost-from-average) or is gated
+            if self.objective is not None and (
+                    self.objective.needs_renew or self.objective.host_only):
+                raise NotImplementedError(
+                    "pre_partition training does not support percentile-"
+                    "renew or host-only objectives yet (their refits "
+                    "need global order statistics)")
+            if self._goss_cfg is not None:
+                raise NotImplementedError(
+                    "pre_partition does not compose with GOSS (its "
+                    "top-k is over global gradient magnitudes)")
         self._maybe_make_train_step()
 
     def _maybe_make_train_step(self) -> None:
@@ -310,6 +323,14 @@ class GBDT:
         if not self.config.boost_from_average:
             return 0.0
         init = self.objective.boost_from_score(class_id)
+        if self.learner is not None and self.learner._multiproc:
+            # every rank's init comes from its LOCAL rows; agree on the
+            # cross-machine mean like the reference
+            # (ObtainAutomaticInitialScore -> GlobalSyncUpByMean,
+            # gbdt.cpp:333-342).  Identity in the replicated-data mode.
+            from ..parallel.metric_sync import process_count, sync_sums
+
+            init = float(sync_sums([init])[0] / process_count())
         if abs(init) > K_EPSILON:
             self.train_scores.add_constant(init, class_id)
             for vs in self.valid_scores:

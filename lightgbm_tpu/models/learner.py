@@ -20,7 +20,7 @@ from ..config import Config
 from ..io.bin_mapper import MissingType
 from ..io.dataset import TrainingData
 from ..ops.grower import GrowerParams, pad_rows, resolve_split_batch
-from ..parallel.mesh import make_mesh, put_global
+from ..parallel.mesh import make_mesh, put_global, put_local
 from ..parallel.strategies import (bins_sharding, make_strategy_grower,
                                    resolve_tree_learner, rows_sharding)
 from ..utils.log import Log
@@ -121,6 +121,29 @@ class TPUTreeLearner:
         else:
             self.f_shards, self.d_shards = 1, self.n_shards
 
+        # ---- pre-partitioned training rows (reference loader
+        # pre_partition, dataset_loader.cpp row distribution): each
+        # PROCESS holds only its local row shard, so the row geometry
+        # and device placement below become process-local and metrics
+        # reduce globally (parallel/metric_sync).
+        self._partitioned = False
+        if (bool(config.pre_partition) and strategy != "serial"
+                and jax.process_count() > 1):
+            if strategy not in ("data", "voting"):
+                raise NotImplementedError(
+                    "pre_partition training rows require tree_learner="
+                    "data or voting (feature sharding needs the full row "
+                    "set on every shard)")
+            if self.n_shards != len(jax.devices()):
+                raise ValueError(
+                    "pre_partition requires num_machines == the total "
+                    f"device count ({len(jax.devices())}); got "
+                    f"{self.n_shards}")
+            if self.n_shards % jax.process_count() != 0:
+                raise ValueError("devices must split evenly across "
+                                 "processes for pre_partition")
+            self._partitioned = True
+
         for key, allowed in (("tpu_partition_impl", ("select", "vselect", "gather")),
                              ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2"))):
             if str(getattr(config, key)) not in allowed:
@@ -140,7 +163,15 @@ class TPUTreeLearner:
         # dataset.cpp:91-263): sparse zero-default features share columns,
         # shrinking the histogram matrix's feature axis ----
         plan = None
+        if self._partitioned and bool(config.enable_bundle):
+            # each rank would find bundles from only ITS rows — divergent
+            # plans change num_columns/meta per rank and corrupt the
+            # global array construction; skip deterministically on every
+            # rank rather than gamble on agreement
+            Log.info("EFB bundling skipped under pre_partition (plans "
+                     "would be found from per-rank local rows)")
         if (bool(config.enable_bundle) and strategy in ("serial", "data")
+                and not self._partitioned
                 and not forced and self.num_features > 1):
             from ..io.bundling import find_bundles
 
@@ -270,7 +301,19 @@ class TPUTreeLearner:
                 return bucket_up(base // eff, 1) * eff
             return min(bucket_up(count, 128), block)
 
-        if self.d_shards > 1:
+        if self._partitioned:
+            # rows per shard must be UNIFORM across the whole mesh: size
+            # from the largest process's share (short ranks pad with
+            # masked rows); n here is only THIS process's row count
+            from jax.experimental import multihost_utils
+
+            shards_local = self.d_shards // jax.process_count()
+            ns = np.asarray(multihost_utils.process_allgather(
+                np.asarray([n], np.int32)))
+            max_shard_rows = -(-int(ns.max()) // shards_local)
+            self.n_pad = bucket_rows(max_shard_rows) * self.d_shards
+            self._local_width = (self.n_pad // self.d_shards) * shards_local
+        elif self.d_shards > 1:
             # every shard holds an equal, whole number of histogram blocks
             self.n_pad = bucket_rows(
                 (n + self.d_shards - 1) // self.d_shards) * self.d_shards
@@ -350,7 +393,9 @@ class TPUTreeLearner:
                      f"{gd_pad}x{self.n_pad}")
         else:
             self._sparse_arrays = None
-            bins_t = np.zeros((self.g_pad, self.n_pad), dtype=bin_dtype)
+            # partitioned: only this process's rows, at its local width
+            width = self._local_width if self._partitioned else self.n_pad
+            bins_t = np.zeros((self.g_pad, width), dtype=bin_dtype)
             bins_t[:self.num_columns, :n] = cols_src.T
 
         # 4-bit packing (reference dense_nbits_bin.hpp): two rows per
@@ -366,7 +411,7 @@ class TPUTreeLearner:
         self.packed_bins = (
             bool(config.tpu_pack_bins) and B <= 16
             and hist_impl in ("pallas", "pallas2") and plan is None
-            and self._sparse_arrays is None
+            and self._sparse_arrays is None and not self._partitioned
             and str(config.tpu_partition_impl) in ("select", "vselect")
             and eff_block % 256 == 0 and local_rows % eff_block == 0)
         if self.packed_bins:
@@ -393,13 +438,27 @@ class TPUTreeLearner:
         else:
             self.mesh = make_mesh(num_data_shards=self.d_shards,
                                   num_feature_shards=self.f_shards)
-            self.bins_t = put_global(
-                bins_t, bins_sharding(self.mesh, strategy))
-            ones = np.ones(self.n_pad, np.float32)
-            ones[n:] = 0.0
-            self._ones_host = ones
-            self._ones_mask = put_global(
-                ones, rows_sharding(self.mesh, strategy))
+            if self._partitioned:
+                # each process contributes only ITS rows to the global
+                # arrays (reference pre_partition: rows never leave
+                # their machine)
+                self.bins_t = put_local(
+                    bins_t, bins_sharding(self.mesh, strategy),
+                    (bins_t.shape[0], self.n_pad))
+                ones = np.zeros(self._local_width, np.float32)
+                ones[:n] = 1.0
+                self._ones_host = ones
+                self._ones_mask = put_local(
+                    ones, rows_sharding(self.mesh, strategy),
+                    (self.n_pad,))
+            else:
+                self.bins_t = put_global(
+                    bins_t, bins_sharding(self.mesh, strategy))
+                ones = np.ones(self.n_pad, np.float32)
+                ones[n:] = 0.0
+                self._ones_host = ones
+                self._ones_mask = put_global(
+                    ones, rows_sharding(self.mesh, strategy))
         self.n = n
 
         meta_cast = {k: (v.astype(np.int32) if v.dtype != np.float32 else v)
@@ -767,18 +826,28 @@ class TPUTreeLearner:
             if self.params.has_cegb_lazy:
                 self.meta["cegb_paid"] = self._cegb_paid
         if self._multiproc:
-            # shard the per-row vectors globally, replicate the small ones
+            # shard the per-row vectors globally, replicate the small
+            # ones.  Partitioned: the row vectors are LOCAL (this
+            # process's rows only) and placed as local shards.
+            width = (self._local_width if self._partitioned
+                     else self.n_pad)
+
             def pad_host(v):
-                out_v = np.zeros(self.n_pad, np.float32)
+                out_v = np.zeros(width, np.float32)
                 out_v[:np.shape(v)[0]] = np.asarray(v, np.float32)
                 return out_v
+
+            def place_rows(v):
+                if self._partitioned:
+                    return put_local(v, self._rows_shard, (self.n_pad,))
+                return put_global(v, self._rows_shard)
 
             mask_np = self._ones_host if row_mask is None else \
                 self._ones_host * pad_host(row_mask)
             out = self.grow(self.bins_t,
-                            put_global(pad_host(grad), self._rows_shard),
-                            put_global(pad_host(hess), self._rows_shard),
-                            put_global(mask_np, self._rows_shard),
+                            place_rows(pad_host(grad)),
+                            place_rows(pad_host(hess)),
+                            place_rows(mask_np),
                             put_global(np.asarray(fmask),
                                        self._rep_sharding),
                             self.meta,
@@ -797,6 +866,16 @@ class TPUTreeLearner:
                 self._cegb_paid = out["cegb_paid"]
         tree = self.build_tree(out)
         if self._multiproc:
+            if self._partitioned:
+                # each process keeps only ITS rows' leaf ids: the score
+                # state is local, so pull the addressable shards in
+                # global row order and trim the pad
+                shards = sorted(out["leaf_ids"].addressable_shards,
+                                key=lambda s: s.index[0].start or 0)
+                lids = np.concatenate(
+                    [np.asarray(jax.device_get(s.data)).ravel()
+                     for s in shards])[:self.n]
+                return tree, jnp.asarray(lids), out
             # reassemble the row-sharded leaf ids on every host: the GBDT
             # driver's score updates and renew paths operate on LOCAL
             # arrays (identical on all ranks), and a non-addressable

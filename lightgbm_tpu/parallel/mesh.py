@@ -98,6 +98,22 @@ def put_global(arr, sharding: NamedSharding):
                                         lambda idx: arr[idx])
 
 
+def put_local(local_arr, sharding: NamedSharding, global_shape) -> "jax.Array":
+    """Build a global array from PER-PROCESS local shards.
+
+    The pre-partitioned ingest (reference loader pre_partition: each
+    machine holds only its own rows, dataset_loader.cpp row
+    distribution): every process passes just the rows its devices own,
+    laid out in its local order; jax maps them onto the process's
+    addressable shards of the global array.  Complements `put_global`,
+    whose contract is the opposite (every process holds the FULL host
+    array)."""
+    if jax.process_count() == 1:
+        return jax.device_put(np.asarray(local_arr), sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local_arr), global_shape)
+
+
 def make_mesh(num_data_shards: int = 1, num_feature_shards: int = 1,
               devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

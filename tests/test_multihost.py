@@ -98,8 +98,8 @@ print(f"rank {{pid}}: {{int(rec[:, 14].sum())}} splits", flush=True)
 # state, allgathered leaf ids) — every rank must produce the same model
 import lightgbm_tpu as lgb
 ds = lgb.Dataset(X, label=y, params=dict(cfg.params))
-bst = lgb.train({{**dict(cfg.params), "verbosity": -1}}, ds,
-                num_boost_round=3)
+bst = lgb.train({{**dict(cfg.params), "verbosity": -1,
+                 "num_iterations": 3}}, ds, num_boost_round=3)
 model = bst.model_to_string().split("\\nparameters:")[0]
 with open({outfile!r} + ".model", "w") as f:
     f.write(model)
@@ -118,6 +118,7 @@ half = 512
 lo, hi = pid * half, (pid + 1) * half
 p_es = dict(cfg.params)
 p_es["verbosity"] = -1
+p_es["num_iterations"] = 12
 p_es["metric"] = ["binary_logloss", "auc"]
 dtr = lgb.Dataset(X, label=y, params=p_es)
 dval = lgb.Dataset(Xv[lo:hi], label=yv[lo:hi], reference=dtr, params=p_es)
@@ -154,6 +155,74 @@ rec2 = {{"best_iter": int(bst3.best_iteration),
 with open({outfile!r} + ".esjson", "w") as f:
     json.dump(rec2, f)
 print(f"rank {{pid}}: es best_iter={{bst3.best_iteration}}", flush=True)
+
+# ---- pre-partitioned TRAINING rows (reference loader pre_partition):
+# each rank holds only its HALF of the training rows; bin finding runs
+# feature-sharded + allgather, rows place as process-local shards, and
+# metrics/boost-from-average reduce globally.  Deterministic f64 with
+# identical global row order => the model must BIT-match a serial
+# full-data run in the same bin space.
+p_pt = dict(cfg.params)
+# boost_from_average=false: the distributed init is the MEAN of the
+# per-rank inits (reference GlobalSyncUpByMean), which legitimately
+# differs from a centralized full-data init on imbalanced halves —
+# bit-matching serial requires removing that known semantic difference
+p_pt.update(verbosity=-1, deterministic=True, pre_partition=True,
+            metric=["auc"], tpu_shape_buckets=0, num_iterations=3,
+            boost_from_average=False)
+half_t = 1024
+ds_pt = lgb.Dataset(X[pid * half_t:(pid + 1) * half_t],
+                    label=y[pid * half_t:(pid + 1) * half_t],
+                    params=p_pt)
+bst_pt = lgb.train(p_pt, ds_pt, num_boost_round=3,
+                   keep_training_booster=True)
+m_pt = bst_pt.model_to_string().split("\\nparameters:")[0]
+auc_pt = dict((nm, v) for _, nm, v, _ in bst_pt.eval_train())["auc"]
+# serial full-data reference in the SAME bin space (shared mappers)
+p_sr = {{k: v for k, v in p_pt.items()
+         if k not in ("machines", "num_machines", "pre_partition")}}
+p_sr["tree_learner"] = "serial"
+ds_sr = lgb.Dataset(X, label=y, reference=ds_pt, params=p_sr)
+bst_sr = lgb.train(p_sr, ds_sr, num_boost_round=3,
+                   keep_training_booster=True)
+m_sr = bst_sr.model_to_string().split("\\nparameters:")[0]
+auc_sr = dict((nm, v) for _, nm, v, _ in bst_sr.eval_train())["auc"]
+
+# psum partial-sum order differs from the serial block scan by f64
+# ulps, and the f32 leaf-value downcast can flip at a rounding
+# boundary — so the contract is STRUCTURAL exactness (every split
+# line identical) + numeric closeness on the value lines
+def split_lines(m):
+    keep = ("split_feature=", "threshold=", "left_child=", "right_child=")
+    out = [l for l in m.splitlines() if l.startswith(keep)]
+    for l in m.splitlines():
+        # default-left (bit 2) may flip on direction-gain ties under a
+        # different reduction order; everything else must be identical
+        if l.startswith("decision_type="):
+            out.append(" ".join(str(int(v) & ~2)
+                                for v in l.split("=")[1].split()))
+    return out
+def value_rows(m):
+    out = []
+    for l in m.splitlines():
+        if l.startswith(("leaf_value=", "internal_value=",
+                         "split_gain=")):
+            out.extend(float(v) for v in l.split("=")[1].split())
+    return np.asarray(out)
+struct_ok = split_lines(m_pt) == split_lines(m_sr)
+v_pt, v_sr = value_rows(m_pt), value_rows(m_sr)
+val_delta = (float(np.max(np.abs(v_pt - v_sr)))
+             if len(v_pt) == len(v_sr) else float("inf"))
+with open({outfile!r} + ".ptmodel", "w") as f:
+    f.write(m_pt)
+with open({outfile!r} + ".srmodel", "w") as f:
+    f.write(m_sr)
+with open({outfile!r} + ".ptjson", "w") as f:
+    json.dump({{"auc_pt": auc_pt, "auc_sr": auc_sr,
+               "struct_ok": bool(struct_ok),
+               "val_delta": val_delta}}, f)
+print(f"rank {{pid}}: partitioned-train auc={{auc_pt:.4f}} "
+      f"struct_ok={{struct_ok}} val_delta={{val_delta:.2e}}", flush=True)
 """
 
 
@@ -225,3 +294,20 @@ class TestTwoProcessRendezvous:
                                                      abs=2e-4)
         # early stopping actually engaged (12 rounds max, patience 2)
         assert 1 <= es0["best_iter"] <= es0["n_iter"] <= 12
+        # pre-partitioned TRAINING: identical models on both ranks, and
+        # (deterministic f64, same global row order) bit-equal to the
+        # serial full-data model; the distributed train-AUC is the
+        # GLOBAL statistic so it matches the serial run's exactly
+        pt0 = open(outs[0] + ".ptmodel").read()
+        pt1 = open(outs[1] + ".ptmodel").read()
+        assert pt0 == pt1 and "tree" in pt0
+        ptj0 = json.load(open(outs[0] + ".ptjson"))
+        ptj1 = json.load(open(outs[1] + ".ptjson"))
+        assert ptj0 == ptj1
+        # every split decision identical to serial full-data training;
+        # value lines within the f32-downcast rounding band
+        assert ptj0["struct_ok"], "partitioned splits diverged from serial"
+        # value lines print 6-digit-rounded; one print digit = 1e-6
+        assert ptj0["val_delta"] < 1e-5, ptj0
+        assert ptj0["auc_pt"] == pytest.approx(ptj0["auc_sr"], abs=1e-6)
+        assert ptj0["auc_pt"] > 0.9
