@@ -222,10 +222,16 @@ class TPUTreeLearner:
                 raise ValueError(
                     "tpu_sparse_threshold requires enable_bundle=false "
                     "(EFB already re-columns sparse features; pick one)")
-            if strategy != "serial":
+            if strategy not in ("serial", "data"):
                 raise NotImplementedError(
                     "tpu_sparse_threshold requires tree_learner=serial "
-                    "(the COO row ids are learner-local)")
+                    "or data (voting needs local-total reconstruction, "
+                    "feature sharding replicates rows)")
+            if self._partitioned:
+                raise NotImplementedError(
+                    "tpu_sparse_threshold does not compose with "
+                    "pre_partition yet (per-shard COO tables would need "
+                    "a cross-process assembly)")
             if forced:
                 raise ValueError("tpu_sparse_threshold does not compose "
                                  "with forced splits")
@@ -358,15 +364,38 @@ class TPUTreeLearner:
             # come from the same nonzero lists
             nz_lists = [np.flatnonzero(cols_src[:, c] != zb_np[c])
                         for c in sparse_idx_cols]
-            M = max(128, -(-max(len(z) for z in nz_lists) // 128) * 128)
-            # pad row-id = n_pad (out of range: partition scatter drops
-            # it); pad bin = B (its one-hot row is all-zero, so the
-            # clipped histogram gather contributes nothing)
-            sp_rows = np.full((Gs, M), self.n_pad, np.int32)
-            sp_bins = np.full((Gs, M), B, np.int32)
-            for s, (c, nz) in enumerate(zip(sparse_idx_cols, nz_lists)):
-                sp_rows[s, :len(nz)] = nz
-                sp_bins[s, :len(nz)] = cols_src[nz, c]
+            # pad row-id = the (local) width (out of range: the
+            # partition scatter drops it); pad bin = B (its one-hot row
+            # is all-zero, so the clipped histogram gather contributes
+            # nothing)
+            if self.d_shards > 1:
+                # data sharding: per-SHARD tables [d, Gs, M] with
+                # shard-local row ids — the grower slices its shard by
+                # axis_index and the sparse contraction psums like the
+                # dense one
+                rps = self.n_pad // self.d_shards
+                per = [[nz[(nz >= s * rps) & (nz < (s + 1) * rps)] - s * rps
+                        for nz in nz_lists]
+                       for s in range(self.d_shards)]
+                max_nnz = max(len(z) for row in per for z in row)
+                M = max(128, -(-max_nnz // 128) * 128)
+                sp_rows = np.full((self.d_shards, Gs, M), rps, np.int32)
+                sp_bins = np.full((self.d_shards, Gs, M), B, np.int32)
+                for s in range(self.d_shards):
+                    for g, (c, nz_l) in enumerate(
+                            zip(sparse_idx_cols, per[s])):
+                        sp_rows[s, g, :len(nz_l)] = nz_l
+                        sp_bins[s, g, :len(nz_l)] = \
+                            cols_src[nz_l + s * rps, c]
+            else:
+                M = max(128,
+                        -(-max(len(z) for z in nz_lists) // 128) * 128)
+                sp_rows = np.full((Gs, M), self.n_pad, np.int32)
+                sp_bins = np.full((Gs, M), B, np.int32)
+                for s, (c, nz) in enumerate(
+                        zip(sparse_idx_cols, nz_lists)):
+                    sp_rows[s, :len(nz)] = nz
+                    sp_bins[s, :len(nz)] = cols_src[nz, c]
             F_ = self.num_features
             is_sparse = np.zeros(F_, np.int32)
             is_sparse[sparse_idx_cols] = 1
@@ -476,12 +505,25 @@ class TPUTreeLearner:
         else:
             self.meta = {k: jnp.asarray(v) for k, v in meta_cast.items()}
         if self._sparse_arrays is not None:
-            # 2-D COO tables ride meta like the CEGB state does (the pad
-            # loop above only handles per-feature vectors)
+            # COO tables ride meta like the CEGB state does (the pad
+            # loop above only handles per-feature vectors).  Data-
+            # sharded learners shard the per-shard leading axis at
+            # placement so no replicated->sharded reshard crosses the
+            # program boundary (the CPU gloo backend aborts on those)
             sp_rows, sp_bins, perm = self._sparse_arrays
-            self.meta["sparse_idx"] = jnp.asarray(sp_rows)
-            self.meta["sparse_bin"] = jnp.asarray(sp_bins)
-            self.meta["hist_perm"] = jnp.asarray(perm)
+            if self._multiproc:
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P_
+
+                shard3 = NamedSharding(self.mesh, P_("data"))
+                self.meta["sparse_idx"] = put_global(sp_rows, shard3)
+                self.meta["sparse_bin"] = put_global(sp_bins, shard3)
+                self.meta["hist_perm"] = put_global(perm,
+                                                    self._rep_sharding)
+            else:
+                self.meta["sparse_idx"] = jnp.asarray(sp_rows)
+                self.meta["sparse_bin"] = jnp.asarray(sp_bins)
+                self.meta["hist_perm"] = jnp.asarray(perm)
 
         self.params = GrowerParams(
             num_leaves=max(int(config.num_leaves), 2),

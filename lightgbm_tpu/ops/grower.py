@@ -234,17 +234,17 @@ def make_grower(params: GrowerParams, num_features: int,
             "packed 4-bit bins require the pallas histogram impl, a "
             "select-family partition lowering, and no EFB bundling")
     if params.has_sparse and (
-            data_axis or feature_axis or voting_k or params.has_bundles
+            feature_axis or voting_k or params.has_bundles
             or params.packed_bins
             or params.partition_impl not in ("select", "vselect")):
-        # the COO row ids are learner-local; sharding them needs a
-        # per-shard re-pad (like cegb_lazy's paid matrix) — serial only
-        # until that exists, and EFB/packing already reshape the dense
-        # matrix the sparse split would have to compose with
+        # voting's LOCAL gain vote would need its own zero-bin
+        # reconstruction from local totals, and EFB/packing already
+        # reshape the dense matrix the sparse split composes with —
+        # serial and plain data-parallel only
         raise ValueError(
             "sparse train-time storage (tpu_sparse_threshold) requires "
-            "tree_learner=serial, a select-family partition lowering, "
-            "and no EFB bundling / 4-bit packing")
+            "tree_learner=serial or data, a select-family partition "
+            "lowering, and no EFB bundling / 4-bit packing")
     precision = params.precision
     K = max(1, min(int(params.split_batch), L - 1))
 
@@ -549,15 +549,31 @@ def make_grower(params: GrowerParams, num_features: int,
         bins_blocks = jnp.moveaxis(bins_hist_t.reshape(Gd, nb, bcols), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
 
+        if params.has_sparse:
+            sp_idx_t = meta["sparse_idx"]
+            sp_bin_t = meta["sparse_bin"]
+            if data_axis:
+                # the [d_shards, Gs, M] per-shard tables (rows
+                # re-indexed shard-local by the learner) shard their
+                # leading axis over 'data': this shard sees its own
+                # [1, Gs, M] block
+                sp_idx_t = sp_idx_t[0]
+                sp_bin_t = sp_bin_t[0]
+        else:
+            sp_idx_t = sp_bin_t = None
+
         def merge_sparse_hist(dense_h, leaf_vec, slot_ids):
             """[.., Gd, B, 3] dense hist -> [.., G, B, 3] feature hist:
             append the sparse groups' O(nnz) gather contraction and
-            reorder by the static feature->slot permutation."""
+            reorder by the static feature->slot permutation.  Under data
+            sharding the contraction runs on this shard's entries and
+            psums like the dense part (zero-bin reconstruction happens
+            AFTER the psum, in select, from global totals)."""
             if not params.has_sparse:
                 return dense_h
-            sp = build_histogram_sparse(
-                meta["sparse_idx"], meta["sparse_bin"], stats, leaf_vec,
-                slot_ids, B, precision)           # [k, Gs, B, 3]
+            sp = preduce_hist(build_histogram_sparse(
+                sp_idx_t, sp_bin_t, stats, leaf_vec,
+                slot_ids, B, precision))          # [k, Gs, B, 3]
             merged = jnp.concatenate([dense_h, sp], axis=-3)
             return jnp.take(merged, meta["hist_perm"], axis=-3)
         if params.hist_impl.startswith("pallas"):
@@ -744,9 +760,9 @@ def make_grower(params: GrowerParams, num_features: int,
                             keepdims=False)
                         slot_k = meta["sparse_slot"][f_k]
                         si_k = jax.lax.dynamic_index_in_dim(
-                            meta["sparse_idx"], slot_k, 0, keepdims=False)
+                            sp_idx_t, slot_k, 0, keepdims=False)
                         sb_k = jax.lax.dynamic_index_in_dim(
-                            meta["sparse_bin"], slot_k, 0, keepdims=False)
+                            sp_bin_t, slot_k, 0, keepdims=False)
                         scol_k = jnp.full(
                             n_pad, meta["default_bin"][f_k],
                             col_k.dtype).at[si_k].set(
@@ -790,8 +806,8 @@ def make_grower(params: GrowerParams, num_features: int,
                     # chosen columns' sparse variants (see the "select"
                     # branch for the semantics)
                     slots = meta["sparse_slot"][sel_feat]    # [K]
-                    si = meta["sparse_idx"][slots]           # [K, M]
-                    sb = meta["sparse_bin"][slots]
+                    si = sp_idx_t[slots]                     # [K, M]
+                    sb = sp_bin_t[slots]
                     scols = jnp.broadcast_to(
                         meta["default_bin"][sel_feat][:, None].astype(
                             cols.dtype), (Kr, n_pad)).at[

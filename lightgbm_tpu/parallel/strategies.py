@@ -82,6 +82,16 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         # lazy CEGB is serial-only and never reaches here
         meta_spec["cegb_used"] = P()
         base_out["cegb_used"] = P()
+    if params.has_sparse:
+        # the per-shard COO tables shard their LEADING axis over 'data'
+        # (each device holds only its own [1, Gs, M] block — replicating
+        # a feature whose purpose is saving HBM would defeat it); the
+        # small per-feature vectors replicate
+        for k in ("is_sparse", "sparse_slot", "dense_col", "dense_ref",
+                  "hist_perm"):
+            meta_spec[k] = P()
+        meta_spec["sparse_idx"] = P("data")
+        meta_spec["sparse_bin"] = P("data")
     if strategy in ("data", "voting"):
         nshards = mesh.shape["data"]
         grow = make_grower(

@@ -98,10 +98,10 @@ class TestSparseStorageParity:
 
 
 class TestSparseStorageGates:
-    def test_requires_serial(self):
+    def test_rejects_voting(self):
         X, y = _sparse_problem(n=512)
-        p = {**BASE, "tpu_sparse_threshold": 0.2, "tree_learner": "data",
-             "num_machines": 2}
+        p = {**BASE, "tpu_sparse_threshold": 0.2,
+             "tree_learner": "voting", "num_machines": 4}
         with pytest.raises(NotImplementedError, match="serial"):
             _model(p, X, y, rounds=1)
 
@@ -148,3 +148,36 @@ class TestBoschShapedMemory:
         # and the model actually trained on the sparse representation
         assert bst.num_trees() == 2
         assert "split_gain" in bst.model_to_string()
+
+
+class TestSparseDataParallel:
+    """Sparse storage composed with the data-parallel learner: per-shard
+    COO tables ([d, Gs, M], shard-local row ids) sliced by axis_index
+    inside the shard_map; the sparse contraction psums like the dense
+    one and the zero bin reconstructs post-psum from global totals."""
+
+    def test_f64_matches_serial(self, _x64_reset):
+        X, y = _sparse_problem()
+        p_ser = {**BASE, "deterministic": True,
+                 "tpu_sparse_threshold": 0.2}
+        p_par = {**p_ser, "tree_learner": "data", "num_machines": 8}
+        models = {}
+        for tag, p in (("serial", p_ser), ("data", p_par)):
+            models[tag] = _model(p, X, y).model_to_string().split(
+                "\nparameters:")[0]
+        assert models["data"] == models["serial"]
+
+    def test_default_precision_learns(self):
+        X, y = _sparse_problem(density=0.02)
+        p = {**BASE, "tpu_sparse_threshold": 0.2, "metric": ["auc"],
+             "tree_learner": "data", "num_machines": 8}
+        bst = _model(p, X, y, rounds=8)
+        auc = dict((nm, v) for _, nm, v, _ in bst.eval_train())["auc"]
+        assert auc > 0.85, auc
+
+    def test_feature_rejected(self):
+        X, y = _sparse_problem(n=512)
+        p = {**BASE, "tpu_sparse_threshold": 0.2,
+             "tree_learner": "feature", "num_machines": 4}
+        with pytest.raises(NotImplementedError, match="serial"):
+            _model(p, X, y, rounds=1)
