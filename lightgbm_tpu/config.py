@@ -227,8 +227,9 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # sparse datasets stop paying dense HBM for empty rows.  Histograms
     # come from a gather contraction over the stored entries with the
     # zero bin reconstructed from leaf totals (the FixHistogram trick,
-    # dataset.cpp:1044-1063).  0 disables.  Requires tree_learner=serial or
-    # data and enable_bundle=false (EFB is the alternative mitigation).
+    # dataset.cpp:1044-1063).  0 disables.  Requires tree_learner=serial,
+    # data, or voting, and enable_bundle=false (EFB is the alternative
+    # mitigation).
     "tpu_sparse_threshold": ("float", 0.0, ()),
 }
 

@@ -222,11 +222,10 @@ class TPUTreeLearner:
                 raise ValueError(
                     "tpu_sparse_threshold requires enable_bundle=false "
                     "(EFB already re-columns sparse features; pick one)")
-            if strategy not in ("serial", "data"):
+            if strategy not in ("serial", "data", "voting"):
                 raise NotImplementedError(
-                    "tpu_sparse_threshold requires tree_learner=serial "
-                    "or data (voting needs local-total reconstruction, "
-                    "feature sharding replicates rows)")
+                    "tpu_sparse_threshold requires tree_learner=serial, "
+                    "data, or voting (feature sharding replicates rows)")
             if self._partitioned:
                 raise NotImplementedError(
                     "tpu_sparse_threshold does not compose with "

@@ -234,16 +234,15 @@ def make_grower(params: GrowerParams, num_features: int,
             "packed 4-bit bins require the pallas histogram impl, a "
             "select-family partition lowering, and no EFB bundling")
     if params.has_sparse and (
-            feature_axis or voting_k or params.has_bundles
+            feature_axis or params.has_bundles
             or params.packed_bins
             or params.partition_impl not in ("select", "vselect")):
-        # voting's LOCAL gain vote would need its own zero-bin
-        # reconstruction from local totals, and EFB/packing already
-        # reshape the dense matrix the sparse split composes with —
-        # serial and plain data-parallel only
+        # EFB/packing already reshape the dense matrix the sparse split
+        # composes with; feature sharding replicates rows — serial,
+        # data-parallel, and voting only
         raise ValueError(
             "sparse train-time storage (tpu_sparse_threshold) requires "
-            "tree_learner=serial or data, a select-family partition "
+            "tree_learner=serial/data/voting, a select-family partition "
             "lowering, and no EFB bundling / 4-bit packing")
     precision = params.precision
     K = max(1, min(int(params.split_batch), L - 1))
@@ -410,28 +409,33 @@ def make_grower(params: GrowerParams, num_features: int,
                 jnp.where(fix[:, None], bin0, hist_f[:, 0, :]))
             return hist_f
 
-        def expand_sparse(hist, sg, sh, cnt):
+        def fix_sparse_bins(hist, isp, db, totals):
+            """hist[f, default_bin] = totals - sum(other bins) where isp:
+            the FixHistogram identity (reference dataset.cpp:1044-1063)
+            over [F', B, 3] rows with caller-supplied leaf totals."""
+            iota_b = jnp.arange(B, dtype=jnp.int32)
+            at_db = isp[:, None] & (iota_b[None, :] == db[:, None])
+            zeroed = jnp.where(at_db[:, :, None], 0.0, hist)
+            bin0 = totals[None, :] - jnp.sum(zeroed, axis=1)
+            return jnp.where(at_db[:, :, None], bin0[:, None, :], zeroed)
+
+        def expand_sparse(hist):
             """Reconstruct each sparse feature's zero bin from the leaf
-            totals: the stored COO entries cover only nonzero bins, so
-            hist[f, default_bin] = totals - sum(other bins) — the same
-            FixHistogram identity the bundle expansion uses (reference
-            dataset.cpp:1044-1063).  [F, B, 3] in and out.
+            totals: the stored COO entries cover only nonzero bins.
+            [F, B, 3] in and out.
 
             The totals come from a known-DENSE feature's own histogram
             (every row lands in exactly one bin per feature), not from
             the f32 scalar leaf sums: the reconstruction then stays
             entirely in the histogram accumulation dtype, so
-            deterministic f64 sparse storage bit-matches dense."""
+            deterministic f64 sparse storage bit-matches dense — and in
+            voting mode, where hist is the shard-LOCAL pool, the derived
+            totals are automatically the LOCAL ones the vote needs."""
             if not params.has_sparse:
                 return hist
-            isp = meta_local["is_sparse"] > 0              # [F]
-            db = meta_local["default_bin"]                 # [F]
-            iota_b = jnp.arange(B, dtype=jnp.int32)
-            at_db = isp[:, None] & (iota_b[None, :] == db[:, None])
-            zeroed = jnp.where(at_db[:, :, None], 0.0, hist)
             totals = jnp.sum(hist[meta_local["dense_ref"][0]], axis=0)
-            bin0 = totals[None, :] - jnp.sum(zeroed, axis=1)  # [F, 3]
-            return jnp.where(at_db[:, :, None], bin0[:, None, :], zeroed)
+            return fix_sparse_bins(hist, meta_local["is_sparse"] > 0,
+                                   meta_local["default_bin"], totals)
 
         def cegb_delta(used, cnt, unpaid=None):
             """[M, FG] per-leaf gain charge (DetlaGain,
@@ -459,12 +463,23 @@ def make_grower(params: GrowerParams, num_features: int,
             delta_local = (fslice(delta) if feature_axis else delta) \
                 if params.has_cegb else None
             if voting_k:
-                # local leaf totals from any one feature's bins (every row
-                # lands in exactly one bin per feature)
-                loc = jnp.sum(hist[0], axis=0)
-                gain_loc, _ = combined_search(hist, loc[0], loc[1], loc[2],
-                                              meta_local, fmask_local,
-                                              local_kw, min_c, max_c)
+                # local leaf totals from any one DENSE feature's bins
+                # (every row lands in exactly one bin per feature; a
+                # sparse column is missing its zero-bin mass)
+                dref = (meta_local["dense_ref"][0] if params.has_sparse
+                        else 0)
+                loc = jnp.sum(hist[dref], axis=0)
+                # sparse features need their LOCAL zero bin before the
+                # local gain vote — reconstructed from the SAME `loc`
+                # totals that (psum'd) later fix the voted aggregation
+                hist_loc = (fix_sparse_bins(hist,
+                                            meta_local["is_sparse"] > 0,
+                                            meta_local["default_bin"],
+                                            loc)
+                            if params.has_sparse else hist)
+                gain_loc, _ = combined_search(
+                    hist_loc, loc[0], loc[1], loc[2], meta_local,
+                    fmask_local, local_kw, min_c, max_c)
                 k2 = min(2 * voting_k, F)
                 vals, idx = jax.lax.top_k(gain_loc, k2)
                 # weighted-gain vote across shards (GlobalVoting :170-200)
@@ -474,9 +489,18 @@ def make_grower(params: GrowerParams, num_features: int,
                 kk = min(voting_k, F)
                 _, sel = jax.lax.top_k(score, kk)
                 sel = sel.astype(jnp.int32)
-                # aggregate ONLY the voted features' histograms
+                # aggregate ONLY the voted features' histograms — RAW
+                # (zero bins reconstructed after the psum from GLOBAL
+                # totals); the 2-D COO tables are not per-feature rows
+                sel_meta = {k: v[sel] for k, v in meta_local.items()
+                            if k not in ("sparse_idx", "sparse_bin",
+                                         "hist_perm")}
                 sel_hist = jax.lax.psum(hist[sel], data_axis)
-                sel_meta = {k: v[sel] for k, v in meta_local.items()}
+                if params.has_sparse:
+                    sel_hist = fix_sparse_bins(
+                        sel_hist, sel_meta["is_sparse"] > 0,
+                        sel_meta["default_bin"],
+                        jax.lax.psum(loc, data_axis))
                 gain_sel, fin = combined_search(sel_hist, sg, sh, cnt,
                                                 sel_meta, fmask_local[sel],
                                                 split_kw, min_c, max_c)
@@ -487,7 +511,7 @@ def make_grower(params: GrowerParams, num_features: int,
                 return res._replace(feature=sel[bi], gain=gain_sel[bi])
 
             hist = expand_bundles(hist, sg, sh, cnt)
-            hist = expand_sparse(hist, sg, sh, cnt)
+            hist = expand_sparse(hist)
             gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_local,
                                             fmask_local, split_kw,
                                             min_c, max_c)
