@@ -226,21 +226,28 @@ class TPUTreeLearner:
                 raise NotImplementedError(
                     "tpu_sparse_threshold requires tree_learner=serial, "
                     "data, or voting (feature sharding replicates rows)")
-            if self._partitioned:
-                raise NotImplementedError(
-                    "tpu_sparse_threshold does not compose with "
-                    "pre_partition yet (per-shard COO tables would need "
-                    "a cross-process assembly)")
             if forced:
                 raise ValueError("tpu_sparse_threshold does not compose "
                                  "with forced splits")
             zb_f = meta_np["default_bin"]
             # per-column counting: a whole-matrix (cols_src != zb)
             # boolean would materialize ~1 GB at Bosch scale
-            nz_frac = np.fromiter(
-                (np.count_nonzero(cols_src[:, c] != zb_f[c]) / max(n, 1)
+            nz_counts = np.fromiter(
+                (np.count_nonzero(cols_src[:, c] != zb_f[c])
                  for c in range(self.num_features)),
-                np.float64, self.num_features)
+                np.int64, self.num_features)
+            denom = n
+            if self._partitioned:
+                # every rank must agree on WHICH features are sparse, or
+                # Gs/perm diverge and the global tables are inconsistent
+                # — decide from the GLOBAL nonzero fractions
+                from jax.experimental import multihost_utils
+
+                g = np.asarray(multihost_utils.process_allgather(
+                    np.concatenate([nz_counts, [n]]).astype(np.int32)))
+                tot = g.sum(axis=0)
+                nz_counts, denom = tot[:-1], int(tot[-1])
+            nz_frac = nz_counts / max(denom, 1)
             sp_mask = nz_frac <= sth
             if sp_mask.all():
                 # the dense kernel needs a nonempty matrix; keep the
@@ -355,7 +362,9 @@ class TPUTreeLearner:
             # 32-multiples — align the DENSE matrix width; the sparse
             # groups never enter that kernel
             gd_pad = -(-gd // 32) * 32 if hist_impl == "pallas2" else gd
-            bins_t = np.zeros((gd_pad, self.n_pad), dtype=bin_dtype)
+            width_sp = (self._local_width if self._partitioned
+                        else self.n_pad)
+            bins_t = np.zeros((gd_pad, width_sp), dtype=bin_dtype)
             bins_t[:gd, :n] = cols_src[:, dense_idx].T
             zb_np = meta_np["default_bin"]
             Gs = len(sparse_idx_cols)
@@ -368,19 +377,31 @@ class TPUTreeLearner:
             # is all-zero, so the clipped histogram gather contributes
             # nothing)
             if self.d_shards > 1:
-                # data sharding: per-SHARD tables [d, Gs, M] with
-                # shard-local row ids — the grower slices its shard by
-                # axis_index and the sparse contraction psums like the
-                # dense one
+                # data sharding: per-SHARD tables with shard-local row
+                # ids — the leading axis shards over 'data' so each
+                # device holds only its block, and the sparse
+                # contraction psums like the dense one.  Partitioned
+                # ingest: this process's local rows cover exactly its
+                # own shards, so it builds [shards_local, Gs, M] and
+                # contributes them via put_local; the entry capacity M
+                # must still be the GLOBAL max.
                 rps = self.n_pad // self.d_shards
+                sl = (self.d_shards // jax.process_count()
+                      if self._partitioned else self.d_shards)
                 per = [[nz[(nz >= s * rps) & (nz < (s + 1) * rps)] - s * rps
                         for nz in nz_lists]
-                       for s in range(self.d_shards)]
+                       for s in range(sl)]
                 max_nnz = max(len(z) for row in per for z in row)
+                if self._partitioned:
+                    from jax.experimental import multihost_utils
+
+                    max_nnz = int(np.asarray(
+                        multihost_utils.process_allgather(
+                            np.asarray([max_nnz], np.int32))).max())
                 M = max(128, -(-max_nnz // 128) * 128)
-                sp_rows = np.full((self.d_shards, Gs, M), rps, np.int32)
-                sp_bins = np.full((self.d_shards, Gs, M), B, np.int32)
-                for s in range(self.d_shards):
+                sp_rows = np.full((sl, Gs, M), rps, np.int32)
+                sp_bins = np.full((sl, Gs, M), B, np.int32)
+                for s in range(sl):
                     for g, (c, nz_l) in enumerate(
                             zip(sparse_idx_cols, per[s])):
                         sp_rows[s, g, :len(nz_l)] = nz_l
@@ -515,8 +536,16 @@ class TPUTreeLearner:
                 from jax.sharding import PartitionSpec as P_
 
                 shard3 = NamedSharding(self.mesh, P_("data"))
-                self.meta["sparse_idx"] = put_global(sp_rows, shard3)
-                self.meta["sparse_bin"] = put_global(sp_bins, shard3)
+                if self._partitioned:
+                    # this process built only ITS shards' tables
+                    gshape = (self.d_shards,) + sp_rows.shape[1:]
+                    self.meta["sparse_idx"] = put_local(sp_rows, shard3,
+                                                        gshape)
+                    self.meta["sparse_bin"] = put_local(sp_bins, shard3,
+                                                        gshape)
+                else:
+                    self.meta["sparse_idx"] = put_global(sp_rows, shard3)
+                    self.meta["sparse_bin"] = put_global(sp_bins, shard3)
                 self.meta["hist_perm"] = put_global(perm,
                                                     self._rep_sharding)
             else:

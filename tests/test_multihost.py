@@ -223,6 +223,44 @@ with open({outfile!r} + ".ptjson", "w") as f:
                "val_delta": val_delta}}, f)
 print(f"rank {{pid}}: partitioned-train auc={{auc_pt:.4f}} "
       f"struct_ok={{struct_ok}} val_delta={{val_delta:.2e}}", flush=True)
+
+# ---- sparse COO storage x pre_partition: the sparse-feature decision
+# comes from GLOBAL nonzero fractions, each process builds only its own
+# shards' tables, and the partitioned model must structurally match a
+# serial-sparse full-data run in the same bin space
+rngs = np.random.default_rng(33)
+Xs_full = np.zeros((2048, 12))
+Xs_full[:, :4] = rngs.normal(size=(2048, 4))
+for f in range(4, 12):
+    nzr = rngs.choice(2048, size=64, replace=False)
+    Xs_full[nzr, f] = rngs.normal(size=64) + 1.0
+ys_full = (Xs_full[:, 0] + 2.0 * Xs_full[:, 5] > 0).astype(np.float64)
+p_sp = dict(p_pt)
+p_sp.update(enable_bundle=False, tpu_sparse_threshold=0.2,
+            num_iterations=2)
+ds_sp = lgb.Dataset(Xs_full[pid * half_t:(pid + 1) * half_t],
+                    label=ys_full[pid * half_t:(pid + 1) * half_t],
+                    params=p_sp)
+bst_sp = lgb.train(p_sp, ds_sp, num_boost_round=2,
+                   keep_training_booster=True)
+assert bst_sp._driver.learner.params.has_sparse, "sparse did not engage"
+m_sp = bst_sp.model_to_string().split("\\nparameters:")[0]
+p_ss = {{k: v for k, v in p_sp.items()
+         if k not in ("machines", "num_machines", "pre_partition")}}
+p_ss["tree_learner"] = "serial"
+ds_ss = lgb.Dataset(Xs_full, label=ys_full, reference=ds_sp, params=p_ss)
+bst_ss = lgb.train(p_ss, ds_ss, num_boost_round=2,
+                   keep_training_booster=True)
+m_ss = bst_ss.model_to_string().split("\\nparameters:")[0]
+sp_struct = split_lines(m_sp) == split_lines(m_ss)
+v_sp, v_ss = value_rows(m_sp), value_rows(m_ss)
+sp_delta = (float(np.max(np.abs(v_sp - v_ss)))
+            if len(v_sp) == len(v_ss) else float("inf"))
+with open({outfile!r} + ".spjson", "w") as f:
+    json.dump({{"struct_ok": bool(sp_struct), "val_delta": sp_delta,
+               "model": m_sp}}, f)
+print(f"rank {{pid}}: sparse x pre_partition struct_ok={{sp_struct}} "
+      f"val_delta={{sp_delta:.2e}}", flush=True)
 """
 
 
@@ -311,3 +349,11 @@ class TestTwoProcessRendezvous:
         assert ptj0["val_delta"] < 1e-5, ptj0
         assert ptj0["auc_pt"] == pytest.approx(ptj0["auc_sr"], abs=1e-6)
         assert ptj0["auc_pt"] > 0.9
+        # sparse COO x pre_partition: both ranks identical, structurally
+        # equal to serial-sparse full-data training
+        spj0 = json.load(open(outs[0] + ".spjson"))
+        spj1 = json.load(open(outs[1] + ".spjson"))
+        assert spj0 == spj1
+        assert spj0["struct_ok"], "sparse partitioned diverged from serial"
+        assert spj0["val_delta"] < 1e-5, spj0
+        assert "tree" in spj0["model"]
