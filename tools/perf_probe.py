@@ -48,6 +48,9 @@ def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
         "tpu_partition_impl": partition,
         "tpu_hist_precision": precision,
         "tpu_split_batch_alpha": alpha,
+        # exact shapes: sweep numbers must stay byte-comparable with the
+        # round-3 3.14 it/s record and bench.py's pinned configuration
+        "tpu_shape_buckets": 0,
         "tpu_ramp": ramp}, train_set=ds)
     t0 = time.time()
     bst.update()
