@@ -1,0 +1,76 @@
+"""Parse docs/HW_RESULTS_r5.log after the hardware queue ran and print
+each staged candidate's decision-rule outcome (docs/PERF_NOTES.md).
+
+The queue (tools/hw_queue.sh) appends raw job output under `---` section
+headers; this script extracts the facts the decision rules need so the
+post-run triage is mechanical:
+
+  * official bench: platform must be "tpu", value vs the 3.1 it/s bar;
+  * packed/vselect validation: the bit-match line or its absence;
+  * bucketed-default bench: gap vs the pinned-shape number against the
+    predicted ~1/buckets overhead;
+  * sweeps/profile: best configs by it/s at matching AUC.
+
+Read-only; prints a summary, exits 1 if the non-negotiable (a TPU bench
+record) is missing.
+"""
+import json
+import os
+import re
+import sys
+
+LOG = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "docs", "HW_RESULTS_r5.log")
+
+
+def main():
+    if not os.path.exists(LOG):
+        print(f"{LOG} does not exist — the queue has not fired")
+        return 1
+    text = open(LOG).read()
+    benches = [json.loads(m.group(0)) for m in re.finditer(
+        r'\{"metric": "higgs1m[^\n]*\}', text)]
+    tpu = [b for b in benches if b.get("platform") == "tpu"]
+    print(f"bench records: {len(benches)} total, {len(tpu)} on TPU")
+    ok = bool(tpu)
+    pinned = None
+    for b in tpu:
+        tag = ("bucketed" if b.get("tpu_shape_buckets") else "pinned")
+        print(f"  [{tag}] {b['value']} it/s  vs_baseline={b['vs_baseline']}"
+              f"  auc={b.get('train_auc')}  compile={b.get('compile_s')}s")
+        if not b.get("tpu_shape_buckets"):
+            pinned = max(pinned or 0.0, float(b["value"]))
+    if pinned is not None:
+        bar = 3.1
+        print(f"  decision: pinned best {pinned} it/s — "
+              + ("CONFIRMS the round-3 3.14 record"
+                 if pinned >= bar else
+                 f"BELOW the {bar} bar; investigate before adopting "
+                 "staged candidates"))
+        bucketed = [float(b["value"]) for b in tpu
+                    if b.get("tpu_shape_buckets")]
+        if bucketed:
+            gap = 1.0 - max(bucketed) / pinned
+            print(f"  bucketed-default gap: {gap:.1%} "
+                  + ("(within the ~1/buckets=3% prediction — keep "
+                     "default 32)"
+                     if gap <= 0.03 else
+                     "(EXCEEDS the ~3% prediction — profile the split "
+                     "pipeline's extra dispatches or flip "
+                     "tpu_shape_buckets default to 0; PERF_NOTES rule)"))
+    if "TPU VALIDATION OK" in text:
+        print("packed/vselect: bit-match on hardware — keep defaults")
+    elif "MISMATCH ON TPU" in text:
+        print("packed/vselect: MISMATCH — flip tpu_pack_bins/"
+              "tpu_partition_impl defaults OFF (PERF_NOTES rule)")
+    else:
+        print("packed/vselect: no verdict in the log yet")
+    for section in ("round3 alpha sweep", "round4 partition sweep",
+                    "profile", "auc_parity full"):
+        present = f"--- {section}" in text
+        print(f"{section}: {'ran' if present else 'not reached'}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
