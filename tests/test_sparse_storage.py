@@ -195,3 +195,48 @@ class TestSparseDataParallel:
                            for _, nm, v, _ in bst.eval_train())["auc"]
                 assert auc > 0.85, auc
         assert roots["voting"] == roots["serial"], roots
+
+
+class TestSparseEdgeCompositions:
+    """Dense-vs-sparse f64 bit-parity under the features that interact
+    with the COO path's masking and bin-space assumptions."""
+
+    def _parity(self, X, y, extra=None, rounds=4, **data_kw):
+        models = {}
+        for tag, sp in (("dense", 0.0), ("sparse", 0.35)):
+            p = {**BASE, "deterministic": True, "tpu_sparse_threshold": sp,
+                 **(extra or {})}
+            ds = lgb.Dataset(X, label=y, params=p, **data_kw)
+            bst = lgb.train(p, ds, num_boost_round=rounds,
+                            keep_training_booster=True)
+            if tag == "sparse":
+                assert bst._driver.learner.params.has_sparse
+            models[tag] = bst.model_to_string().split("\nparameters:")[0]
+        assert models["sparse"] == models["dense"]
+
+    def test_categorical_sparse_column(self, _x64_reset):
+        """A mostly-zero CATEGORICAL column stored sparse: the bin-space
+        bitset decision and the cat split search must see the same
+        histograms either way."""
+        rng = np.random.default_rng(13)
+        n = 3000
+        X = np.zeros((n, 6))
+        X[:, :3] = rng.normal(size=(n, 3))
+        nz = rng.choice(n, size=200, replace=False)
+        X[nz, 4] = rng.integers(1, 6, size=200)  # sparse categorical
+        X[:, 5] = rng.integers(0, 4, size=n)     # dense categorical
+        y = ((X[:, 0] > 0) ^ (X[:, 4] == 2)).astype(np.float64)
+        self._parity(X, y, extra={"categorical_feature": "4,5"})
+
+    def test_bagging_masks_sparse_rows(self, _x64_reset):
+        """Bagging zeroes stats per row; the COO gather must respect the
+        mask and the zero-bin reconstruction must use MASKED totals."""
+        X, y = _sparse_problem()
+        self._parity(X, y, extra={"bagging_fraction": 0.6,
+                                  "bagging_freq": 1})
+
+    def test_row_weights(self, _x64_reset):
+        X, y = _sparse_problem()
+        rng = np.random.default_rng(5)
+        w = rng.random(len(y)) + 0.5
+        self._parity(X, y, weight=w)
