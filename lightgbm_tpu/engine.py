@@ -37,6 +37,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                   "num_tree", "num_trees", "num_round", "num_rounds",
                   "n_estimators"):
         if alias in params:
+            # params win over the argument, but never silently
+            # (reference engine.py:148 warns identically)
+            import warnings
+
+            warnings.warn(f"Found `{alias}` in params. Will use it "
+                          "instead of argument")
             num_boost_round = int(params.pop(alias))
     for alias in ("early_stopping_round", "early_stopping_rounds",
                   "early_stopping", "n_iter_no_change"):
