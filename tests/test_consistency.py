@@ -77,6 +77,28 @@ class TestGoldenConfigs:
             o = _final_metric(ours, metric)
             assert abs(r - o) < 0.01, f"{metric}: ref {r} vs ours {o}"
 
+    def test_binary_conf_sparse_storage(self, tmp_path):
+        """The COO train-time storage must preserve the math contract
+        against the REFERENCE oracle, not just against our own dense
+        path.  The example is Higgs-dense, so threshold 0.5 routes its 3
+        sparsest features (35-49% nonzero) through the COO pipeline; f32
+        histogram precision isolates the path's structure from hilo
+        cancellation in the zero-bin subtraction, which grows with the
+        subtracted mass and is why the threshold targets TRULY sparse
+        features in production."""
+        ref = _run_ref_cli("binary_classification", tmp_path,
+                           overrides=("num_trees=60",))
+        ours = _run_our_cli("binary_classification", tmp_path,
+                            overrides=("num_trees=60",
+                                       "tpu_sparse_threshold=0.5",
+                                       "tpu_hist_precision=f32",
+                                       "enable_bundle=false"))
+        assert "sparse storage:" in ours, "COO path never engaged"
+        for metric in ("binary_logloss", "auc"):
+            r = _final_metric(ref, metric)
+            o = _final_metric(ours, metric)
+            assert abs(r - o) < 0.01, f"{metric}: ref {r} vs ours {o}"
+
     def test_regression_conf(self, tmp_path):
         cap = ("num_trees=40",)
         ref = _run_ref_cli("regression", tmp_path, overrides=cap)
