@@ -194,10 +194,9 @@ class GBDT:
                     "pre_partition training does not support percentile-"
                     "renew or host-only objectives yet (their refits "
                     "need global order statistics)")
-            if self._goss_cfg is not None:
-                raise NotImplementedError(
-                    "pre_partition does not compose with GOSS (its "
-                    "top-k is over global gradient magnitudes)")
+            # GOSS composes: its threshold/sample run over LOCAL rows,
+            # which is the reference's distributed behavior too (each
+            # machine subsets its own data, goss.hpp Bagging override)
         self._maybe_make_train_step()
 
     def _maybe_make_train_step(self) -> None:

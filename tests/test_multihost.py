@@ -261,6 +261,22 @@ with open({outfile!r} + ".spjson", "w") as f:
                "model": m_sp}}, f)
 print(f"rank {{pid}}: sparse x pre_partition struct_ok={{sp_struct}} "
       f"val_delta={{sp_delta:.2e}}", flush=True)
+
+# ---- GOSS x pre_partition: the threshold/sample run over LOCAL rows
+# (the reference's distributed behavior — each machine subsets its own
+# data); every rank must still produce the identical global model
+p_go = dict(p_pt)
+p_go.update(boosting="goss", top_rate=0.3, other_rate=0.2,
+            learning_rate=1.0, num_iterations=3)
+ds_go = lgb.Dataset(X[pid * half_t:(pid + 1) * half_t],
+                    label=y[pid * half_t:(pid + 1) * half_t],
+                    params=p_go)
+bst_go = lgb.train(p_go, ds_go, num_boost_round=3)
+m_go = bst_go.model_to_string().split("\\nparameters:")[0]
+with open({outfile!r} + ".gossmodel", "w") as f:
+    f.write(m_go)
+print(f"rank {{pid}}: goss x pre_partition trained "
+      f"{{bst_go.num_trees()}} trees", flush=True)
 """
 
 
@@ -357,3 +373,8 @@ class TestTwoProcessRendezvous:
         assert spj0["struct_ok"], "sparse partitioned diverged from serial"
         assert spj0["val_delta"] < 1e-5, spj0
         assert "tree" in spj0["model"]
+        # GOSS x pre_partition: per-machine sampling, identical global
+        # model on both ranks
+        g0 = open(outs[0] + ".gossmodel").read()
+        g1 = open(outs[1] + ".gossmodel").read()
+        assert g0 == g1 and "tree" in g0
