@@ -187,13 +187,15 @@ class GBDT:
                 "CEGB penalties do not compose with GOSS yet")
         if getattr(self.learner, "_partitioned", False):
             # pre-partitioned rows: every statistic that must be GLOBAL
-            # either reduces (metrics, boost-from-average) or is gated
-            if self.objective is not None and (
-                    self.objective.needs_renew or self.objective.host_only):
+            # either reduces (metrics, boost-from-average), is local by
+            # the reference's own distributed semantics (GOSS and the
+            # per-query ranking lambdas — queries live whole on one
+            # rank), or is gated
+            if self.objective is not None and self.objective.needs_renew:
                 raise NotImplementedError(
                     "pre_partition training does not support percentile-"
-                    "renew or host-only objectives yet (their refits "
-                    "need global order statistics)")
+                    "renew objectives yet (their leaf refits need global "
+                    "order statistics)")
             # GOSS composes: its threshold/sample run over LOCAL rows,
             # which is the reference's distributed behavior too (each
             # machine subsets its own data, goss.hpp Bagging override)

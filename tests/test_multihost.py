@@ -277,6 +277,42 @@ with open({outfile!r} + ".gossmodel", "w") as f:
     f.write(m_go)
 print(f"rank {{pid}}: goss x pre_partition trained "
       f"{{bst_go.num_trees()}} trees", flush=True)
+
+# ---- lambdarank x pre_partition: per-query lambdas run over LOCAL
+# queries (queries live whole on one rank — the reference's distributed
+# ranking semantics), histograms aggregate globally, and the NDCG train
+# metric reduces across ranks.  Deterministic f64: structural parity
+# with serial full-data training, identical global NDCG.
+rngr = np.random.default_rng(44)
+Xr2 = rngr.normal(size=(2048, 10))
+rel2 = np.minimum((np.abs(Xr2[:, 0]) * 2).astype(np.int64), 3)
+qsz = 16
+p_lr = dict(p_pt)
+p_lr.update(objective="lambdarank", metric=["ndcg"], eval_at=[3],
+            num_iterations=2, label_gain=",".join(
+                str((1 << i) - 1) for i in range(4)))
+ds_lr = lgb.Dataset(Xr2[pid * half_t:(pid + 1) * half_t],
+                    label=rel2[pid * half_t:(pid + 1) * half_t],
+                    group=[qsz] * (half_t // qsz), params=p_lr)
+bst_lr = lgb.train(p_lr, ds_lr, num_boost_round=2,
+                   keep_training_booster=True)
+m_lr = bst_lr.model_to_string().split("\\nparameters:")[0]
+ndcg_lr = bst_lr.eval_train()[0][2]
+p_ls = {{k: v for k, v in p_lr.items()
+         if k not in ("machines", "num_machines", "pre_partition")}}
+p_ls["tree_learner"] = "serial"
+ds_ls = lgb.Dataset(Xr2, label=rel2, group=[qsz] * (2048 // qsz),
+                    reference=ds_lr, params=p_ls)
+bst_ls = lgb.train(p_ls, ds_ls, num_boost_round=2,
+                   keep_training_booster=True)
+m_ls = bst_ls.model_to_string().split("\\nparameters:")[0]
+ndcg_ls = bst_ls.eval_train()[0][2]
+lr_struct = split_lines(m_lr) == split_lines(m_ls)
+with open({outfile!r} + ".lrjson", "w") as f:
+    json.dump({{"struct_ok": bool(lr_struct),
+               "ndcg_pt": ndcg_lr, "ndcg_sr": ndcg_ls}}, f)
+print(f"rank {{pid}}: lambdarank x pre_partition struct_ok={{lr_struct}} "
+      f"ndcg={{ndcg_lr:.4f}}", flush=True)
 """
 
 
@@ -378,3 +414,11 @@ class TestTwoProcessRendezvous:
         g0 = open(outs[0] + ".gossmodel").read()
         g1 = open(outs[1] + ".gossmodel").read()
         assert g0 == g1 and "tree" in g0
+        # lambdarank x pre_partition: local per-query lambdas, global
+        # histograms and a globally-reduced NDCG — structural parity
+        # with serial full-data and matching metric
+        lr0 = json.load(open(outs[0] + ".lrjson"))
+        lr1 = json.load(open(outs[1] + ".lrjson"))
+        assert lr0 == lr1
+        assert lr0["struct_ok"], "lambdarank partitioned diverged"
+        assert lr0["ndcg_pt"] == pytest.approx(lr0["ndcg_sr"], abs=1e-6)
