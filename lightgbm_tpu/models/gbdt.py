@@ -185,17 +185,11 @@ class GBDT:
         if self.learner.params.has_cegb and self._goss_cfg is not None:
             raise NotImplementedError(
                 "CEGB penalties do not compose with GOSS yet")
-        if getattr(self.learner, "_partitioned", False):
-            # pre-partitioned rows: every statistic that must be GLOBAL
-            # either reduces (metrics, boost-from-average), is local by
-            # the reference's own distributed semantics (GOSS and the
-            # per-query ranking lambdas — queries live whole on one
-            # rank), or is gated
-            if self.objective is not None and self.objective.needs_renew:
-                raise NotImplementedError(
-                    "pre_partition training does not support percentile-"
-                    "renew objectives yet (their leaf refits need global "
-                    "order statistics)")
+        # pre-partitioned rows: every statistic that must be GLOBAL
+        # either reduces (metrics, boost-from-average, the renew leaf
+        # averaging in _renew_and_update) or is local by the reference's
+        # own distributed semantics (GOSS sampling, per-query ranking
+        # lambdas, per-machine percentile renew)
             # GOSS composes: its threshold/sample run over LOCAL rows,
             # which is the reference's distributed behavior too (each
             # machine subsets its own data, goss.hpp Bagging override)
@@ -504,6 +498,20 @@ class GBDT:
             mask_np = (np.ones(len(leaf_np), bool) if mask is None
                        else np.asarray(jax.device_get(mask)) > 0)
             self.objective.renew_tree_output(tree, score_np, leaf_np, mask_np)
+            if getattr(self.learner, "_partitioned", False):
+                # distributed renew averages each leaf's PER-MACHINE
+                # local-percentile output over the machines that had
+                # rows on that leaf — the reference's exact scheme
+                # (serial_tree_learner.cpp:865-891: GlobalSum of
+                # outputs / GlobalSum of nonzero-worker counts)
+                from ..parallel.metric_sync import sync_sums
+
+                L = tree.num_leaves
+                cnt = np.bincount(leaf_np[mask_np], minlength=L)[:L]
+                has = (cnt > 0).astype(np.float64)
+                outs = np.asarray(tree.leaf_value[:L], np.float64) * has
+                g = sync_sums(np.concatenate([outs, has]))
+                tree.leaf_value[:L] = g[:L] / np.maximum(g[L:], 1.0)
         tree.apply_shrinkage(self.shrinkage_rate)
         # train scores: leaf-partition gather (ScoreUpdater::AddScore train path)
         leaf_vals = jnp.asarray(tree.leaf_value[:tree.num_leaves]

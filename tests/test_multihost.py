@@ -313,6 +313,28 @@ with open({outfile!r} + ".lrjson", "w") as f:
                "ndcg_pt": ndcg_lr, "ndcg_sr": ndcg_ls}}, f)
 print(f"rank {{pid}}: lambdarank x pre_partition struct_ok={{lr_struct}} "
       f"ndcg={{ndcg_lr:.4f}}", flush=True)
+
+# ---- percentile-renew x pre_partition: each rank refits leaf outputs
+# from its LOCAL rows' percentiles; the driver then averages per leaf
+# over contributing machines (the reference's GlobalSum scheme,
+# serial_tree_learner.cpp:865-891).  Both ranks must agree bitwise and
+# the l1 train metric (globally reduced) must beat the constant model.
+p_q = dict(p_pt)
+p_q.update(objective="regression_l1", metric=["l1"], num_iterations=3,
+           learning_rate=0.5)
+yq = X[:, 0] * 2.0 + 0.3 * rng.normal(size=2048)
+ds_q = lgb.Dataset(X[pid * half_t:(pid + 1) * half_t],
+                   label=yq[pid * half_t:(pid + 1) * half_t],
+                   params=p_q)
+bst_q = lgb.train(p_q, ds_q, num_boost_round=3,
+                  keep_training_booster=True)
+m_q = bst_q.model_to_string().split("\\nparameters:")[0]
+l1_q = bst_q.eval_train()[0][2]
+base_l1 = float(np.abs(yq - np.median(yq)).mean())
+with open({outfile!r} + ".qjson", "w") as f:
+    json.dump({{"model": m_q, "l1": l1_q, "base_l1": base_l1}}, f)
+print(f"rank {{pid}}: renew x pre_partition l1={{l1_q:.4f}} "
+      f"(const model {{base_l1:.4f}})", flush=True)
 """
 
 
@@ -422,3 +444,10 @@ class TestTwoProcessRendezvous:
         assert lr0 == lr1
         assert lr0["struct_ok"], "lambdarank partitioned diverged"
         assert lr0["ndcg_pt"] == pytest.approx(lr0["ndcg_sr"], abs=1e-6)
+        # percentile-renew x pre_partition: bitwise rank agreement (the
+        # leaf averaging is a collective) and the refit actually learns
+        q0 = json.load(open(outs[0] + ".qjson"))
+        q1 = json.load(open(outs[1] + ".qjson"))
+        assert q0 == q1, "renew ranks diverged"
+        assert "tree" in q0["model"]
+        assert q0["l1"] < 0.7 * q0["base_l1"], q0  # 3 trees at lr 0.5
