@@ -21,14 +21,16 @@ from lightgbm_tpu.parallel import metric_sync
 
 
 class _Meta:
-    def __init__(self, label, weight=None, query_boundaries=None):
+    def __init__(self, label, weight=None, query_boundaries=None,
+                 qweights=None):
         self.label = np.asarray(label, np.float64)
         self.weight = weight
         self.query_boundaries = query_boundaries
         self.init_score = None
+        self._qw = qweights
 
     def query_weights(self):
-        return None
+        return self._qw
 
 
 class _FakeWorld:
@@ -209,6 +211,38 @@ class TestMergedMetricsEqualFull:
         score = rng.normal(size=(nc, self.n))
         _merged_vs_full(monkeypatch, "auc_mu", Config({"num_class": nc,
                                 "objective": "multiclass"}), label, score)
+
+    def test_rank_metrics_weighted_queries(self, monkeypatch):
+        """Per-query WEIGHTS make the reduction a genuine weighted sum
+        (results and sum_query_weights both reduce)."""
+        rng = np.random.default_rng(17)
+        n = self.n
+        qsz = 10
+        qb = list(range(0, n + 1, qsz))
+        nq = len(qb) - 1
+        label = rng.integers(0, 4, size=n).astype(np.float64)
+        score = rng.normal(size=n)
+        qw = rng.random(nq) + 0.5
+        cfg = Config()
+
+        def _eval(lbl, sc, qbound, qws):
+            m = create_metric("ndcg", cfg)
+            m.init(_Meta(lbl, None, qbound, qws), len(lbl))
+            return m.eval_all(np.asarray(sc)[None, :], None)
+
+        full = _eval(label, score, qb, qw)
+        h = n // 2
+        hq = nq // 2
+        world = _FakeWorld(monkeypatch)
+        world.record(lambda: _eval(label[h:], score[h:],
+                                   [q - h for q in qb if q >= h],
+                                   qw[hq:]))
+        merged = world.replay(lambda: _eval(label[:h], score[:h],
+                                            [q for q in qb if q <= h],
+                                            qw[:hq]))
+        for (nf, vf), (nm, vm) in zip(full, merged):
+            assert nf == nm
+            assert vm == pytest.approx(vf, rel=1e-12)
 
     def test_rank_metrics(self, monkeypatch):
         # 40 queries of 10 docs: the halfway split lands on a query
