@@ -263,20 +263,12 @@ class TrainingData:
         if reference is not None:
             self._adopt_reference_mappers(reference)
         else:
-            from .distributed_binning import (config_wants_distributed,
-                                              ensure_distributed)
-
-            ensure_distributed(config)
-            if config_wants_distributed(config):
-                # a host silently densifying while its peers shard
-                # features would change sample semantics mid-collective;
-                # reject loudly until the sharded path learns CSC
-                raise NotImplementedError(
-                    "sparse input with distributed (pre_partition) bin "
-                    "finding is not supported yet; densify or load from "
-                    "file")
-            self._find_mappers(sp, config, categorical_features or [],
-                               forced_bins or {})
+            # sparse ingest joins the collective bin-finding path
+            # directly: the feature-sharded mapper search slices CSC
+            # columns and samples stored values exactly like the local
+            # find (local_payload -> _find_mappers is sparse-aware)
+            self._find_mappers_maybe_distributed(
+                sp, config, categorical_features or [], forced_bins or {})
 
         from ..utils import timer
 

@@ -238,7 +238,10 @@ ys_full = (Xs_full[:, 0] + 2.0 * Xs_full[:, 5] > 0).astype(np.float64)
 p_sp = dict(p_pt)
 p_sp.update(enable_bundle=False, tpu_sparse_threshold=0.2,
             num_iterations=2)
-ds_sp = lgb.Dataset(Xs_full[pid * half_t:(pid + 1) * half_t],
+# scipy ingest composes with the distributed (feature-sharded) bin
+# finding: the CSC columns ride the same collective as dense input
+import scipy.sparse as sps
+ds_sp = lgb.Dataset(sps.csr_matrix(Xs_full[pid * half_t:(pid + 1) * half_t]),
                     label=ys_full[pid * half_t:(pid + 1) * half_t],
                     params=p_sp)
 bst_sp = lgb.train(p_sp, ds_sp, num_boost_round=2,

@@ -133,16 +133,17 @@ class TestSparseTrainPredict:
         out = bst.predict(sp[:, :6], predict_disable_shape_check=True)
         assert out.shape == (200,)
 
-    def test_distributed_binning_rejected_loudly(self):
-        sp = _random_sparse(100, 4, 0.2)
+    def test_distributed_binning_degrades_to_local(self):
+        """Sparse ingest joins the collective bin-finding path; in a
+        single-process group it degrades to the plain local find and
+        must produce the same mappers as dense input."""
+        sp = _random_sparse(300, 4, 0.2)
         cfg = Config({"pre_partition": True, "num_machines": 2})
-        from lightgbm_tpu.io.distributed_binning import \
-            config_wants_distributed
-
-        if not config_wants_distributed(cfg):
-            pytest.skip("config does not trigger the distributed path")
-        with pytest.raises(NotImplementedError, match="sparse"):
-            TrainingData.from_sparse(sp, config=cfg)
+        td_sp = TrainingData.from_sparse(sp, config=cfg)
+        td_de = TrainingData.from_matrix(np.asarray(sp.todense()),
+                                         config=Config({}))
+        for a, b in zip(td_sp.mappers, td_de.mappers):
+            assert a.to_dict() == b.to_dict()
 
 
 @pytest.mark.slow
