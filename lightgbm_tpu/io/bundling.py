@@ -49,6 +49,16 @@ class BundlePlan(NamedTuple):
         return all(len(g) == 1 for g in self.groups)
 
 
+def _stride_sample(bins: np.ndarray, quota: int) -> np.ndarray:
+    """Deterministic strided row sample, shared by the local and
+    multihost finders so their plan-parity holds."""
+    n = bins.shape[0]
+    if n > quota:
+        step = n // quota
+        return bins[::step][:quota]
+    return bins
+
+
 def find_bundles(bins: np.ndarray, num_bin: np.ndarray,
                  most_freq_is_zero: np.ndarray, max_conflict_rate: float,
                  max_bundle_bins: int, sample_rows: int = 100_000
@@ -59,11 +69,7 @@ def find_bundles(bins: np.ndarray, num_bin: np.ndarray,
     counted on a row sample like the reference's sampled FindGroups.
     """
     n, F = bins.shape
-    if n > sample_rows:
-        step = n // sample_rows
-        sample = bins[::step][:sample_rows]
-    else:
-        sample = bins
+    sample = _stride_sample(bins, sample_rows)
     ns = sample.shape[0]
     budget_total = max_conflict_rate * ns
 
@@ -126,6 +132,55 @@ def find_bundles(bins: np.ndarray, num_bin: np.ndarray,
     return BundlePlan(groups=final, bundle_idx=bundle_idx,
                       bin_offset=bin_offset, needs_fix=needs_fix,
                       num_bin=g_bins)
+
+
+def find_bundles_multihost(local_bins: np.ndarray, num_bin: np.ndarray,
+                           local_zero_frac: np.ndarray, local_rows: int,
+                           sparse_threshold: float,
+                           max_conflict_rate: float,
+                           max_bundle_bins: int,
+                           sample_rows: int = 100_000) -> BundlePlan:
+    """Bundling plan agreed across a jax.distributed process group.
+
+    EVERYTHING plan-determining reduces globally inside this function —
+    callers pass only LOCAL statistics (zero fractions and row count
+    from this rank's rows), so no half of the agreement contract can be
+    forgotten at a call site.  The candidate filter comes from the
+    globally weighted zero fractions; the greedy's per-bundle occupancy
+    is a UNION over sample rows, so a consistent plan cannot come from
+    locally-found plans or pairwise count sums: every rank contributes
+    an equal quota of its local rows, the samples allgather (ragged,
+    uint16 transport — never demoted), and the IDENTICAL greedy runs on
+    the identical global sample everywhere.  Single-process groups
+    degrade to the local find.
+    """
+    import jax
+
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return find_bundles(local_bins, num_bin,
+                            local_zero_frac >= sparse_threshold,
+                            max_conflict_rate, max_bundle_bins,
+                            sample_rows=sample_rows)
+    from jax.experimental import multihost_utils
+
+    zf = np.asarray(multihost_utils.process_allgather(np.concatenate(
+        [np.asarray(local_zero_frac, np.float64) * local_rows,
+         [local_rows]]).astype(np.float32)))
+    tot = zf.sum(axis=0)
+    mfz = tot[:-1] / max(tot[-1], 1) >= sparse_threshold
+    samp = _stride_sample(local_bins, max(1, sample_rows // nproc))
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray([samp.shape[0]], np.int32)))[:, 0]
+    mx = int(lens.max())
+    buf = np.zeros((mx, local_bins.shape[1]), np.uint16)
+    buf[:samp.shape[0]] = samp
+    g = np.asarray(multihost_utils.process_allgather(buf))  # [P, mx, F]
+    sample_global = np.concatenate(
+        [g[p, :int(lens[p])] for p in range(nproc)])
+    return find_bundles(sample_global, num_bin, mfz,
+                        max_conflict_rate, max_bundle_bins,
+                        sample_rows=sample_global.shape[0])
 
 
 def apply_bundles(bins: np.ndarray, plan: BundlePlan) -> np.ndarray:

@@ -163,23 +163,31 @@ class TPUTreeLearner:
         # dataset.cpp:91-263): sparse zero-default features share columns,
         # shrinking the histogram matrix's feature axis ----
         plan = None
-        if self._partitioned and bool(config.enable_bundle):
-            # each rank would find bundles from only ITS rows — divergent
-            # plans change num_columns/meta per rank and corrupt the
-            # global array construction; skip deterministically on every
-            # rank rather than gamble on agreement
-            Log.info("EFB bundling skipped under pre_partition (plans "
-                     "would be found from per-rank local rows)")
+        if (bool(config.enable_bundle) and strategy not in ("serial", "data")
+                and self.num_features > 1):
+            # voting/feature learners train unbundled (the grower's
+            # bundle expansion composes with serial/data only) — say so
+            # instead of silently dropping the requested EFB
+            Log.info(f"EFB bundling is inactive under tree_learner="
+                     f"{strategy}; training on plain columns")
         if (bool(config.enable_bundle) and strategy in ("serial", "data")
-                and not self._partitioned
                 and not forced and self.num_features > 1):
-            from ..io.bundling import find_bundles
+            from ..io.bundling import find_bundles, find_bundles_multihost
 
             zero_frac = (train_data.bins == 0).mean(axis=0)
-            mfz = zero_frac >= float(config.sparse_threshold)
-            cand_plan = find_bundles(
-                train_data.bins, meta_np["num_bin"], mfz,
-                float(config.max_conflict_rate), B)
+            if self._partitioned:
+                # every rank must greedy-group the SAME plan or the
+                # global arrays' num_columns/meta diverge; all plan-
+                # determining statistics reduce inside the helper
+                cand_plan = find_bundles_multihost(
+                    train_data.bins, meta_np["num_bin"], zero_frac, n,
+                    float(config.sparse_threshold),
+                    float(config.max_conflict_rate), B)
+            else:
+                cand_plan = find_bundles(
+                    train_data.bins, meta_np["num_bin"],
+                    zero_frac >= float(config.sparse_threshold),
+                    float(config.max_conflict_rate), B)
             if not cand_plan.is_trivial:
                 plan = cand_plan
                 B = max(B, int(plan.num_bin.max()))

@@ -338,6 +338,46 @@ with open({outfile!r} + ".qjson", "w") as f:
     json.dump({{"model": m_q, "l1": l1_q, "base_l1": base_l1}}, f)
 print(f"rank {{pid}}: renew x pre_partition l1={{l1_q:.4f}} "
       f"(const model {{base_l1:.4f}})", flush=True)
+
+# ---- EFB x pre_partition: the bundling plan is found from a globally
+# allgathered row sample (and globally reduced zero fractions), so
+# every rank greedy-groups identically; with the full data inside the
+# sample quota the plan equals the serial full-data one -> structural
+# parity in deterministic f64
+rngb = np.random.default_rng(55)
+Xb = np.zeros((2048, 10))
+Xb[:, :2] = rngb.normal(size=(2048, 2))
+owner = rngb.integers(2, 10, size=2048)
+for f in range(2, 10):
+    rows_f = np.flatnonzero(owner == f)
+    # strictly positive stored values keep 0.0 in bin 0 (the
+    # bundling heuristic keys on the bin-0 default) and a handful of
+    # DISTINCT levels keeps each feature's bin count small enough for
+    # several features to share one bundle's bin budget
+    Xb[rows_f, f] = rngb.integers(1, 6, size=len(rows_f)).astype(float)
+yb = ((Xb[:, 0] > 0) ^ (owner % 2 == 0)).astype(np.float64)
+p_b = dict(p_pt)
+# max_bin=64: at the worker default of 16 a bundle cannot hold two
+# 16-bin features (budget is max_bundle_bins-1), so no plan would form
+p_b.update(enable_bundle=True, num_iterations=2, max_bin=64)
+ds_b = lgb.Dataset(Xb[pid * half_t:(pid + 1) * half_t],
+                   label=yb[pid * half_t:(pid + 1) * half_t], params=p_b)
+bst_b = lgb.train(p_b, ds_b, num_boost_round=2,
+                  keep_training_booster=True)
+assert bst_b._driver.learner.bundle_plan is not None, "EFB did not engage"
+m_b = bst_b.model_to_string().split("\\nparameters:")[0]
+p_bs = {{k: v for k, v in p_b.items()
+         if k not in ("machines", "num_machines", "pre_partition")}}
+p_bs["tree_learner"] = "serial"
+ds_bs = lgb.Dataset(Xb, label=yb, reference=ds_b, params=p_bs)
+bst_bs = lgb.train(p_bs, ds_bs, num_boost_round=2,
+                   keep_training_booster=True)
+m_bs = bst_bs.model_to_string().split("\\nparameters:")[0]
+b_struct = split_lines(m_b) == split_lines(m_bs)
+with open({outfile!r} + ".efbjson", "w") as f:
+    json.dump({{"struct_ok": bool(b_struct), "model": m_b}}, f)
+print(f"rank {{pid}}: efb x pre_partition struct_ok={{b_struct}}",
+      flush=True)
 """
 
 
@@ -454,3 +494,10 @@ class TestTwoProcessRendezvous:
         assert q0 == q1, "renew ranks diverged"
         assert "tree" in q0["model"]
         assert q0["l1"] < 0.7 * q0["base_l1"], q0  # 3 trees at lr 0.5
+        # EFB x pre_partition: globally-agreed plan, identical ranks,
+        # structural parity with the serial full-data plan
+        e0 = json.load(open(outs[0] + ".efbjson"))
+        e1 = json.load(open(outs[1] + ".efbjson"))
+        assert e0 == e1, "EFB ranks diverged"
+        assert e0["struct_ok"], "EFB partitioned diverged from serial"
+        assert "tree" in e0["model"]
