@@ -76,6 +76,8 @@ print('platform-stamp:', probe_default_backend(timeout_s=110, retries=0))" \
   timeout 2400 python -u tools/perf_probe.py round4 >> "$LOG" 2>&1
   echo "--- auc_parity full $(date -u)" >> "$LOG"; stamp
   timeout 10800 python -u tools/auc_parity.py >> "$LOG" 2>&1
+  echo "--- decision triage $(date -u)" >> "$LOG"
+  timeout 300 python -u tools/hw_decide.py >> "$LOG" 2>&1
   echo DONE >> "$LOG"
   break
 done
