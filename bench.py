@@ -107,8 +107,40 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     train_s = time.time() - t0
     iters_per_sec = bench_iters / train_s
 
-    # sanity: the model must actually learn
+    # prediction throughput: full-forest raw predict rows/s on the path
+    # the configuration would actually use (device bin-space traversal on
+    # TPU, native walker otherwise)
+    bst.predict(X_eval, raw_score=True)  # warm (pack + compile)
+    t_pred = time.time()
+    for _ in range(3):
+        bst.predict(X_eval, raw_score=True)
+    predict_rows_per_sec = 3 * n_eval / (time.time() - t_pred)
+    # sanity AUC BEFORE the eval-overhead block: its extra update() calls
+    # would otherwise make the recorded train_auc describe a model
+    # trained more than bench_iters iterations
     pred = bst.predict(X_eval)
+    # per-iteration valid-eval overhead the training loop pays when early
+    # stopping is on: LIVE update+eval iterations (per-tree valid scoring
+    # + materialize + metric fetch) minus the plain training it/s above —
+    # timing eval_valid() alone after training would miss the incremental
+    # device tree-scoring this path exists to speed up
+    vd = ds.create_valid(X_eval, label=y[:n_eval])
+    bst.add_valid(vd, "valid")
+    bst.update()
+    bst.eval_valid()  # warm (replay + compile)
+    host_sync(bst._driver.train_scores.scores)
+    eval_iters = 3
+    t_eval = time.time()
+    for _ in range(eval_iters):
+        bst.update()
+        bst.eval_valid()
+    host_sync(bst._driver.train_scores.scores)
+    eval_ms_per_iter = max(
+        (time.time() - t_eval) / eval_iters - train_s / bench_iters,
+        0.0) * 1e3
+
+    # sanity: the model must actually learn (pred captured above, at
+    # exactly bench_iters + warmup iterations)
     from lightgbm_tpu.models.metrics import AUCMetric
     from lightgbm_tpu.config import Config
     m = AUCMetric(Config())
@@ -132,6 +164,8 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "vs_baseline": (round(iters_per_sec / BASELINE_ITERS_PER_SEC, 3)
                         if comparable else 0.0),
         "train_auc": round(float(auc), 4),
+        "predict_rows_per_sec": round(predict_rows_per_sec, 0),
+        "eval_ms_per_iter": round(eval_ms_per_iter, 1),
         "bench_iters": bench_iters,
         "data_gen_s": round(data_s, 1),
         "binning_s": round(bin_s, 1),

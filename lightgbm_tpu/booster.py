@@ -185,10 +185,63 @@ class Booster:
         data.construct()
         return self._driver.eval_for_data(data._inner, name, feval=feval)
 
+    def _device_predict_requested(self, kwargs,
+                                  for_dataset: bool = False) -> bool:
+        """Route this predict through the jitted bin-space forest
+        predictor?  `device='tpu'` (kwarg, or the stored device_type)
+        selects it, modulated by tpu_predict_device: `true` forces it,
+        `false` pins the native walker, `auto` (default) uses it only
+        when the default jax backend is an actual TPU — on CPU hosts the
+        native OMP walker stays faster for one-shot predicts.
+        Pre-binned Dataset input (`for_dataset`) has NO native
+        alternative, so auto mode accepts it on every backend."""
+        # raw param reads (alias-aware), not a full Config build: this
+        # runs on EVERY predict call and only needs two values
+        from .config import parse_tristate
+
+        raw_dev = self.params.get("device_type",
+                                  self.params.get("device", "tpu"))
+        dev = str(kwargs.get("device", raw_dev)).strip().lower()
+        if dev != "tpu":
+            return False
+        mode = parse_tristate(self.params.get("tpu_predict_device", "auto"))
+        if mode == "true":
+            return True
+        if mode == "false":
+            return False
+        if for_dataset:
+            return True
+        import jax
+
+        try:
+            return jax.default_backend() == "tpu"
+        except Exception:
+            return False
+
     def predict(self, data, num_iteration: Optional[int] = None,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
-        from .basic import _to_2d_array
+        from .basic import Dataset, _to_2d_array
+        if isinstance(data, Dataset):
+            # pre-binned device predict: a constructed Dataset sharing the
+            # training mappers skips the host binning pass entirely
+            if pred_leaf or pred_contrib or kwargs.get("pred_early_stop"):
+                raise ValueError("pred_leaf/pred_contrib/pred_early_stop "
+                                 "need raw data, not a Dataset (they run "
+                                 "on the native walker)")
+            if not self._device_predict_requested(kwargs, for_dataset=True):
+                raise TypeError(
+                    "Cannot use Dataset instance for prediction on the "
+                    "native path; pass raw data, or enable the device "
+                    "predictor (device='tpu' with tpu_predict_device "
+                    "not 'false')")
+            data.construct()
+            if num_iteration is None:
+                num_iteration = (self.best_iteration
+                                 if self.best_iteration >= 0 else -1)
+            return self._driver.predict_binned_device(
+                data._inner, num_iteration=num_iteration,
+                raw_score=raw_score)
         if isinstance(data, str):
             from .io.parser import load_text_file
             cfg = Config(self.params)
@@ -226,7 +279,8 @@ class Booster:
             pred_early_stop=bool(kwargs.get("pred_early_stop", False)),
             pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq", 10)),
             pred_early_stop_margin=float(
-                kwargs.get("pred_early_stop_margin", 10.0)))
+                kwargs.get("pred_early_stop_margin", 10.0)),
+            device_predict=self._device_predict_requested(kwargs))
 
     def _check_predict_shape(self, ncols: int, kwargs) -> None:
         """Raise on a predict feature-count mismatch unless
@@ -259,6 +313,7 @@ class Booster:
             self._check_predict_shape(data.shape[1], kwargs)
         if num_iteration is None:
             num_iteration = self.best_iteration if self.best_iteration >= 0 else -1
+        device_predict = self._device_predict_requested(kwargs)
         Xr = data.tocsr()
         if Xr.shape[1] > n_feat:
             # drop extra columns while still sparse (O(nnz)) — densifying
@@ -279,7 +334,8 @@ class Booster:
                 pred_early_stop_freq=int(kwargs.get("pred_early_stop_freq",
                                                     10)),
                 pred_early_stop_margin=float(
-                    kwargs.get("pred_early_stop_margin", 10.0))))
+                    kwargs.get("pred_early_stop_margin", 10.0)),
+                device_predict=device_predict))
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 
     def model_from_string(self, model_str: str, verbose: bool = True
@@ -528,6 +584,9 @@ class Booster:
         # training data at save time (the oracle rejects a model file
         # without feature_infos)
         drv.loaded_params["feature_infos"] = drv._feature_infos()
+        # keep the bin mappers + per-feature metadata: device='tpu'
+        # predict stays available on the freed (predict-only) booster
+        drv.snapshot_predict_context()
         self._train_set = None
         drv.train_data = None
         drv.learner = None

@@ -231,6 +231,22 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # data, or voting, and enable_bundle=false (EFB is the alternative
     # mitigation).
     "tpu_sparse_threshold": ("float", 0.0, ()),
+    # device-resident forest prediction (ops/predict.py): jitted bin-space
+    # traversal for valid-score updates, score replay, and device='tpu'
+    # Booster.predict.
+    #   auto  - score replay goes on-device above tpu_predict_min_rows;
+    #           Booster.predict uses the device path only when the default
+    #           jax backend is a TPU (the native OMP walker wins on CPU)
+    #   true  - always use the device predictor where structurally possible
+    #   false - host/native predictors everywhere (parity oracle path)
+    "tpu_predict_device": ("str", "auto", ()),
+    # rows per device-predict chunk: bounds the [rows, F] bin block and the
+    # [k, rows] score block shipped per kernel launch; full-size chunks are
+    # padded so multi-chunk predicts reuse ONE compiled program
+    "tpu_predict_chunk_rows": ("int", 65536, ()),
+    # below this row count the auto mode keeps score replay on the host
+    # walker (jit dispatch + compile dominate tiny valid sets)
+    "tpu_predict_min_rows": ("int", 4096, ()),
 }
 
 _ALIAS: Dict[str, str] = {}
@@ -249,6 +265,18 @@ def _parse_bool(v: Any) -> bool:
     if s in ("false", "0", "f", "no", "off", "-"):
         return False
     raise ValueError(f"cannot parse bool from {v!r}")
+
+
+def parse_tristate(v: Any) -> str:
+    """'true' / 'false' / 'auto' from a bool-ish or mode string — the ONE
+    spelling authority for tri-state params like tpu_predict_device, so
+    predict routing and training-time replay can never disagree on a
+    value.  Unrecognized spellings raise: a typo silently mapped to
+    'auto' would run the opposite of the requested configuration."""
+    s = str(v).strip().lower()
+    if s == "auto":
+        return "auto"
+    return "true" if _parse_bool(s) else "false"
 
 
 def _coerce(typ: str, v: Any) -> Any:

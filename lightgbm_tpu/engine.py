@@ -142,7 +142,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if not keep_training_booster:
         # reference engine.py: the returned booster becomes predict-only
         # (training data freed); pass keep_training_booster=True to keep
-        # updating it
+        # updating it.  free_dataset snapshots the bin mappers first, so
+        # the returned booster keeps the device='tpu' predict path
+        # (jitted bin-space forest traversal) without its training data.
         booster.free_dataset()
     return booster
 

@@ -150,9 +150,11 @@ def find_bundles_multihost(local_bins: np.ndarray, num_bin: np.ndarray,
     is a UNION over sample rows, so a consistent plan cannot come from
     locally-found plans or pairwise count sums: every rank contributes
     an equal quota of its local rows, the samples allgather (ragged,
-    uint16 transport — never demoted), and the IDENTICAL greedy runs on
-    the identical global sample everywhere.  Single-process groups
-    degrade to the local find.
+    integer transport — never demoted; uint16 normally, widened to
+    uint32 when any feature's bin ids exceed the uint16 range so the
+    gather cannot silently truncate them), and the IDENTICAL greedy
+    runs on the identical global sample everywhere.  Single-process
+    groups degrade to the local find.
     """
     import jax
 
@@ -173,7 +175,14 @@ def find_bundles_multihost(local_bins: np.ndarray, num_bin: np.ndarray,
     lens = np.asarray(multihost_utils.process_allgather(
         np.asarray([samp.shape[0]], np.int32)))[:, 0]
     mx = int(lens.max())
-    buf = np.zeros((mx, local_bins.shape[1]), np.uint16)
+    # transport dtype must hold every bin id: uint16 truncates silently
+    # past 65535, so wide-bin features ride uint32 instead (num_bin is
+    # plan input on every rank, so all ranks agree on the widening)
+    transport = (np.uint32
+                 if int(np.asarray(num_bin).max(initial=0))
+                 > int(np.iinfo(np.uint16).max)
+                 else np.uint16)
+    buf = np.zeros((mx, local_bins.shape[1]), transport)
     buf[:samp.shape[0]] = samp
     g = np.asarray(multihost_utils.process_allgather(buf))  # [P, mx, F]
     sample_global = np.concatenate(

@@ -22,6 +22,9 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.bin_mapper import BinMapper, MissingType
 from ..io.dataset import TrainingData
+from ..ops.predict import (PackedForest, feature_meta_dev, device_tables,
+                           forest_class_scores, forest_leaf_values,
+                           pack_trees)
 from ..utils import timer
 from .learner import TPUTreeLearner
 from .metrics import Metric, create_metrics
@@ -263,15 +266,21 @@ class GBDT:
         self.train_scores = _ScoreState(self.num_tree_per_iteration,
                                         data.num_data,
                                         data.metadata.init_score)
-        K = max(self.num_tree_per_iteration, 1)
-        for k in range(K):
-            trees = [t for i, t in enumerate(self.models)
-                     if i % K == k and t.num_leaves >= 1]
-            if trees:
-                self.train_scores.add(k, jnp.asarray(
-                    (self._replay_scale() * self._score_trees_binned(
-                        data.bins, trees, [1.0] * len(trees)))
-                    .astype(np.float32)))
+        # replay the whole model onto the new rows: one device pass over
+        # the packed forest (class = position % K), host walker fallback
+        if not self._replay_scores_device(self.train_scores, data,
+                                          self.models,
+                                          scale=self._replay_scale(),
+                                          cache_bins=False):
+            K = max(self.num_tree_per_iteration, 1)
+            for k in range(K):
+                trees = [t for i, t in enumerate(self.models)
+                         if i % K == k and t.num_leaves >= 1]
+                if trees:
+                    self.train_scores.add(k, jnp.asarray(
+                        (self._replay_scale() * self._score_trees_binned(
+                            data.bins, trees, [1.0] * len(trees)))
+                        .astype(np.float32)))
         # stale per-dataset state: bagging mask and the fused step close
         # over the old row count (reference ResetTrainingData rebuilds its
         # bagging buffers too)
@@ -300,13 +309,16 @@ class GBDT:
         self.valid_scores.append(_ScoreState(
             self.num_tree_per_iteration, data.num_data,
             data.metadata.init_score))
-        # replay existing model onto the new valid set
-        meta = self.learner.meta_np
-        for i, tree in enumerate(self.models):
-            k = i % self.num_tree_per_iteration
-            self.valid_scores[-1].add(
-                k, jnp.asarray(_predict_binned(tree, data.bins, meta)
-                               .astype(np.float32)))
+        # replay existing model onto the new valid set: one packed-forest
+        # device pass when eligible, host walker otherwise
+        if not self._replay_scores_device(self.valid_scores[-1], data,
+                                          self.models):
+            meta = self.learner.meta_np
+            for i, tree in enumerate(self.models):
+                k = i % self.num_tree_per_iteration
+                self.valid_scores[-1].add(
+                    k, jnp.asarray(_predict_binned(tree, data.bins, meta)
+                                   .astype(np.float32)))
 
     # ------------------------------------------------------------------
     def _boost_from_average(self, class_id: int) -> float:
@@ -468,10 +480,17 @@ class GBDT:
             tree = self.learner.build_tree_from_records(np.asarray(rec))
             if tree.num_leaves > 1:
                 tree.apply_shrinkage(self.shrinkage_rate)
+                # valid scores stay device-resident: the new tree's packed
+                # table traverses all rows on device (zero device_get per
+                # tree — the async train pipeline never stalls on eval)
+                pc: Dict = {}
                 for vs, vd in zip(self.valid_scores, self.valid_sets):
-                    vs.add(class_id, jnp.asarray(
-                        self._score_trees_binned(vd.bins, [tree], [1.0])
-                        .astype(np.float32)))
+                    delta = self._tree_delta_device(vd, tree, pack_cache=pc)
+                    if delta is None:
+                        delta = jnp.asarray(
+                            self._score_trees_binned(vd.bins, [tree], [1.0])
+                            .astype(np.float32))
+                    vs.add(class_id, delta)
                 if abs(init) > K_EPSILON:
                     tree.add_bias(init)
                 self.models.append(tree)
@@ -517,27 +536,42 @@ class GBDT:
         leaf_vals = jnp.asarray(tree.leaf_value[:tree.num_leaves]
                                 .astype(np.float32))
         self.train_scores.add(class_id, leaf_vals[leaf_ids])
-        # valid scores: binned traversal
+        # valid scores: binned traversal (device kernel, host fallback)
+        pc: Dict = {}
         for vs, vd in zip(self.valid_scores, self.valid_sets):
-            vs.add(class_id, jnp.asarray(
-                self._score_trees_binned(vd.bins, [tree], [1.0])
-                .astype(np.float32)))
+            delta = self._tree_delta_device(vd, tree, pack_cache=pc)
+            if delta is None:
+                delta = jnp.asarray(
+                    self._score_trees_binned(vd.bins, [tree], [1.0])
+                    .astype(np.float32))
+            vs.add(class_id, delta)
 
     def rollback_one_iter(self) -> None:
         self._materialize()
         self._invalidate_tables()
         if self.iter_ <= 0:
             return
+        train_bins = None
+        if (self.train_data.bins is not None and self.learner is not None
+                and self._device_replay_ok(self.train_data.num_data)):
+            # one upload shared by every popped tree this call
+            train_bins = self._device_bins_for(self.train_data, cache=False)
         for k in range(self.num_tree_per_iteration):
             tree = self.models.pop()
             k_id = self.num_tree_per_iteration - 1 - k
-            self.train_scores.add(k_id, jnp.asarray(
-                self._score_trees_binned(self.train_data.bins, [tree],
-                                         [-1.0]).astype(np.float32)))
+            pc: Dict = {}
+            delta = self._tree_delta_device(self.train_data, tree,
+                                            bins_dev=train_bins,
+                                            pack_cache=pc)
+            self.train_scores.add(k_id, -delta if delta is not None
+                                  else jnp.asarray(self._score_trees_binned(
+                                      self.train_data.bins, [tree], [-1.0])
+                                      .astype(np.float32)))
             for vs, vd in zip(self.valid_scores, self.valid_sets):
-                vs.add(k_id, jnp.asarray(
-                    self._score_trees_binned(vd.bins, [tree], [-1.0])
-                    .astype(np.float32)))
+                delta = self._tree_delta_device(vd, tree, pack_cache=pc)
+                vs.add(k_id, -delta if delta is not None
+                       else jnp.asarray(self._score_trees_binned(
+                           vd.bins, [tree], [-1.0]).astype(np.float32)))
         self.iter_ -= 1
 
     # ------------------------------------------------------------------
@@ -614,10 +648,13 @@ class GBDT:
         # per-feature bin metadata comes from the shared mappers, so the
         # eval dataset's own arrays equal the training ones
         meta = data.feature_arrays()
-        for i, tree in enumerate(self.models):
-            k = i % self.num_tree_per_iteration
-            state.add(k, jnp.asarray(
-                _predict_binned(tree, data.bins, meta).astype(np.float32)))
+        if not self._replay_scores_device(state, data, self.models,
+                                          meta=meta, cache_bins=False):
+            for i, tree in enumerate(self.models):
+                k = i % self.num_tree_per_iteration
+                state.add(k, jnp.asarray(
+                    _predict_binned(tree, data.bins, meta)
+                    .astype(np.float32)))
         scores = state.numpy()
         out = []
         for m in ms:
@@ -636,6 +673,7 @@ class GBDT:
         set_leaf_value) must invalidate explicitly.  (The binned walker
         packs its tables per call and has no cache to go stale.)"""
         self._ft_key = None
+        self._pf = None  # device forest tables share the contract
 
     def _forest_tables(self):
         """Concatenated node tables for the native predictor, cached per
@@ -673,6 +711,269 @@ class GBDT:
             acc += sc * _predict_binned(tree, bins, meta)
         return acc
 
+    # ------------------------------------------------------------------
+    # device-resident prediction (ops/predict.py): jitted bin-space
+    # traversal for valid-score updates, score replay, and device='tpu'
+    # predict.  The host walker (_predict_binned/_score_trees_binned)
+    # stays as the parity oracle and the tiny-data fallback.
+    # ------------------------------------------------------------------
+    def _device_replay_ok(self, n_rows: int) -> bool:
+        """Should score replay for `n_rows` rows run on device?"""
+        if self.config is None:
+            return False
+        from ..config import parse_tristate
+
+        mode = parse_tristate(self.config.tpu_predict_device)
+        if mode == "false":
+            return False
+        if mode == "true":
+            return True
+        # auto: jit dispatch + compile dominate tiny sets; the host
+        # walker stays cheaper there
+        return n_rows >= int(self.config.tpu_predict_min_rows)
+
+    def _meta_dev(self):
+        """Device (num_bin, default_bin, missing_type) triple, cached per
+        learner rebuild (init/reset_training_data/reset_config swap
+        meta_np wholesale, never mutate it)."""
+        meta = self.learner.meta_np
+        # identity held via a strong ref (never a bare id(): a freed dict
+        # and its successor can share an address)
+        if getattr(self, "_meta_dev_for", None) is not meta:
+            self._meta_dev_cache = feature_meta_dev(meta)
+            self._meta_dev_for = meta
+        return self._meta_dev_cache
+
+    def _device_bins_for(self, data: TrainingData, cache: bool):
+        """Device int32 bins for a dataset.  cache=True keeps them on the
+        dataset (valid sets: reused every iteration); cache=False ships a
+        one-shot copy for replay over the TRAINING bins, which the
+        learner already holds in its own layout — caching a second
+        full-size copy there would pin 4x-uint8 HBM for one pass."""
+        if cache:
+            return data.device_bins()
+        if data._device_bins is not None:  # already resident: reuse
+            return data._device_bins
+        return jnp.asarray(data.bins.astype(np.int32))
+
+    def _tree_delta_device(self, data: TrainingData, tree: Tree,
+                           bins_dev=None, pack_cache: Optional[Dict] = None):
+        """Device [n] f32 leaf values of ONE tree over a binned dataset;
+        None -> caller uses the host walker.  The per-iteration valid-
+        score path: packs just the new tree (never the forest) and does
+        zero device_get.  `pack_cache` (a per-tree dict) reuses the
+        packed device tables across multiple valid sets."""
+        if data.bins is None or tree.num_leaves < 1 \
+                or self.learner is None \
+                or not self._device_replay_ok(data.num_data):
+            return None
+        if bins_dev is None:
+            bins_dev = data.device_bins()
+        if pack_cache is not None and "packed" in pack_cache:
+            tables_dev, depth = pack_cache["packed"]
+        else:
+            # pinned leaf width + pow2-padded bitset pool: every tree of
+            # a training run packs to ONE table shape, so the jitted
+            # kernel compiles a handful of programs instead of one per
+            # tree shape
+            tables, depth = pack_trees(
+                [tree], leaf_width=int(self.config.num_leaves),
+                pad_cat_words=True)
+            tables_dev = device_tables(tables)
+            if pack_cache is not None:
+                pack_cache["packed"] = (tables_dev, depth)
+        vals = forest_leaf_values(tables_dev, bins_dev, self._meta_dev(),
+                                  depth)
+        return vals[0]
+
+    def _replay_scores_device(self, state: "_ScoreState", data: TrainingData,
+                              trees, scale: float = 1.0, meta=None,
+                              cache_bins: bool = True) -> bool:
+        """Batch-replay `trees` (class = position % k) into a score state
+        on device; False -> caller must use the host walker."""
+        if not trees or data.bins is None \
+                or not self._device_replay_ok(data.num_data):
+            return False
+        if meta is not None:
+            md = feature_meta_dev(meta)
+        elif self.learner is not None:
+            md = self._meta_dev()
+        else:
+            return False
+        bins_dev = self._device_bins_for(data, cache_bins)
+        k = max(self.num_tree_per_iteration, 1)
+        # bound the kernel's [T, rows] node-state intermediates: trees in
+        # blocks of ~128 (multiples of k so position % k stays the global
+        # class id), rows in device-sliced chunks — a 2000-tree forest on
+        # a multi-million-row set must not become one O(T*n) launch
+        t_block = k * max(128 // k, 1)
+        chunk = max(int(self.config.tpu_predict_chunk_rows), 1024)
+        n = data.num_data
+        for s in range(0, len(trees), t_block):
+            tables, depth = pack_trees(list(trees[s:s + t_block]))
+            tables_dev = device_tables(tables)
+            if n > chunk:
+                parts = []
+                for lo in range(0, n, chunk):
+                    hi = min(lo + chunk, n)
+                    sub = bins_dev[lo:hi]
+                    if hi - lo < chunk:
+                        # pad the tail so every launch reuses ONE program
+                        sub = jnp.concatenate(
+                            [sub, jnp.zeros((chunk - (hi - lo),
+                                             sub.shape[1]), sub.dtype)])
+                    parts.append(forest_class_scores(
+                        tables_dev, sub, md, k, depth, scale)[:, :hi - lo])
+                scores = jnp.concatenate(parts, axis=1)
+            else:
+                scores = forest_class_scores(tables_dev, bins_dev, md, k,
+                                             depth, scale)
+            for kk in range(k):
+                state.add(kk, scores[kk])
+        return True
+
+    def snapshot_predict_context(self) -> None:
+        """Capture the bin mappers + per-feature metadata so device
+        predict survives free_dataset (the training data itself is
+        dropped; the mappers are small host objects)."""
+        td = (self.train_data if self.train_data is not None
+              else self.learner.td if self.learner is not None else None)
+        if td is not None:
+            self._pred_ctx = _PredictContext(td)
+
+    def _pred_context(self) -> Optional["_PredictContext"]:
+        td = (self.train_data if self.train_data is not None
+              else self.learner.td if self.learner is not None else None)
+        if td is not None:
+            # cache per dataset object (strong ref, compared by identity:
+            # mappers/meta only change when the dataset itself is swapped
+            # by reset_training_data, which replaces the ref here too)
+            if getattr(self, "_pred_ctx_for", None) is not td:
+                self._pred_ctx_live = _PredictContext(td)
+                self._pred_ctx_for = td
+            return self._pred_ctx_live
+        return getattr(self, "_pred_ctx", None)
+
+    def _packed_forest(self) -> PackedForest:
+        """Appendable device forest tables, cached across predict calls;
+        append-only between invalidations (truncation or a reordering
+        rebuilds, in-place leaf mutation goes through
+        _invalidate_tables)."""
+        pf = getattr(self, "_pf", None)
+        if pf is not None and pf._count > 0 and (
+                pf._count > len(self.models)
+                or id(self.models[pf._count - 1]) != self._pf_last):
+            pf = None  # truncated or reordered: rebuild from scratch
+        if pf is None:
+            pf = PackedForest()
+            self._pf = pf
+        pf.sync(self.models)
+        self._pf_last = id(self.models[pf._count - 1]) if pf._count else 0
+        return pf
+
+    def _model_subset(self, num_iteration: int) -> Tuple[int, float]:
+        """(tree count, RF-averaging divisor) for a num_iteration subset
+        — the ONE place the slicing + average_output rules live, shared
+        by every predict path."""
+        k = max(self.num_tree_per_iteration, 1)
+        total = len(self.models)
+        if num_iteration is not None and num_iteration > 0:
+            total = min(total, num_iteration * k)
+        div = (float(max(total // k, 1))
+               if self.average_output and total > 0 else 1.0)
+        return total, div
+
+    def predict_raw_device(self, X: np.ndarray, num_iteration: int = -1
+                           ) -> Optional[np.ndarray]:
+        """[k, n] raw scores via the jitted bin-space predictor: bin the
+        raw rows with the training mappers, then traverse the packed
+        forest on device in fixed row chunks.  None when the booster has
+        no binning context (file-loaded model) or no trees."""
+        self._materialize()
+        ctx = self._pred_context()
+        if ctx is None or not self.models:
+            return None
+        total, div = self._model_subset(num_iteration)
+        if total == 0:
+            return None
+        pf = self._packed_forest()
+        out = self._chunked_device_scores(
+            pf.device(total),  # num_iteration subset = table slice
+            ctx.meta_dev(), self.num_tree_per_iteration, pf.depth,
+            X.shape[0], lambda lo, hi: ctx.bin_rows(X[lo:hi]))
+        return out / div
+
+    def _chunked_device_scores(self, tables, meta_dev, k: int, depth: int,
+                               n: int, get_bins) -> np.ndarray:
+        """[k, n] f64 host scores from the packed device forest, chunked
+        over rows: one bounded [chunk, F] int32 upload per launch, tail
+        chunks padded so every launch reuses ONE compiled program.
+        `get_bins(lo, hi)` supplies host bins per chunk."""
+        chunk = max(int(self.config.tpu_predict_chunk_rows)
+                    if self.config is not None else 65536, 1024)
+        out = np.zeros((k, n), np.float64)
+        for lo in range(0, max(n, 1), chunk):
+            hi = min(lo + chunk, n)
+            rows = hi - lo
+            bins = get_bins(lo, hi)
+            # pad every launch to a bucketed row count (full chunks for
+            # multi-chunk predicts, pow2 below that) so repeated predicts
+            # of varying batch sizes reuse a handful of compiled programs
+            # instead of one per distinct n
+            target = chunk if n > chunk else \
+                min(chunk, max(1024, 1 << (max(rows, 1) - 1).bit_length()))
+            if rows < target:
+                bins = np.concatenate(
+                    [bins, np.zeros((target - rows, bins.shape[1]),
+                                    np.int32)])
+            scores = forest_class_scores(tables, jnp.asarray(bins),
+                                         meta_dev, k, depth)
+            out[:, lo:hi] = np.asarray(
+                jax.device_get(scores), np.float64)[:, :rows]
+        return out
+
+    def predict_binned_device(self, data: TrainingData,
+                              num_iteration: int = -1,
+                              raw_score: bool = False) -> np.ndarray:
+        """Device predict on an ALREADY-BINNED dataset sharing the
+        training mappers (the pre-binned half of the device='tpu'
+        predict path — no host binning pass at all)."""
+        self._materialize()
+        ctx = self._pred_context()
+        if ctx is None:
+            raise ValueError("device predict on binned data needs a booster "
+                             "with a training context (file-loaded boosters "
+                             "carry no bin mappers)")
+        if data.bins is None:
+            raise ValueError("dataset has no binned representation")
+        # strict identity: the mapper list survives free_dataset inside
+        # the snapshot, so unlike eval_for_data there is no freed-booster
+        # gap to bridge — a looser check would silently traverse foreign
+        # bin space
+        if data.mappers is not ctx.mappers:
+            raise ValueError("predict data must be created with "
+                             "reference=the training dataset")
+        k = self.num_tree_per_iteration
+        total, div = self._model_subset(num_iteration)
+        n = data.num_data
+        raw = np.zeros((k, n), np.float64)
+        if total > 0:
+            pf = self._packed_forest()
+            # chunk straight off the HOST bins: one bounded [chunk, F]
+            # upload per launch, nothing cached on the caller's dataset
+            raw = self._chunked_device_scores(
+                pf.device(total), ctx.meta_dev(), k, pf.depth, n,
+                lambda lo, hi: np.ascontiguousarray(
+                    data.bins[lo:hi].astype(np.int32))) / div
+        return self._finish_predict(raw, raw_score)
+
+    def _finish_predict(self, raw: np.ndarray, raw_score: bool) -> np.ndarray:
+        if not raw_score and self.objective is not None:
+            raw = self.objective.convert_output(raw)
+        if raw.shape[0] == 1:
+            return raw[0]
+        return raw.T  # [n, k] multiclass
+
     def predict_raw(self, X: np.ndarray, num_iteration: int = -1,
                     early_stop_freq: int = 0,
                     early_stop_margin: float = 0.0) -> np.ndarray:
@@ -687,9 +988,7 @@ class GBDT:
         if X.ndim == 1:
             X = X[None, :]
         k = self.num_tree_per_iteration
-        total = len(self.models)
-        if num_iteration is not None and num_iteration > 0:
-            total = min(total, num_iteration * k)
+        total, rf_div = self._model_subset(num_iteration)
         # native OpenMP walker over all trees at once (the per-tree Python
         # loop dominated wall-clock at hundreds of trees); numpy fallback
         # when the native lib is unavailable
@@ -714,15 +1013,15 @@ class GBDT:
                         top2 = np.sort(out, axis=0)[-2:]
                         margin = top2[1] - top2[0]
                     active &= margin < early_stop_margin
-        if self.average_output and total > 0:
-            out /= max(total // k, 1)  # RF averaging (gbdt_prediction.cpp:55)
+        out /= rf_div  # RF averaging (gbdt_prediction.cpp:55)
         return out
 
     def predict(self, X: np.ndarray, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, pred_early_stop: bool = False,
                 pred_early_stop_freq: int = 10,
-                pred_early_stop_margin: float = 10.0) -> np.ndarray:
+                pred_early_stop_margin: float = 10.0,
+                device_predict: bool = False) -> np.ndarray:
         self._materialize()
         X = np.asarray(X, np.float64)
         if X.ndim == 1:
@@ -748,17 +1047,19 @@ class GBDT:
             if k == 1:
                 return out[:, 0, :]                      # [n, F+1]
             return out.reshape(X.shape[0], -1)           # [n, k*(F+1)]
-        raw = self.predict_raw(
-            X, num_iteration,
-            early_stop_freq=(int(pred_early_stop_freq)
-                             if pred_early_stop else 0),
-            early_stop_margin=float(pred_early_stop_margin))
-        if not raw_score and self.objective is not None:
-            conv = self.objective.convert_output(raw)
-            raw = conv
-        if raw.shape[0] == 1:
-            return raw[0]
-        return raw.T  # [n, k] multiclass
+        raw = None
+        if device_predict and not pred_early_stop:
+            # device bin-space traversal; prediction early stopping keeps
+            # the native walker (its per-row margin bailout is inherently
+            # row-sequential)
+            raw = self.predict_raw_device(X, num_iteration)
+        if raw is None:
+            raw = self.predict_raw(
+                X, num_iteration,
+                early_stop_freq=(int(pred_early_stop_freq)
+                                 if pred_early_stop else 0),
+                early_stop_margin=float(pred_early_stop_margin))
+        return self._finish_predict(raw, raw_score)
 
     # ------------------------------------------------------------------
     def refit(self, X: np.ndarray, label: np.ndarray,
@@ -843,6 +1144,10 @@ class GBDT:
 
     def shuffle_models(self, start: int = 0, end: int = -1) -> None:
         self._materialize()
+        # reordering invalidates both node-table caches: their staleness
+        # keys sample only (count, last tree), which a shuffle can leave
+        # untouched
+        self._invalidate_tables()
         if end < 0:
             end = len(self.models)
         rng = np.random.default_rng(0)
@@ -1035,16 +1340,21 @@ class GBDT:
                 self._rebind_tree(tree)
         self.models = other.models + self.models
         self.num_init_iteration = other.current_iteration()
-        # replay loaded trees onto the score states
+        # replay loaded trees onto the score states (device batch pass
+        # per dataset when eligible, host walker otherwise)
+        datasets = [(self.train_scores, self.train_data, False)] + \
+            [(vs, vd, True) for vs, vd in zip(self.valid_scores,
+                                              self.valid_sets)]
         meta = self.learner.meta_np
-        for i, tree in enumerate(other.models):
-            kk = i % self.num_tree_per_iteration
-            self.train_scores.add(kk, jnp.asarray(
-                _predict_binned(tree, self.train_data.bins, meta)
-                .astype(np.float32)))
-            for vs, vd in zip(self.valid_scores, self.valid_sets):
-                vs.add(kk, jnp.asarray(
-                    _predict_binned(tree, vd.bins, meta).astype(np.float32)))
+        for state, data, cache in datasets:
+            if self._replay_scores_device(state, data, other.models,
+                                          cache_bins=cache):
+                continue
+            for i, tree in enumerate(other.models):
+                kk = i % self.num_tree_per_iteration
+                state.add(kk, jnp.asarray(
+                    _predict_binned(tree, data.bins, meta)
+                    .astype(np.float32)))
 
     def dump_model(self, num_iteration: int = -1, start_iteration: int = 0) -> Dict:
         self._materialize()
@@ -1115,6 +1425,34 @@ class GBDT:
             "tree_structure": node(0) if tree.num_leaves > 1 else {
                 "leaf_value": float(tree.leaf_value[0])},
         }
+
+
+class _PredictContext:
+    """The slice of a TrainingData needed to bin + device-predict raw
+    rows: mappers, used-column map, per-feature bin metadata.  Snapshot
+    by free_dataset so trained boosters keep the device path."""
+
+    def __init__(self, td: TrainingData):
+        self.mappers = td.mappers
+        self.used_feature_idx = list(td.used_feature_idx)
+        self.meta = td.feature_arrays()
+        self._meta_dev = None
+
+    def meta_dev(self):
+        """Device (num_bin, default_bin, missing_type) triple, uploaded
+        once per context."""
+        if self._meta_dev is None:
+            from ..ops.predict import feature_meta_dev
+
+            self._meta_dev = feature_meta_dev(self.meta)
+        return self._meta_dev
+
+    def bin_rows(self, X: np.ndarray) -> np.ndarray:
+        """[n, F_used] int32 bins from raw rows, training-mapper space."""
+        bins = np.zeros((X.shape[0], len(self.used_feature_idx)), np.int32)
+        for j, col in enumerate(self.used_feature_idx):
+            bins[:, j] = self.mappers[col].values_to_bins(X[:, col])
+        return bins
 
 
 class _FevalData:

@@ -119,16 +119,22 @@ class GammaDevianceMetric(Metric):
     name = "gamma_deviance"
 
     def eval(self, score, objective):
-        from ..parallel.metric_sync import sync_sums
-
         pred = _convert(score[0], objective)
         tmp = self.label / (pred + 1e-9)
         loss = tmp - _safe_log(tmp) - 1.0
         if self.weight is not None:
             loss = loss * self.weight
-        # a global SUM (no denominator), so the cross-rank reduction is
-        # the one-element sum of the local sums
-        return float(sync_sums([loss.sum()])[0] * 2.0)
+        total = float(loss.sum())
+        # a global SUM (no denominator): unlike averaged losses, a sum is
+        # NOT replication-safe — adding the local sums of P replicated
+        # ranks reports P x the true value.  Reduce across ranks only
+        # when each rank holds a distinct row shard (pre_partition);
+        # replicated ranks already hold the full sum locally.
+        if bool(self.config.pre_partition):
+            from ..parallel.metric_sync import sync_sums
+
+            total = float(sync_sums([total])[0])
+        return total * 2.0
 
 
 @register_metric

@@ -1,2 +1,4 @@
 from .histogram import build_histogram, pack_stats
+from .predict import (PackedForest, forest_class_scores, forest_leaf_values,
+                      pack_trees)
 from .split import find_best_split_all_features

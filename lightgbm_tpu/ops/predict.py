@@ -1,0 +1,289 @@
+"""Device-resident forest prediction: jitted bin-space traversal.
+
+The training hot loop is asynchronous and device-bound, but every
+materialized tree used to score validation data through a host-numpy
+walk (`gbdt._predict_binned`) — one synchronous O(depth) full-data pass
+per tree per valid set, stalling the pipeline whenever `valid_sets` or
+early stopping is on.  This module keeps prediction on the accelerator:
+
+* `pack_trees` flattens host `Tree` models into dense per-tree node
+  tables (split feature / threshold-in-bin / decision type / children,
+  leaf values, flattened categorical bitset words),
+* `forest_leaf_values` traverses all rows x all trees with one
+  `lax.fori_loop` over depth — the bin-space analog of
+  `NumericalDecisionInner` / `CategoricalDecisionInner` (reference
+  tree.h:252-318), including NaN/zero missing routing,
+* `forest_class_scores` reduces the [T, n] leaf values into [k, n]
+  per-class raw scores (tree i belongs to class i % k),
+* `PackedForest` appends newly materialized trees into amortized host
+  buffers so the full-forest table is never re-packed per iteration.
+
+Traversal is EXACT per tree: leaf values are gathered as f32 and match
+the host walker leaf-for-leaf (`gbdt._predict_binned` stays as the
+parity oracle and the tiny-data CPU fallback).  Compile keys are kept
+small by bucketing the depth trip count to the next power of two and by
+the callers' fixed row chunking.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# table keys that are [T, num_internal_nodes] int32
+_NODE_KEYS = ("split_feature", "threshold", "decision_type",
+              "left_child", "right_child", "cat_start", "cat_width")
+
+
+def _depth_bucket(depth: int) -> int:
+    """Round the fori_loop trip count up to a power of two so growing
+    trees reuse a handful of compiled programs instead of one per depth."""
+    d = max(int(depth), 1)
+    return 1 << (d - 1).bit_length()
+
+
+def pack_trees(trees: Sequence, leaf_width: int = 0,
+               pad_cat_words: bool = False
+               ) -> Tuple[Dict[str, np.ndarray], int]:
+    """Flatten host Tree models into dense [T, ...] node tables.
+
+    Returns (tables, max_depth).  Leaves stay encoded as `~leaf_idx` in
+    the child columns; a constant tree starts at node `~0` so the
+    traversal loop is a no-op for it.  Categorical nodes carry a
+    (start, width) window into the shared `cat_words` bitset pool; word
+    0 of the pool is a permanent zero so non-categorical nodes can point
+    at it harmlessly.
+
+    `leaf_width` pins the leaf axis (callers on a jit hot path pass the
+    config num_leaves so every tree packs to ONE shape);
+    `pad_cat_words` pads the bitset pool to the next power of two for
+    the same reason — zero words are inert, the per-node windows ignore
+    them.
+    """
+    T = len(trees)
+    L = max([t.num_leaves for t in trees] + [max(int(leaf_width), 1)])
+    ni_w = max(L - 1, 1)
+    sf = np.zeros((T, ni_w), np.int32)
+    thr = np.zeros((T, ni_w), np.int32)
+    dt = np.zeros((T, ni_w), np.int32)
+    lc = np.zeros((T, ni_w), np.int32)
+    rc = np.zeros((T, ni_w), np.int32)
+    cs = np.zeros((T, ni_w), np.int32)
+    cw = np.zeros((T, ni_w), np.int32)
+    lv = np.zeros((T, L), np.float32)
+    init = np.zeros(T, np.int32)
+    words: List[np.ndarray] = [np.zeros(1, np.uint32)]
+    woff = 1
+    depth = 1
+    for ti, t in enumerate(trees):
+        nl = int(t.num_leaves)
+        lv[ti, :nl] = t.leaf_value[:nl]
+        ni = nl - 1
+        if ni <= 0:
+            init[ti] = -1  # ~0: already at leaf 0
+            continue
+        sf[ti, :ni] = t.split_feature_inner[:ni]
+        thr[ti, :ni] = t.threshold_in_bin[:ni]
+        dt[ti, :ni] = t.decision_type[:ni].astype(np.int32) & 0xF
+        lc[ti, :ni] = t.left_child[:ni]
+        rc[ti, :ni] = t.right_child[:ni]
+        depth = max(depth, int(t.max_depth()))
+        if t.num_cat > 0:
+            cb = np.asarray(t.cat_boundaries_inner, np.int64)
+            tw = np.asarray(t.cat_threshold_inner, np.uint32)
+            is_cat = (dt[ti, :ni] & 1) != 0
+            ci = np.clip(thr[ti, :ni], 0, max(len(cb) - 2, 0))
+            cs[ti, :ni] = np.where(is_cat, woff + cb[ci], 0)
+            cw[ti, :ni] = np.where(is_cat, cb[ci + 1] - cb[ci], 0)
+            if len(tw):
+                words.append(tw)
+                woff += len(tw)
+    pool = np.concatenate(words)
+    if pad_cat_words:
+        target = 1 << (len(pool) - 1).bit_length()
+        if len(pool) < target:
+            pool = np.concatenate(
+                [pool, np.zeros(target - len(pool), np.uint32)])
+    tables = {"split_feature": sf, "threshold": thr, "decision_type": dt,
+              "left_child": lc, "right_child": rc, "cat_start": cs,
+              "cat_width": cw, "leaf_value": lv, "init_node": init,
+              "cat_words": pool}
+    return tables, depth
+
+
+def device_tables(tables: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
+    """Host tables -> device arrays (one transfer per array)."""
+    return {k: jnp.asarray(v) for k, v in tables.items()}
+
+
+@partial(jax.jit, static_argnames=("depth", "has_cat"))
+def _leaf_values_kernel(tables, bins, num_bin, default_bin, missing_type,
+                        depth: int, has_cat: bool):
+    """[T, n] f32 leaf values: every tree walked over every row.
+
+    bins is [n, F] int32 (the TrainingData.device_bins layout); the
+    features-major transpose lives INSIDE the jit so XLA fuses it into
+    the per-node feature gather instead of materializing a copy per
+    call.  The loop body mirrors gbdt._predict_binned exactly: missing
+    routing first, numerical compare, categorical bitset override, then
+    the child step — inactive lanes (node < 0, already at a leaf) keep
+    their state.
+    """
+    bins_t = bins.T                                        # [F, n]
+    T = tables["leaf_value"].shape[0]
+    node0 = jnp.broadcast_to(tables["init_node"][:, None],
+                             (T, bins_t.shape[1]))
+
+    def body(_, node):
+        nid = jnp.maximum(node, 0)
+        f = jnp.take_along_axis(tables["split_feature"], nid, axis=1)
+        fbin = jnp.take_along_axis(bins_t, f, axis=0)          # [T, n]
+        mt = jnp.take(missing_type, f)
+        is_missing = jnp.where(
+            mt == 2, fbin == jnp.take(num_bin, f) - 1,
+            (mt == 1) & (fbin == jnp.take(default_bin, f)))
+        dt = jnp.take_along_axis(tables["decision_type"], nid, axis=1)
+        thr = jnp.take_along_axis(tables["threshold"], nid, axis=1)
+        go_left = jnp.where(is_missing, (dt & 2) != 0, fbin <= thr)
+        if has_cat:
+            cs = jnp.take_along_axis(tables["cat_start"], nid, axis=1)
+            width = jnp.take_along_axis(tables["cat_width"], nid, axis=1)
+            word_idx = fbin // 32
+            word = jnp.take(
+                tables["cat_words"],
+                jnp.clip(cs + word_idx, 0, tables["cat_words"].shape[0] - 1))
+            bit = (word >> (fbin % 32).astype(jnp.uint32)) & jnp.uint32(1)
+            go_cat = (word_idx < width) & (bit == jnp.uint32(1))
+            go_left = jnp.where((dt & 1) != 0, go_cat, go_left)
+        nxt = jnp.where(go_left,
+                        jnp.take_along_axis(tables["left_child"], nid, axis=1),
+                        jnp.take_along_axis(tables["right_child"], nid,
+                                            axis=1))
+        return jnp.where(node >= 0, nxt, node)
+
+    node = lax.fori_loop(0, depth, body, node0)
+    leaf = jnp.where(node < 0, ~node, 0)
+    return jnp.take_along_axis(tables["leaf_value"], leaf, axis=1)
+
+
+@partial(jax.jit, static_argnames=("depth", "has_cat", "k"))
+def _class_scores_kernel(tables, bins, num_bin, default_bin, missing_type,
+                         scale, depth: int, has_cat: bool, k: int):
+    """[k, n] f32 per-class raw scores: tree i adds to class i % k."""
+    vals = _leaf_values_kernel(tables, bins, num_bin, default_bin,
+                               missing_type, depth, has_cat) * scale
+    T = vals.shape[0]
+    if k == 1:
+        return vals.sum(axis=0, keepdims=True)
+    cid = jnp.arange(T, dtype=jnp.int32) % k
+    return jax.ops.segment_sum(vals, cid, num_segments=k)
+
+
+def feature_meta_dev(meta) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Device (num_bin, default_bin, missing_type) per-feature triple."""
+    return (jnp.asarray(np.asarray(meta["num_bin"], np.int32)),
+            jnp.asarray(np.asarray(meta["default_bin"], np.int32)),
+            jnp.asarray(np.asarray(meta["missing_type"], np.int32)))
+
+
+def forest_leaf_values(tables_dev: Dict[str, jnp.ndarray], bins_dev,
+                       meta_dev, depth: int) -> jnp.ndarray:
+    """[T, n] device leaf values.  `bins_dev` is [n, F] int32 (the
+    TrainingData.device_bins layout); `meta_dev` the
+    (num_bin, default_bin, missing_type) triple from `feature_meta_dev`."""
+    nb, db, mt = meta_dev
+    has_cat = int(tables_dev["cat_words"].shape[0]) > 1
+    return _leaf_values_kernel(tables_dev, bins_dev, nb, db, mt,
+                               _depth_bucket(depth), has_cat)
+
+
+def forest_class_scores(tables_dev: Dict[str, jnp.ndarray], bins_dev,
+                        meta_dev, k: int, depth: int,
+                        scale: float = 1.0) -> jnp.ndarray:
+    """[k, n] device per-class raw scores (tree i -> class i % k)."""
+    nb, db, mt = meta_dev
+    has_cat = int(tables_dev["cat_words"].shape[0]) > 1
+    return _class_scores_kernel(tables_dev, bins_dev, nb, db, mt,
+                                jnp.float32(scale), _depth_bucket(depth),
+                                has_cat, int(k))
+
+
+class PackedForest:
+    """Appendable forest tables: amortized host buffers + device cache.
+
+    `sync(models)` packs only the trees added since the last call into
+    capacity-doubling host buffers (never the whole forest), then
+    refreshes the device copy iff the tree count changed.  Growing leaf
+    width (a wider tree than any seen) forces one full repack — rare,
+    since `num_leaves` is fixed per config.  In-place leaf mutation
+    (DART shrinkage, refit, set_leaf_value) must drop the instance —
+    same invalidation contract as the native ForestTables cache.
+    """
+
+    def __init__(self):
+        self._count = 0
+        self._cap = 0
+        self._host: Optional[Dict[str, np.ndarray]] = None
+        self._depth = 1
+        self._dev: Optional[Dict[str, jnp.ndarray]] = None
+        self._dev_count = -1
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def sync(self, models: Sequence) -> int:
+        """Append models[self._count:]; returns the packed tree count."""
+        new = models[self._count:]
+        if not new:
+            return self._count
+        width = max([t.num_leaves for t in new] + [1])
+        if self._host is None or width > self._host["leaf_value"].shape[1]:
+            # first pack, or a wider tree arrived: rebuild at full width
+            tables, depth = pack_trees(list(models))
+            self._host = tables
+            self._depth = max(self._depth, depth)
+            self._cap = len(models)
+            self._count = len(models)
+        else:
+            tables, depth = pack_trees(
+                list(new), leaf_width=self._host["leaf_value"].shape[1])
+            self._depth = max(self._depth, depth)
+            need = self._count + len(new)
+            if need > self._cap:
+                self._cap = max(need, 2 * self._cap)
+                for key in _NODE_KEYS + ("leaf_value", "init_node"):
+                    old = self._host[key]
+                    grown = np.zeros((self._cap,) + old.shape[1:], old.dtype)
+                    grown[:self._count] = old[:self._count]
+                    self._host[key] = grown
+            base = int(self._host["cat_words"].shape[0])
+            for key in _NODE_KEYS + ("leaf_value", "init_node"):
+                self._host[key][self._count:need] = tables[key]
+            # rebase the new trees' bitset windows past the existing pool
+            # (pack_trees numbered them from its own word 1)
+            if tables["cat_words"].shape[0] > 1:
+                cs = self._host["cat_start"][self._count:need]
+                cs[cs > 0] += base - 1
+                self._host["cat_words"] = np.concatenate(
+                    [self._host["cat_words"], tables["cat_words"][1:]])
+            self._count = need
+        return self._count
+
+    def device(self, num_trees: int = -1) -> Dict[str, jnp.ndarray]:
+        """Device tables for the first `num_trees` trees (-1 = all)."""
+        if self._dev_count != self._count:
+            host = {k: (v[:self._count] if k != "cat_words" else v)
+                    for k, v in self._host.items()}
+            self._dev = device_tables(host)
+            self._dev_count = self._count
+        if num_trees < 0 or num_trees >= self._count:
+            return self._dev
+        return {k: (v[:num_trees] if k != "cat_words" else v)
+                for k, v in self._dev.items()}

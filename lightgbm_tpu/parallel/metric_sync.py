@@ -21,9 +21,15 @@ their SUFFICIENT STATISTICS across processes before the final division:
 Every helper is an identity when ``jax.process_count() == 1`` — the
 single-process hot path pays one attribute read.  The reduction is also
 SAFE in the all-data-on-all-machines ingest mode (`put_global`'s
-replicated-host contract): duplicating a full sample P times changes
-neither a weighted average (numerator and denominator both scale by P)
-nor a pairwise/positional rank statistic, so ranks agree either way.
+replicated-host contract) for RATIO statistics: duplicating a full
+sample P times changes neither a weighted average (numerator and
+denominator both scale by P) nor a pairwise/positional rank statistic,
+so ranks agree either way.  SUM-type metrics (no denominator — e.g.
+``gamma_deviance``'s 2x summed deviance) are the exception: summing the
+local sums of P replicated ranks reports P x the true value, so they
+must reduce only under ``pre_partition`` (distinct row shards) and skip
+the cross-rank reduction in replicated mode, where each rank's local
+sum already IS the global sum.
 
 Collective discipline: these are process-level collectives — every rank
 must call them in the same order.  The engine's eval cadence is

@@ -104,3 +104,56 @@ class TestBundledTraining:
                         keep_training_booster=True)
         lrn = bst._driver.learner
         assert lrn.num_columns == lrn.num_features
+
+
+class TestMultihostTransport:
+    """find_bundles_multihost ships bin-id samples across ranks; the
+    transport dtype must hold every bin id (uint16 silently truncates
+    past 65535)."""
+
+    def _fake_world(self, monkeypatch, seen):
+        import jax
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+        def gather(a):
+            seen.append(np.array(a, copy=True))
+            return np.stack([a, a])
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", gather)
+
+    def test_wide_bins_ride_uint32(self, monkeypatch):
+        from lightgbm_tpu.io.bundling import find_bundles_multihost
+
+        rng = np.random.default_rng(0)
+        n, F = 64, 3
+        num_bin = np.array([70_000, 5, 5], np.int64)
+        bins = np.zeros((n, F), np.int32)
+        bins[:, 0] = rng.integers(60_000, 70_000, size=n)  # > uint16 range
+        bins[:, 1] = rng.integers(0, 5, size=n)
+        seen = []
+        self._fake_world(monkeypatch, seen)
+        find_bundles_multihost(bins, num_bin, np.zeros(F), n,
+                               sparse_threshold=0.9, max_conflict_rate=0.0,
+                               max_bundle_bins=256)
+        samples = [a for a in seen if a.ndim == 2]
+        assert samples, "no sample payload was gathered"
+        assert samples[0].dtype == np.uint32
+        assert int(samples[0][:, 0].max()) >= 60_000, \
+            "bin ids were truncated in transport"
+
+    def test_narrow_bins_keep_uint16(self, monkeypatch):
+        from lightgbm_tpu.io.bundling import find_bundles_multihost
+
+        rng = np.random.default_rng(1)
+        n, F = 64, 3
+        num_bin = np.array([255, 5, 5], np.int64)
+        bins = (rng.integers(0, 5, size=(n, F))).astype(np.uint16)
+        seen = []
+        self._fake_world(monkeypatch, seen)
+        find_bundles_multihost(bins, num_bin, np.zeros(F), n,
+                               sparse_threshold=0.9, max_conflict_rate=0.0,
+                               max_bundle_bins=256)
+        samples = [a for a in seen if a.ndim == 2]
+        assert samples and samples[0].dtype == np.uint16

@@ -1,0 +1,367 @@
+"""Device-resident forest prediction (ops/predict.py).
+
+Parity contract: the jitted bin-space traversal must match the host
+walker `gbdt._predict_binned` LEAF-FOR-LEAF (f32-exact on leaf values)
+across missing types (NaN/zero/none), categorical splits, multiclass,
+and `num_iteration` subsets — plus the pipeline guarantee that valid-set
+scoring performs zero per-tree host transfers.
+"""
+
+import numpy as np
+import pytest
+
+from .conftest import *  # noqa: F401,F403  (cpu backend pin)
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.models import gbdt as gbdt_mod
+from lightgbm_tpu.models.gbdt import _predict_binned
+from lightgbm_tpu.models.tree import Tree
+from lightgbm_tpu.ops.predict import (PackedForest, feature_meta_dev,
+                                      device_tables, forest_class_scores,
+                                      forest_leaf_values, pack_trees)
+
+DEVICE_ON = {"tpu_predict_device": "true", "verbose": -1}
+
+
+def _make_data(n=1500, f=6, seed=0, with_nan=True, with_zero=True,
+               with_cat=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    if with_nan:
+        X[rng.random((n, f)) < 0.12] = np.nan
+    if with_zero:
+        X[:, 2] = np.where(rng.random(n) < 0.55, 0.0, X[:, 2])
+    cat_cols = []
+    if with_cat:
+        X[:, f - 1] = rng.integers(0, 14, size=n).astype(float)
+        cat_cols = [f - 1]
+    y = (np.nansum(X[:, :3], axis=1)
+         + (X[:, f - 1] % 3 == 0 if with_cat else 0) > 0).astype(float)
+    return X, y, cat_cols
+
+
+def _train(X, y, cat_cols, params=None, rounds=8):
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                     categorical_feature=cat_cols or "auto")
+    p = {"objective": "binary", "num_leaves": 15, **DEVICE_ON,
+         **(params or {})}
+    return lgb.train(p, ds, num_boost_round=rounds, verbose_eval=False,
+                     keep_training_booster=True)
+
+
+class TestLeafForLeafParity:
+    def _assert_forest_parity(self, drv, data):
+        meta = drv.learner.meta_np
+        tables, depth = pack_trees(drv.models)
+        vals = np.asarray(forest_leaf_values(
+            device_tables(tables), data.device_bins(),
+            feature_meta_dev(meta), depth))
+        assert vals.dtype == np.float32
+        for i, tree in enumerate(drv.models):
+            host = _predict_binned(tree, data.bins, meta).astype(np.float32)
+            np.testing.assert_array_equal(
+                host, vals[i], err_msg=f"tree {i} diverged from the host "
+                "walker")
+
+    @pytest.mark.parametrize("with_nan,with_zero",
+                             [(True, True), (True, False), (False, True),
+                              (False, False)])
+    def test_missing_types(self, with_nan, with_zero):
+        X, y, cats = _make_data(with_nan=with_nan, with_zero=with_zero)
+        bst = _train(X, y, cats)
+        drv = bst._driver
+        drv._materialize()
+        if with_nan or with_zero:
+            assert any(t.num_cat > 0 for t in drv.models), \
+                "fixture lost its categorical splits"
+        self._assert_forest_parity(drv, drv.train_data)
+
+    def test_randomized_trees(self):
+        """Structural fuzz: random bin-space trees (every missing type,
+        random default-left, random categorical bitsets) over random bin
+        matrices — no training involved."""
+        rng = np.random.default_rng(7)
+        F, n = 5, 400
+        num_bin = rng.integers(4, 33, size=F).astype(np.int32)
+        meta = {"num_bin": num_bin,
+                "default_bin": (num_bin // 3).astype(np.int32),
+                "missing_type": rng.integers(0, 3, size=F).astype(np.int32)}
+        bins = (rng.random((n, F)) * num_bin).astype(np.int64) % num_bin
+        import jax.numpy as jnp
+
+        bins_dev = jnp.asarray(bins.astype(np.int32))
+        trees = []
+        for _ in range(12):
+            t = Tree(8)
+            leaf = 0
+            for _s in range(rng.integers(1, 8)):
+                f = int(rng.integers(0, F))
+                if rng.random() < 0.3:
+                    width = int(num_bin[f])
+                    members = rng.integers(0, 2, size=width)
+                    words = np.zeros(width // 32 + 1, np.int64)
+                    for b in np.nonzero(members)[0]:
+                        words[b // 32] |= 1 << (b % 32)
+                    t.split_categorical(
+                        leaf, f, f, [int(w) for w in words],
+                        [int(w) for w in words],
+                        float(rng.normal()), float(rng.normal()), 10, 10,
+                        1.0, 1.0, 1.0,
+                        missing_type=int(meta["missing_type"][f]))
+                else:
+                    t.split(leaf, f, f,
+                            int(rng.integers(0, num_bin[f])),
+                            0.0, float(rng.normal()), float(rng.normal()),
+                            10, 10, 1.0, 1.0, 1.0,
+                            missing_type=int(meta["missing_type"][f]),
+                            default_left=bool(rng.random() < 0.5))
+                leaf = int(rng.integers(0, t.num_leaves))
+            trees.append(t)
+        trees.append(Tree(2))  # constant tree rides along
+        trees[-1].as_constant_tree(0.625)
+        tables, depth = pack_trees(trees)
+        vals = np.asarray(forest_leaf_values(
+            device_tables(tables), bins_dev, feature_meta_dev(meta), depth))
+        for i, t in enumerate(trees):
+            host = _predict_binned(t, bins, meta).astype(np.float32)
+            np.testing.assert_array_equal(host, vals[i],
+                                          err_msg=f"random tree {i}")
+
+    def test_multiclass_class_scores(self):
+        X, y, cats = _make_data(with_cat=False)
+        y3 = (np.abs(y * 2 + (X[:, 0] > 0)) % 3).astype(float)
+        bst = _train(X, y3, cats, params={"objective": "multiclass",
+                                          "num_class": 3})
+        drv = bst._driver
+        drv._materialize()
+        td = drv.train_data
+        meta = drv.learner.meta_np
+        k = drv.num_tree_per_iteration
+        assert k == 3
+        tables, depth = pack_trees(drv.models)
+        dev = np.asarray(forest_class_scores(
+            device_tables(tables), td.device_bins(),
+            feature_meta_dev(meta), k, depth))
+        host = np.zeros((k, td.num_data), np.float64)
+        for i, t in enumerate(drv.models):
+            host[i % k] += _predict_binned(t, td.bins, meta)
+        np.testing.assert_allclose(dev, host, rtol=0, atol=1e-5)
+
+
+class TestPredictPaths:
+    def test_device_predict_matches_native(self):
+        X, y, cats = _make_data()
+        bst = _train(X, y, cats)
+        p_native = bst.predict(X, raw_score=True)
+        p_dev = bst.predict(X, raw_score=True, device="tpu")
+        np.testing.assert_allclose(p_dev, p_native, rtol=0, atol=1e-5)
+        # probabilities convert identically on both paths
+        np.testing.assert_allclose(bst.predict(X, device="tpu"),
+                                   bst.predict(X), rtol=0, atol=1e-5)
+
+    def test_num_iteration_table_slice(self):
+        X, y, cats = _make_data()
+        bst = _train(X, y, cats, rounds=10)
+        for ni in (1, 3, 10):
+            np.testing.assert_allclose(
+                bst.predict(X, raw_score=True, num_iteration=ni,
+                            device="tpu"),
+                bst.predict(X, raw_score=True, num_iteration=ni),
+                rtol=0, atol=1e-5,
+                err_msg=f"num_iteration={ni}")
+
+    def test_prebinned_dataset_predict(self):
+        X, y, cats = _make_data()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                         categorical_feature=cats)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         **DEVICE_ON}, ds, num_boost_round=6,
+                        verbose_eval=False, keep_training_booster=True)
+        Xv = X[:400]
+        vd = ds.create_valid(Xv, label=y[:400])
+        p_binned = bst.predict(vd, raw_score=True, device="tpu")
+        p_raw = bst.predict(Xv, raw_score=True, device="tpu")
+        np.testing.assert_allclose(p_binned, p_raw, rtol=0, atol=1e-5)
+
+    def test_dataset_predict_needs_device_path(self):
+        X, y, cats = _make_data()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "tpu_predict_device": "false", "verbose": -1},
+                        ds, num_boost_round=2, verbose_eval=False,
+                        keep_training_booster=True)
+        with pytest.raises(TypeError):
+            bst.predict(ds)
+
+    def test_shuffle_models_invalidates_packed_forest(self):
+        X, y, cats = _make_data()
+        bst = _train(X, y, cats, rounds=6)
+        before = bst.predict(X, raw_score=True, device="tpu")
+        bst._driver.shuffle_models()  # reorders trees in place
+        after = bst.predict(X, raw_score=True, device="tpu")
+        native = bst.predict(X, raw_score=True)
+        # sums are order-invariant, so parity with the native walker
+        # proves the device tables repacked in the NEW order (a stale
+        # cache would only show up via num_iteration subsets)
+        np.testing.assert_allclose(after, native, rtol=0, atol=1e-5)
+        sub_dev = bst.predict(X, raw_score=True, num_iteration=2,
+                              device="tpu")
+        sub_nat = bst.predict(X, raw_score=True, num_iteration=2)
+        np.testing.assert_allclose(sub_dev, sub_nat, rtol=0, atol=1e-5)
+        del before
+
+    def test_foreign_mappers_rejected(self):
+        """A Dataset binned against a DIFFERENT reference must be refused
+        — traversing foreign bin space would silently return garbage."""
+        X, y, cats = _make_data()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                         categorical_feature=cats)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         **DEVICE_ON}, ds, num_boost_round=3,
+                        verbose_eval=False, keep_training_booster=True)
+        X2, y2, _ = _make_data(seed=99)
+        ds2 = lgb.Dataset(X2, label=y2, params={"max_bin": 31})
+        foreign = ds2.create_valid(X2[:200], label=y2[:200])
+        foreign.construct()
+        with pytest.raises(ValueError, match="reference"):
+            bst.predict(foreign, device="tpu")
+
+    def test_device_predict_survives_free_dataset(self):
+        X, y, cats = _make_data()
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                         categorical_feature=cats)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         **DEVICE_ON}, ds, num_boost_round=4,
+                        verbose_eval=False)  # train() frees the dataset
+        assert bst._driver.train_data is None
+        p_dev = bst.predict(X, raw_score=True, device="tpu")
+        p_native = bst.predict(X, raw_score=True)
+        np.testing.assert_allclose(p_dev, p_native, rtol=0, atol=1e-5)
+
+
+class TestValidScoringPipeline:
+    def test_valid_scores_match_host_replay(self):
+        X, y, cats = _make_data(n=1200)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                         categorical_feature=cats)
+        Xv, yv = X[:500].copy(), y[:500]
+        vd = ds.create_valid(Xv, label=yv)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "metric": "binary_logloss", **DEVICE_ON},
+                        ds, num_boost_round=8, valid_sets=[vd],
+                        verbose_eval=False, keep_training_booster=True)
+        drv = bst._driver
+        drv._materialize()
+        meta = drv.learner.meta_np
+        host = np.zeros(drv.valid_sets[0].num_data, np.float32)
+        for t in drv.models:
+            host += _predict_binned(t, drv.valid_sets[0].bins,
+                                    meta).astype(np.float32)
+        dev = drv.valid_scores[0].numpy()[0].astype(np.float32)
+        np.testing.assert_allclose(dev, host, rtol=0, atol=1e-5)
+
+    def test_materialize_does_no_per_tree_fetches(self, monkeypatch):
+        """The async-pipeline contract: materializing N pending trees
+        with valid sets attached performs exactly ONE device_get (the
+        batched record fetch) and never touches the host walker."""
+        X, y, cats = _make_data(n=800)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                         categorical_feature=cats)
+        vd = ds.create_valid(X[:300].copy(), label=y[:300])
+        bst = lgb.Booster(params={"objective": "binary", "num_leaves": 15,
+                                  **DEVICE_ON}, train_set=ds)
+        bst.add_valid(vd, "valid")
+        n_iters = 5
+        for _ in range(n_iters):
+            bst.update()
+        drv = bst._driver
+        assert drv._pending, "async fast path not engaged"
+
+        import jax
+
+        calls = {"device_get": 0}
+        real_device_get = jax.device_get
+
+        def counting_device_get(x):
+            calls["device_get"] += 1
+            return real_device_get(x)
+
+        monkeypatch.setattr(gbdt_mod.jax, "device_get", counting_device_get)
+
+        def no_host_walk(*a, **k):
+            raise AssertionError("host binned walker used for valid "
+                                 "scoring on the device path")
+
+        monkeypatch.setattr(gbdt_mod, "_predict_binned", no_host_walk)
+        monkeypatch.setattr(drv, "_score_trees_binned", no_host_walk)
+        drv._materialize()
+        assert calls["device_get"] == 1, \
+            f"expected 1 batched fetch, saw {calls['device_get']}"
+        assert len(drv.models) == n_iters
+
+    def test_add_valid_replays_on_device(self, monkeypatch):
+        X, y, cats = _make_data(n=900)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                         categorical_feature=cats)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         **DEVICE_ON}, ds, num_boost_round=5,
+                        verbose_eval=False, keep_training_booster=True)
+        drv = bst._driver
+        drv._materialize()
+        monkeypatch.setattr(
+            gbdt_mod, "_predict_binned",
+            lambda *a, **k: (_ for _ in ()).throw(
+                AssertionError("host walker used in add_valid replay")))
+        vd = ds.create_valid(X[:300].copy(), label=y[:300])
+        vd.construct()
+        drv.add_valid(vd._inner, "late_valid")
+        meta = drv.learner.meta_np
+        # parity of the replayed state against a fresh device pass
+        tables, depth = pack_trees(drv.models)
+        dev = np.asarray(forest_class_scores(
+            device_tables(tables), vd._inner.device_bins(),
+            feature_meta_dev(meta), 1, depth))
+        np.testing.assert_allclose(drv.valid_scores[-1].numpy(), dev,
+                                   rtol=0, atol=1e-5)
+
+
+class TestPackedForestAppend:
+    def test_incremental_append_matches_full_pack(self):
+        X, y, cats = _make_data()
+        bst = _train(X, y, cats, rounds=4)
+        drv = bst._driver
+        drv._materialize()
+        pf = PackedForest()
+        pf.sync(drv.models[:2])
+        pf.sync(drv.models)  # appends trees 2..3 only
+        full, depth = pack_trees(drv.models)
+        dev = pf.device()
+        for key in full:
+            np.testing.assert_array_equal(
+                np.asarray(dev[key]),
+                full[key] if key == "cat_words"
+                else full[key][:len(drv.models)],
+                err_msg=f"table {key} diverged after incremental append")
+        assert pf.depth >= depth
+
+    def test_cat_word_rebase(self):
+        """Bitset windows of appended categorical trees must land past
+        the existing word pool."""
+        X, y, cats = _make_data()
+        bst = _train(X, y, cats, rounds=6)
+        drv = bst._driver
+        drv._materialize()
+        cat_trees = [t for t in drv.models if t.num_cat > 0]
+        if len(cat_trees) < 2:
+            pytest.skip("fixture produced too few categorical trees")
+        pf = PackedForest()
+        pf.sync(cat_trees[:1])
+        pf.sync(cat_trees)
+        meta = drv.learner.meta_np
+        td = drv.train_data
+        vals = np.asarray(forest_leaf_values(
+            pf.device(), td.device_bins(), feature_meta_dev(meta),
+            pf.depth))
+        for i, t in enumerate(cat_trees):
+            host = _predict_binned(t, td.bins, meta).astype(np.float32)
+            np.testing.assert_array_equal(host, vals[i])

@@ -185,10 +185,29 @@ class TestMergedMetricsEqualFull:
                             self.weight)
 
     def test_gamma_deviance_global_sum(self, monkeypatch):
+        # sum-type metric: reduces across ranks ONLY under pre_partition
+        # (distinct row shards); the harness models exactly that world
         label = np.abs(self.label_reg) + 0.5
         score = np.abs(self.score) + 0.5
-        _merged_vs_full(monkeypatch, "gamma_deviance", Config(), label,
+        _merged_vs_full(monkeypatch, "gamma_deviance",
+                        Config({"pre_partition": True}), label,
                         score, self.weight)
+
+    def test_gamma_deviance_replicated_not_scaled(self, monkeypatch):
+        """Replicated multiprocess mode (every rank holds ALL rows): the
+        sum must NOT be multiplied by the process count."""
+        label = np.abs(self.label_reg) + 0.5
+        score = np.abs(self.score) + 0.5
+        full = _eval_metric("gamma_deviance", Config(), label, score,
+                            self.weight)
+        monkeypatch.setattr(metric_sync, "process_count", lambda: 2)
+        # replicated ranks skip the collective entirely, so an armed
+        # allgather would raise (_no_allgather is already installed)
+        replicated = _eval_metric("gamma_deviance", Config(), label,
+                                  score, self.weight)
+        for (n_f, v_f), (n_r, v_r) in zip(full, replicated):
+            assert n_f == n_r
+            assert v_r == pytest.approx(v_f, rel=1e-12)
 
     def test_kldiv(self, monkeypatch):
         rng = np.random.default_rng(3)

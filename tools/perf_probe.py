@@ -90,10 +90,90 @@ def sweep(X, y, configs, iters=6, reraise=False):
                   flush=True)
 
 
+def run_predict_sweep(X, y, rounds=50, leaves=255, bins=255):
+    """Prediction-throughput sweep: full-forest raw predict rows/s for
+    the device bin-space predictor across row-chunk sizes, next to the
+    native walker and the per-iteration valid-eval overhead.
+
+        N=1000000 ROUNDS=50 python tools/perf_probe.py predict
+    """
+    import lightgbm_tpu as lgb
+
+    ds = lgb.Dataset(X, label=y, params={"max_bin": bins})
+    bst = lgb.Booster(params={
+        "objective": "binary", "num_leaves": leaves, "learning_rate": 0.1,
+        "max_bin": bins, "tpu_shape_buckets": 0,
+        "tpu_predict_device": "true"}, train_set=ds)
+    t0 = time.time()
+    for _ in range(rounds):
+        bst.update()
+    bst._driver._materialize()
+    print(f"trained {rounds} iters in {time.time() - t0:.0f}s "
+          f"({bst.num_trees()} trees)", flush=True)
+    n = X.shape[0]
+
+    def timed(fn, reps=3):
+        fn()  # warm (compile + pack)
+        t = time.time()
+        for _ in range(reps):
+            fn()
+        return (time.time() - t) / reps
+
+    # device='cpu' pins the baseline to the native OMP walker — with
+    # tpu_predict_device='true' an unqualified predict would route the
+    # device path and the comparison would measure it against itself
+    s = timed(lambda: bst.predict(X, raw_score=True, device="cpu"))
+    print(f"native walker:           {n / s:12.0f} rows/s", flush=True)
+    for chunk in (8192, 32768, 65536, 131072, 262144):
+        bst.params["tpu_predict_chunk_rows"] = chunk
+        # predict_raw_device reads the DRIVER's config (frozen at Booster
+        # construction), not the handle's params dict
+        bst._driver.config.params["tpu_predict_chunk_rows"] = chunk
+        s = timed(lambda: bst.predict(X, raw_score=True, device="tpu"))
+        print(f"device chunk={chunk:<7d}     {n / s:12.0f} rows/s",
+              flush=True)
+    # per-iteration eval overhead: LIVE update+eval iterations (the
+    # incremental device tree-score pass + materialize + metric fetch)
+    # against plain update iterations — a post-training eval_valid()
+    # would only time the score fetch
+    from lightgbm_tpu.utils.backend import host_sync
+
+    def train_loop(with_eval, iters=3):
+        t = time.time()
+        for _ in range(iters):
+            bst.update()
+            if with_eval:
+                bst.eval_valid()
+        bst._driver._materialize()
+        host_sync(bst._driver.train_scores.scores)
+        return (time.time() - t) / iters
+
+    n_eval = min(50_000, n)
+    # baseline BEFORE the valid set attaches: once added, every update's
+    # materialize pays the per-tree valid scoring, which belongs on the
+    # with_eval side of the subtraction
+    bst.update()  # warm
+    base = train_loop(False)
+    vd = ds.create_valid(X[:n_eval].copy(), label=y[:n_eval])
+    bst.add_valid(vd, "valid")
+    bst.update()
+    bst.eval_valid()  # warm the replay + eval compiles
+    with_eval = train_loop(True)
+    print(f"valid eval ({n_eval} rows): "
+          f"{max(with_eval - base, 0.0) * 1e3:8.1f} ms/iter overhead "
+          f"(train {base * 1e3:.0f} -> train+eval {with_eval * 1e3:.0f})",
+          flush=True)
+
+
 def main():
     n = int(os.environ.get("N", 1_000_000))
     X, y = make_data(n)
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "predict":
+        run_predict_sweep(X, y, rounds=int(os.environ.get("ROUNDS", 50)),
+                          leaves=int(os.environ.get("LEAVES", 255)),
+                          bins=int(os.environ.get("BINS", 255)))
+        return
     if arg == "one":
         sweep(X, y, [dict(k=int(os.environ.get("K", 25)),
                           block=int(os.environ.get("BLOCK", 16384)),
