@@ -119,6 +119,45 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     # would otherwise make the recorded train_auc describe a model
     # trained more than bench_iters iterations
     pred = bst.predict(X_eval)
+
+    # serving throughput: closed-loop hammer through the registry +
+    # micro-batcher (lightgbm_tpu/serving) over the same booster —
+    # measures the path a long-lived inference service actually runs
+    # (warmup'd row buckets, coalesced launches), not bare predict
+    from lightgbm_tpu.serving import ServingSession
+
+    serve_rows = min(1024 if degraded else 4096, n_eval)
+    serve_threads, serve_reqs = 4, 8
+    sess = ServingSession(params={
+        "serving_max_batch_rows": serve_rows, "verbosity": -1})
+    sess.load("bench", booster=bst)  # packs + warms every row bucket
+    Xs = X_eval[:serve_rows]
+
+    serve_errors = []
+
+    def _hammer():
+        try:
+            for _ in range(serve_reqs):
+                sess.predict("bench", Xs, raw_score=True)
+        except Exception as exc:  # surfaced below: a dead thread must
+            serve_errors.append(exc)  # not silently inflate the number
+
+    import threading as _threading
+
+    workers = [_threading.Thread(target=_hammer)
+               for _ in range(serve_threads)]
+    t_serve = time.time()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    serve_s = time.time() - t_serve
+    if serve_errors:
+        raise serve_errors[0]
+    serve_rows_per_sec = serve_threads * serve_reqs * serve_rows / serve_s
+    serve_p99_ms = sess.stats()["latency_p99_ms"]
+    sess.close()
+
     # per-iteration valid-eval overhead the training loop pays when early
     # stopping is on: LIVE update+eval iterations (per-tree valid scoring
     # + materialize + metric fetch) minus the plain training it/s above —
@@ -165,6 +204,8 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
                         if comparable else 0.0),
         "train_auc": round(float(auc), 4),
         "predict_rows_per_sec": round(predict_rows_per_sec, 0),
+        "serve_rows_per_sec": round(serve_rows_per_sec, 0),
+        "serve_p99_ms": round(serve_p99_ms, 1),
         "eval_ms_per_iter": round(eval_ms_per_iter, 1),
         "bench_iters": bench_iters,
         "data_gen_s": round(data_s, 1),

@@ -40,6 +40,8 @@ from .plotting import (
     plot_split_value_histogram,
     plot_tree,
 )
+# serving runtime (registry + micro-batched inference) stays a lazy
+# submodule: `from lightgbm_tpu.serving import ServingSession`
 
 __all__ = [
     "__version__",

@@ -48,8 +48,31 @@ class Application:
             self.refit()
         elif task == "convert_model":
             self.convert_model()
+        elif task in ("serve", "serving"):
+            self.serve()
         else:
             raise ValueError(f"unknown task {task!r}")
+
+    # ------------------------------------------------------------------
+    def serve(self) -> None:
+        """task=serve: load input_model into a serving registry and run
+        the HTTP/JSON endpoint (lightgbm_tpu/serving) until ^C."""
+        from .serving import ServingSession
+        from .serving.server import serve_forever
+
+        cfg = self.config
+        if not cfg.input_model:
+            raise ValueError("serve needs input_model=<file>")
+        session = ServingSession(params=dict(self.raw_params))
+        # CLI params reach the served booster too (tpu_predict_device,
+        # tpu_predict_chunk_rows, predict_disable_shape_check, ...)
+        key = session.load(str(cfg.serving_model_name),
+                           model_file=str(cfg.input_model),
+                           params=dict(self.raw_params))
+        print(f"[lightgbm_tpu] serving {key} on "
+              f"http://{cfg.serving_host}:{int(cfg.serving_port)} "
+              "(POST /predict, POST /load, GET /stats, GET /models)")
+        serve_forever(session, str(cfg.serving_host), int(cfg.serving_port))
 
     # ------------------------------------------------------------------
     def convert_model(self) -> None:
@@ -166,7 +189,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     argv = argv if argv is not None else sys.argv[1:]
     if not argv:
         print("usage: python -m lightgbm_tpu task=train config=train.conf "
-              "[key=value ...]")
+              "[key=value ...]\n"
+              "       python -m lightgbm_tpu serve input_model=model.txt "
+              "[serving_port=18080 ...]")
         return 1
+    # `python -m lightgbm_tpu serve ...` sugar for task=serve
+    if argv[0] in ("serve", "serving"):
+        argv = ["task=serve"] + list(argv[1:])
     Application(argv).run()
     return 0

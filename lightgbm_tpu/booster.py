@@ -204,7 +204,11 @@ class Booster:
         dev = str(kwargs.get("device", raw_dev)).strip().lower()
         if dev != "tpu":
             return False
-        mode = parse_tristate(self.params.get("tpu_predict_device", "auto"))
+        # kwargs override the stored mode (serving pins the device path
+        # per call without mutating the booster's own params)
+        mode = parse_tristate(kwargs.get(
+            "tpu_predict_device",
+            self.params.get("tpu_predict_device", "auto")))
         if mode == "true":
             return True
         if mode == "false":

@@ -126,6 +126,31 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "pred_early_stop": ("bool", False, ()),
     "pred_early_stop_freq": ("int", 10, ()),
     "pred_early_stop_margin": ("float", 10.0, ()),
+    # --- serving (lightgbm_tpu/serving: registry + micro-batched inference) ---
+    # rows the micro-batcher coalesces into one device predict; also the
+    # largest row bucket the registry warmup pre-compiles
+    "serving_max_batch_rows": ("int", 4096, ()),
+    # how long the batcher holds an under-filled batch open for
+    # coalescing before dispatching it anyway
+    "serving_max_wait_ms": ("float", 2.0, ()),
+    # admission control: total rows allowed in the queue; requests past
+    # it are shed immediately with ServingQueueFull (HTTP 503)
+    "serving_queue_rows": ("int", 65536, ()),
+    # per-request wait budget; expiry raises ServingTimeout (HTTP 504)
+    "serving_timeout_ms": ("float", 10000.0, ()),
+    # model registry capacity: least-recently-used non-current versions
+    # are evicted past this many resident models
+    "serving_max_models": ("int", 4, ()),
+    # pre-compile every row-bucket shape at load time so no request size
+    # ever hits a cold jit compile
+    "serving_warmup": ("bool", True, ()),
+    # registry name the CLI `serve` task loads input_model under
+    "serving_model_name": ("str", "default", ()),
+    # HTTP/JSON endpoint bind address for `python -m lightgbm_tpu serve`
+    "serving_host": ("str", "127.0.0.1", ()),
+    "serving_port": ("int", 18080, ()),
+    # rolling latency samples kept for the p50/p95/p99 stats
+    "serving_stats_window": ("int", 4096, ()),
     # --- objective ---
     "num_class": ("int", 1, ("num_classes",)),
     "is_unbalance": ("bool", False, ("unbalance", "unbalanced_sets")),

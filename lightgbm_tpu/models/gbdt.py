@@ -24,7 +24,7 @@ from ..io.bin_mapper import BinMapper, MissingType
 from ..io.dataset import TrainingData
 from ..ops.predict import (PackedForest, feature_meta_dev, device_tables,
                            forest_class_scores, forest_leaf_values,
-                           pack_trees)
+                           pack_trees, row_bucket)
 from ..utils import timer
 from .learner import TPUTreeLearner
 from .metrics import Metric, create_metrics
@@ -33,6 +33,10 @@ from .objectives import (Objective, create_objective,
 from .tree import Tree
 
 K_EPSILON = 1e-15
+
+# model-string trailer carrying the bin-mapper snapshot (written by
+# save_model_to_string, parsed back by from_model_string)
+_MAPPER_MARKER = "tpu_bin_mappers:"
 
 
 def _predict_binned(tree: Tree, bins: np.ndarray,
@@ -81,6 +85,71 @@ def _predict_binned(tree: Tree, bins: np.ndarray,
         node[active] = np.where(go_left, tree.left_child[nid],
                                 tree.right_child[nid]).astype(np.int32)
     return tree.leaf_value[~node]
+
+
+def _split_mapper_snapshot(text: str):
+    """Split a model string into (model_text, _PredictContext | None) —
+    the `tpu_bin_mappers:` analog of Booster's pandas_categorical
+    split."""
+    import json
+
+    marker = "\n" + _MAPPER_MARKER
+    pos = text.rfind(marker)
+    if pos < 0:
+        return text, None
+    line_end = text.find("\n", pos + 1)
+    payload = text[pos + len(marker): len(text) if line_end < 0
+                   else line_end].strip()
+    rest = "" if line_end < 0 else text[line_end:]
+    try:
+        ctx = _PredictContext.from_payload(json.loads(payload))
+    except (json.JSONDecodeError, KeyError, ValueError, TypeError) as exc:
+        raise ValueError(
+            f"corrupt tpu_bin_mappers line in model: {payload[:80]!r}"
+        ) from exc
+    return text[:pos] + rest, ctx
+
+
+def _rebind_tree_to_mappers(tree: Tree, mappers: List[BinMapper],
+                            used_pos: Dict[int, int]) -> None:
+    """Map a tree's real-feature splits into the given mappers' bin
+    space (split_feature_inner / threshold_in_bin / *_inner bitsets) —
+    shared by init_model continuation and model-string reload."""
+    cat_nodes: Dict[int, List[int]] = {}  # cat_idx -> bin words
+    for j in range(tree.num_leaves - 1):
+        real_f = int(tree.split_feature[j])
+        if real_f not in used_pos:
+            raise ValueError(
+                f"model splits on feature {real_f} which is trivial/"
+                "unused in the binning context")
+        tree.split_feature_inner[j] = used_pos[real_f]
+        mapper = mappers[real_f]
+        if int(tree.decision_type[j]) & 1:
+            # categorical: decode the raw-category value bitset, re-map
+            # each category to its bin under these mappers, re-encode
+            cat_idx = int(tree.threshold[j])
+            start = tree.cat_boundaries[cat_idx]
+            end = tree.cat_boundaries[cat_idx + 1]
+            words = tree.cat_threshold[start:end]
+            cats = [w * 32 + b for w, word in enumerate(words)
+                    for b in range(32) if (int(word) >> b) & 1]
+            bins = [mapper.categorical_2_bin[c] for c in cats
+                    if c in mapper.categorical_2_bin]
+            bw = [0] * (max(bins) // 32 + 1 if bins else 1)
+            for b in bins:
+                bw[b // 32] |= 1 << (b % 32)
+            cat_nodes[cat_idx] = bw
+        else:
+            tree.threshold_in_bin[j] = mapper.value_to_bin(
+                float(tree.threshold[j]))
+    if cat_nodes:
+        bounds, words = [0], []
+        for ci in range(tree.num_cat):
+            bw = cat_nodes.get(ci, [0])
+            words.extend(bw)
+            bounds.append(bounds[-1] + len(bw))
+        tree.cat_boundaries_inner = bounds
+        tree.cat_threshold_inner = words
 
 
 class _ScoreState:
@@ -839,7 +908,7 @@ class GBDT:
         td = (self.train_data if self.train_data is not None
               else self.learner.td if self.learner is not None else None)
         if td is not None:
-            self._pred_ctx = _PredictContext(td)
+            self._pred_ctx = _PredictContext.from_training_data(td)
 
     def _pred_context(self) -> Optional["_PredictContext"]:
         td = (self.train_data if self.train_data is not None
@@ -849,7 +918,7 @@ class GBDT:
             # mappers/meta only change when the dataset itself is swapped
             # by reset_training_data, which replaces the ref here too)
             if getattr(self, "_pred_ctx_for", None) is not td:
-                self._pred_ctx_live = _PredictContext(td)
+                self._pred_ctx_live = _PredictContext.from_training_data(td)
                 self._pred_ctx_for = td
             return self._pred_ctx_live
         return getattr(self, "_pred_ctx", None)
@@ -903,25 +972,30 @@ class GBDT:
             X.shape[0], lambda lo, hi: ctx.bin_rows(X[lo:hi]))
         return out / div
 
+    def predict_chunk_rows(self) -> int:
+        """Rows per device-predict launch (file-loaded boosters carry no
+        Config; they use the registry default) — the chunk every predict
+        row bucket is computed against."""
+        return max(int(self.config.tpu_predict_chunk_rows)
+                   if self.config is not None else 65536, 1024)
+
     def _chunked_device_scores(self, tables, meta_dev, k: int, depth: int,
                                n: int, get_bins) -> np.ndarray:
         """[k, n] f64 host scores from the packed device forest, chunked
         over rows: one bounded [chunk, F] int32 upload per launch, tail
         chunks padded so every launch reuses ONE compiled program.
         `get_bins(lo, hi)` supplies host bins per chunk."""
-        chunk = max(int(self.config.tpu_predict_chunk_rows)
-                    if self.config is not None else 65536, 1024)
+        chunk = self.predict_chunk_rows()
         out = np.zeros((k, n), np.float64)
         for lo in range(0, max(n, 1), chunk):
             hi = min(lo + chunk, n)
             rows = hi - lo
             bins = get_bins(lo, hi)
-            # pad every launch to a bucketed row count (full chunks for
-            # multi-chunk predicts, pow2 below that) so repeated predicts
-            # of varying batch sizes reuse a handful of compiled programs
-            # instead of one per distinct n
-            target = chunk if n > chunk else \
-                min(chunk, max(1024, 1 << (max(rows, 1) - 1).bit_length()))
+            # pad every launch to a bucketed row count (row_bucket: full
+            # chunks for multi-chunk predicts, pow2 below that) so
+            # repeated predicts of varying batch sizes reuse a handful of
+            # compiled programs instead of one per distinct n
+            target = chunk if n > chunk else row_bucket(rows, chunk)
             if rows < target:
                 bins = np.concatenate(
                     [bins, np.zeros((target - rows, bins.shape[1]),
@@ -1237,6 +1311,15 @@ class GBDT:
                     val = int(val)
                 buf.write(f"[{key}: {val}]\n")
         buf.write("\nend of parameters\n")
+        # Python-layer trailer (like `pandas_categorical:` below it): the
+        # bin-mapper snapshot that lets a RELOADED model keep the device
+        # predict path.  The reference parser ignores trailing lines, so
+        # files stay interchange-compatible.
+        ctx = self._pred_context()
+        if ctx is not None:
+            import json
+
+            buf.write(_MAPPER_MARKER + json.dumps(ctx.to_payload()) + "\n")
         return buf.getvalue()
 
     @classmethod
@@ -1248,6 +1331,7 @@ class GBDT:
         pos = text.rfind("\npandas_categorical:")
         if pos >= 0:
             text = text[:pos]
+        text, ctx = _split_mapper_snapshot(text)
         lines = text.split("\n")
         kv: Dict[str, str] = {}
         tree_blocks: List[str] = []
@@ -1284,6 +1368,22 @@ class GBDT:
         for block in tree_blocks:
             self.models.append(Tree.from_string(
                 block.split("\n", 1)[1] if "\n" in block else ""))
+        if ctx is not None:
+            # re-enter bin space: loaded trees carry only raw-value
+            # thresholds; with the snapshot mappers restored, rebinding
+            # is EXACT (each saved threshold is a bin upper bound, and
+            # value_to_bin maps it back to the same bin)
+            try:
+                used_pos = {col: j for j, col
+                            in enumerate(ctx.used_feature_idx)}
+                for tree in self.models:
+                    if tree.num_leaves > 1:
+                        _rebind_tree_to_mappers(tree, ctx.mappers, used_pos)
+                self._pred_ctx = ctx
+            except (KeyError, ValueError, IndexError):
+                # a hand-edited model may split on columns the snapshot
+                # never binned; the native walker stays available
+                self._pred_ctx = None
         self.num_init_iteration = self.current_iteration()
         self.iter_ = 0
         return self
@@ -1293,41 +1393,7 @@ class GBDT:
         binned traversal (_predict_binned) is valid for score replay."""
         used_pos = {col: j for j, col in
                     enumerate(self.train_data.used_feature_idx)}
-        cat_nodes: Dict[int, List[int]] = {}  # cat_idx -> bin words
-        for j in range(tree.num_leaves - 1):
-            real_f = int(tree.split_feature[j])
-            if real_f not in used_pos:
-                raise ValueError(
-                    f"init model splits on feature {real_f} which is trivial/"
-                    "unused in the new training data")
-            tree.split_feature_inner[j] = used_pos[real_f]
-            mapper = self.train_data.mappers[real_f]
-            if int(tree.decision_type[j]) & 1:
-                # categorical: decode the raw-category value bitset, re-map
-                # each category to its bin in the NEW dataset, re-encode
-                cat_idx = int(tree.threshold[j])
-                start = tree.cat_boundaries[cat_idx]
-                end = tree.cat_boundaries[cat_idx + 1]
-                words = tree.cat_threshold[start:end]
-                cats = [w * 32 + b for w, word in enumerate(words)
-                        for b in range(32) if (int(word) >> b) & 1]
-                bins = [mapper.categorical_2_bin[c] for c in cats
-                        if c in mapper.categorical_2_bin]
-                bw = [0] * (max(bins) // 32 + 1 if bins else 1)
-                for b in bins:
-                    bw[b // 32] |= 1 << (b % 32)
-                cat_nodes[cat_idx] = bw
-            else:
-                tree.threshold_in_bin[j] = mapper.value_to_bin(
-                    float(tree.threshold[j]))
-        if cat_nodes:
-            bounds, words = [0], []
-            for ci in range(tree.num_cat):
-                bw = cat_nodes.get(ci, [0])
-                words.extend(bw)
-                bounds.append(bounds[-1] + len(bw))
-            tree.cat_boundaries_inner = bounds
-            tree.cat_threshold_inner = words
+        _rebind_tree_to_mappers(tree, self.train_data.mappers, used_pos)
 
     def merge_from_model_string(self, text: str) -> None:
         """Continued training: prepend a loaded model (init_model)."""
@@ -1430,13 +1496,50 @@ class GBDT:
 class _PredictContext:
     """The slice of a TrainingData needed to bin + device-predict raw
     rows: mappers, used-column map, per-feature bin metadata.  Snapshot
-    by free_dataset so trained boosters keep the device path."""
+    by free_dataset so trained boosters keep the device path, and
+    round-tripped through the model string (`tpu_bin_mappers:` trailer)
+    so SAVED models keep it too — the serving registry depends on
+    reloaded models staying on the packed-forest path."""
 
-    def __init__(self, td: TrainingData):
-        self.mappers = td.mappers
-        self.used_feature_idx = list(td.used_feature_idx)
-        self.meta = td.feature_arrays()
+    def __init__(self, mappers: List[BinMapper], used_feature_idx):
+        self.mappers = mappers
+        self.used_feature_idx = list(used_feature_idx)
+        idx = self.used_feature_idx
+        self.meta = {
+            "num_bin": np.array([mappers[i].num_bin for i in idx], np.int32),
+            "default_bin": np.array([mappers[i].default_bin for i in idx],
+                                    np.int32),
+            "missing_type": np.array([int(mappers[i].missing_type)
+                                      for i in idx], np.int32),
+        }
         self._meta_dev = None
+
+    @classmethod
+    def from_training_data(cls, td: TrainingData) -> "_PredictContext":
+        # keeps the SAME mapper list object: predict_binned_device's
+        # strict `data.mappers is ctx.mappers` identity check relies on it
+        return cls(td.mappers, td.used_feature_idx)
+
+    # -- model-string round trip ---------------------------------------
+    def to_payload(self) -> Dict:
+        """JSON-able snapshot: only used columns carry a real mapper
+        (trivial columns rebuild as defaults — bin_rows never reads
+        them)."""
+        return {
+            "num_total_features": len(self.mappers),
+            "used_feature_idx": self.used_feature_idx,
+            "mappers": {str(c): self.mappers[c].to_dict()
+                        for c in self.used_feature_idx},
+        }
+
+    @classmethod
+    def from_payload(cls, payload: Dict) -> "_PredictContext":
+        total = int(payload["num_total_features"])
+        used = [int(i) for i in payload["used_feature_idx"]]
+        mappers = [BinMapper() for _ in range(total)]
+        for key, d in payload["mappers"].items():
+            mappers[int(key)] = BinMapper.from_dict(d)
+        return cls(mappers, used)
 
     def meta_dev(self):
         """Device (num_bin, default_bin, missing_type) triple, uploaded

@@ -48,6 +48,39 @@ def _depth_bucket(depth: int) -> int:
     return 1 << (d - 1).bit_length()
 
 
+def row_bucket(rows: int, chunk: int, min_rows: int = 1024) -> int:
+    """Padded row count for one device-predict launch.
+
+    The row-axis analog of `_depth_bucket`: launches are padded up to a
+    power of two (floored at `min_rows`, capped at the caller's chunk
+    size) so predicts of arbitrary batch sizes reuse a handful of
+    compiled programs instead of one per distinct n.  Every
+    `forest_leaf_values`/`forest_class_scores` caller that wants a
+    bounded compile cache must pad through this ONE formula — the
+    serving batcher sizes its warmup sweep from it."""
+    rows = max(int(rows), 1)
+    if rows >= chunk:
+        return chunk
+    return min(chunk, max(min_rows, 1 << (rows - 1).bit_length()))
+
+
+def predict_row_buckets(max_rows: int, chunk: int,
+                        min_rows: int = 1024) -> List[int]:
+    """Ascending distinct launch shapes `row_bucket` can produce for
+    predicts of 1..max_rows rows — the exact set a serving warmup must
+    pre-compile so no request size triggers a cold jit."""
+    out: List[int] = []
+    b = min_rows
+    while True:
+        bucket = min(b, chunk)
+        if bucket not in out:
+            out.append(bucket)
+        if b >= max_rows or bucket >= chunk:
+            break
+        b <<= 1
+    return out
+
+
 def pack_trees(trees: Sequence, leaf_width: int = 0,
                pad_cat_words: bool = False
                ) -> Tuple[Dict[str, np.ndarray], int]:

@@ -1,0 +1,42 @@
+"""Serving runtime: model registry + shape-bucketed micro-batching.
+
+Turns trained models into a long-lived inference service on top of the
+device-resident forest predictor (`lightgbm_tpu/ops/predict.py`):
+
+* `registry`  — load-once `name@version` model registry with LRU
+  eviction, atomic hot-swap, and per-model warmup that pre-compiles
+  every row-bucket launch shape,
+* `batcher`   — micro-batching queue coalescing concurrent requests up
+  to `serving_max_batch_rows` / `serving_max_wait_ms`, with bounded-
+  queue admission control,
+* `server`    — the thread-safe `ServingSession` front end and an
+  optional stdlib HTTP/JSON endpoint (`python -m lightgbm_tpu serve`),
+* `stats`     — rolling p50/p95/p99 latency, queue depth, batch fill,
+  compile-cache hit/miss and shed counters.
+
+Quick start::
+
+    from lightgbm_tpu.serving import ServingSession
+
+    session = ServingSession(params={"serving_max_batch_rows": 4096})
+    session.load("churn", model_file="model.txt")   # packs + warms up
+    y = session.predict("churn", X)                 # thread-safe
+    session.stats()                                 # p99, fill, ...
+"""
+
+from .batcher import MicroBatcher, ServingQueueFull, ServingTimeout
+from .registry import ModelEntry, ModelRegistry
+from .server import ServingSession, serve_forever, serve_http
+from .stats import ServingStats
+
+__all__ = [
+    "MicroBatcher",
+    "ModelEntry",
+    "ModelRegistry",
+    "ServingQueueFull",
+    "ServingSession",
+    "ServingStats",
+    "ServingTimeout",
+    "serve_forever",
+    "serve_http",
+]

@@ -1,0 +1,221 @@
+"""Micro-batching queue: coalesce concurrent predicts into one launch.
+
+Sustained accelerator throughput comes from the batching layer above the
+kernel, not the kernel itself (arXiv:1806.11248, arXiv:2005.09148): a
+stream of small independent predict requests must ride a handful of
+fixed launch shapes instead of paying one dispatch (or worse, one
+compile) each.  The batcher:
+
+* queues requests per **batch key** — (model, predict options) — so only
+  result-compatible requests ever share a launch,
+* holds an under-filled batch open up to `max_wait_ms`, dispatching
+  early once `max_batch_rows` rows have coalesced,
+* runs batches on ONE worker thread (device access is serialized; jit
+  caches and packed-forest tables never see concurrent mutation),
+* scatters each request's row slice back and wakes its caller,
+* sheds load at admission time: past `queue_rows` queued rows new
+  requests fail immediately with `ServingQueueFull` instead of growing
+  an unbounded backlog.
+
+Row-bucket padding itself happens in the ops layer
+(`ops.predict.row_bucket` via `gbdt._chunked_device_scores`) — the
+batcher only bounds *batch composition*; the registry entry accounts the
+resulting launch shape against the compile cache.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Callable, Hashable, Optional
+
+import numpy as np
+
+from .stats import ServingStats
+
+
+class ServingQueueFull(RuntimeError):
+    """Admission control rejected the request (queue at capacity)."""
+
+    http_status = 503
+
+
+class ServingTimeout(TimeoutError):
+    """The request waited past its serving_timeout_ms budget."""
+
+    http_status = 504
+
+
+class _Request:
+    __slots__ = ("X", "n", "done", "result", "error", "t_submit",
+                 "abandoned")
+
+    def __init__(self, X: np.ndarray):
+        self.X = X
+        self.n = int(X.shape[0])
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.t_submit = time.monotonic()
+        self.abandoned = False  # caller timed out; skip, don't compute
+
+
+class MicroBatcher:
+    """Bounded coalescing queue + single dispatch worker."""
+
+    def __init__(self, max_batch_rows: int = 4096, max_wait_ms: float = 2.0,
+                 queue_rows: int = 65536,
+                 stats: Optional[ServingStats] = None):
+        self.max_batch_rows = max(int(max_batch_rows), 1)
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+        self.queue_rows = max(int(queue_rows), 1)
+        self.stats = stats if stats is not None else ServingStats()
+        self._cv = threading.Condition()
+        self._queues: "OrderedDict[Hashable, deque]" = OrderedDict()
+        self._runners: dict = {}
+        self._pending_rows = 0
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "MicroBatcher":
+        with self._cv:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop, name="lgbm-serving-batcher",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    # ------------------------------------------------------------------
+    def submit(self, key: Hashable, runner: Callable[[np.ndarray], np.ndarray],
+               X: np.ndarray) -> _Request:
+        """Enqueue one request; returns a handle for `wait`.
+
+        `runner(X_batch)` must be row-independent: request i's rows in a
+        coalesced batch produce the same values they would alone (the
+        bin-space traversal is, per construction)."""
+        return self.submit_many(key, runner, [X])[0]
+
+    def submit_many(self, key: Hashable,
+                    runner: Callable[[np.ndarray], np.ndarray],
+                    slices) -> list:
+        """Enqueue the slices of ONE logical request atomically:
+        admission is all-or-nothing (a mid-request shed would leave
+        already-queued slices burning device time for a caller that
+        already got ServingQueueFull), and the counters see one request."""
+        reqs = [_Request(X) for X in slices]
+        if not reqs:
+            # an empty deque would crash the dispatch worker's oldest-
+            # head selection and brick the whole session
+            raise ValueError("submit_many needs at least one slice")
+        total = sum(r.n for r in reqs)
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("batcher is closed")
+            if self._pending_rows + total > self.queue_rows:
+                self.stats.count("requests_shed")
+                raise ServingQueueFull(
+                    f"serving queue full: {self._pending_rows} rows queued, "
+                    f"request of {total} exceeds serving_queue_rows="
+                    f"{self.queue_rows}")
+            self.stats.count("requests_total")
+            self.stats.count("rows_total", total)
+            if key not in self._queues:
+                self._queues[key] = deque()
+            self._queues[key].extend(reqs)
+            self._runners[key] = runner
+            self._pending_rows += total
+            self.stats.set_queue_depth(self._pending_rows)
+            self._cv.notify_all()
+        return reqs
+
+    def wait(self, req: _Request, timeout_s: float) -> np.ndarray:
+        if not req.done.wait(timeout_s):
+            # the caller is gone: mark the queued slices so the worker
+            # sheds them instead of burning device time on a result
+            # nobody will read (goodput under overload)
+            req.abandoned = True
+            self.stats.count("requests_timeout")
+            raise ServingTimeout(
+                f"request of {req.n} rows not served within "
+                f"{timeout_s * 1e3:.0f} ms")
+        if req.error is not None:
+            # failed requests stay out of the latency window: fast-
+            # failing error streams would otherwise drag p50/p99 down
+            # exactly while the service is erroring
+            raise req.error
+        self.stats.record_latency(time.monotonic() - req.t_submit)
+        return req.result
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._stop and not self._queues:
+                    self._cv.wait()
+                if self._stop and not self._queues:
+                    return
+                # serve the key whose head request has waited longest
+                key = min(self._queues,
+                          key=lambda k: self._queues[k][0].t_submit)
+                dq = self._queues[key]
+                rows = sum(r.n for r in dq)
+                deadline = dq[0].t_submit + self.max_wait_s
+                now = time.monotonic()
+                if rows < self.max_batch_rows and now < deadline \
+                        and not self._stop:
+                    # hold the batch open for more coalescing
+                    self._cv.wait(deadline - now)
+                    continue
+                batch = []
+                take = 0
+                dropped = 0
+                while dq and (not batch
+                              or take + dq[0].n <= self.max_batch_rows):
+                    r = dq.popleft()
+                    if r.abandoned:
+                        dropped += r.n
+                        r.done.set()
+                        continue
+                    batch.append(r)
+                    take += r.n
+                runner = self._runners[key]
+                if not dq:
+                    # drop the drained queue AND its runner: a stale
+                    # runner closure would pin its ModelEntry (packed
+                    # device forest included) long past LRU eviction
+                    del self._queues[key]
+                    del self._runners[key]
+                self._pending_rows -= take + dropped
+                self.stats.set_queue_depth(self._pending_rows)
+            if batch:
+                self._run(runner, batch)
+
+    @staticmethod
+    def _run(runner, batch) -> None:
+        X = batch[0].X if len(batch) == 1 else \
+            np.concatenate([r.X for r in batch], axis=0)
+        try:
+            out = runner(X)
+        except BaseException as exc:  # delivered to every waiter
+            for r in batch:
+                r.error = exc
+                r.done.set()
+            return
+        off = 0
+        for r in batch:
+            # axis-0 slice works for [n] and [n, k] outputs alike; padded
+            # launch rows were already cut off inside the ops layer
+            r.result = out[off:off + r.n]
+            off += r.n
+            r.done.set()

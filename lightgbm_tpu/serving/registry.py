@@ -1,0 +1,267 @@
+"""Model registry: load-once, device-resident models keyed `name@version`.
+
+A model is loaded ONCE into a `Booster` + packed device forest
+(`gbdt._packed_forest`) and then served read-only.  The registry adds
+the runtime discipline around that:
+
+* **versioning / hot-swap** — every load gets a `name@version` key and
+  atomically flips the bare-`name` alias to it; in-flight requests on
+  the old version finish against their resolved entry, new requests see
+  the new one.  Old versions stay addressable by full key until evicted.
+* **LRU eviction** — past `serving_max_models` resident entries the
+  least-recently-resolved non-current version is dropped (current
+  aliases are only evicted when nothing else is left).
+* **warmup** — at load time every `row_bucket` launch shape a request of
+  1..serving_max_batch_rows rows can produce is pre-compiled, so the
+  steady state never pays a cold jit (`stats.compile_cache_misses`
+  stays 0).
+* **fallback** — a device-path failure mid-request falls back to the
+  native host walker for that batch and is counted, not raised.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..config import Config, parse_tristate
+from ..ops.predict import predict_row_buckets, row_bucket
+from .stats import ServingStats
+
+
+class ModelEntry:
+    """One resident model: booster + device tables + launch accounting."""
+
+    def __init__(self, name: str, version: str, booster, config: Config,
+                 stats: ServingStats):
+        self.name = name
+        self.version = version
+        self.key = f"{name}@{version}"
+        self.booster = booster
+        self.stats = stats
+        drv = booster._driver
+        drv._materialize()
+        self.num_feature = booster.num_feature()
+        self.chunk = drv.predict_chunk_rows()
+        self.max_batch_rows = int(config.serving_max_batch_rows)
+        # serving pins the device predictor: 'auto' (native walker on CPU
+        # hosts) would defeat the bounded-compile/warmup contract, so it
+        # promotes to 'true' — per predict CALL (kwargs override), never
+        # by mutating the adopted booster's own params; an explicit
+        # 'false' stays respected
+        mode = parse_tristate(booster.params.get("tpu_predict_device",
+                                                 "auto"))
+        if mode == "auto":
+            mode = "true"
+        self.device_on = (mode == "true"
+                          and drv._pred_context() is not None
+                          and booster.num_trees() > 0)
+        if self.device_on:
+            drv._packed_forest()  # pack + upload the forest tables once
+
+    # ------------------------------------------------------------------
+    def default_num_iteration(self) -> int:
+        """The num_iteration a None request resolves to — mirrors
+        Booster.predict's best_iteration default, and is what warmup
+        must pre-compile (an early-stopped model's sliced tree tables
+        are a different jit shape than the full forest's)."""
+        bi = self.booster.best_iteration
+        return bi if bi is not None and bi >= 0 else -1
+
+    def warmup(self) -> int:
+        """Pre-compile every launch shape; returns the bucket count."""
+        if not self.device_on:
+            return 0
+        buckets = predict_row_buckets(self.max_batch_rows, self.chunk)
+        ni = self.default_num_iteration()
+        for b in buckets:
+            self.predict(np.zeros((b, self.num_feature), np.float64),
+                         num_iteration=ni, warmup=True)
+        return len(buckets)
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                num_iteration: int = -1, warmup: bool = False) -> np.ndarray:
+        """The batch runner: one device predict with launch-shape
+        accounting, native-walker fallback on device failure."""
+        ni = -1 if num_iteration is None else int(num_iteration)
+        if not self.device_on:
+            if not warmup:
+                self.stats.note_batch(X.shape[0], X.shape[0])
+            return self.booster.predict(X, raw_score=raw_score,
+                                        num_iteration=ni, device="cpu")
+        n = int(X.shape[0])
+        bucket = row_bucket(n, self.chunk)
+        if not warmup:
+            # a batch wider than the predict chunk runs ceil(n/chunk)
+            # padded launches inside _chunked_device_scores — account
+            # them all, or batch_fill_ratio would exceed 1.0
+            launches = -(-n // self.chunk) if n > self.chunk else 1
+            self.stats.note_batch(n, launches * bucket, launches=launches)
+        self.stats.note_shape((self.key, ni, bucket), warmup=warmup)
+        try:
+            return self.booster.predict(X, raw_score=raw_score,
+                                        num_iteration=ni, device="tpu",
+                                        tpu_predict_device="true")
+        except Exception:
+            # count a fallback only when the host walker actually
+            # serves it — a data error raises identically on both paths
+            # and must not inflate the device-failure signal
+            out = self.booster.predict(X, raw_score=raw_score,
+                                       num_iteration=ni, device="cpu")
+            self.stats.count("device_fallbacks")
+            return out
+
+    def describe(self) -> Dict:
+        return {"key": self.key, "name": self.name, "version": self.version,
+                "num_feature": self.num_feature,
+                "num_trees": self.booster.num_trees(),
+                "device": bool(self.device_on)}
+
+
+class ModelRegistry:
+    """name@version -> ModelEntry with LRU eviction and hot-swap."""
+
+    def __init__(self, config: Optional[Config] = None,
+                 stats: Optional[ServingStats] = None):
+        self.config = config if config is not None else Config({})
+        self.stats = stats if stats is not None else ServingStats()
+        self._lock = threading.RLock()
+        self._entries: "OrderedDict[str, ModelEntry]" = OrderedDict()
+        self._latest: Dict[str, str] = {}   # name -> current key
+        self._counts: Dict[str, int] = {}   # name -> loads so far
+
+    # ------------------------------------------------------------------
+    def load(self, name: str, model_file: Optional[str] = None,
+             model_str: Optional[str] = None, booster=None,
+             params: Optional[Dict] = None,
+             version: Optional[str] = None) -> ModelEntry:
+        """Load + warm a model, then atomically flip `name` to it.
+
+        The expensive part (parse, pack, warmup compiles) runs OUTSIDE
+        the registry lock: a hot-swap never blocks serving of the old
+        version.  A user-supplied `booster` is adopted as-is (its
+        tpu_predict_device param may be promoted to 'true')."""
+        if "@" in name:
+            raise ValueError("model name must not contain '@' "
+                             "(reserved for name@version keys)")
+        if booster is None:
+            from ..booster import Booster
+
+            merged = dict(params or {})
+            if model_file is not None:
+                booster = Booster(params=merged, model_file=model_file)
+            elif model_str is not None:
+                booster = Booster(params=merged, model_str=model_str)
+            else:
+                raise ValueError(
+                    "load needs model_file=, model_str= or booster=")
+        with self._lock:
+            if version is not None:
+                ver = str(version)
+                # keep the implicit counter ahead of explicit NUMERIC
+                # versions so a later version-less load never reuses (and
+                # silently replaces) an existing name@N entry
+                try:
+                    self._counts[name] = max(self._counts.get(name, 0),
+                                             int(ver))
+                except ValueError:
+                    pass
+            else:
+                self._counts[name] = self._counts.get(name, 0) + 1
+                ver = str(self._counts[name])
+        entry = ModelEntry(name, ver, booster, self.config, self.stats)
+        if bool(self.config.serving_warmup):
+            entry.warmup()
+        with self._lock:
+            self._entries[entry.key] = entry
+            self._entries.move_to_end(entry.key)
+            # atomic flip (hot-swap) — but never BACKWARDS: concurrent
+            # loads finish warmup in arbitrary order, and last-finisher-
+            # wins would let a stale version steal the alias
+            if not self._version_newer(self._latest.get(name), ver):
+                self._latest[name] = entry.key
+            self.stats.count("models_loaded")
+            self._evict_locked()
+        return entry
+
+    @staticmethod
+    def _version_newer(current_key: Optional[str], candidate: str) -> bool:
+        """True when the currently-aliased version outranks `candidate`
+        (numeric compare when both versions are numeric, else the flip
+        always proceeds — explicit string versions are caller-ordered)."""
+        if current_key is None:
+            return False
+        try:
+            return int(current_key.rsplit("@", 1)[1]) > int(candidate)
+        except (ValueError, IndexError):
+            return False
+
+    def _evict_locked(self) -> None:
+        cap = max(int(self.config.serving_max_models), 1)
+        while len(self._entries) > cap:
+            current = set(self._latest.values())
+            victim = next((k for k in self._entries if k not in current),
+                          None)
+            if victim is None:
+                # every entry is someone's current version: retire the
+                # least-recently-used name entirely
+                victim = next(iter(self._entries))
+                self._latest = {n: k for n, k in self._latest.items()
+                                if k != victim}
+            del self._entries[victim]
+            self.stats.count("models_evicted")
+
+    # ------------------------------------------------------------------
+    def resolve(self, name: str) -> ModelEntry:
+        """`name` (current version) or exact `name@version` -> entry."""
+        with self._lock:
+            key = self._latest.get(name, name)
+            entry = self._entries.get(key)
+            if entry is None:
+                raise KeyError(f"no model {name!r} in the serving registry")
+            self._entries.move_to_end(key)  # LRU touch
+            return entry
+
+    def unload(self, name: str) -> None:
+        """Drop one version (`name@version`) or, for a bare name, EVERY
+        resident version of it — an operator unload must actually
+        release the packed device tables, not just the current alias.
+        Unloading the CURRENT version re-aliases the name to its newest
+        surviving version (the rollback workflow), rather than leaving
+        resident versions unreachable by bare name."""
+        with self._lock:
+            if "@" in name:
+                victims = [name]
+            else:
+                victims = [k for k, e in self._entries.items()
+                           if e.name == name]
+            removed = [self._entries.pop(k) for k in victims
+                       if k in self._entries]
+            gone = set(victims)
+            self._latest = {n: k for n, k in self._latest.items()
+                            if k not in gone and n != name}
+            for e in removed:
+                if e.name in self._latest:
+                    continue
+                survivors = [k for k, s in self._entries.items()
+                             if s.name == e.name]
+                if survivors:
+                    self._latest[e.name] = max(
+                        survivors, key=self._version_rank)
+
+    @staticmethod
+    def _version_rank(key: str):
+        ver = key.rsplit("@", 1)[1]
+        try:
+            return (1, int(ver), ver)
+        except ValueError:
+            return (0, 0, ver)
+
+    def models(self) -> List[Dict]:
+        with self._lock:
+            current = {k: n for n, k in self._latest.items()}
+            return [{**e.describe(), "current": e.key in current}
+                    for e in self._entries.values()]

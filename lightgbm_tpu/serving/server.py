@@ -1,0 +1,241 @@
+"""Serving front end: thread-safe `ServingSession` + HTTP/JSON endpoint.
+
+`ServingSession` is the process-local API: it owns one registry, one
+micro-batcher and one stats sink, and `session.predict(name, X)` is safe
+to call from any number of threads — requests coalesce in the batcher
+and run serialized on its worker.  The HTTP layer is a thin stdlib
+(`http.server`) translation of the same calls for non-Python clients;
+`python -m lightgbm_tpu serve` binds it.
+
+Error contract (mirrored into HTTP statuses):
+* unknown model                -> KeyError            -> 404
+* malformed request            -> ValueError          -> 400
+* queue at capacity (shed)     -> ServingQueueFull    -> 503
+* per-request timeout          -> ServingTimeout      -> 504
+* device failure               -> served via the native-walker fallback
+                                  (counted in stats, never an error)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..config import Config
+from .batcher import MicroBatcher, ServingQueueFull, ServingTimeout
+from .registry import ModelRegistry
+from .stats import ServingStats
+
+
+class ServingSession:
+    """Long-lived inference service over a model registry."""
+
+    def __init__(self, params: Optional[Dict] = None, start: bool = True):
+        cfg = params if isinstance(params, Config) else Config(dict(params or {}))
+        self.config = cfg
+        self._stats = ServingStats(window=int(cfg.serving_stats_window))
+        self.registry = ModelRegistry(cfg, self._stats)
+        self.batcher = MicroBatcher(
+            max_batch_rows=int(cfg.serving_max_batch_rows),
+            max_wait_ms=float(cfg.serving_max_wait_ms),
+            queue_rows=int(cfg.serving_queue_rows),
+            stats=self._stats)
+        if start:
+            self.batcher.start()
+
+    # ------------------------------------------------------------------
+    def load(self, name: str, **kwargs) -> str:
+        """Load/hot-swap a model (see ModelRegistry.load); returns the
+        `name@version` key."""
+        return self.registry.load(name, **kwargs).key
+
+    def unload(self, name: str) -> None:
+        self.registry.unload(name)
+
+    def models(self):
+        return self.registry.models()
+
+    def stats(self) -> Dict:
+        return self._stats.snapshot()
+
+    # ------------------------------------------------------------------
+    def predict(self, name: str, X, raw_score: bool = False,
+                num_iteration: Optional[int] = None,
+                timeout_ms: Optional[float] = None) -> np.ndarray:
+        """Micro-batched predict: blocks until this request's rows come
+        back (or sheds/times out).  Results are exactly what
+        `entry.booster.predict` returns for the same rows — coalescing
+        never changes a row's value (the traversal is row-independent)."""
+        entry = self.registry.resolve(name)
+        from ..basic import _to_2d_array
+
+        Xm = _to_2d_array(X, entry.booster.pandas_categorical)
+        Xm = np.ascontiguousarray(np.atleast_2d(Xm), np.float64)
+        if Xm.shape[0] > self.batcher.queue_rows:
+            # no load level can ever admit this: a 503 would invite
+            # pointless retries, so fail it as a caller error (HTTP 400)
+            raise ValueError(
+                f"request of {Xm.shape[0]} rows exceeds serving_queue_rows="
+                f"{self.batcher.queue_rows}; raise the limit or split the "
+                "request")
+        # None matches Booster.predict's default (best_iteration when
+        # set) — the same value warmup pre-compiled
+        ni = (entry.default_num_iteration() if num_iteration is None
+              else int(num_iteration))
+        # feature width is part of the batch key: a wrong-width request
+        # must fail alone, never poison the batch it would coalesce into
+        key = (entry.key, bool(raw_score), ni, Xm.shape[1])
+        runner = lambda Xb: entry.predict(Xb, raw_score=raw_score,  # noqa: E731
+                                          num_iteration=ni)
+        timeout_s = (float(self.config.serving_timeout_ms)
+                     if timeout_ms is None else float(timeout_ms)) / 1e3
+        # oversize requests split into max_batch_rows slices so every
+        # launch stays inside the warmed row buckets (an unsplit 10k-row
+        # batch would hit a cold 16k-bucket compile); admission is
+        # all-or-nothing and ONE timeout budget covers all slices
+        max_rows = self.batcher.max_batch_rows
+        reqs = self.batcher.submit_many(
+            key, runner, [Xm[lo:lo + max_rows]
+                          for lo in range(0, max(Xm.shape[0], 1), max_rows)])
+        deadline = time.monotonic() + timeout_s
+        try:
+            outs = [self.batcher.wait(r,
+                                      max(deadline - time.monotonic(), 0.0))
+                    for r in reqs]
+        except BaseException:
+            # one slice failed/timed out: the whole logical request is
+            # dead — shed its remaining queued slices
+            for r in reqs:
+                r.abandoned = True
+            raise
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+class _ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    session: ServingSession = None
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "lightgbm-tpu-serve"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args):  # no stderr chatter per request
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self) -> Dict:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            obj = json.loads(raw.decode() or "{}")
+        except json.JSONDecodeError as exc:
+            raise ValueError(f"malformed JSON body: {exc}") from exc
+        if not isinstance(obj, dict):
+            raise ValueError("request body must be a JSON object")
+        return obj
+
+    # ------------------------------------------------------------------
+    def do_GET(self) -> None:
+        session = self.server.session
+        if self.path == "/stats":
+            self._json(200, session.stats())
+        elif self.path == "/models":
+            self._json(200, {"models": session.models()})
+        elif self.path == "/healthz":
+            self._json(200, {"ok": True})
+        else:
+            self._json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:
+        session = self.server.session
+        try:
+            body = self._body()
+            if self.path == "/predict":
+                name = body.get("model")
+                rows = body.get("rows")
+                if not name or rows is None:
+                    raise ValueError("need 'model' and 'rows'")
+                X = np.asarray(rows, np.float64)
+                out = session.predict(
+                    str(name), X, raw_score=bool(body.get("raw_score")),
+                    num_iteration=body.get("num_iteration"),
+                    timeout_ms=body.get("timeout_ms"))
+                self._json(200, {"model": str(name),
+                                 "predictions": np.asarray(out).tolist()})
+            elif self.path == "/load":
+                name = body.get("name")
+                if not name:
+                    raise ValueError("need 'name'")
+                key = session.load(
+                    str(name), model_file=body.get("model_file"),
+                    model_str=body.get("model_str"),
+                    params=body.get("params"),
+                    version=body.get("version"))
+                self._json(200, {"loaded": key})
+            else:
+                self._json(404, {"error": f"no route {self.path}"})
+        except ServingQueueFull as exc:
+            self._json(503, {"error": str(exc)})
+        except ServingTimeout as exc:
+            self._json(504, {"error": str(exc)})
+        except KeyError as exc:
+            self._json(404, {"error": str(exc.args[0]) if exc.args
+                             else str(exc)})
+        except ValueError as exc:
+            self._json(400, {"error": str(exc)})
+        except Exception as exc:
+            from ..utils.log import LightGBMError
+
+            if isinstance(exc, LightGBMError):
+                # data errors (feature-count mismatch, ...) are the
+                # CALLER's fault, not a server fault
+                self._json(400, {"error": str(exc)})
+            else:  # pragma: no cover - defensive
+                self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
+
+
+def serve_http(session: ServingSession, host: str = "127.0.0.1",
+               port: int = 18080) -> _ServingHTTPServer:
+    """Start the HTTP endpoint on a daemon thread; returns the server
+    (its bound port is `server.server_address[1]`; stop with
+    `server.shutdown()`)."""
+    server = _ServingHTTPServer((host, int(port)), _Handler)
+    server.session = session
+    thread = threading.Thread(target=server.serve_forever,
+                              name="lgbm-serving-http", daemon=True)
+    thread.start()
+    return server
+
+
+def serve_forever(session: ServingSession, host: str = "127.0.0.1",
+                  port: int = 18080) -> None:
+    """Blocking variant for the CLI `serve` task."""
+    server = _ServingHTTPServer((host, int(port)), _Handler)
+    server.session = session
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:  # clean ^C exit for the CLI
+        pass
+    finally:
+        server.server_close()
+        session.close()

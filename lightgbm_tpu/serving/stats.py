@@ -1,0 +1,100 @@
+"""Serving observability: rolling latency percentiles + counters.
+
+One `ServingStats` instance is shared by the whole serving stack
+(registry, batcher, session, HTTP endpoint).  Everything is O(1) per
+event under one lock: latencies land in a fixed ring buffer (percentiles
+are computed lazily at `snapshot()` time), batch fill is a running
+numerator/denominator, and the compile-cache accounting is a set of
+launch-shape keys — a shape first seen AFTER warmup is a
+`compile_cache_misses` increment, which is exactly the quantity the
+warmup contract promises stays at zero for request sizes within
+`serving_max_batch_rows`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Hashable
+
+import numpy as np
+
+_COUNTERS = (
+    "requests_total", "rows_total", "batches_total", "requests_shed",
+    "requests_timeout", "device_fallbacks", "compile_cache_hits",
+    "compile_cache_misses", "compiles_warmup", "models_loaded",
+    "models_evicted",
+)
+
+
+class ServingStats:
+    """Thread-safe serving counters + rolling latency window."""
+
+    def __init__(self, window: int = 4096):
+        self._lock = threading.Lock()
+        self._window = max(int(window), 16)
+        self._lat = np.zeros(self._window, np.float64)
+        self._lat_n = 0  # total latencies ever recorded
+        self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        self._fill_rows = 0      # real rows dispatched
+        self._fill_bucket = 0    # padded launch rows they rode in
+        self._queue_depth = 0
+        self._shapes: set = set()
+
+    # -- events --------------------------------------------------------
+    def count(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def record_latency(self, seconds: float) -> None:
+        with self._lock:
+            self._lat[self._lat_n % self._window] = seconds
+            self._lat_n += 1
+
+    def note_batch(self, rows: int, bucket: int, launches: int = 1) -> None:
+        """One dispatched batch: `rows` real rows across `launches`
+        device launches totalling `bucket` padded rows (fill ratio =
+        rows / padded rows aggregated over batches)."""
+        with self._lock:
+            self._counters["batches_total"] += max(int(launches), 1)
+            self._fill_rows += int(rows)
+            self._fill_bucket += max(int(bucket), 1)
+
+    def note_shape(self, key: Hashable, warmup: bool = False) -> bool:
+        """Record one jit launch shape; returns True when it is new.
+
+        New shapes during warmup count as `compiles_warmup`; new shapes
+        afterwards are `compile_cache_misses` (the number the
+        zero-cold-compile acceptance test asserts on)."""
+        with self._lock:
+            if key in self._shapes:
+                self._counters["compile_cache_hits"] += 1
+                return False
+            self._shapes.add(key)
+            self._counters["compiles_warmup" if warmup
+                           else "compile_cache_misses"] += 1
+            return True
+
+    def set_queue_depth(self, rows: int) -> None:
+        with self._lock:
+            self._queue_depth = int(rows)
+
+    # -- reading -------------------------------------------------------
+    def snapshot(self) -> Dict:
+        with self._lock:
+            out = dict(self._counters)
+            n = min(self._lat_n, self._window)
+            lat = self._lat[:n].copy()
+            out["queue_depth_rows"] = self._queue_depth
+            out["batch_fill_ratio"] = (
+                round(self._fill_rows / self._fill_bucket, 4)
+                if self._fill_bucket else 0.0)
+            out["latency_window"] = int(n)
+        if n:
+            p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
+            out["latency_p50_ms"] = round(float(p50) * 1e3, 3)
+            out["latency_p95_ms"] = round(float(p95) * 1e3, 3)
+            out["latency_p99_ms"] = round(float(p99) * 1e3, 3)
+        else:
+            out["latency_p50_ms"] = out["latency_p95_ms"] = \
+                out["latency_p99_ms"] = 0.0
+        return out

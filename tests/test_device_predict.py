@@ -239,6 +239,105 @@ class TestPredictPaths:
         np.testing.assert_allclose(p_dev, p_native, rtol=0, atol=1e-5)
 
 
+class TestReloadedModelDevicePath:
+    """save_model / model_to_string round trips carry the bin-mapper
+    snapshot (`tpu_bin_mappers:` trailer), so a RELOADED booster keeps
+    the packed-forest device path instead of silently degrading to the
+    host walker."""
+
+    def _assert_device_path_used(self, booster, X):
+        """Predict with the native walker broken: only the packed path
+        can produce the answer."""
+        drv = booster._driver
+
+        def boom(*a, **k):
+            raise AssertionError("native walker used on a reloaded model")
+
+        real = drv.predict_raw
+        drv.predict_raw = boom
+        try:
+            return booster.predict(X, raw_score=True, device="tpu")
+        finally:
+            drv.predict_raw = real
+
+    def test_model_file_reload_stays_on_device(self, tmp_path):
+        X, y, cats = _make_data()
+        bst = _train(X, y, cats)
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        assert "tpu_bin_mappers:" in open(path).read()
+        loaded = lgb.Booster(params=dict(DEVICE_ON), model_file=path)
+        assert loaded._driver._pred_context() is not None
+        dev = self._assert_device_path_used(loaded, X)
+        # bitwise vs the original booster's device predict, allclose vs
+        # its native walker (the usual f32-traversal tolerance)
+        np.testing.assert_array_equal(
+            dev, bst.predict(X, raw_score=True, device="tpu"))
+        np.testing.assert_allclose(dev, bst.predict(X, raw_score=True),
+                                   rtol=0, atol=1e-5)
+
+    def test_model_str_reload_num_iteration_subsets(self):
+        X, y, cats = _make_data()
+        bst = _train(X, y, cats, rounds=10)
+        loaded = lgb.Booster(params=dict(DEVICE_ON),
+                             model_str=bst.model_to_string())
+        for ni in (1, 4, 10):
+            np.testing.assert_array_equal(
+                loaded.predict(X, raw_score=True, num_iteration=ni,
+                               device="tpu"),
+                bst.predict(X, raw_score=True, num_iteration=ni,
+                            device="tpu"),
+                err_msg=f"num_iteration={ni}")
+
+    def test_pickle_roundtrip_keeps_device_path(self):
+        import pickle
+
+        X, y, cats = _make_data(n=800)
+        bst = _train(X, y, cats, rounds=4)
+        clone = pickle.loads(pickle.dumps(bst))
+        assert clone._driver._pred_context() is not None
+        np.testing.assert_array_equal(
+            self._assert_device_path_used(clone, X),
+            bst.predict(X, raw_score=True, device="tpu"))
+
+    def test_double_reload_preserves_snapshot(self):
+        """Re-saving a reloaded booster keeps the trailer (the snapshot
+        survives save -> load -> save -> load)."""
+        X, y, cats = _make_data(n=800)
+        bst = _train(X, y, cats, rounds=3)
+        text1 = bst.model_to_string()
+        loaded1 = lgb.Booster(params=dict(DEVICE_ON), model_str=text1)
+        text2 = loaded1.model_to_string()
+        assert "tpu_bin_mappers:" in text2
+        loaded2 = lgb.Booster(params=dict(DEVICE_ON), model_str=text2)
+        np.testing.assert_array_equal(
+            loaded2.predict(X, raw_score=True, device="tpu"),
+            bst.predict(X, raw_score=True, device="tpu"))
+
+    def test_stripped_snapshot_degrades_to_host_walker(self):
+        """Reference-produced models (no trailer) keep working on the
+        native walker — the device path is opt-in via the snapshot."""
+        X, y, cats = _make_data(n=800)
+        bst = _train(X, y, cats, rounds=3)
+        text = bst.model_to_string()
+        stripped = text[:text.rfind("tpu_bin_mappers:")]
+        loaded = lgb.Booster(params=dict(DEVICE_ON), model_str=stripped)
+        assert loaded._driver._pred_context() is None
+        # native walker vs native walker: exact
+        np.testing.assert_array_equal(
+            loaded.predict(X, raw_score=True, device="cpu"),
+            bst.predict(X, raw_score=True, device="cpu"))
+
+    def test_corrupt_snapshot_line_raises(self):
+        X, y, cats = _make_data(n=600)
+        bst = _train(X, y, cats, rounds=2)
+        text = bst.model_to_string()
+        broken = text.rsplit("tpu_bin_mappers:", 1)[0] \
+            + "tpu_bin_mappers:{\"num_total\n"
+        with pytest.raises(ValueError, match="corrupt tpu_bin_mappers"):
+            lgb.Booster(model_str=broken)
+
+
 class TestValidScoringPipeline:
     def test_valid_scores_match_host_replay(self):
         X, y, cats = _make_data(n=1200)

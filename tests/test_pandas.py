@@ -140,6 +140,67 @@ class TestPandasEdgeCases:
         with pytest.raises(ValueError, match="no stored pandas category"):
             bst.predict(df)
 
+    def test_categorical_roundtrip_predictions_bitwise(self, tmp_path):
+        """save_model -> Booster(model_file) with a pandas-categorical
+        table: predictions must match pre-save EXACTLY (thresholds and
+        leaf values round-trip through repr, the category table through
+        the `pandas_categorical:` JSON line)."""
+        df, y = _frame()
+        ds = lgb.Dataset(df, label=y)
+        bst = lgb.train(PARAMS, ds, num_boost_round=6)
+        pre = bst.predict(df)
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        loaded = lgb.Booster(model_file=path)
+        np.testing.assert_array_equal(loaded.predict(df), pre)
+        # string round trip too, and with reordered category declarations
+        b2 = lgb.Booster(model_str=bst.model_to_string())
+        df2 = df.copy()
+        df2["color"] = df2["color"].cat.reorder_categories(
+            ["violet", "blue", "green", "red"])
+        np.testing.assert_array_equal(b2.predict(df2), pre)
+
+    def test_numpy_scalar_categories_roundtrip(self, tmp_path):
+        """np.integer / np.floating category values go through the
+        _pandas_categorical_line np_default converter and come back as
+        plain ints/floats."""
+        rng = np.random.default_rng(31)
+        n = 1500
+        cats = np.array([5, 15, 25], dtype=np.int64)
+        code = pd.Categorical.from_codes(rng.integers(0, 3, size=n),
+                                         categories=cats)
+        df = pd.DataFrame({"c": code, "x": rng.normal(size=n)})
+        y = (np.asarray(code.codes) == 2).astype(float) * 2 \
+            + df["x"].to_numpy() * 0.1
+        ds = lgb.Dataset(df, label=y)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1}, ds, num_boost_round=4)
+        assert [int(c) for c in bst.pandas_categorical[0]] == [5, 15, 25]
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        loaded = lgb.Booster(model_file=path)
+        assert loaded.pandas_categorical == [[5, 15, 25]]
+        np.testing.assert_array_equal(loaded.predict(df), bst.predict(df))
+
+    def test_unsupported_category_type_fails_at_save(self):
+        """Non-str/int/float category values must fail AT SAVE TIME: a
+        str() fallback would write a table whose values no longer match
+        the frame's at predict time (everything -> missing)."""
+        rng = np.random.default_rng(33)
+        n = 600
+        stamps = pd.to_datetime(["2020-01-01", "2021-06-01", "2022-12-31"])
+        code = pd.Categorical.from_codes(rng.integers(0, 3, size=n),
+                                         categories=stamps)
+        df = pd.DataFrame({"c": code, "x": rng.normal(size=n)})
+        y = df["x"].to_numpy() + (np.asarray(code.codes) == 1)
+        ds = lgb.Dataset(df, label=y)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1}, ds, num_boost_round=2)
+        with pytest.raises(TypeError, match="cannot persist"):
+            bst.model_to_string()
+        with pytest.raises(TypeError, match="cannot persist"):
+            bst.save_model("/dev/null")
+
     def test_corrupt_table_line_raises(self):
         df, y = _frame(n=500)
         ds = lgb.Dataset(df, label=y)

@@ -1,0 +1,641 @@
+"""Serving runtime (lightgbm_tpu/serving): registry + micro-batching.
+
+Contracts under test:
+* `ServingSession.predict` is BITWISE-identical to a direct
+  `Booster.predict` through the same device path for every
+  missing-type/categorical/dtype case — batching, coalescing, and
+  launch padding never change a row's value.
+* concurrency: a 64-thread hammer sees zero cross-request bleed.
+* admission control sheds deterministically; timeouts raise.
+* registry warmup bounds compiles: a request-size sweep 1..4096 after
+  load triggers ZERO new jit compilations.
+* hot-swap flips atomically; LRU evicts non-current versions.
+
+Everything runs under JAX_PLATFORMS=cpu (tier-1).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from .conftest import *  # noqa: F401,F403  (cpu backend pin)
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import (MicroBatcher, ServingQueueFull,
+                                  ServingSession, ServingStats,
+                                  ServingTimeout, serve_http)
+
+PARAMS = {"objective": "binary", "num_leaves": 15,
+          "tpu_predict_device": "true", "verbose": -1}
+
+
+def _make_data(n=4500, f=6, seed=0, with_nan=True, with_zero=True,
+               with_cat=True):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    if with_nan:
+        X[rng.random((n, f)) < 0.12] = np.nan
+    if with_zero:
+        X[:, 2] = np.where(rng.random(n) < 0.55, 0.0, X[:, 2])
+    cat_cols = []
+    if with_cat:
+        X[:, f - 1] = rng.integers(0, 14, size=n).astype(float)
+        cat_cols = [f - 1]
+    y = (np.nansum(X[:, :3], axis=1)
+         + (X[:, f - 1] % 3 == 0 if with_cat else 0) > 0).astype(float)
+    return X, y, cat_cols
+
+
+def _train(X, y, cat_cols, params=None, rounds=8):
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                     categorical_feature=cat_cols or "auto")
+    return lgb.train({**PARAMS, **(params or {})}, ds,
+                     num_boost_round=rounds, verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One trained model loaded into a running session."""
+    X, y, cats = _make_data()
+    bst = _train(X, y, cats)
+    sess = ServingSession(params={"serving_max_batch_rows": 4096,
+                                  "serving_max_wait_ms": 2.0})
+    sess.load("m", booster=bst)
+    yield sess, bst, X
+    sess.close()
+
+
+class TestServingParity:
+    @pytest.mark.parametrize("with_nan,with_zero,with_cat",
+                             [(True, True, True), (True, False, False),
+                              (False, True, True), (False, False, False)])
+    def test_bitwise_vs_direct_predict(self, with_nan, with_zero, with_cat):
+        X, y, cats = _make_data(n=1500, with_nan=with_nan,
+                                with_zero=with_zero, with_cat=with_cat)
+        bst = _train(X, y, cats)
+        sess = ServingSession()
+        sess.load("m", booster=bst)
+        try:
+            for sz in (1, 3, 97, 700):
+                got = sess.predict("m", X[:sz])
+                solo = bst.predict(X[:sz], device="tpu")
+                np.testing.assert_array_equal(
+                    got, solo, err_msg=f"size {sz} diverged from direct "
+                    "Booster.predict")
+        finally:
+            sess.close()
+
+    def test_dtype_cases(self, served):
+        sess, bst, X = served
+        for cast in (np.float32, np.float64):
+            Xc = X[:64].astype(cast)
+            np.testing.assert_array_equal(
+                sess.predict("m", Xc), bst.predict(Xc, device="tpu"),
+                err_msg=f"dtype {cast} diverged")
+        Xi = np.nan_to_num(X[:64], nan=0.0).astype(np.int64)
+        np.testing.assert_array_equal(sess.predict("m", Xi),
+                                      bst.predict(Xi, device="tpu"))
+        # 1-d single row
+        row = X[5]
+        np.testing.assert_array_equal(sess.predict("m", row),
+                                      bst.predict(row[None], device="tpu"))
+
+    def test_raw_score_and_num_iteration(self, served):
+        sess, bst, X = served
+        got = sess.predict("m", X[:50], raw_score=True, num_iteration=3)
+        solo = bst.predict(X[:50], raw_score=True, num_iteration=3,
+                           device="tpu")
+        np.testing.assert_array_equal(got, solo)
+
+    def test_best_iteration_honored_by_default(self):
+        """num_iteration=None must resolve to best_iteration exactly
+        like direct Booster.predict (early-stopped models) — and warmup
+        must pre-compile THAT subset's shapes, not the full forest's."""
+        X, y, cats = _make_data(n=1200)
+        bst = _train(X, y, cats, rounds=8)
+        bst.best_iteration = 3
+        sess = ServingSession()  # warmup ON
+        sess.load("es", booster=bst)
+        try:
+            np.testing.assert_array_equal(
+                sess.predict("es", X[:40]),
+                bst.predict(X[:40], device="tpu"))
+            # and that is genuinely the 3-iteration subset
+            np.testing.assert_array_equal(
+                sess.predict("es", X[:40]),
+                bst.predict(X[:40], num_iteration=3, device="tpu"))
+            assert sess.stats()["compile_cache_misses"] == 0, \
+                "warmup compiled the wrong num_iteration subset"
+        finally:
+            sess.close()
+
+    def test_multiclass_scatter(self):
+        X, y, _ = _make_data(n=1200, with_cat=False)
+        y3 = (np.abs(y * 2 + (X[:, 0] > 0)) % 3).astype(float)
+        bst = _train(X, y3, [], params={"objective": "multiclass",
+                                        "num_class": 3})
+        sess = ServingSession()
+        sess.load("mc", booster=bst)
+        try:
+            got = sess.predict("mc", X[:41])
+            assert got.shape == (41, 3)
+            np.testing.assert_array_equal(got,
+                                          bst.predict(X[:41], device="tpu"))
+        finally:
+            sess.close()
+
+    def test_pandas_frame_requests(self):
+        pd = pytest.importorskip("pandas")
+        rng = np.random.default_rng(5)
+        n = 1500
+        df = pd.DataFrame({
+            "x0": rng.normal(size=n),
+            "x1": rng.normal(size=n),
+            "color": pd.Categorical.from_codes(
+                rng.integers(0, 4, size=n),
+                ["red", "green", "blue", "violet"]),
+        })
+        y = (df["x0"].to_numpy() + (df["color"].cat.codes.to_numpy() == 1)
+             > 0).astype(float)
+        ds = lgb.Dataset(df, label=y)
+        bst = lgb.train(PARAMS, ds, num_boost_round=5, verbose_eval=False)
+        sess = ServingSession()
+        sess.load("pd", booster=bst)
+        try:
+            got = sess.predict("pd", df.iloc[:77])
+            solo = bst.predict(df.iloc[:77], device="tpu")
+            np.testing.assert_array_equal(got, solo)
+        finally:
+            sess.close()
+
+
+class TestConcurrency:
+    def test_64_thread_hammer_zero_bleed(self, served):
+        sess, bst, X = served
+        n_threads, reqs = 64, 3
+        rng = np.random.default_rng(1000)
+        # per-thread request slices + solo oracle answers, computed
+        # sequentially up front so the hammer itself only exercises the
+        # serving path
+        plans = []
+        for i in range(n_threads):
+            plan = []
+            for _ in range(reqs):
+                sz = int(rng.integers(1, 60))
+                lo = int(rng.integers(0, X.shape[0] - sz))
+                Xi = X[lo:lo + sz]
+                plan.append((Xi, bst.predict(Xi, device="tpu")))
+            plans.append(plan)
+        failures = []
+        barrier = threading.Barrier(n_threads)
+
+        def worker(i):
+            barrier.wait()
+            for r, (Xi, solo) in enumerate(plans[i]):
+                try:
+                    got = sess.predict("m", Xi)
+                except Exception as exc:
+                    failures.append((i, r, repr(exc)))
+                    continue
+                if got.shape != solo.shape or not np.array_equal(got, solo):
+                    failures.append((i, r, "result bleed"))
+
+        ts = [threading.Thread(target=worker, args=(i,))
+              for i in range(n_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not failures, failures[:5]
+        st = sess.stats()
+        # the hammer must actually have exercised coalescing
+        assert st["batches_total"] < st["requests_total"]
+
+    def test_padded_rows_never_leak(self, served):
+        sess, bst, X = served
+        for sz in (1, 2, 3, 5):
+            got = sess.predict("m", X[:sz])
+            assert got.shape == (sz,)
+            np.testing.assert_array_equal(got,
+                                          bst.predict(X[:sz], device="tpu"))
+
+
+class TestAdmissionControl:
+    def test_queue_full_sheds_deterministically(self):
+        stats = ServingStats()
+        b = MicroBatcher(max_batch_rows=64, max_wait_ms=50.0,
+                         queue_rows=100, stats=stats)  # worker NOT started
+        runner = lambda Xb: Xb[:, 0]  # noqa: E731
+        b.submit("k", runner, np.zeros((60, 2)))
+        b.submit("k", runner, np.zeros((40, 2)))   # exactly at capacity
+        with pytest.raises(ServingQueueFull):
+            b.submit("k", runner, np.zeros((1, 2)))
+        snap = stats.snapshot()
+        assert snap["requests_shed"] == 1
+        assert snap["requests_total"] == 2
+        assert snap["queue_depth_rows"] == 100
+
+    def test_timeout_raises(self):
+        X, y, cats = _make_data(n=600)
+        bst = _train(X, y, cats, rounds=2)
+        sess = ServingSession(params={"serving_warmup": False},
+                              start=False)  # no worker -> guaranteed stall
+        sess.load("m", booster=bst)
+        try:
+            with pytest.raises(ServingTimeout):
+                sess.predict("m", X[:4], timeout_ms=50)
+            assert sess.stats()["requests_timeout"] == 1
+        finally:
+            sess.close()
+
+    def test_wrong_width_request_fails_alone(self, served):
+        """Feature width is part of the batch key: a malformed request
+        errors by itself and never poisons well-formed traffic."""
+        sess, bst, X = served
+        from lightgbm_tpu.utils.log import LightGBMError
+
+        with pytest.raises(LightGBMError, match="number of features"):
+            sess.predict("m", X[:8, :3])
+        np.testing.assert_array_equal(sess.predict("m", X[:8]),
+                                      bst.predict(X[:8], device="tpu"))
+
+    def test_drained_queue_releases_runner(self):
+        """Runner closures must not outlive their queue — a retained one
+        would pin an LRU-evicted model's packed forest forever."""
+        b = MicroBatcher(max_batch_rows=64, max_wait_ms=0.0)
+        b.start()
+        try:
+            r = b.submit("k", lambda Xb: Xb[:, 0], np.zeros((3, 2)))
+            b.wait(r, 5.0)
+            with b._cv:
+                assert not b._runners and not b._queues
+        finally:
+            b.close()
+
+    def test_empty_submit_rejected_and_errors_stay_out_of_latency(self):
+        stats = ServingStats()
+        b = MicroBatcher(max_batch_rows=64, max_wait_ms=0.0, stats=stats)
+        with pytest.raises(ValueError, match="at least one slice"):
+            b.submit_many("k", lambda Xb: Xb, [])
+        b.start()
+        try:
+
+            def boom(Xb):
+                raise RuntimeError("nope")
+
+            r = b.submit("k", boom, np.zeros((2, 2)))
+            with pytest.raises(RuntimeError):
+                b.wait(r, 5.0)
+            assert stats.snapshot()["latency_window"] == 0, \
+                "failed request polluted the latency percentiles"
+            # the worker survived the empty-submit attempt and the error
+            ok = b.submit("k2", lambda Xb: Xb[:, 0], np.zeros((3, 2)))
+            assert b.wait(ok, 5.0).shape == (3,)
+        finally:
+            b.close()
+
+    def test_abandoned_requests_are_shed_not_computed(self):
+        """Slices whose caller already timed out must never reach the
+        runner — wasted device work under overload kills goodput."""
+        ran = []
+        b = MicroBatcher(max_batch_rows=64, max_wait_ms=0.0)
+
+        def runner(Xb):
+            ran.append(Xb.shape[0])
+            return Xb[:, 0]
+
+        r1 = b.submit("k", runner, np.zeros((3, 2)))
+        r1.abandoned = True              # caller departed before start()
+        r2 = b.submit("k", runner, np.zeros((5, 2)))
+        b.start()
+        try:
+            out = b.wait(r2, 5.0)
+            assert out.shape == (5,)
+            assert ran == [5], "abandoned slice was computed"
+            with b._cv:
+                assert b._pending_rows == 0
+        finally:
+            b.close()
+
+    def test_runner_error_delivered_to_all_waiters(self):
+        stats = ServingStats()
+        b = MicroBatcher(max_batch_rows=64, max_wait_ms=1.0, stats=stats)
+        b.start()
+
+        def boom(Xb):
+            raise RuntimeError("kernel exploded")
+
+        try:
+            r1 = b.submit("k", boom, np.zeros((3, 2)))
+            r2 = b.submit("k", boom, np.zeros((4, 2)))
+            for r in (r1, r2):
+                with pytest.raises(RuntimeError, match="kernel exploded"):
+                    b.wait(r, 5.0)
+        finally:
+            b.close()
+
+
+class TestWarmupBoundsCompiles:
+    def test_sweep_1_to_4096_zero_new_compiles(self):
+        X, y, cats = _make_data(n=4500)
+        bst = _train(X, y, cats)
+        sess = ServingSession(params={"serving_max_batch_rows": 4096})
+        sess.load("m", booster=bst)
+        try:
+            st0 = sess.stats()
+            assert st0["compiles_warmup"] >= 3  # 1024/2048/4096 buckets
+            assert st0["compile_cache_misses"] == 0
+            from lightgbm_tpu.ops.predict import _class_scores_kernel
+
+            jit_before = (_class_scores_kernel._cache_size()
+                          if hasattr(_class_scores_kernel, "_cache_size")
+                          else None)
+            for sz in (1, 2, 3, 7, 64, 100, 513, 1024, 1025, 2048, 2049,
+                       3000, 4095, 4096):
+                sess.predict("m", X[:sz])
+            st = sess.stats()
+            assert st["compile_cache_misses"] == 0, \
+                "request-size sweep hit a cold compile after warmup"
+            assert st["compile_cache_hits"] >= 14
+            if jit_before is not None:
+                assert _class_scores_kernel._cache_size() == jit_before, \
+                    "the jit cache itself grew during the sweep"
+            # oversize requests split into warmed max_batch_rows slices
+            # instead of hitting a cold 8192-row bucket
+            Xbig = np.concatenate([X, X[:1500]], axis=0)  # 6000 rows
+            got = sess.predict("m", Xbig)
+            assert got.shape == (6000,)
+            assert sess.stats()["compile_cache_misses"] == 0
+            if jit_before is not None:
+                assert _class_scores_kernel._cache_size() == jit_before
+            # value check against the native walker (a solo 6000-row
+            # DEVICE predict would itself compile the 8192 bucket)
+            np.testing.assert_allclose(
+                got, bst.predict(Xbig, device="cpu"), rtol=0, atol=1e-5)
+        finally:
+            sess.close()
+
+
+class TestRegistry:
+    def test_hot_swap_flips_atomically(self):
+        X, y, cats = _make_data(n=900, seed=1)
+        bst_a = _train(X, y, cats, rounds=3)
+        bst_b = _train(X, y, cats, rounds=7)
+        sess = ServingSession(params={"serving_warmup": False})
+        try:
+            k1 = sess.load("m", booster=bst_a)
+            assert k1 == "m@1"
+            np.testing.assert_array_equal(sess.predict("m", X[:30]),
+                                          bst_a.predict(X[:30],
+                                                        device="tpu"))
+            k2 = sess.load("m", booster=bst_b)  # hot-swap
+            assert k2 == "m@2"
+            np.testing.assert_array_equal(sess.predict("m", X[:30]),
+                                          bst_b.predict(X[:30],
+                                                        device="tpu"))
+            # the retired version stays addressable by full key
+            np.testing.assert_array_equal(sess.predict("m@1", X[:30]),
+                                          bst_a.predict(X[:30],
+                                                        device="tpu"))
+        finally:
+            sess.close()
+
+    def test_hot_swap_never_flips_backwards(self):
+        """Concurrent loads finish warmup in arbitrary order; a slower
+        OLDER version must not steal the alias back from a newer one."""
+        X, y, cats = _make_data(n=700, seed=6)
+        bst_a = _train(X, y, cats, rounds=2)
+        bst_b = _train(X, y, cats, rounds=5)
+        sess = ServingSession(params={"serving_warmup": False})
+        try:
+            sess.load("m", booster=bst_b, version=2)  # newer lands first
+            sess.load("m", booster=bst_a, version=1)  # stale finisher
+            np.testing.assert_array_equal(
+                sess.predict("m", X[:20]),
+                bst_b.predict(X[:20], device="tpu"))
+            # the stale version is still resident under its full key
+            np.testing.assert_array_equal(
+                sess.predict("m@1", X[:20]),
+                bst_a.predict(X[:20], device="tpu"))
+        finally:
+            sess.close()
+
+    def test_lru_evicts_non_current_versions(self):
+        X, y, cats = _make_data(n=900, seed=2)
+        boosters = [_train(X, y, cats, rounds=2) for _ in range(3)]
+        sess = ServingSession(params={"serving_max_models": 2,
+                                      "serving_warmup": False})
+        try:
+            sess.load("m", booster=boosters[0])      # m@1
+            sess.load("m", booster=boosters[1])      # m@2 (current)
+            sess.load("other", booster=boosters[2])  # forces eviction
+            with pytest.raises(KeyError):
+                sess.predict("m@1", X[:5])
+            # current versions survive
+            sess.predict("m", X[:5])
+            sess.predict("other", X[:5])
+            st = sess.stats()
+            assert st["models_loaded"] == 3 and st["models_evicted"] == 1
+        finally:
+            sess.close()
+
+    def test_load_does_not_mutate_adopted_booster(self):
+        """Serving pins the device path per CALL; the user's booster
+        must behave exactly as before outside the session."""
+        X, y, cats = _make_data(n=700, seed=9)
+        ds = lgb.Dataset(X, label=y, params={"max_bin": 31},
+                         categorical_feature=cats)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbose": -1}, ds, num_boost_round=3,
+                        verbose_eval=False)  # note: no tpu_predict_device
+        before = dict(bst.params)
+        p_before = bst.predict(X[:30])
+        sess = ServingSession(params={"serving_warmup": False})
+        try:
+            sess.load("m", booster=bst)
+            sess.predict("m", X[:10])
+            assert bst.params == before
+            np.testing.assert_array_equal(bst.predict(X[:30]), p_before)
+        finally:
+            sess.close()
+
+    def test_unload_current_version_realises_rollback(self):
+        """Unloading the bad current version re-points the bare name at
+        the newest surviving version instead of going dark."""
+        X, y, cats = _make_data(n=700, seed=10)
+        bst_a = _train(X, y, cats, rounds=2)
+        bst_b = _train(X, y, cats, rounds=4)
+        sess = ServingSession(params={"serving_warmup": False})
+        try:
+            sess.load("m", booster=bst_a)   # m@1
+            sess.load("m", booster=bst_b)   # m@2 current
+            sess.unload("m@2")              # roll back the bad deploy
+            np.testing.assert_array_equal(
+                sess.predict("m", X[:10]),
+                bst_a.predict(X[:10], device="tpu"))
+        finally:
+            sess.close()
+
+    def test_mixed_explicit_implicit_versions_never_collide(self):
+        X, y, cats = _make_data(n=700, seed=7)
+        bst_a = _train(X, y, cats, rounds=2)
+        bst_b = _train(X, y, cats, rounds=4)
+        sess = ServingSession(params={"serving_warmup": False})
+        try:
+            sess.load("m", booster=bst_a, version=2)
+            key = sess.load("m", booster=bst_b)  # implicit: must NOT be m@2
+            assert key == "m@3"
+            np.testing.assert_array_equal(
+                sess.predict("m@2", X[:10]),
+                bst_a.predict(X[:10], device="tpu"))
+        finally:
+            sess.close()
+
+    def test_unload_releases_every_version(self):
+        X, y, cats = _make_data(n=700, seed=8)
+        boosters = [_train(X, y, cats, rounds=2) for _ in range(2)]
+        sess = ServingSession(params={"serving_warmup": False})
+        try:
+            sess.load("m", booster=boosters[0])
+            sess.load("m", booster=boosters[1])
+            sess.unload("m")
+            assert sess.models() == []
+            with pytest.raises(KeyError):
+                sess.predict("m@1", X[:2])
+        finally:
+            sess.close()
+
+    def test_request_beyond_queue_capacity_is_caller_error(self, served):
+        sess, _, X = served
+        big = np.zeros((int(sess.config.serving_queue_rows) + 1, X.shape[1]))
+        with pytest.raises(ValueError, match="serving_queue_rows"):
+            sess.predict("m", big)
+
+    def test_unknown_model_and_bad_name(self, served):
+        sess, _, X = served
+        with pytest.raises(KeyError):
+            sess.predict("nope", X[:2])
+        with pytest.raises(ValueError, match="@"):
+            sess.load("bad@name", model_str="x")
+
+    def test_model_without_mapper_snapshot_serves_native(self):
+        """A reference-style model string (no tpu_bin_mappers trailer)
+        still serves — through the native walker, with no launch-shape
+        accounting."""
+        X, y, cats = _make_data(n=700, seed=3)
+        bst = _train(X, y, cats, rounds=2)
+        text = bst.model_to_string()
+        stripped = text[:text.rfind("tpu_bin_mappers:")]
+        assert "tpu_bin_mappers:" not in stripped
+        sess = ServingSession()
+        try:
+            sess.load("legacy", model_str=stripped)
+            entry = sess.registry.resolve("legacy")
+            assert not entry.device_on
+            got = sess.predict("legacy", X[:40])
+            np.testing.assert_allclose(got,
+                                       bst.predict(X[:40], device="cpu"),
+                                       rtol=0, atol=1e-12)
+            assert sess.stats()["compiles_warmup"] == 0
+        finally:
+            sess.close()
+
+    def test_device_failure_falls_back_to_host_walker(self, monkeypatch):
+        X, y, cats = _make_data(n=700, seed=4)
+        bst = _train(X, y, cats, rounds=3)
+        sess = ServingSession(params={"serving_warmup": False})
+        try:
+            sess.load("m", booster=bst)
+            monkeypatch.setattr(
+                bst._driver, "predict_raw_device",
+                lambda *a, **k: (_ for _ in ()).throw(
+                    RuntimeError("device lost")))
+            got = sess.predict("m", X[:25])
+            np.testing.assert_allclose(
+                got, bst.predict(X[:25], device="cpu"), rtol=0, atol=1e-12)
+            assert sess.stats()["device_fallbacks"] >= 1
+        finally:
+            sess.close()
+
+
+class TestServeCLI:
+    def test_serve_task_requires_input_model(self):
+        from lightgbm_tpu.application import Application
+
+        with pytest.raises(ValueError, match="input_model"):
+            Application(["task=serve"]).run()
+
+    def test_bare_serve_argv_maps_to_task(self, monkeypatch):
+        from lightgbm_tpu import application
+
+        seen = {}
+
+        class FakeApp:
+            def __init__(self, argv):
+                seen["params"] = application.parse_argv(argv)
+
+            def run(self):
+                pass
+
+        monkeypatch.setattr(application, "Application", FakeApp)
+        assert application.main(["serve", "serving_port=0"]) == 0
+        assert seen["params"]["task"] == "serve"
+        assert seen["params"]["serving_port"] == "0"
+
+
+class TestHTTPEndpoint:
+    @pytest.fixture()
+    def http_served(self, served):
+        sess, bst, X = served
+        server = serve_http(sess, "127.0.0.1", 0)
+        port = server.server_address[1]
+        yield f"http://127.0.0.1:{port}", bst, X
+        server.shutdown()
+
+    @staticmethod
+    def _post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_predict_roundtrip(self, http_served):
+        base, bst, X = http_served
+        rows = np.nan_to_num(X[:9], nan=0.0)  # JSON carries no NaN
+        status, out = self._post(base + "/predict",
+                                 {"model": "m", "rows": rows.tolist()})
+        assert status == 200
+        np.testing.assert_array_equal(np.asarray(out["predictions"]),
+                                      bst.predict(rows, device="tpu"))
+
+    def test_stats_and_models_routes(self, http_served):
+        base, _, _ = http_served
+        with urllib.request.urlopen(base + "/stats") as resp:
+            st = json.loads(resp.read())
+        for key in ("latency_p50_ms", "latency_p95_ms", "latency_p99_ms",
+                    "queue_depth_rows", "batch_fill_ratio",
+                    "compile_cache_misses", "requests_shed"):
+            assert key in st
+        with urllib.request.urlopen(base + "/models") as resp:
+            models = json.loads(resp.read())["models"]
+        assert any(m["key"] == "m@1" and m["current"] for m in models)
+
+    def test_unknown_model_404_and_bad_body_400(self, http_served):
+        base, _, _ = http_served
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base + "/predict", {"model": "nope", "rows": [[0.0]]})
+        assert ei.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base + "/predict", {"rows": [[0.0]]})
+        assert ei.value.code == 400
+        # wrong feature count is a CALLER error (LightGBMError -> 400),
+        # not a 500 server fault
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base + "/predict",
+                       {"model": "m", "rows": [[0.0, 1.0]]})
+        assert ei.value.code == 400

@@ -1,0 +1,193 @@
+"""Closed-loop load generator for the serving runtime.
+
+Hammers a `ServingSession` with concurrent predict requests and reports
+achieved QPS / rows/s / latency percentiles per configuration — the
+serving analog of `tools/perf_probe.py predict`.
+
+Modes:
+* batch-size sweep (default): one line per request size in `--sweep`,
+  each run closed-loop (every worker fires its next request as soon as
+  the previous returns).
+* target QPS (`--qps N`): workers pace their requests to an aggregate
+  open-loop arrival rate, reporting achieved QPS and shed counts — the
+  overload-behavior probe.
+
+The model comes from `--model model.txt`, or a synthetic binary model is
+trained in-process (same shape family as bench.py, much smaller).
+
+Usage:
+    python tools/serve_bench.py                      # sweep 1..4096
+    python tools/serve_bench.py --qps 500 --rows 64  # paced load
+    python tools/serve_bench.py --model model.txt --threads 16
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_model(n=20000, f=16, rounds=20):
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(n, f))
+    y = ((X[:, :4] ** 2 - 1.0).sum(axis=1) + rng.logistic(size=n)
+         > 0).astype(np.float64)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "verbosity": -1}, ds, num_boost_round=rounds,
+                    verbose_eval=False)
+    return bst, X
+
+
+def run_closed_loop(sess, name, X, rows, threads, duration_s):
+    """Every worker fires back-to-back requests for `duration_s`."""
+    stop = time.monotonic() + duration_s
+    counts = [0] * threads
+    errors = [0] * threads
+
+    def worker(i):
+        Xi = X[:rows]
+        while time.monotonic() < stop:
+            try:
+                sess.predict(name, Xi, raw_score=True)
+                counts[i] += 1
+            except Exception:
+                errors[i] += 1
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.monotonic() - t0
+    return sum(counts), sum(errors), dt
+
+
+def run_paced(sess, name, X, rows, threads, qps, duration_s):
+    """Open-loop: aggregate arrivals paced to `qps` across workers."""
+    period = threads / float(qps)  # each worker fires every `period` s
+    stop = time.monotonic() + duration_s
+    counts = [0] * threads
+    shed = [0] * threads
+
+    def worker(i):
+        from lightgbm_tpu.serving import ServingQueueFull, ServingTimeout
+
+        Xi = X[:rows]
+        next_t = time.monotonic() + (i / threads) * period
+        while True:
+            now = time.monotonic()
+            if now >= stop:
+                return
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.01))
+                continue
+            next_t += period
+            try:
+                sess.predict(name, Xi, raw_score=True)
+                counts[i] += 1
+            except (ServingQueueFull, ServingTimeout):
+                shed[i] += 1
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
+    t0 = time.monotonic()
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.monotonic() - t0
+    return sum(counts), sum(shed), dt
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
+    ap.add_argument("--model", default="", help="model file (default: "
+                    "train a small synthetic model in-process)")
+    ap.add_argument("--threads", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=3.0,
+                    help="seconds per configuration")
+    ap.add_argument("--sweep", default="1,16,256,1024,4096",
+                    help="comma-separated request row sizes")
+    ap.add_argument("--rows", type=int, default=256,
+                    help="rows per request in --qps mode")
+    ap.add_argument("--qps", type=float, default=0.0,
+                    help="target aggregate QPS (0 = closed-loop sweep)")
+    ap.add_argument("--max-batch-rows", type=int, default=4096)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    args = ap.parse_args()
+
+    from lightgbm_tpu.serving import ServingSession
+
+    def new_session():
+        """Fresh session (and stats) per configuration: cumulative
+        counters/latency windows would misattribute earlier configs'
+        numbers to later sweep lines."""
+        s = ServingSession(params={
+            "serving_max_batch_rows": args.max_batch_rows,
+            "serving_max_wait_ms": args.max_wait_ms,
+            "verbosity": -1})
+        if args.model:
+            s.load("bench", model_file=args.model,
+                   params={"tpu_predict_device": "true"})
+        else:
+            s.load("bench", booster=bst)
+        return s
+
+    if args.model:
+        probe = ServingSession(params={"serving_warmup": False})
+        probe.load("bench", model_file=args.model)
+        n_feat = probe.registry.resolve("bench").num_feature
+        probe.close()
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(max(args.max_batch_rows, 4096), n_feat))
+        bst = None
+    else:
+        bst, X = make_model()
+    sess = new_session()
+
+    if args.qps > 0:
+        n_ok, n_shed, dt = run_paced(sess, "bench", X, args.rows,
+                                     args.threads, args.qps, args.duration)
+        st = sess.stats()
+        print(json.dumps({
+            "mode": "paced", "target_qps": args.qps,
+            "achieved_qps": round(n_ok / dt, 1),
+            "rows_per_request": args.rows,
+            "rows_per_sec": round(n_ok * args.rows / dt, 0),
+            "shed": n_shed,
+            "p50_ms": st["latency_p50_ms"], "p95_ms": st["latency_p95_ms"],
+            "p99_ms": st["latency_p99_ms"],
+            "batch_fill_ratio": st["batch_fill_ratio"],
+            "compile_cache_misses": st["compile_cache_misses"]}))
+    else:
+        for i, rows in enumerate(int(s) for s in args.sweep.split(",") if s):
+            if i > 0:
+                sess.close()
+                sess = new_session()  # clean stats per sweep line
+            n_ok, n_err, dt = run_closed_loop(sess, "bench", X, rows,
+                                              args.threads, args.duration)
+            st = sess.stats()
+            print(json.dumps({
+                "mode": "closed_loop", "rows_per_request": rows,
+                "threads": args.threads,
+                "qps": round(n_ok / dt, 1),
+                "rows_per_sec": round(n_ok * rows / dt, 0),
+                "errors": n_err,
+                "p50_ms": st["latency_p50_ms"],
+                "p99_ms": st["latency_p99_ms"],
+                "batch_fill_ratio": st["batch_fill_ratio"],
+                "compile_cache_misses": st["compile_cache_misses"]}))
+    sess.close()
+
+
+if __name__ == "__main__":
+    main()
