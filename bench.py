@@ -72,10 +72,22 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     X, y = make_data(n_rows, N_FEATURES)
     data_s = time.time() - t_data
 
+    # ingest phase split (sketch = bin finding, binning = value->bin,
+    # layout = the learner's device-layout step, captured below after
+    # Booster construction)
+    from lightgbm_tpu.utils import timer as phase_timer
+
+    phase_timer.enable(True)
+    phase_timer.reset()
     t_bin = time.time()
     ds = lgb.Dataset(X, label=y, params={"max_bin": max_bin})
     ds.construct()
+    if ds._inner._ingest_bins is not None:
+        # device ingest dispatches async; the honest rows/s number
+        # waits for the binned matrix to actually exist
+        jax.block_until_ready(ds._inner._ingest_bins)
     bin_s = time.time() - t_bin
+    ingest_rows_per_sec = n_rows / max(bin_s, 1e-9)
     n_eval = min(50000, n_rows)
     X_eval = X[:n_eval].copy()
     del X
@@ -92,6 +104,10 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
               "tpu_shape_buckets": int(os.environ.get(
                   "BENCH_SHAPE_BUCKETS", 0))}
     bst = Booster(params=params, train_set=ds)
+    # snapshot ingest phases NOW: later valid-set constructs would
+    # double-count sketch/binning
+    phases = dict(phase_timer.summary())
+    phase_timer.enable(False)
     from lightgbm_tpu.utils.backend import host_sync
 
     t_compile = time.time()
@@ -207,9 +223,13 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "serve_rows_per_sec": round(serve_rows_per_sec, 0),
         "serve_p99_ms": round(serve_p99_ms, 1),
         "eval_ms_per_iter": round(eval_ms_per_iter, 1),
+        "ingest_rows_per_sec": round(ingest_rows_per_sec, 0),
         "bench_iters": bench_iters,
         "data_gen_s": round(data_s, 1),
         "binning_s": round(bin_s, 1),
+        "sketch_s": round(phases.get("sketch", 0.0), 2),
+        "bin_s": round(phases.get("binning", 0.0), 2),
+        "layout_s": round(phases.get("layout", 0.0), 2),
         "compile_s": round(compile_s, 1),
         "platform": jax.devices()[0].platform,
     }

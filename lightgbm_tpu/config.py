@@ -272,6 +272,25 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # below this row count the auto mode keeps score replay on the host
     # walker (jit dispatch + compile dominate tiny valid sets)
     "tpu_predict_min_rows": ("int", 4096, ()),
+    # device-parallel dataset ingest (ops/binning.py): raw rows are
+    # quantized on the accelerator in streamed chunks (host key prep for
+    # chunk i+1 overlaps device binning of chunk i) and the [n, F] bin
+    # matrix stays device-resident — the host copy materializes lazily,
+    # only when a host consumer (EFB planning, get_data, save_binary)
+    # asks.  Bins are bit-identical to the host path on every backend
+    # (integer-key compares, never f32 float compares).
+    #   auto  - device binning only when the default jax backend is an
+    #           accelerator (host numpy wins on plain CPU)
+    #   true  - always route ingest through the device kernel
+    #   false - host numpy binning everywhere (the reference path)
+    "tpu_ingest_device": ("str", "auto", ()),
+    # rows per ingest chunk: bounds the [chunk, F] key-plane upload and
+    # the kernel's compare working set; every chunk reuses ONE compiled
+    # program (the last partial chunk pads up to this size)
+    "tpu_ingest_chunk_rows": ("int", 65536, ()),
+    # below this row count ingest stays on the host even in auto mode
+    # (kernel dispatch overhead dominates tiny matrices)
+    "tpu_ingest_min_rows": ("int", 16384, ()),
 }
 
 _ALIAS: Dict[str, str] = {}

@@ -11,6 +11,11 @@ from .basic import Dataset
 from .booster import Booster
 from .callback import CallbackEnv, EarlyStopException, early_stopping, log_evaluation
 
+# iteration-count aliases already warned about this process: repeated
+# train() calls with the same alias (sweeps, CV loops, MULTICHIP runs)
+# warn once, not once per call
+_warned_num_iter_aliases: set = set()
+
 
 def train(params: Dict[str, Any], train_set: Dataset,
           num_boost_round: int = 100,
@@ -38,11 +43,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
                   "n_estimators"):
         if alias in params:
             # params win over the argument, but never silently
-            # (reference engine.py:148 warns identically)
-            import warnings
+            # (reference engine.py:148 warns identically) — deduped per
+            # alias per process so retrain loops don't spam the log
+            if alias not in _warned_num_iter_aliases:
+                import warnings
 
-            warnings.warn(f"Found `{alias}` in params. Will use it "
-                          "instead of argument")
+                warnings.warn(f"Found `{alias}` in params. Will use it "
+                              "instead of argument")
+                _warned_num_iter_aliases.add(alias)
             num_boost_round = int(params.pop(alias))
     for alias in ("early_stopping_round", "early_stopping_rounds",
                   "early_stopping", "n_iter_no_change"):

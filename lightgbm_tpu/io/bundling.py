@@ -31,6 +31,12 @@ from typing import List, NamedTuple, Optional
 import numpy as np
 
 
+# default row-sample quota the bundling greedy counts conflicts on; the
+# learner pre-samples through TrainingData.strided_row_sample with the
+# SAME constant so device-resident matrices never materialize wholesale
+EFB_SAMPLE_ROWS = 100_000
+
+
 class BundlePlan(NamedTuple):
     # per bundle: list of used-feature positions (len 1 = untouched column)
     groups: List[List[int]]
@@ -61,7 +67,7 @@ def _stride_sample(bins: np.ndarray, quota: int) -> np.ndarray:
 
 def find_bundles(bins: np.ndarray, num_bin: np.ndarray,
                  most_freq_is_zero: np.ndarray, max_conflict_rate: float,
-                 max_bundle_bins: int, sample_rows: int = 100_000
+                 max_bundle_bins: int, sample_rows: int = EFB_SAMPLE_ROWS
                  ) -> BundlePlan:
     """Greedy conflict-budget bundling over the binned [n, F] matrix.
 
@@ -139,7 +145,7 @@ def find_bundles_multihost(local_bins: np.ndarray, num_bin: np.ndarray,
                            sparse_threshold: float,
                            max_conflict_rate: float,
                            max_bundle_bins: int,
-                           sample_rows: int = 100_000) -> BundlePlan:
+                           sample_rows: int = EFB_SAMPLE_ROWS) -> BundlePlan:
     """Bundling plan agreed across a jax.distributed process group.
 
     EVERYTHING plan-determining reduces globally inside this function —

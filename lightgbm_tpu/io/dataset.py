@@ -34,6 +34,28 @@ def _is_scipy_sparse(data) -> bool:
     return hasattr(data, "tocsc") and hasattr(data, "nnz")
 
 
+def _parallel_columns(fn, count: int, config: Optional[Config]) -> None:
+    """Fan per-column ingest work out on a thread pool — the analog of
+    the reference's OpenMP-parallel `ConstructBinMappersFromData`
+    (dataset_loader.cpp:696).  numpy's sort / searchsorted release the
+    GIL on large arrays, so column work genuinely overlaps.  Output is
+    deterministic: every column writes only its own pre-allocated slot,
+    and `fn` is pure per column."""
+    workers = int(getattr(config, "num_threads", 0) or 0) if config else 0
+    if workers <= 0:
+        workers = os.cpu_count() or 1
+    workers = min(workers, count)
+    if workers <= 1 or count <= 1:
+        for j in range(count):
+            fn(j)
+        return
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(max_workers=workers) as ex:
+        # list() drains the iterator so worker exceptions propagate
+        list(ex.map(fn, range(count)))
+
+
 class Metadata:
     """Labels, weights, query boundaries, init scores (reference dataset.h:87)."""
 
@@ -133,7 +155,8 @@ class TrainingData:
         self.num_total_features: int = 0
         self.used_feature_idx: List[int] = []     # used col -> original col
         self.mappers: List[BinMapper] = []        # one per ORIGINAL column
-        self.bins: Optional[np.ndarray] = None    # [n, num_used] uint8/uint16
+        self._bins: Optional[np.ndarray] = None   # [n, num_used] uint8/uint16
+        self._ingest_bins = None   # device-resident [n, num_used] (ops/binning)
         self.metadata: Optional[Metadata] = None
         self.feature_names: List[str] = []
         self.config: Optional[Config] = None
@@ -142,6 +165,35 @@ class TrainingData:
         self._device_bins = None
 
     # ------------------------------------------------------------------
+    @property
+    def bins(self) -> Optional[np.ndarray]:
+        """Host binned matrix.  When ingest ran on device the host copy
+        materializes LAZILY here, on first access by a host consumer
+        (EFB planning, get_data, save_binary, subset) — the device fast
+        path never pays for it."""
+        if self._bins is None and self._ingest_bins is not None:
+            self._bins = np.asarray(self._ingest_bins)
+        return self._bins
+
+    @bins.setter
+    def bins(self, value: Optional[np.ndarray]) -> None:
+        self._bins = value
+        self._ingest_bins = None
+        self._device_bins = None
+
+    @property
+    def has_bins(self) -> bool:
+        """True when ANY binned representation exists (host or device).
+        Check this instead of `bins is None`: the property fetch would
+        force a host materialization of a device-resident matrix."""
+        return self._bins is not None or self._ingest_bins is not None
+
+    def device_ingest_bins(self):
+        """The device-resident narrow-dtype bin matrix, or None when the
+        host copy is authoritative (host ingest, or a consumer already
+        materialized + possibly mutated through the property)."""
+        return self._ingest_bins if self._bins is None else None
+
     @property
     def num_features(self) -> int:
         return len(self.used_feature_idx)
@@ -169,11 +221,64 @@ class TrainingData:
                 "monotone": mono.astype(np.int32), "penalty": penalty.astype(np.float32)}
 
     def device_bins(self):
-        """Device copy of the binned matrix (cached)."""
+        """Device int32 copy of the binned matrix (cached).  Ingest that
+        ran on device just widens in place — no host round trip."""
         import jax.numpy as jnp
         if self._device_bins is None:
-            self._device_bins = jnp.asarray(self.bins.astype(np.int32))
+            if self._ingest_bins is not None:
+                self._device_bins = self._ingest_bins.astype(jnp.int32)
+            else:
+                self._device_bins = jnp.asarray(self.bins.astype(np.int32))
         return self._device_bins
+
+    # -- reductions host consumers ask for without forcing the full
+    # host matrix (the learner's layout step reads these) -------------
+    def column_zero_fraction(self) -> np.ndarray:
+        """Per-used-column fraction of rows at bin 0 (the EFB candidate
+        gate).  Device-resident matrices reduce on device and fetch only
+        the [F] counts; the division happens in f64 on the host either
+        way, so the result is bit-identical to `(bins == 0).mean(0)`."""
+        dev = self.device_ingest_bins()
+        if dev is not None:
+            import jax.numpy as jnp
+            cnt = np.asarray(jnp.sum(dev == 0, axis=0, dtype=jnp.int32))
+            return cnt.astype(np.float64) / max(self.num_data, 1)
+        return (self.bins == 0).mean(axis=0)
+
+    def column_nonzero_counts(self, zero_bins: np.ndarray) -> np.ndarray:
+        """Per-used-column count of rows NOT at that column's zero bin
+        (the sparse-storage gate).  One vectorized pass — device reduce
+        when resident, row-chunked host sweep otherwise (bounds the
+        boolean temporary on Bosch-shaped data)."""
+        zb = np.asarray(zero_bins)
+        dev = self.device_ingest_bins()
+        if dev is not None:
+            import jax.numpy as jnp
+            return np.asarray(jnp.sum(
+                dev != jnp.asarray(zb.astype(np.int32))[None, :],
+                axis=0, dtype=jnp.int32)).astype(np.int64)
+        bins = self.bins
+        n = bins.shape[0]
+        step = max((1 << 28) // max(bins.shape[1], 1), 1024)
+        out = np.zeros(bins.shape[1], np.int64)
+        for lo in range(0, n, step):
+            out += (bins[lo:lo + step] != zb[None, :]).sum(axis=0)
+        return out
+
+    def strided_row_sample(self, quota: int) -> np.ndarray:
+        """The deterministic strided row sample `bundling._stride_sample`
+        would take, fetched as a host array — a device slice-gather when
+        resident, so EFB planning never pulls the full matrix."""
+        dev = self.device_ingest_bins()
+        if dev is None:
+            from .bundling import _stride_sample
+
+            return _stride_sample(self.bins, quota)
+        n = self.num_data
+        if n > quota:
+            step = n // quota
+            return np.asarray(dev[::step][:quota])
+        return np.asarray(dev)
 
     # ------------------------------------------------------------------
     @classmethod
@@ -204,26 +309,62 @@ class TrainingData:
         self.feature_names = (list(feature_names) if feature_names
                               else [f"Column_{i}" for i in range(nf)])
 
-        if reference is not None:
-            self._adopt_reference_mappers(reference)
-        else:
-            self._find_mappers_maybe_distributed(
-                X, config, categorical_features or [], forced_bins or {})
-
-        # bin all used columns
         from ..utils import timer
 
+        with timer.PHASE("sketch"):
+            if reference is not None:
+                self._adopt_reference_mappers(reference)
+            else:
+                self._find_mappers_maybe_distributed(
+                    X, config, categorical_features or [], forced_bins or {})
+
+        # bin all used columns: device chunk-streamed kernel on the fast
+        # path, host per-column numpy otherwise
         with timer.PHASE("binning"):
             dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
-            bins = np.empty((n, self.num_features), dtype=dtype)
-            for j, col in enumerate(self.used_feature_idx):
-                bins[:, j] = self.mappers[col].values_to_bins(
-                    X[:, col]).astype(dtype)
-            self.bins = bins
+            binner = self._make_device_binner(config, dtype, n)
+            if binner is not None:
+                self._ingest_bins = binner.bin_matrix(X)
+                self._bins = None
+            else:
+                bins = np.empty((n, self.num_features), dtype=dtype)
+
+                def _bin_col(j: int) -> None:
+                    col = self.used_feature_idx[j]
+                    # contiguous column copy: searchsorted on a strided
+                    # view costs ~40% more than the 8 MB copy saves
+                    bins[:, j] = self.mappers[col].values_to_bins(
+                        np.ascontiguousarray(X[:, col])).astype(
+                            dtype, copy=False)
+
+                _parallel_columns(_bin_col, self.num_features, config)
+                self.bins = bins
 
         self.metadata = Metadata(n, label, weight, group_sizes, init_score)
         self._set_constraints(config)
         return self
+
+    def _make_device_binner(self, config: Config, dtype, n_rows: int):
+        """A ready DeviceBinner when config routes ingest to the device
+        kernel (ops/binning.py), else None.  'auto' requires an
+        accelerator default backend AND enough rows to amortize the
+        dispatch; huge categorical id spaces fall back to host (the
+        kernel's LUT is dense)."""
+        from ..config import parse_tristate
+
+        mode = parse_tristate(config.tpu_ingest_device)
+        if mode == "false" or self.num_features == 0:
+            return None
+        if mode == "auto":
+            import jax
+
+            if (jax.default_backend() == "cpu"
+                    or n_rows < int(config.tpu_ingest_min_rows)):
+                return None
+        from ..ops.binning import DeviceBinner
+
+        return DeviceBinner.build(self.mappers, self.used_feature_idx,
+                                  dtype, int(config.tpu_ingest_chunk_rows))
 
     @classmethod
     def from_sparse(cls, sp, label: Optional[np.ndarray] = None,
@@ -260,17 +401,18 @@ class TrainingData:
         self.feature_names = (list(feature_names) if feature_names
                               else [f"Column_{i}" for i in range(nf)])
 
-        if reference is not None:
-            self._adopt_reference_mappers(reference)
-        else:
-            # sparse ingest joins the collective bin-finding path
-            # directly: the feature-sharded mapper search slices CSC
-            # columns and samples stored values exactly like the local
-            # find (local_payload -> _find_mappers is sparse-aware)
-            self._find_mappers_maybe_distributed(
-                sp, config, categorical_features or [], forced_bins or {})
-
         from ..utils import timer
+
+        with timer.PHASE("sketch"):
+            if reference is not None:
+                self._adopt_reference_mappers(reference)
+            else:
+                # sparse ingest joins the collective bin-finding path
+                # directly: the feature-sharded mapper search slices CSC
+                # columns and samples stored values exactly like the local
+                # find (local_payload -> _find_mappers is sparse-aware)
+                self._find_mappers_maybe_distributed(
+                    sp, config, categorical_features or [], forced_bins or {})
 
         with timer.PHASE("binning"):
             dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
@@ -280,9 +422,9 @@ class TrainingData:
                 m = self.mappers[col]
                 lo, hi = int(indptr[col]), int(indptr[col + 1])
                 # implicit zeros take the column's zero-value bin
-                # (most_freq_bin semantics fall out of value_to_bin(0))
-                zero_bin = int(m.values_to_bins(np.zeros(1))[0])
-                colbins = np.full(n, zero_bin, dtype=dtype)
+                # (default_bin IS value_to_bin(0.0), set at find time;
+                # most_freq_bin semantics fall out of it)
+                colbins = np.full(n, m.default_bin, dtype=dtype)
                 if hi > lo:
                     vals = np.asarray(data[lo:hi], dtype=np.float64)
                     colbins[indices[lo:hi]] = \
@@ -389,30 +531,44 @@ class TrainingData:
             raise ValueError(f"empty data file {path}")
         label = np.concatenate(labels_parts)
 
+        from ..utils import timer
+
         self = cls()
         self.config = config
         self.num_data = n
         self.num_total_features = ncols
         self.feature_names = list(names)
-        if reference is not None:
-            self._adopt_reference_mappers(reference)
-        else:
-            cat = _parse_column_spec(config.categorical_feature, names)
-            self._find_mappers_maybe_distributed(
-                sample, config, cat or [], _load_forced_bins(config),
-                total_rows=n)
+        with timer.PHASE("sketch"):
+            if reference is not None:
+                self._adopt_reference_mappers(reference)
+            else:
+                cat = _parse_column_spec(config.categorical_feature, names)
+                self._find_mappers_maybe_distributed(
+                    sample, config, cat or [], _load_forced_bins(config),
+                    total_rows=n)
 
-        # ---- pass 2: stream rows into bins ----
-        dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
-        bins = np.empty((n, self.num_features), dtype=dtype)
-        row = 0
-        for Xc, _ in reader.chunks():
-            m = Xc.shape[0]
-            for j, col in enumerate(self.used_feature_idx):
-                bins[row:row + m, j] = \
-                    self.mappers[col].values_to_bins(Xc[:, col]).astype(dtype)
-            row += m
-        self.bins = bins
+        # ---- pass 2: stream rows into bins (file chunks feed the
+        # device kernel directly when ingest is device-routed, so the
+        # full host matrix never exists on that path either) ----
+        with timer.PHASE("binning"):
+            dtype = np.uint8 if self.max_num_bin <= 256 else np.uint16
+            binner = self._make_device_binner(config, dtype, n)
+            if binner is not None:
+                # bin_stream re-chunks across reader blocks, so only the
+                # file's final launch pads
+                self._ingest_bins = binner.bin_stream(
+                    Xc for Xc, _ in reader.chunks())
+                self._bins = None
+            else:
+                bins = np.empty((n, self.num_features), dtype=dtype)
+                row = 0
+                for Xc, _ in reader.chunks():
+                    m = Xc.shape[0]
+                    for j, col in enumerate(self.used_feature_idx):
+                        bins[row:row + m, j] = self.mappers[col] \
+                            .values_to_bins(Xc[:, col]).astype(dtype)
+                    row += m
+                self.bins = bins
 
         weight, group, init_score = load_sidecars(path)
         self.metadata = Metadata(n, label, weight, group, init_score)
@@ -573,17 +729,16 @@ class TrainingData:
         # near-unsplittable feature filter (reference dataset_loader.cpp:599-600)
         filter_cnt = int(float(config.min_data_in_leaf) * total / full_n)
 
-        self.mappers = []
-        self.used_feature_idx = []
-        for col in range(nf):
+        self.mappers = [BinMapper() for _ in range(nf)]
+
+        def _find_col(col: int) -> None:
             gcol = int(feature_subset[col]) if feature_subset is not None \
                 else col
-            m = BinMapper()
+            m = self.mappers[col]
             if gcol in ignore:
                 m.num_bin = 1
                 m.is_trivial = True
-                self.mappers.append(m)
-                continue
+                return
             if sp_csc is not None:
                 colv = sp_csc.data[sp_csc.indptr[col]:sp_csc.indptr[col + 1]]
                 colv = np.asarray(colv, dtype=np.float64)
@@ -592,7 +747,8 @@ class TrainingData:
             # drop (near-)zeros: implied by total_sample_cnt (reference
             # dataset_loader.cpp sparse-aware sampling; stored sparse
             # zeros drop identically to dense explicit zeros)
-            nonzero = colv[~((np.abs(colv) <= K_ZERO_THRESHOLD) & ~np.isnan(colv))]
+            nonzero = colv[~((np.abs(colv) <= K_ZERO_THRESHOLD)
+                             & ~np.isnan(colv))]
             mb = int(config.max_bin)
             if max_bin_by_feature and gcol < len(max_bin_by_feature):
                 mb = int(max_bin_by_feature[gcol])
@@ -604,9 +760,13 @@ class TrainingData:
                        use_missing=bool(config.use_missing),
                        zero_as_missing=bool(config.zero_as_missing),
                        forced_bounds=forced_bins.get(gcol))
-            self.mappers.append(m)
-            if not m.is_trivial:
-                self.used_feature_idx.append(col)
+
+        # per-column fan-out (reference OpenMP pragma over features,
+        # dataset_loader.cpp:696): each column fills only its own
+        # pre-constructed mapper, so the result is order-independent
+        _parallel_columns(_find_col, nf, config)
+        self.used_feature_idx = [c for c in range(nf)
+                                 if not self.mappers[c].is_trivial]
 
     def _set_constraints(self, config: Config) -> None:
         mono = list(config.monotone_constraints)

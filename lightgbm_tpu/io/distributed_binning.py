@@ -117,6 +117,38 @@ def local_payload(X_local: np.ndarray, features: Sequence[int],
         "mappers": [m.to_dict() for m in td.mappers]})
 
 
+def gather_row_samples(X_local: np.ndarray, quota: int,
+                       seed: int) -> np.ndarray:
+    """Deterministic per-host row sample, allgathered into ONE global
+    bin-finding sample every host holds identically.
+
+    Reuses the `find_bundles_multihost` ragged fixed-width transport:
+    per-host lengths allgather first, then a zero-padded f64 block, and
+    each host's contribution is sliced back out in process order — so
+    the result is deterministic given (data, seed, process layout).
+    Each host contributes at most `quota` of its local rows (sorted
+    deterministic choice, the same sampler `_find_mappers` uses)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    n = X_local.shape[0]
+    if n > quota:
+        rng = np.random.default_rng(seed)
+        idx = np.sort(rng.choice(n, size=quota, replace=False))
+        samp = np.ascontiguousarray(
+            np.asarray(X_local, np.float64)[idx])
+    else:
+        samp = np.asarray(X_local, np.float64)
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray([samp.shape[0]], np.int64)))[:, 0]
+    mx = max(int(lens.max()), 1)
+    buf = np.zeros((mx, X_local.shape[1]), np.float64)
+    buf[:samp.shape[0]] = samp
+    g = np.asarray(multihost_utils.process_allgather(buf))  # [P, mx, F]
+    return np.concatenate(
+        [g[p, :int(lens[p])] for p in range(jax.process_count())])
+
+
 def find_mappers_multihost(X_local: np.ndarray, config: Config,
                            categorical: Sequence[int] = (),
                            forced_bins: Optional[Dict[int, List[float]]]
@@ -130,6 +162,14 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
     local_total_rows is THIS host's full row count when X_local is already
     a sample (two-round); the near-unsplittable filter always scales
     against the allgather-summed GLOBAL count.
+
+    Dense inputs first gather a `bin_construct_sample_cnt`-bounded
+    GLOBAL row sample (each host contributes an equal quota of its local
+    rows), so feature f's mapper no longer depends on which host owned f
+    — boundaries are consistent with what a single-host find over the
+    same sample would produce.  Sparse inputs keep the reference's
+    local-rows behavior (densifying a wide sparse sample for transport
+    would defeat the O(nnz) ingest path).
     """
     import jax
 
@@ -149,7 +189,14 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
         np.asarray([local_n], np.int64)).sum())
     assignment = assign_features(nf, nproc)
     mine = assignment[jax.process_index()]
-    payload = local_payload(X_local, mine, config, categorical, forced_bins,
+    from .dataset import _is_scipy_sparse
+
+    X_find = X_local
+    if not _is_scipy_sparse(X_local):
+        quota = max(1, int(config.bin_construct_sample_cnt) // nproc)
+        X_find = gather_row_samples(np.asarray(X_local, np.float64),
+                                    quota, int(config.data_random_seed))
+    payload = local_payload(X_find, mine, config, categorical, forced_bins,
                             total_rows=global_rows,
                             feature_names=feature_names)
 

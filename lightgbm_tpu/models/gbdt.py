@@ -621,7 +621,7 @@ class GBDT:
         if self.iter_ <= 0:
             return
         train_bins = None
-        if (self.train_data.bins is not None and self.learner is not None
+        if (self.train_data.has_bins and self.learner is not None
                 and self._device_replay_ok(self.train_data.num_data)):
             # one upload shared by every popped tree this call
             train_bins = self._device_bins_for(self.train_data, cache=False)
@@ -823,6 +823,8 @@ class GBDT:
             return data.device_bins()
         if data._device_bins is not None:  # already resident: reuse
             return data._device_bins
+        if data._ingest_bins is not None:  # device ingest: widen in place
+            return data._ingest_bins.astype(jnp.int32)
         return jnp.asarray(data.bins.astype(np.int32))
 
     def _tree_delta_device(self, data: TrainingData, tree: Tree,
@@ -832,7 +834,7 @@ class GBDT:
         score path: packs just the new tree (never the forest) and does
         zero device_get.  `pack_cache` (a per-tree dict) reuses the
         packed device tables across multiple valid sets."""
-        if data.bins is None or tree.num_leaves < 1 \
+        if not data.has_bins or tree.num_leaves < 1 \
                 or self.learner is None \
                 or not self._device_replay_ok(data.num_data):
             return None
@@ -860,7 +862,7 @@ class GBDT:
                               cache_bins: bool = True) -> bool:
         """Batch-replay `trees` (class = position % k) into a score state
         on device; False -> caller must use the host walker."""
-        if not trees or data.bins is None \
+        if not trees or not data.has_bins \
                 or not self._device_replay_ok(data.num_data):
             return False
         if meta is not None:
@@ -1018,7 +1020,7 @@ class GBDT:
             raise ValueError("device predict on binned data needs a booster "
                              "with a training context (file-loaded boosters "
                              "carry no bin mappers)")
-        if data.bins is None:
+        if not data.has_bins:
             raise ValueError("dataset has no binned representation")
         # strict identity: the mapper list survives free_dataset inside
         # the snapshot, so unlike eval_for_data there is no freed-booster

@@ -9,6 +9,7 @@ variants wrap the same grower with mesh shardings (lightgbm_tpu.parallel).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional, Tuple
 
 import numpy as np
@@ -23,6 +24,7 @@ from ..ops.grower import GrowerParams, pad_rows, resolve_split_batch
 from ..parallel.mesh import make_mesh, put_global, put_local
 from ..parallel.strategies import (bins_sharding, make_strategy_grower,
                                    resolve_tree_learner, rows_sharding)
+from ..utils import timer
 from ..utils.log import Log
 from .tree import Tree
 
@@ -159,6 +161,11 @@ class TPUTreeLearner:
             self.f_pad = (-(-self.num_features // self.f_shards)
                           * self.f_shards)
 
+        # layout phase timer (bench.py splits ingest into sketch / bin /
+        # layout): everything from EFB planning to the placed device
+        # arrays below counts as layout
+        _t_layout = time.perf_counter()
+
         # ---- EFB bundling (reference FindGroups/FastFeatureBundling,
         # dataset.cpp:91-263): sparse zero-default features share columns,
         # shrinking the histogram matrix's feature axis ----
@@ -172,9 +179,10 @@ class TPUTreeLearner:
                      f"{strategy}; training on plain columns")
         if (bool(config.enable_bundle) and strategy in ("serial", "data")
                 and not forced and self.num_features > 1):
-            from ..io.bundling import find_bundles, find_bundles_multihost
+            from ..io.bundling import (EFB_SAMPLE_ROWS, find_bundles,
+                                       find_bundles_multihost)
 
-            zero_frac = (train_data.bins == 0).mean(axis=0)
+            zero_frac = train_data.column_zero_fraction()
             if self._partitioned:
                 # every rank must greedy-group the SAME plan or the
                 # global arrays' num_columns/meta diverge; all plan-
@@ -184,10 +192,16 @@ class TPUTreeLearner:
                     float(config.sparse_threshold),
                     float(config.max_conflict_rate), B)
             else:
+                # the greedy only ever reads the strided row sample;
+                # hand it exactly that sample (a bounded device fetch
+                # when the matrix is device-resident) instead of the
+                # full host matrix — identical rows, identical plan
                 cand_plan = find_bundles(
-                    train_data.bins, meta_np["num_bin"],
+                    train_data.strided_row_sample(EFB_SAMPLE_ROWS),
+                    meta_np["num_bin"],
                     zero_frac >= float(config.sparse_threshold),
-                    float(config.max_conflict_rate), B)
+                    float(config.max_conflict_rate), B,
+                    sample_rows=EFB_SAMPLE_ROWS)
             if not cand_plan.is_trivial:
                 plan = cand_plan
                 B = max(B, int(plan.num_bin.max()))
@@ -200,18 +214,24 @@ class TPUTreeLearner:
         if plan is not None:
             from ..io.bundling import apply_bundles
 
-            bundled = apply_bundles(train_data.bins, plan)
-            cols_src = bundled
+            cols_src = apply_bundles(train_data.bins, plan)
+            dev_src = None
             meta_np["bundle_idx"] = plan.bundle_idx.astype(np.int32)
             meta_np["bin_offset"] = plan.bin_offset.astype(np.int32)
             meta_np["needs_fix"] = plan.needs_fix.astype(np.int32)
+            self.num_columns = cols_src.shape[1]
         else:
-            cols_src = train_data.bins
+            # device-resident ingest keeps the host matrix lazy: the
+            # plain-column layout below can transpose on device, so
+            # cols_src stays unmaterialized until a host-only path
+            # (sparse COO packing, parallel placement) asks for it
+            dev_src = train_data.device_ingest_bins()
+            cols_src = None if dev_src is not None else train_data.bins
             F_ = self.num_features
             meta_np["bundle_idx"] = np.arange(F_, dtype=np.int32)
             meta_np["bin_offset"] = np.zeros(F_, np.int32)
             meta_np["needs_fix"] = np.zeros(F_, np.int32)
-        self.num_columns = cols_src.shape[1]
+            self.num_columns = F_
         self.g_pad = (self.f_pad if self.f_shards > 1 else self.num_columns)
 
         # ---- sparse train-time storage (reference OrderedSparseBin,
@@ -238,12 +258,12 @@ class TPUTreeLearner:
                 raise ValueError("tpu_sparse_threshold does not compose "
                                  "with forced splits")
             zb_f = meta_np["default_bin"]
-            # per-column counting: a whole-matrix (cols_src != zb)
-            # boolean would materialize ~1 GB at Bosch scale
-            nz_counts = np.fromiter(
-                (np.count_nonzero(cols_src[:, c] != zb_f[c])
-                 for c in range(self.num_features)),
-                np.int64, self.num_features)
+            # one vectorized (bins != zero_bin).sum(axis=0) pass — the
+            # sparse gate implies enable_bundle=false, so the columns
+            # are the plain training bins; the helper row-chunks the
+            # boolean temporary (Bosch scale) and reduces on device
+            # when the matrix is device-resident
+            nz_counts = train_data.column_nonzero_counts(zb_f)
             denom = n
             if self._partitioned:
                 # every rank must agree on WHICH features are sparse, or
@@ -363,6 +383,9 @@ class TPUTreeLearner:
         # the one-hot compare upcasts on the fly
         bin_dtype = np.uint8 if B <= 256 else np.int32
         if self._sparse_mask is not None:
+            if cols_src is None:  # COO packing reads host columns
+                cols_src = train_data.bins
+                dev_src = None
             dense_idx = np.flatnonzero(~self._sparse_mask)
             sparse_idx_cols = np.flatnonzero(self._sparse_mask)
             gd = len(dense_idx)
@@ -376,10 +399,25 @@ class TPUTreeLearner:
             bins_t[:gd, :n] = cols_src[:, dense_idx].T
             zb_np = meta_np["default_bin"]
             Gs = len(sparse_idx_cols)
-            # ONE scan per sparse column; counts and the COO fill both
-            # come from the same nonzero lists
-            nz_lists = [np.flatnonzero(cols_src[:, c] != zb_np[c])
-                        for c in sparse_idx_cols]
+            # ONE vectorized nonzero pass over the sparse columns,
+            # column-blocked to bound the boolean temporary; entries
+            # come out sorted by (slot, row), exactly the order the
+            # per-column scans produced
+            slot_parts, row_parts, bin_parts = [], [], []
+            blk = max((1 << 28) // max(n, 1), 1)
+            for lo_c in range(0, Gs, blk):
+                cols = sparse_idx_cols[lo_c:lo_c + blk]
+                sub = cols_src[:, cols]
+                g_i, r_i = np.nonzero((sub != zb_np[cols][None, :]).T)
+                slot_parts.append((g_i + lo_c).astype(np.int64))
+                row_parts.append(r_i.astype(np.int64))
+                bin_parts.append(sub[r_i, g_i].astype(np.int32))
+            slot = (np.concatenate(slot_parts) if slot_parts
+                    else np.zeros(0, np.int64))
+            row_id = (np.concatenate(row_parts) if row_parts
+                      else np.zeros(0, np.int64))
+            binval = (np.concatenate(bin_parts) if bin_parts
+                      else np.zeros(0, np.int32))
             # pad row-id = the (local) width (out of range: the
             # partition scatter drops it); pad bin = B (its one-hot row
             # is all-zero, so the clipped histogram gather contributes
@@ -396,10 +434,10 @@ class TPUTreeLearner:
                 rps = self.n_pad // self.d_shards
                 sl = (self.d_shards // jax.process_count()
                       if self._partitioned else self.d_shards)
-                per = [[nz[(nz >= s * rps) & (nz < (s + 1) * rps)] - s * rps
-                        for nz in nz_lists]
-                       for s in range(sl)]
-                max_nnz = max(len(z) for row in per for z in row)
+                shard = row_id // rps
+                key = shard * Gs + slot
+                counts = np.bincount(key, minlength=sl * Gs)
+                max_nnz = int(counts.max()) if counts.size else 0
                 if self._partitioned:
                     from jax.experimental import multihost_utils
 
@@ -409,21 +447,25 @@ class TPUTreeLearner:
                 M = max(128, -(-max_nnz // 128) * 128)
                 sp_rows = np.full((sl, Gs, M), rps, np.int32)
                 sp_bins = np.full((sl, Gs, M), B, np.int32)
-                for s in range(sl):
-                    for g, (c, nz_l) in enumerate(
-                            zip(sparse_idx_cols, per[s])):
-                        sp_rows[s, g, :len(nz_l)] = nz_l
-                        sp_bins[s, g, :len(nz_l)] = \
-                            cols_src[nz_l + s * rps, c]
+                # stable sort by (shard, slot) keeps rows ascending
+                # within each table row, like the per-shard slices did
+                order = np.argsort(key, kind="stable")
+                k_s = key[order]
+                starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+                pos = np.arange(len(k_s)) - starts[k_s]
+                sp_rows[shard[order], slot[order], pos] = \
+                    row_id[order] - shard[order] * rps
+                sp_bins[shard[order], slot[order], pos] = binval[order]
             else:
-                M = max(128,
-                        -(-max(len(z) for z in nz_lists) // 128) * 128)
+                counts = np.bincount(slot, minlength=Gs)
+                max_nnz = int(counts.max()) if counts.size else 0
+                M = max(128, -(-max_nnz // 128) * 128)
                 sp_rows = np.full((Gs, M), self.n_pad, np.int32)
                 sp_bins = np.full((Gs, M), B, np.int32)
-                for s, (c, nz) in enumerate(
-                        zip(sparse_idx_cols, nz_lists)):
-                    sp_rows[s, :len(nz)] = nz
-                    sp_bins[s, :len(nz)] = cols_src[nz, c]
+                starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+                pos = np.arange(len(row_id)) - starts[slot]
+                sp_rows[slot, pos] = row_id
+                sp_bins[slot, pos] = binval
             F_ = self.num_features
             is_sparse = np.zeros(F_, np.int32)
             is_sparse[sparse_idx_cols] = 1
@@ -452,8 +494,20 @@ class TPUTreeLearner:
             self._sparse_arrays = None
             # partitioned: only this process's rows, at its local width
             width = self._local_width if self._partitioned else self.n_pad
-            bins_t = np.zeros((self.g_pad, width), dtype=bin_dtype)
-            bins_t[:self.num_columns, :n] = cols_src.T
+            if dev_src is not None and strategy == "serial":
+                # device-side layout: transpose + pad the device-
+                # resident ingest matrix in HBM — the host [n, F]
+                # matrix never exists on this path
+                bins_t = jnp.zeros(
+                    (self.g_pad, width),
+                    dtype=jnp.uint8 if B <= 256 else jnp.int32)
+                bins_t = bins_t.at[:self.num_columns, :n].set(
+                    dev_src.T.astype(bins_t.dtype))
+            else:
+                if cols_src is None:  # parallel placement ships host
+                    cols_src = train_data.bins
+                bins_t = np.zeros((self.g_pad, width), dtype=bin_dtype)
+                bins_t[:self.num_columns, :n] = cols_src.T
 
         # 4-bit packing (reference dense_nbits_bin.hpp): two rows per
         # byte in a per-block stride layout (row j low nibble, row
@@ -474,9 +528,12 @@ class TPUTreeLearner:
         if self.packed_bins:
             x = bins_t.reshape(self.g_pad, self.n_pad // eff_block, 2,
                                eff_block // 2)
-            bins_t = np.ascontiguousarray(
-                (x[:, :, 0, :] | (x[:, :, 1, :] << 4)).reshape(
-                    self.g_pad, self.n_pad // 2))
+            packed = (x[:, :, 0, :] | (x[:, :, 1, :] << 4)).reshape(
+                self.g_pad, self.n_pad // 2)
+            # device-laid-out bins_t packs in HBM; host arrays keep the
+            # contiguity the kernel's DMA expects
+            bins_t = (np.ascontiguousarray(packed)
+                      if isinstance(packed, np.ndarray) else packed)
 
         meta_host = {}
         for k, v in meta_np.items():
@@ -560,6 +617,7 @@ class TPUTreeLearner:
                 self.meta["sparse_idx"] = jnp.asarray(sp_rows)
                 self.meta["sparse_bin"] = jnp.asarray(sp_bins)
                 self.meta["hist_perm"] = jnp.asarray(perm)
+        timer.add("layout", time.perf_counter() - _t_layout)
 
         self.params = GrowerParams(
             num_leaves=max(int(config.num_leaves), 2),

@@ -1,0 +1,245 @@
+"""Device-parallel dataset binning: chunked jitted value->bin kernel.
+
+The ingest analog of ops/predict.py: raw rows are quantized into bin ids
+on the accelerator instead of column-by-column host numpy.  The kernel
+is a batched searchsorted — for every (row, feature) it counts how many
+of the feature's bin upper bounds are strictly below the value, which is
+exactly `np.searchsorted(ub[:hi], v, side="left")`
+(`BinMapper.values_to_bins`, the reference `BinMapper::ValueToBin`,
+bin.h:472-508).
+
+Bitwise parity on EVERY backend is non-negotiable (the training bins
+feed split decisions), but accelerators run f32 while the host bounds
+are f64.  The kernel therefore never compares floats: each f64 is mapped
+on the host to its MONOTONE int64 key (sign-flipped IEEE bit pattern —
+total order identical to the f64 order, with -0.0 == +0.0 keying to the
+same value), shipped as two planes (hi int32, lo uint32), and compared
+lexicographically on device.  Integer compares are exact everywhere, so
+the device bins match `values_to_bins` bit-for-bit even in x32 mode.
+
+NaN rides a reserved key (INT64_MAX, unreachable by finite/inf keys) and
+is routed per the feature's MissingType: last bin when NaN-missing, the
+0.0 bin (`default_bin`) otherwise.  Categorical features look up a
+flattened per-feature category->bin table; negative / unseen / too-large
+categories fall to the last bin like `value_to_bin`.
+
+`DeviceBinner` streams `[chunk, F]` blocks: the host computes chunk
+i+1's key planes (cheap vectorized bit twiddling) while the device bins
+chunk i — transfer and compute overlap through jax's async dispatch —
+and the full `[n, F]` matrix is assembled device-side, never
+materialized on the host unless a host consumer asks (see
+`TrainingData.bins`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..io.bin_mapper import BinMapper, BinType, MissingType, sort_keys
+
+_NAN_KEY = np.int64(np.iinfo(np.int64).max)
+_NAN_KEY_HI = np.int32(_NAN_KEY >> 32)
+_NAN_KEY_LO = np.uint32(_NAN_KEY & 0xFFFFFFFF)
+_NAN_CAT = -2  # host-side category sentinel for NaN values
+# per-feature / total category-LUT capacity: features with larger raw
+# category ids fall back to host binning (pandas codes and typical int
+# categories sit far below this)
+_CAT_LUT_MAX = 1 << 16
+_CAT_LUT_TOTAL_MAX = 1 << 22
+
+
+def split_keys(keys: np.ndarray):
+    """int64 keys -> (hi int32, lo uint32) planes for x32-safe compare."""
+    return ((keys >> 32).astype(np.int32),
+            (keys & np.int64(0xFFFFFFFF)).astype(np.uint32))
+
+
+@partial(jax.jit, static_argnames=("has_cat", "out_dtype"))
+def _bin_chunk_kernel(vhi, vlo, cv, t: Dict[str, jnp.ndarray],
+                      has_cat: bool, out_dtype: str):
+    """[chunk, F] key planes (+ category codes) -> [chunk, F] bin ids.
+
+    t: bhi/blo [F, B] bound-key planes (padded with the NaN key so
+    padding never counts), num_bin/default_bin/nan_is_last [F], and —
+    when has_cat — is_cat/cat_offset/cat_width/nan_cat_bin [F] plus the
+    flattened category LUT.
+    """
+    # lexicographic (hi, lo) compare == int64 key compare == f64 '<'
+    lt = (t["bhi"][None, :, :] < vhi[:, :, None]) | (
+        (t["bhi"][None, :, :] == vhi[:, :, None])
+        & (t["blo"][None, :, :] < vlo[:, :, None]))
+    num = jnp.sum(lt, axis=-1, dtype=jnp.int32)
+    is_nan = (vhi == _NAN_KEY_HI) & (vlo == _NAN_KEY_LO)
+    last = t["num_bin"][None, :] - 1
+    nan_bin = jnp.where(t["nan_is_last"][None, :] > 0, last,
+                        t["default_bin"][None, :])
+    out = jnp.where(is_nan, nan_bin, num)
+    if has_cat:
+        width = t["cat_width"][None, :]
+        idx = t["cat_offset"][None, :] + jnp.clip(cv, 0, width - 1)
+        catbin = jnp.take(t["cat_lut"], idx, axis=0)
+        unseen = (cv < 0) | (cv >= width)
+        catbin = jnp.where(unseen, last, catbin)
+        catbin = jnp.where(cv == _NAN_CAT, t["nan_cat_bin"][None, :], catbin)
+        out = jnp.where(t["is_cat"][None, :] > 0, catbin, out)
+    return out.astype(out_dtype)
+
+
+class DeviceBinner:
+    """Streams raw row chunks through the device bin kernel.
+
+    Build once per mapper set (`DeviceBinner.build` returns None when a
+    categorical feature's ids exceed the LUT capacity — callers fall
+    back to host binning), then `bin_matrix(X)` yields the device
+    `[n, F]` binned matrix in the dataset's storage dtype.
+    """
+
+    def __init__(self, tables: Dict[str, np.ndarray], used_cols: List[int],
+                 has_cat: bool, out_dtype: np.dtype, chunk_rows: int):
+        self.used_cols = used_cols
+        self.has_cat = has_cat
+        self.out_dtype = np.dtype(out_dtype)
+        self.chunk_rows = max(int(chunk_rows), 256)
+        self._cat_widths = tables["cat_width"].copy() if has_cat else None
+        self._is_cat = tables["is_cat"].copy() if has_cat else None
+        self._dev_tables = {k: jnp.asarray(v) for k, v in tables.items()}
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def build(cls, mappers: Sequence[BinMapper], used_cols: Sequence[int],
+              out_dtype, chunk_rows: int) -> Optional["DeviceBinner"]:
+        used = [int(c) for c in used_cols]
+        F = len(used)
+        if F == 0:
+            return None
+        ms = [mappers[c] for c in used]
+        # numerical bound tables: ub[:hi] keys, NaN-key padded
+        his = [(m.num_bin - 1 - (1 if m.missing_type == MissingType.NAN
+                                 else 0))
+               if m.bin_type == BinType.NUMERICAL else 0 for m in ms]
+        B = max(max(his), 1)
+        bkeys = np.full((F, B), _NAN_KEY, np.int64)
+        for j, (m, hi) in enumerate(zip(ms, his)):
+            if hi > 0:
+                bkeys[j, :hi] = sort_keys(m.bin_upper_bound[:hi])
+        bhi, blo = split_keys(bkeys)
+        num_bin = np.array([m.num_bin for m in ms], np.int32)
+        default_bin = np.array([m.default_bin for m in ms], np.int32)
+        nan_is_last = np.array(
+            [int(m.missing_type == MissingType.NAN) for m in ms], np.int32)
+        tables = {"bhi": bhi, "blo": blo, "num_bin": num_bin,
+                  "default_bin": default_bin, "nan_is_last": nan_is_last}
+
+        has_cat = any(m.bin_type == BinType.CATEGORICAL for m in ms)
+        if has_cat:
+            widths = np.zeros(F, np.int64)
+            for j, m in enumerate(ms):
+                if m.bin_type != BinType.CATEGORICAL:
+                    continue
+                real = [c for c in m.categorical_2_bin if c >= 0]
+                w = (max(real) + 1) if real else 1
+                if w > _CAT_LUT_MAX:
+                    return None  # ids too large for a dense LUT
+                widths[j] = w
+            if widths.sum() > _CAT_LUT_TOTAL_MAX:
+                return None
+            offsets = np.concatenate([[0], np.cumsum(widths)[:-1]])
+            lut = np.zeros(max(int(widths.sum()), 1), np.int32)
+            nan_cat_bin = np.zeros(F, np.int32)
+            for j, m in enumerate(ms):
+                if m.bin_type != BinType.CATEGORICAL:
+                    continue
+                lo, w = int(offsets[j]), int(widths[j])
+                lut[lo:lo + w] = m.num_bin - 1  # unmapped -> last bin
+                for c, b in m.categorical_2_bin.items():
+                    if 0 <= c < w:
+                        lut[lo + c] = b
+                # NaN: dedicated last bin when NaN-missing, else the
+                # category-0 route (values_to_bins nan_cat semantics)
+                nan_cat_bin[j] = (m.num_bin - 1
+                                  if m.missing_type == MissingType.NAN
+                                  else int(lut[lo]) if w > 0
+                                  else m.num_bin - 1)
+            tables.update({
+                "is_cat": np.array(
+                    [int(m.bin_type == BinType.CATEGORICAL) for m in ms],
+                    np.int32),
+                "cat_offset": offsets.astype(np.int32),
+                "cat_width": widths.astype(np.int32),
+                "cat_lut": lut,
+                "nan_cat_bin": nan_cat_bin})
+        return cls(tables, used, has_cat, out_dtype, chunk_rows)
+
+    # ------------------------------------------------------------------
+    def _prep_chunk(self, block: np.ndarray):
+        """Raw f64 [rows, F] -> host key planes (+ category codes)."""
+        vals = np.ascontiguousarray(block, dtype=np.float64)
+        vhi, vlo = split_keys(sort_keys(vals))
+        cv = None
+        if self.has_cat:
+            # int(v) truncation toward zero; NaN -> sentinel; clip keeps
+            # the int32 cast defined for huge/inf values (they are
+            # unseen either way)
+            isnan = np.isnan(vals)
+            t = np.clip(np.trunc(np.where(isnan, -1.0, vals)), -1.0,
+                        float(_CAT_LUT_MAX)).astype(np.int32)
+            cv = np.where(isnan, np.int32(_NAN_CAT), t)
+        return vhi, vlo, cv
+
+    def bin_chunk(self, block: np.ndarray) -> jnp.ndarray:
+        """Bin one [rows, F] raw block; pads to the chunk shape so every
+        launch reuses ONE compiled program, slicing the pad off on
+        device."""
+        rows = block.shape[0]
+        pad = self.chunk_rows - rows if rows < self.chunk_rows else 0
+        if pad:
+            block = np.concatenate(
+                [block, np.zeros((pad, block.shape[1]), block.dtype)])
+        vhi, vlo, cv = self._prep_chunk(block)
+        dummy = np.zeros((0,), np.int32)
+        out = _bin_chunk_kernel(
+            jnp.asarray(vhi), jnp.asarray(vlo),
+            jnp.asarray(cv) if cv is not None else jnp.asarray(dummy),
+            self._dev_tables, self.has_cat, str(self.out_dtype))
+        return out[:rows] if pad else out
+
+    def bin_matrix(self, X: np.ndarray) -> jnp.ndarray:
+        """Stream X's used columns through the kernel chunk by chunk.
+
+        Dispatch is async: while the device bins chunk i, the host is
+        already building chunk i+1's key planes, overlapping transfer
+        with compute (the "Out-of-Core GPU Gradient Boosting" chunked
+        ingest pattern).
+        """
+        return self.bin_stream([X])
+
+    def bin_stream(self, blocks) -> jnp.ndarray:
+        """Bin an iterable of raw row blocks, re-chunking across block
+        boundaries so only the FINAL kernel launch pads — a file
+        reader's chunk size rarely aligns with `chunk_rows`, and padding
+        every reader chunk's tail would waste a steady fraction of the
+        kernel work on long streams."""
+        parts = []
+        pend: list = []
+        pend_rows = 0
+        for block in blocks:
+            b = np.asarray(block, dtype=np.float64)[:, self.used_cols]
+            pend.append(b)
+            pend_rows += b.shape[0]
+            while pend_rows >= self.chunk_rows:
+                buf = pend[0] if len(pend) == 1 else np.concatenate(pend)
+                parts.append(self.bin_chunk(buf[:self.chunk_rows]))
+                pend = [buf[self.chunk_rows:]]
+                pend_rows = pend[0].shape[0]
+        if pend_rows > 0 or not parts:
+            if not pend:
+                return jnp.zeros((0, len(self.used_cols)), self.out_dtype)
+            buf = pend[0] if len(pend) == 1 else np.concatenate(pend)
+            parts.append(self.bin_chunk(buf))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=0)

@@ -165,10 +165,50 @@ def run_predict_sweep(X, y, rounds=50, leaves=255, bins=255):
           flush=True)
 
 
+def run_ingest_sweep(X, y, bins=255):
+    """Ingest-throughput sweep: Dataset construct rows/s for the host
+    binning path next to the device kernel across chunk sizes, with the
+    sketch (bin finding) phase split out.
+
+        N=1000000 python tools/perf_probe.py ingest
+    """
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.utils import timer as phase_timer
+
+    n = X.shape[0]
+
+    def once(mode, chunk):
+        phase_timer.enable(True)
+        phase_timer.reset()
+        t0 = time.time()
+        ds = lgb.Dataset(X, label=y, params={
+            "max_bin": bins, "tpu_ingest_device": mode,
+            "tpu_ingest_chunk_rows": chunk})
+        ds.construct()
+        if ds._inner._ingest_bins is not None:
+            jax.block_until_ready(ds._inner._ingest_bins)
+        wall = time.time() - t0
+        ph = dict(phase_timer.summary())
+        phase_timer.enable(False)
+        return wall, ph.get("sketch", 0.0), ph.get("binning", 0.0)
+
+    s, sk, bn = once("false", 65536)
+    print(f"host binning:            {n / s:12.0f} rows/s "
+          f"(sketch {sk:5.2f}s bin {bn:5.2f}s)", flush=True)
+    for chunk in (16384, 32768, 65536, 131072, 262144):
+        s, sk, bn = once("true", chunk)
+        print(f"device chunk={chunk:<7d}    {n / s:12.0f} rows/s "
+              f"(sketch {sk:5.2f}s bin {bn:5.2f}s)", flush=True)
+
+
 def main():
     n = int(os.environ.get("N", 1_000_000))
     X, y = make_data(n)
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "ingest":
+        run_ingest_sweep(X, y, bins=int(os.environ.get("BINS", 255)))
+        return
     if arg == "predict":
         run_predict_sweep(X, y, rounds=int(os.environ.get("ROUNDS", 50)),
                           leaves=int(os.environ.get("LEAVES", 255)),
