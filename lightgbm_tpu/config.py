@@ -189,7 +189,27 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     #   "hilo"   - bf16 hi/lo split stats, f32 accumulate (default; ~f32 accurate, MXU speed)
     #   "bf16"   - single bf16 stats pass (fastest, lossy)
     #   "f32"    - full f32 dots (XLA 'highest' precision)
+    #   "int16"/"int8" - QUANTIZED gradients: per-iteration stochastic
+    #   rounding onto an integer grid, narrow-int MXU dots with exact
+    #   int32 accumulation.  Data-parallel split decisions are bit-
+    #   identical for any shard count (int32 psum is associative) and
+    #   the stats operand is 2-4x narrower than hilo's
     "tpu_hist_precision": ("str", "hilo", ("hist_precision",)),
+    # gradient-grid rounding under tpu_hist_precision=int16|int8:
+    # "stochastic" (unbiased, deterministic given `seed`, invariant to
+    # row sharding) or "nearest"
+    "tpu_quant_round": ("str", "stochastic", ()),
+    # quantized training only: recompute final leaf outputs from the true
+    # f32 grad/hess sums over each leaf's rows (split decisions stay
+    # integer-exact; leaf values regain float precision — LightGBM
+    # quantized training's renew-leaf).  Turn off for strictly bitwise
+    # cross-shard model files
+    "tpu_quant_refit_leaves": ("bool", True, ()),
+    # persistent XLA compilation cache directory (empty = off): repeat
+    # runs of same-shaped programs skip the cold compile tail.  Applied
+    # at first device use (jax_compilation_cache_dir); CPU-destined
+    # processes get a host-fingerprinted subdir (utils/backend.py)
+    "tpu_compile_cache_dir": ("str", "", ()),
     # rows per histogram scan block (device-side); 0 = auto (256 for the
     # pallas backend — its VMEM-resident accumulator wants short blocks —
     # 16384 for the xla scan, tuned for HBM streaming)

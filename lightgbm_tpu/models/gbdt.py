@@ -471,7 +471,12 @@ class GBDT:
                     base_scores, self.train_scores.scores,
                     self._key, self._bag_key, k, refresh, **extra)
                 self.train_scores.scores = scores
-                self._pending.append((records, k, inits[k]))
+                # quantized leaf refit: the host Tree must take its leaf
+                # values from the refitted device vector, not the records
+                self._pending.append((
+                    records,
+                    leaf_out if self.learner.refits_leaves else None,
+                    k, inits[k]))
             self.iter_ += 1
             ctx.__exit__(None, None, None)
             return False
@@ -540,13 +545,16 @@ class GBDT:
 
     def _materialize_inner(self) -> None:
         pending, self._pending = self._pending, []
-        # one batched fetch for all pending trees
-        recs = jax.device_get([p[0] for p in pending])
+        # one batched fetch for all pending trees (None leaf-out entries
+        # are empty pytrees and fetch as None)
+        fetched = jax.device_get([(p[0], p[1]) for p in pending])
         meta = self.learner.meta_np
-        for (_, class_id, init), rec in zip(pending, recs):
+        for (_, _, class_id, init), (rec, leaf_out) in zip(pending, fetched):
             if self._stopped:
                 break  # drop queued post-stall iterations (reference pops them)
-            tree = self.learner.build_tree_from_records(np.asarray(rec))
+            tree = self.learner.build_tree_from_records(
+                np.asarray(rec),
+                None if leaf_out is None else np.asarray(leaf_out))
             if tree.num_leaves > 1:
                 tree.apply_shrinkage(self.shrinkage_rate)
                 # valid scores stay device-resident: the new tree's packed

@@ -45,6 +45,14 @@ class TPUTreeLearner:
     def __init__(self, config: Config, train_data: TrainingData):
         self.config = config
         self.td = train_data
+        # persistent XLA compilation cache (tpu_compile_cache_dir): wire
+        # it up at first device use so repeat runs of the same shapes
+        # skip the cold compile tail; off by default
+        cache_dir = str(config.tpu_compile_cache_dir or "")
+        if cache_dir:
+            from ..utils.backend import enable_compilation_cache
+
+            enable_compilation_cache(cache_dir, min_compile_time_secs=0.0)
         n = train_data.num_data
         self.num_features = train_data.num_features
         if self.num_features == 0:
@@ -147,12 +155,16 @@ class TPUTreeLearner:
             self._partitioned = True
 
         for key, allowed in (("tpu_partition_impl", ("select", "vselect", "gather")),
-                             ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2"))):
+                             ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2")),
+                             ("tpu_hist_precision", ("hilo", "bf16", "f32",
+                                                     "f64", "int8", "int16")),
+                             ("tpu_quant_round", ("stochastic", "nearest"))):
             if str(getattr(config, key)) not in allowed:
                 raise ValueError(f"{key}={getattr(config, key)!r}; "
                                  f"expected one of {allowed}")
 
         precision = self._resolve_precision(config)
+        quantized = precision in ("int8", "int16")
 
         # feature axis padded to a multiple of the shard count; padding
         # features are trivial (num_bin=1) and can never split
@@ -243,6 +255,13 @@ class TPUTreeLearner:
         self._sparse_mask = None
         sth = float(config.tpu_sparse_threshold)
         if sth > 0.0:
+            if quantized:
+                # the sparse zero-bin reconstruction mixes histogram rows
+                # with scalar leaf totals; keeping that exact in the
+                # integer domain is future work — reject loudly
+                raise ValueError(
+                    "tpu_sparse_threshold does not compose with quantized "
+                    "histogram precisions (tpu_hist_precision=int8|int16)")
             if bool(config.enable_bundle):
                 # deterministic gate on the FLAG, not on whether a plan
                 # happened to form for this data — the error must not
@@ -653,7 +672,13 @@ class TPUTreeLearner:
             has_sparse=self._sparse_arrays is not None,
             packed_bins=self.packed_bins,
             ramp=bool(config.tpu_ramp),
+            quant_round=str(config.tpu_quant_round),
+            quant_refit=(quantized
+                         and bool(config.tpu_quant_refit_leaves)),
         )
+        # quantized leaf refit: the driver must fetch out["leaf_output"]
+        # and override the record-replayed leaf values at tree build
+        self.refits_leaves = self.params.quant_refit
         if has_cegb_lazy and strategy != "serial":
             # the reference's lazy bitset is learner-local over the full
             # data; under row sharding the paid matrix would need its own
@@ -725,9 +750,13 @@ class TPUTreeLearner:
             on_tpu = jax.devices()[0].platform == "tpu"
             # f32/f64 stay on xla: auto only picks the validated bf16/hilo
             # kernel shape (an explicit tpu_hist_impl=pallas/pallas2 still
-            # honors f32 via Precision.HIGHEST inside _hist_pallas)
+            # honors f32 via Precision.HIGHEST inside _hist_pallas).
+            # int8 rides the same kernel (int8 MXU dots, int32 VMEM
+            # accumulator; the [3, n] stats plane is leaner than hilo's
+            # [5, n]); int16 stays on xla in auto until Mosaic int16
+            # dots are hardware-validated — explicit pallas2 still works
             impl = ("pallas2" if on_tpu and chunk_fits and block_ok
-                    and precision in ("hilo", "bf16") else "xla")
+                    and precision in ("hilo", "bf16", "int8") else "xla")
         if block <= 0:
             block = {"pallas": 256, "pallas2": 8192}.get(impl, 16384)
         return impl, block
@@ -739,9 +768,15 @@ class TPUTreeLearner:
         deterministic=true accumulates everything in f64 (the reference's
         HistogramBinEntry representation, bin.h:33-40) so serial and
         data-parallel decisions agree exactly; requires jax x64, which is
-        enabled here process-wide."""
+        enabled here process-wide.  The quantized precisions (int8/int16)
+        are ALREADY reduction-order invariant — int32 sums are associative
+        — so deterministic=true keeps them as-is at full speed instead of
+        forcing the slow f64 path (the recommended deterministic mode)."""
+        precision = str(config.tpu_hist_precision)
         if not bool(config.deterministic):
-            return str(config.tpu_hist_precision)
+            return precision
+        if precision in ("int8", "int16"):
+            return precision
         jax.config.update("jax_enable_x64", True)
         if str(config.tpu_hist_impl).startswith("pallas"):
             raise ValueError(
@@ -1025,10 +1060,17 @@ class TPUTreeLearner:
 
     def build_tree(self, out: Dict) -> Tree:
         """Replay device split records into a reference-compatible Tree."""
-        rec = np.asarray(jax.device_get(out["records"]))  # [L-1, 15], one fetch
-        return self.build_tree_from_records(rec)
+        fetch = [out["records"]]
+        if self.refits_leaves:
+            fetch.append(out["leaf_output"])
+        got = jax.device_get(fetch)  # one fetch
+        rec = np.asarray(got[0])
+        leaf_out = np.asarray(got[1]) if self.refits_leaves else None
+        return self.build_tree_from_records(rec, leaf_out)
 
-    def build_tree_from_records(self, rec: np.ndarray) -> Tree:
+    def build_tree_from_records(self, rec: np.ndarray,
+                                leaf_output: Optional[np.ndarray] = None
+                                ) -> Tree:
         from ..ops import grower as G
         L = self.params.num_leaves
         tree = Tree(L)
@@ -1070,4 +1112,12 @@ class TPUTreeLearner:
                     threshold_double=mappers[real_f].bin_to_value(thr_bin),
                     default_left=row[G.REC_DEFAULT_LEFT] > 0.5,
                     **common)
+        if leaf_output is not None and tree.num_leaves > 1:
+            # quantized leaf refit (GrowerParams.quant_refit): the grower
+            # leaf ids ARE the Tree leaf indices (left child keeps the
+            # parent's id, right child takes the next fresh id — the same
+            # contract the record replay above follows), so the device-
+            # refitted outputs overwrite the record values positionally
+            tree.leaf_value[:tree.num_leaves] = np.asarray(
+                leaf_output[:tree.num_leaves], np.float64)
         return tree

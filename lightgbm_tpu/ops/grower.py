@@ -64,6 +64,14 @@ modes, mirroring the reference's parallel tree learners (SURVEY.md §2.3):
 Cost model: each round is one O(n) batched contraction covering up to K
 splits, so a 255-leaf tree costs ~ (log2(K) + 254/K) full-data passes at
 MXU-shaped operand sizes — versus 254 passes at M=8 shapes before.
+
+Quantized precisions ("int8"/"int16", GrowerParams.precision): grad/hess
+discretize per tree onto an integer grid (stochastic rounding hashed on
+GLOBAL row indices — sharding-invariant, deterministic given the seed),
+the histogram pool/psum/subtraction stay in exact int32, and the scales
+rescale (g, h) to f32 once per leaf inside select().  Because integer
+sums are associative, the `data_axis` mode's split decisions are
+bit-identical for ANY shard count — the fast deterministic mode.
 """
 
 from __future__ import annotations
@@ -75,7 +83,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .histogram import (build_histogram_batched_t, build_histogram_sparse,
-                        build_histogram_t, pack_stats, unpack2d)
+                        build_histogram_t, key_words, pack_stats,
+                        quant_limit, quantize_values, unpack2d)
 from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
                     leaf_split_gain, per_feature_best_split,
                     per_feature_best_split_categorical,
@@ -166,6 +175,14 @@ class GrowerParams(NamedTuple):
     # time).  Disabled automatically when forced splits pre-grow the
     # frontier beyond the 2^r bound.
     ramp: bool = False
+    # quantized precisions (int16/int8) only: grad/hess rounding onto the
+    # integer grid — "stochastic" (unbiased, hashed global-row-index
+    # randomness, shard-count invariant) or "nearest"
+    quant_round: str = "stochastic"
+    # recompute final leaf outputs from the TRUE f32 grad/hess sums over
+    # each leaf's rows (LightGBM quantized training's renew-leaf): split
+    # DECISIONS stay integer-exact, leaf values regain float precision
+    quant_refit: bool = False
 
 
 def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
@@ -245,6 +262,23 @@ def make_grower(params: GrowerParams, num_features: int,
             "tree_learner=serial/data/voting, a select-family partition "
             "lowering, and no EFB bundling / 4-bit packing")
     precision = params.precision
+    # quantized-gradient mode (tpu_hist_precision=int16|int8): stats ride
+    # the MXU as narrow ints, histograms/pool/psum/subtraction stay in
+    # exact int32, and the per-iteration scales rescale (g, h) back to
+    # floats once per leaf at the split-search boundary (select)
+    quantized = precision in ("int8", "int16")
+    if quantized:
+        if params.forced:
+            raise ValueError("quantized histogram precisions do not "
+                             "compose with forced splits")
+        if params.has_sparse:
+            raise ValueError(
+                "quantized histogram precisions do not compose with "
+                "sparse train-time storage (tpu_sparse_threshold)")
+        if params.quant_round not in ("stochastic", "nearest"):
+            raise ValueError(
+                f"tpu_quant_round={params.quant_round!r}; expected "
+                "stochastic or nearest")
     K = max(1, min(int(params.split_batch), L - 1))
 
     def preduce_scalar(x):
@@ -468,7 +502,7 @@ def make_grower(params: GrowerParams, num_features: int,
                 # sparse column is missing its zero-bin mass)
                 dref = (meta_local["dense_ref"][0] if params.has_sparse
                         else 0)
-                loc = jnp.sum(hist[dref], axis=0)
+                loc = dequant(jnp.sum(hist[dref], axis=0))
                 # sparse features need their LOCAL zero bin before the
                 # local gain vote — reconstructed from the SAME `loc`
                 # totals that (psum'd) later fix the voted aggregation
@@ -478,7 +512,7 @@ def make_grower(params: GrowerParams, num_features: int,
                                             loc)
                             if params.has_sparse else hist)
                 gain_loc, _ = combined_search(
-                    hist_loc, loc[0], loc[1], loc[2], meta_local,
+                    dequant(hist_loc), loc[0], loc[1], loc[2], meta_local,
                     fmask_local, local_kw, min_c, max_c)
                 k2 = min(2 * voting_k, F)
                 vals, idx = jax.lax.top_k(gain_loc, k2)
@@ -501,8 +535,9 @@ def make_grower(params: GrowerParams, num_features: int,
                         sel_hist, sel_meta["is_sparse"] > 0,
                         sel_meta["default_bin"],
                         jax.lax.psum(loc, data_axis))
-                gain_sel, fin = combined_search(sel_hist, sg, sh, cnt,
-                                                sel_meta, fmask_local[sel],
+                gain_sel, fin = combined_search(dequant(sel_hist), sg, sh,
+                                                cnt, sel_meta,
+                                                fmask_local[sel],
                                                 split_kw, min_c, max_c)
                 if params.has_cegb:
                     gain_sel = apply_delta(gain_sel, delta_local[sel])
@@ -510,6 +545,10 @@ def make_grower(params: GrowerParams, num_features: int,
                 res = fin(bi)
                 return res._replace(feature=sel[bi], gain=gain_sel[bi])
 
+            # the leaf-cost boundary: integer histograms rescale to f32
+            # stats HERE, once per leaf — everything upstream (psum, pool,
+            # sibling subtraction) was exact int32
+            hist = dequant(hist)
             hist = expand_bundles(hist, sg, sh, cnt)
             hist = expand_sparse(hist)
             gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_local,
@@ -556,16 +595,62 @@ def make_grower(params: GrowerParams, num_features: int,
         # ---- root ----------------------------------------------------
         g = grad * row_mask
         h = hess * row_mask
-        # deterministic (f64) mode: the scalar leaf sums must be reduced in
-        # f64 too, or psum reassociation of f32 partials re-enters by the
-        # back door
-        sum_t = jnp.float64 if precision == "f64" else jnp.float32
-        sum_g = preduce_scalar(jnp.sum(g, dtype=sum_t)).astype(jnp.float32)
-        sum_h = preduce_scalar(jnp.sum(h, dtype=sum_t)).astype(jnp.float32)
-        cnt = preduce_scalar(
-            jnp.sum(row_mask, dtype=sum_t)).astype(jnp.float32)
-        # per-tree packed stats, reused by every round's contraction
-        stats = pack_stats(g, h, row_mask, precision)         # [S, n_pad]
+        if quantized:
+            # per-iteration gradient discretization: symmetric max-abs
+            # scales per class (max is associative, so pmax makes them
+            # bit-identical on every shard), stochastic rounding keyed on
+            # GLOBAL row indices (invariant to row sharding), and a grid
+            # capped by quant_limit so a worst-case int32 bin can never
+            # overflow across the GLOBAL row count
+            total_rows = n_pad * (num_shards if data_axis else 1)
+            qmax = quant_limit(precision, total_rows)
+            amax_g = jnp.max(jnp.abs(g))
+            amax_h = jnp.max(jnp.abs(h))
+            if data_axis:
+                amax_g = jax.lax.pmax(amax_g, data_axis)
+                amax_h = jax.lax.pmax(amax_h, data_axis)
+            g_scale = jnp.maximum(amax_g, jnp.float32(1e-30)) / qmax
+            h_scale = jnp.maximum(amax_h, jnp.float32(1e-30)) / qmax
+            # fold_in leaves the caller's split stream untouched, so the
+            # bynode draws below stay on their usual sequence
+            seed_a, seed_b = key_words(jax.random.fold_in(key, 0x5154))
+            row0 = (jax.lax.axis_index(data_axis) * n_pad if data_axis
+                    else 0)
+            g_q = quantize_values(g, g_scale, qmax, params.quant_round,
+                                  seed_a, seed_b, row0, salt=0x9E3779B9)
+            h_q = quantize_values(h, h_scale, qmax, params.quant_round,
+                                  seed_a, seed_b, row0, salt=0x85EBCA6B)
+            qscale = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
+
+            def dequant(hh):
+                return hh.astype(jnp.float32) * qscale
+
+            # scalar leaf totals from the SAME quantized values the
+            # histograms accumulate (int32 sums, psum-exact), rescaled
+            sum_g = (preduce_scalar(jnp.sum(g_q, dtype=jnp.int32))
+                     .astype(jnp.float32) * g_scale)
+            sum_h = (preduce_scalar(jnp.sum(h_q, dtype=jnp.int32))
+                     .astype(jnp.float32) * h_scale)
+            cnt = (preduce_scalar(
+                jnp.sum(row_mask.astype(jnp.int32), dtype=jnp.int32))
+                .astype(jnp.float32))
+            stats = pack_stats(g_q, h_q, row_mask, precision)  # [3, n_pad]
+        else:
+            def dequant(hh):  # identity: floats never rescale
+                return hh
+
+            # deterministic (f64) mode: the scalar leaf sums must be
+            # reduced in f64 too, or psum reassociation of f32 partials
+            # re-enters by the back door
+            sum_t = jnp.float64 if precision == "f64" else jnp.float32
+            sum_g = preduce_scalar(
+                jnp.sum(g, dtype=sum_t)).astype(jnp.float32)
+            sum_h = preduce_scalar(
+                jnp.sum(h, dtype=sum_t)).astype(jnp.float32)
+            cnt = preduce_scalar(
+                jnp.sum(row_mask, dtype=sum_t)).astype(jnp.float32)
+            # per-tree packed stats, reused by every round's contraction
+            stats = pack_stats(g, h, row_mask, precision)     # [S, n_pad]
         S = stats.shape[0]
         # dense column count from the matrix itself: with sparse storage
         # bins_t holds only the dense groups (Gd < G = feature width)
@@ -651,8 +736,11 @@ def make_grower(params: GrowerParams, num_features: int,
         # pool under deterministic f64 would silently round every stored
         # leaf histogram back to f32 (and mixed-dtype scatters become
         # errors in future jax) — the reference's deterministic analog
-        # keeps f64 HistogramBinEntry end to end (bin.h:33-40)
-        hist_t = jnp.float64 if precision == "f64" else jnp.float32
+        # keeps f64 HistogramBinEntry end to end (bin.h:33-40).  Int
+        # precisions keep the pool in int32 so sibling subtraction stays
+        # EXACT (and reduction-order invariant) until select() rescales.
+        hist_t = (jnp.float64 if precision == "f64"
+                  else jnp.int32 if quantized else jnp.float32)
         state = {
             "leaf_ids": jnp.zeros(n_pad, jnp.int32),
             "pool": jnp.zeros((L, G, B, 3), hist_t).at[0].set(root_hist),
@@ -1146,6 +1234,24 @@ def make_grower(params: GrowerParams, num_features: int,
                 kr *= 2
 
         state = jax.lax.while_loop(cond, body, state)
+        if quantized and params.quant_refit:
+            # leaf-value refit: the tree STRUCTURE came from integer
+            # histograms; the final outputs come from the true f32
+            # grad/hess sums over each leaf's rows, so leaf values carry
+            # no quantization error (LightGBM quantized training's
+            # renew-leaf).  f32 psum here is the one reduction whose
+            # shard-order ulps can reach the model — turn refit off for
+            # strictly bitwise cross-shard model files.
+            rg = preduce_scalar(
+                jnp.zeros(L, jnp.float32).at[state["leaf_ids"]].add(g))
+            rh = preduce_scalar(
+                jnp.zeros(L, jnp.float32).at[state["leaf_ids"]].add(h))
+            refit = jnp.clip(
+                leaf_output(rg, rh + jnp.float32(2e-15), params.l1,
+                            params.l2, params.max_delta_step),
+                state["leaf_min"], state["leaf_max"])
+            state["leaf_output"] = jnp.where(state["leaf_cnt"] > 0,
+                                             refit, state["leaf_output"])
         out = {
             "records": state["records"][:L - 1],  # [L-1, W], REC_* indices
             "leaf_ids": state["leaf_ids"],

@@ -23,6 +23,15 @@ Precision modes (`tpu_hist_precision`):
     per pass (K=25 -> N=125, one 128-lane MXU tile).
   * "f32": full f32 matmul with HIGHEST precision (slowest, exact).
   * "bf16": single bf16 pass (fastest, ~8 mantissa bits).
+  * "int16" / "int8": QUANTIZED gradients (the Booster-accelerator /
+    LightGBM-quantized-training idea): grad/hess are stochastically
+    rounded per iteration onto a fixed-point grid (`quantize_values`,
+    scales = per-class max-abs / `quant_limit`), the stats matrix is a
+    [3, n] int8/int16 plane, and the MXU contracts narrow-int operands
+    with EXACT int32 accumulation (`preferred_element_type=int32`).
+    Integer sums are associative, so data-parallel psum'd histograms are
+    bit-identical for any shard count — the fast deterministic mode —
+    and the stats operand is 2-4x narrower than hilo's [5, n] bf16.
 """
 
 from __future__ import annotations
@@ -33,6 +42,128 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+# --------------------------------------------------------------------------
+# Quantized-gradient support (tpu_hist_precision=int16|int8)
+# --------------------------------------------------------------------------
+
+_INT_STAT_DTYPES = {"int8": jnp.int8, "int16": jnp.int16}
+_INT_TYPE_MAX = {"int8": 127, "int16": 32767}
+
+
+def _dot_spec(precision: str):
+    """(operand dtype, accumulator dtype, lax precision) for a histogram
+    contraction — the ONE table every builder below reads, so the xla and
+    pallas backends can never disagree on the int32-exact contract."""
+    if precision in _INT_STAT_DTYPES:
+        # integer dots ignore lax.Precision; int32 accumulation is exact
+        return (_INT_STAT_DTYPES[precision], jnp.int32,
+                jax.lax.Precision.DEFAULT)
+    if precision == "f64":
+        return jnp.float64, jnp.float64, jax.lax.Precision.HIGHEST
+    if precision == "f32":
+        return jnp.float32, jnp.float32, jax.lax.Precision.HIGHEST
+    return jnp.bfloat16, jnp.float32, jax.lax.Precision.DEFAULT
+
+
+def quant_limit(precision: str, total_rows: int) -> int:
+    """Largest |quantized| stat value such that a worst-case histogram bin
+    (every row landing in it at max magnitude) still fits int32.
+
+    The grid narrows below the dtype's own range once total_rows exceeds
+    2^31 / type_max (~65k rows for int16, ~16.9M for int8): the stats
+    still ship/contract at the narrow dtype's width, only the effective
+    mantissa shrinks — overflow is impossible by construction, on one
+    shard or across any psum of shards (the bound is on GLOBAL rows)."""
+    cap = (2 ** 31 - 1) // max(int(total_rows), 1)
+    q = min(_INT_TYPE_MAX[precision], cap)
+    if q < 1:
+        raise ValueError(
+            f"{total_rows} rows overflow int32 histogram accumulation even "
+            "at 1-bit quantization; use a float tpu_hist_precision")
+    return q
+
+
+def _hash_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Stateless PCG-style avalanche over uint32 counters (wrapping
+    arithmetic): the per-row randomness source for stochastic rounding.
+    Keyed on the GLOBAL row index so the draw is invariant to how rows
+    are sharded — a requirement for bit-identical data-parallel
+    quantization, which jax.random's shape-keyed streams cannot give
+    under shard_map."""
+    x = x * jnp.uint32(747796405) + jnp.uint32(2891336453)
+    w = ((x >> ((x >> jnp.uint32(28)) + jnp.uint32(4))) ^ x) \
+        * jnp.uint32(277803737)
+    return (w >> jnp.uint32(22)) ^ w
+
+
+def hashed_uniform(idx: jnp.ndarray, seed_a, seed_b, salt: int
+                   ) -> jnp.ndarray:
+    """[n] uniforms in [0, 1) from uint32 row counters + two key words."""
+    h = _hash_u32(idx.astype(jnp.uint32)
+                  ^ (jnp.asarray(seed_a, jnp.uint32) ^ jnp.uint32(salt)))
+    h = _hash_u32(h + jnp.asarray(seed_b, jnp.uint32))
+    return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
+
+def key_words(key: jnp.ndarray):
+    """Two uint32 words from a PRNG key (raw uint32[2] or typed)."""
+    try:
+        if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+            key = jax.random.key_data(key)
+    except (AttributeError, TypeError):  # pragma: no cover - old jax
+        pass
+    kw = jnp.ravel(key).astype(jnp.uint32)
+    return kw[0], kw[-1]
+
+
+def quantize_values(x: jnp.ndarray, scale, qmax: int, mode: str,
+                    seed_a=0, seed_b=0, row_offset=0, salt: int = 0
+                    ) -> jnp.ndarray:
+    """f32 [n] -> int32 grid values in [-qmax, qmax]: x ~= result * scale.
+
+    mode="stochastic" rounds floor(q) up with probability frac(q) —
+    unbiased (E[result] * scale == x on-grid) and deterministic given the
+    seed words; the randomness comes from `hashed_uniform` over GLOBAL
+    row indices (row_offset = this shard's first global row), so the
+    rounded values are identical under any row sharding.
+    mode="nearest" is plain round-half-to-even."""
+    q = jnp.clip(x / scale, -float(qmax), float(qmax))
+    if mode == "nearest":
+        return jnp.rint(q).astype(jnp.int32)
+    fl = jnp.floor(q)
+    idx = (jnp.arange(x.shape[0], dtype=jnp.uint32)
+           + jnp.asarray(row_offset).astype(jnp.uint32))
+    r = hashed_uniform(idx, seed_a, seed_b, salt)
+    return (fl + (r < (q - fl))).astype(jnp.int32)
+
+
+def bench_hist_operands(bins_np: np.ndarray, precision: str, block: int,
+                        seed: int = 0):
+    """Blocked operands for histogram micro-benchmarks (bench.py's
+    hist_rows_per_sec and tools/perf_probe.py's hist sweep — ONE
+    implementation so the stats layout and quantization call can't
+    drift between them): slice to whole blocks, transpose to the
+    [nb, F, block] layout, draw synthetic grad/hess, quantize for int
+    precisions.  Returns (bins_t_blocks, stats_blocks, n_use)."""
+    n, F = bins_np.shape
+    nb = n // block
+    if nb < 1:
+        raise ValueError(f"need >= {block} rows, have {n}")
+    n_use = nb * block
+    rng = np.random.default_rng(seed)
+    g = jnp.asarray(rng.normal(size=n_use).astype(np.float32))
+    h = jnp.asarray((np.abs(rng.normal(size=n_use)) + 0.1)
+                    .astype(np.float32))
+    ones = jnp.ones(n_use, jnp.float32)
+    if precision in _INT_STAT_DTYPES:
+        q = quant_limit(precision, n_use)
+        g = quantize_values(g, jnp.max(jnp.abs(g)) / q, q, "nearest")
+        h = quantize_values(h, jnp.max(jnp.abs(h)) / q, q, "nearest")
+    stats = pack_stats(g, h, ones, precision)
+    bins_tb = jnp.asarray(np.ascontiguousarray(bins_np[:n_use].T)
+                          .reshape(F, nb, block).transpose(1, 0, 2))
+    return bins_tb, stats.reshape(-1, nb, block), n_use
 
 
 def pack_stats(grad: jnp.ndarray, hess: jnp.ndarray, mask: jnp.ndarray,
@@ -48,7 +179,15 @@ def pack_stats(grad: jnp.ndarray, hess: jnp.ndarray, mask: jnp.ndarray,
     (reference include/LightGBM/bin.h:33-40), so serial and data-parallel
     split decisions agree bit-for-bit on real data regardless of psum
     reduction order.
+
+    "int8"/"int16": grad/hess must ALREADY be quantized int values from
+    `quantize_values` (within +-quant_limit); the return is the narrow
+    [3, n] integer stats plane the int32-accumulating contraction reads.
     """
+    if precision in _INT_STAT_DTYPES:
+        dt = _INT_STAT_DTYPES[precision]
+        return jnp.stack([grad.astype(dt), hess.astype(dt),
+                          mask.astype(dt)])
     if precision == "f64":
         return jnp.stack([grad, hess, mask]).astype(jnp.float64)
     if precision == "f32":
@@ -65,8 +204,12 @@ def pack_stats(grad: jnp.ndarray, hess: jnp.ndarray, mask: jnp.ndarray,
 
 
 def _unpack_hist(raw: jnp.ndarray, precision: str) -> jnp.ndarray:
-    """[S, F*B] accumulated rows -> [F*B, 3] (g, h, cnt)."""
-    if precision in ("f32", "f64", "bf16"):
+    """[S, F*B] accumulated rows -> [F*B, 3] (g, h, cnt).
+
+    Int precisions stay int32 here: the grower's pool, psum, and sibling
+    subtraction all run on exact integers; rescaling to f32 happens once
+    per leaf at the split-search boundary (ops/grower.py select)."""
+    if precision in ("f32", "f64", "bf16", "int8", "int16"):
         g, h, c = raw[0], raw[1], raw[2]
     else:
         g = raw[0] + raw[1]
@@ -90,10 +233,7 @@ def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
     into the matmul operand.
     """
     n, num_features = bins.shape
-    dot_dtype = {"f32": jnp.float32,
-                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
-    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
-            else jax.lax.Precision.DEFAULT)
+    dot_dtype, acc_dtype, prec = _dot_spec(precision)
 
     block = min(block_rows, max(n, 1))
     num_blocks = (n + block - 1) // block
@@ -105,8 +245,6 @@ def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
     bins_blocks = bins.reshape(num_blocks, block, num_features)
     stats_blocks = stats.reshape(stats.shape[0], num_blocks, block)
     iota = jnp.arange(num_bins, dtype=jnp.int32)
-
-    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
 
     def body(acc, xs):
         b_blk, s_blk = xs  # [block, F], [S, block]
@@ -156,12 +294,7 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
     nb, num_features, block = bins_t_blocks.shape
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
-    dot_dtype = {"f32": jnp.float32,
-                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
-    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
-            else jax.lax.Precision.DEFAULT)
-
-    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
+    dot_dtype, acc_dtype, prec = _dot_spec(precision)
 
     def body(acc, xs):
         b_t, s_blk, l_blk = xs  # [F, blk], [S, blk], [blk]
@@ -216,11 +349,7 @@ def build_histogram_sparse(sidx: jnp.ndarray, sbin: jnp.ndarray,
     Gs, M = sidx.shape
     S = stats.shape[0]
     K = slot_leaf_ids.shape[0]
-    dot_dtype = {"f32": jnp.float32,
-                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
-    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
-            else jax.lax.Precision.DEFAULT)
-    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
+    dot_dtype, acc_dtype, prec = _dot_spec(precision)
 
     mb = min(block_entries, M)
     nmb = (M + mb - 1) // mb
@@ -323,9 +452,16 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
     B = num_bins
     # sublane-aligned per-feature row offset (perfeature variant only)
     Bp = -(-B // 8) * 8 if variant == "perfeature" else B
-    dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
-    dot_prec = (jax.lax.Precision.HIGHEST if precision == "f32"
-                else jax.lax.Precision.DEFAULT)
+    # int accumulator twins: narrow-int operands, exact int32 VMEM
+    # accumulator — the [3, n] int8 stats plane is 2-4x leaner than
+    # hilo's [5, n] bf16, so larger row blocks fit the same VMEM budget
+    if precision in _INT_STAT_DTYPES:
+        dot_dtype, acc_dtype, dot_prec = _dot_spec(precision)
+    else:
+        dot_dtype = jnp.float32 if precision == "f32" else jnp.bfloat16
+        acc_dtype = jnp.float32
+        dot_prec = (jax.lax.Precision.HIGHEST if precision == "f32"
+                    else jax.lax.Precision.DEFAULT)
 
     def expand_slots(stats_ref, leaf_ref, slots_ref):
         """[K*S, blk] per-slot stats: slot one-hot x packed stat rows."""
@@ -357,7 +493,7 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
         onehot = onehot.reshape(F * B, block)
         acc = jax.lax.dot_general(
             onehot, sexp, (((1,), (1,)), ((), ())),
-            precision=dot_prec, preferred_element_type=jnp.float32)
+            precision=dot_prec, preferred_element_type=acc_dtype)
         accumulate(i, out_ref, slice(None), acc)
 
     def kernel_perfeature_chunk(fblk):
@@ -374,7 +510,7 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
                 acc = jax.lax.dot_general(
                     onehot, sexp, (((1,), (1,)), ((), ())),
                     precision=dot_prec,
-                    preferred_element_type=jnp.float32)
+                    preferred_element_type=acc_dtype)
                 accumulate(i, out_ref, slice(f * Bp, (f + 1) * Bp), acc)
         return kernel
 
@@ -395,7 +531,7 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
                 pl.BlockSpec((K, 1), lambda i: (0, 0)),
             ],
             out_specs=pl.BlockSpec((F * B, K * S), lambda i: (0, 0)),
-            out_shape=jax.ShapeDtypeStruct((F * B, K * S), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((F * B, K * S), acc_dtype),
             interpret=interpret,
         )(bins_t_blocks, stats_nb, leaf_blocks.reshape(nb, 1, block),
           slot_leaf_ids.reshape(K, 1))
@@ -439,7 +575,7 @@ def _hist_pallas(bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             ],
             out_specs=pl.BlockSpec((fblk * Bp, K * S),
                                    lambda fi, i: (fi, 0)),
-            out_shape=jax.ShapeDtypeStruct((F * Bp, K * S), jnp.float32),
+            out_shape=jax.ShapeDtypeStruct((F * Bp, K * S), acc_dtype),
             interpret=interpret,
         )(bins_t_blocks, stats_nb, leaf_blocks.reshape(nb, 1, block),
           slot_leaf_ids.reshape(K, 1))
@@ -461,12 +597,7 @@ def build_histogram_t(bins_t_blocks, stats_blocks, num_bins: int,
     Returns [F, B, 3] f32.
     """
     nb, num_features, block = bins_t_blocks.shape
-    dot_dtype = {"f32": jnp.float32,
-                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
-    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
-            else jax.lax.Precision.DEFAULT)
-
-    acc_dtype = jnp.float64 if precision == "f64" else jnp.float32
+    dot_dtype, acc_dtype, prec = _dot_spec(precision)
 
     def body(acc, xs):
         b_t, s_blk = xs
@@ -516,10 +647,9 @@ def build_histogram_batched_inline(bins_blocks, stats_blocks, leaf_blocks,
     nb, block, num_features = bins_blocks.shape
     S = stats_blocks.shape[0]
     K = slot_leaf_ids.shape[0]
-    dot_dtype = {"f32": jnp.float32,
-                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
-    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
-            else jax.lax.Precision.DEFAULT)
+    dot_dtype, _, prec = _dot_spec(precision)
+    acc_dtype = (jnp.int32 if precision in _INT_STAT_DTYPES
+                 else jnp.float32)
     iota = jnp.arange(num_bins, dtype=jnp.int32)
 
     def body(acc, xs):
@@ -532,10 +662,10 @@ def build_histogram_batched_inline(bins_blocks, stats_blocks, leaf_blocks,
         sexp = sexp.reshape(block, K * S)
         acc = acc + jax.lax.dot_general(
             onehot, sexp, (((0,), (0,)), ((), ())),
-            precision=prec, preferred_element_type=jnp.float32)
+            precision=prec, preferred_element_type=acc_dtype)
         return acc, None
 
-    init = jnp.zeros((num_features * num_bins, K * S), jnp.float32)
+    init = jnp.zeros((num_features * num_bins, K * S), acc_dtype)
     raw, _ = jax.lax.scan(
         body, init, (bins_blocks, jnp.moveaxis(stats_blocks, 1, 0),
                      leaf_blocks))
@@ -552,10 +682,9 @@ def build_histogram_inline(bins_blocks, stats_blocks, num_bins: int,
     bins_blocks: [nb, block, F], stats_blocks: [S, nb, block] (already padded).
     """
     nb, block, num_features = bins_blocks.shape
-    dot_dtype = {"f32": jnp.float32,
-                 "f64": jnp.float64}.get(precision, jnp.bfloat16)
-    prec = (jax.lax.Precision.HIGHEST if precision in ("f32", "f64")
-            else jax.lax.Precision.DEFAULT)
+    dot_dtype, _, prec = _dot_spec(precision)
+    acc_dtype = (jnp.int32 if precision in _INT_STAT_DTYPES
+                 else jnp.float32)
     iota = jnp.arange(num_bins, dtype=jnp.int32)
 
     def body(acc, xs):
@@ -564,9 +693,10 @@ def build_histogram_inline(bins_blocks, stats_blocks, num_bins: int,
         onehot = onehot.reshape(block, num_features * num_bins)
         acc = acc + jnp.dot(s_blk.astype(dot_dtype), onehot,
                             precision=prec,
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=acc_dtype)
         return acc, None
 
-    init = jnp.zeros((stats_blocks.shape[0], num_features * num_bins), jnp.float32)
+    init = jnp.zeros((stats_blocks.shape[0], num_features * num_bins),
+                     acc_dtype)
     raw, _ = jax.lax.scan(body, init, (bins_blocks, jnp.moveaxis(stats_blocks, 1, 0)))
     return _unpack_hist(raw, precision).reshape(num_features, num_bins, 3)

@@ -19,6 +19,14 @@ of the same grower body over a `jax.sharding.Mesh` axis:
 All four present the SAME call signature
     grow(bins_t, grad, hess, row_mask, feature_mask, meta, key) -> out dict
 so the driver/learner code is strategy-agnostic.
+
+Collectives dtype note: under the quantized histogram precisions
+(tpu_hist_precision=int16|int8) the `data` axis psums int32 histograms.
+Integer psum is associative, so data-parallel split decisions are
+bit-identical across any shard count (the f32/hilo modes only promise
+~ulp agreement); the per-shard contraction additionally reads a stats
+operand 2-4x narrower than hilo's — see ops/histogram.py and
+docs/USAGE.md "Quantized training".
 """
 
 from __future__ import annotations

@@ -137,7 +137,8 @@ def host_sync(x):
     return _np.asarray(x)
 
 
-def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
+def enable_compilation_cache(cache_dir: Optional[str] = None,
+                             min_compile_time_secs: float = 1.0) -> None:
     """Turn on JAX's persistent compilation cache.
 
     The whole-tree grower is one large XLA program; a cold compile costs
@@ -146,6 +147,12 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
     (shape, params, platform): subsequent processes deserialize in seconds.
     Defaults to `<repo>/.jax_cache` so the cache survives across runs of
     bench.py / the CLI on the same checkout.
+
+    min_compile_time_secs gates which programs get written: the implicit
+    package-import default keeps jax's 1s floor (don't litter the repo
+    cache with trivial jits), while the explicit `tpu_compile_cache_dir`
+    config path passes 0 so EVERY program of a run replays warm — the
+    whole point of opting in by hand.
     """
     if cache_dir is None:
         cache_dir = os.environ.get(
@@ -163,9 +170,20 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> None:
     try:
         import jax
 
+        prev_dir = jax.config.jax_compilation_cache_dir
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_time_secs))
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        if prev_dir and prev_dir != cache_dir:
+            # the cache singleton latches its directory at first use
+            # (jax _initialize_cache runs at most once), so re-pointing
+            # the config after any compile — e.g. the package-import
+            # default cache already served the Dataset jits — silently
+            # keeps writing to the OLD dir unless the singleton resets
+            import jax._src.compilation_cache as _cc
+
+            _cc.reset_cache()
     except Exception:  # pragma: no cover - config knobs moved
         pass
 

@@ -165,6 +165,78 @@ def run_predict_sweep(X, y, rounds=50, leaves=255, bins=255):
           flush=True)
 
 
+def run_hist_sweep(X, y, bins=255, reps=4):
+    """Histogram-kernel rows/s sweep: precision (hilo/f32/int16/int8) x
+    impl (xla/pallas/pallas2) x block size, on the grower's own batched
+    contraction (build_histogram_batched_t, K=25 slots), plus the
+    auto-selection table `tpu_hist_impl=auto` would pick per precision.
+
+        N=1000000 python tools/perf_probe.py hist
+    """
+    import jax
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.learner import TPUTreeLearner
+    from lightgbm_tpu.ops.histogram import (bench_hist_operands,
+                                            build_histogram_batched_t)
+    from lightgbm_tpu.utils.backend import host_sync
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    ds = lgb.Dataset(X, label=y, params={"max_bin": bins})
+    ds.construct()
+    bins_np = np.asarray(ds._inner.bins)
+    n_all, F = bins_np.shape
+    B = bins + 1
+    K = 25
+    rng = np.random.default_rng(0)
+
+    def one(precision, impl, block):
+        # pallas off-TPU runs the interpreter — cap the rows handed to
+        # the helper at ONE block so the sweep finishes; the printed
+        # rows/s is still labeled per-config
+        n_cap = n_all if (on_tpu or impl == "xla") \
+            else min(n_all, max(4096, block))
+        if n_cap < block:
+            raise ValueError(f"need >= {block} rows, have {n_cap}")
+        bins_tb, stats, n_use = bench_hist_operands(
+            bins_np[:n_cap], precision, block)
+        nb = n_use // block
+        leaf_b = jnp.asarray(
+            rng.integers(0, K, size=n_use).astype(np.int32)
+            .reshape(nb, block))
+        slots = jnp.arange(K, dtype=jnp.int32)
+        fn = jax.jit(lambda b, s, l: build_histogram_batched_t(
+            b, s, l, slots, B, precision, impl=impl))
+        host_sync(fn(bins_tb, stats, leaf_b))  # compile
+        t0 = time.time()
+        for _ in range(reps):
+            host_sync(fn(bins_tb, stats, leaf_b))
+        return n_use * reps / max(time.time() - t0, 1e-9), n_use
+
+    blocks = {"xla": (8192, 16384), "pallas": (256,),
+              "pallas2": (4096, 8192)}
+    for precision in ("hilo", "f32", "int16", "int8"):
+        for impl in ("xla", "pallas", "pallas2"):
+            for block in blocks[impl]:
+                label = f"prec={precision:<5s} impl={impl:<7s} block={block}"
+                try:
+                    rps, n_use = one(precision, impl, block)
+                    print(f"{label}: {rps:14.0f} rows/s ({n_use} rows)",
+                          flush=True)
+                except Exception as exc:
+                    print(f"{label}: FAILED {type(exc).__name__}: "
+                          f"{str(exc)[:120]}", flush=True)
+
+    print("\nauto-selection (tpu_hist_impl=auto on this backend):",
+          flush=True)
+    for precision in ("hilo", "f32", "int16", "int8"):
+        cfg = Config({"objective": "binary", "num_leaves": 255,
+                      "max_bin": bins, "tpu_hist_precision": precision})
+        impl, block = TPUTreeLearner._resolve_hist_impl(cfg, B, precision)
+        print(f"  {precision:<5s} -> impl={impl} block={block}", flush=True)
+
+
 def run_ingest_sweep(X, y, bins=255):
     """Ingest-throughput sweep: Dataset construct rows/s for the host
     binning path next to the device kernel across chunk sizes, with the
@@ -206,6 +278,9 @@ def main():
     n = int(os.environ.get("N", 1_000_000))
     X, y = make_data(n)
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "hist":
+        run_hist_sweep(X, y, bins=int(os.environ.get("BINS", 255)))
+        return
     if arg == "ingest":
         run_ingest_sweep(X, y, bins=int(os.environ.get("BINS", 255)))
         return
