@@ -228,6 +228,25 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # round-trips to HBM), else xla.  pallas2 = per-feature one-hot variant
     # running 2-8k-row blocks (experimental until timed on hardware)
     "tpu_hist_impl": ("str", "auto", ()),
+    # data-axis histogram aggregation (tree_learner=data / voting /
+    # data_feature): psum | scatter | auto.
+    #   psum    - every shard receives the full aggregated [K, F, B, 3]
+    #             histograms (XLA lowers to reduce-scatter + all-gather)
+    #             and repeats the whole split search P times
+    #   scatter - stop after the reduce-scatter (lax.psum_scatter): each
+    #             shard keeps only its F/P feature slice of the
+    #             aggregated histograms and pool, searches just that
+    #             slice, and the global winner is ONE tiny best-split
+    #             record (all_gather + shared deterministic tie-break) —
+    #             the reference's Network::ReduceScatter +
+    #             SyncUpGlobalBestSplit (data_parallel_tree_learner.cpp:
+    #             149-163).  ~2× less ICI receive volume, ~P× less
+    #             per-shard histogram-pool HBM, and the search runs once
+    #             instead of P times; int8/int16 decisions stay
+    #             bit-identical to psum at every shard count.  In voting
+    #             mode the voted [k, B, 3] aggregation scatters instead.
+    #   auto    - scatter whenever the data axis spans >1 device
+    "tpu_hist_agg": ("str", "auto", ()),
     # f64 histogram accumulation everywhere (requires x64): serial and
     # data-parallel split decisions become reduction-order independent,
     # like the reference f64 HistogramBinEntry (bin.h:33-40)

@@ -158,10 +158,13 @@ class TPUTreeLearner:
                              ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2")),
                              ("tpu_hist_precision", ("hilo", "bf16", "f32",
                                                      "f64", "int8", "int16")),
-                             ("tpu_quant_round", ("stochastic", "nearest"))):
+                             ("tpu_quant_round", ("stochastic", "nearest")),
+                             ("tpu_hist_agg", ("auto", "psum", "scatter"))):
             if str(getattr(config, key)) not in allowed:
                 raise ValueError(f"{key}={getattr(config, key)!r}; "
                                  f"expected one of {allowed}")
+        self.hist_agg = self._resolve_hist_agg(config, strategy,
+                                               self.d_shards)
 
         precision = self._resolve_precision(config)
         quantized = precision in ("int8", "int16")
@@ -393,6 +396,30 @@ class TPUTreeLearner:
             else:
                 # EFB keeps g_pad (bundle columns) separate from f_pad
                 self.g_pad = bucket_up(self.g_pad, align)
+
+        # ---- scatter-aggregation alignment (tpu_hist_agg=scatter): the
+        # reduce-scatter hands shard d a contiguous 1/P slice of the
+        # histogram column axis, so that axis must divide by the data-
+        # shard count — on top of whatever alignment feature sharding /
+        # the pallas2 kernel already demanded.  Padding columns/features
+        # are trivial (num_bin=1) and can never split.  Voting scatters
+        # only the voted [k, B, 3] block (padded inside the grower).
+        if self.hist_agg == "scatter" and strategy != "voting":
+            import math
+
+            if plan is None:
+                a = self.f_shards * self.d_shards
+                if hist_impl == "pallas2":
+                    a = math.lcm(a, 32 * max(self.f_shards, 1))
+                self.f_pad = -(-self.f_pad // a) * a
+                self.g_pad = self.f_pad
+            else:
+                # EFB: only the bundle-column axis scatters; the shard ->
+                # feature assignment rides the scatter_feat table below
+                a = self.d_shards
+                if hist_impl == "pallas2":
+                    a = math.lcm(a, 32)
+                self.g_pad = -(-self.g_pad // a) * a
 
         # transposed [G, n] bin matrix: rows ride the 128-lane minor axis
         # for the histogram contraction (see ops/histogram.py).  Stored
@@ -636,6 +663,24 @@ class TPUTreeLearner:
                 self.meta["sparse_idx"] = jnp.asarray(sp_rows)
                 self.meta["sparse_bin"] = jnp.asarray(sp_bins)
                 self.meta["hist_perm"] = jnp.asarray(perm)
+        if self.hist_agg == "scatter" and plan is not None:
+            # static shard -> feature-ids table for the scattered EFB
+            # search: shard d owns bundle columns [d*SGc, (d+1)*SGc) and
+            # therefore exactly the features bundled into them.  Rows are
+            # ascending (so the per-shard argmax keeps the lowest-feature
+            # tie-break) and -1-padded to the widest shard's count.
+            sgc = self.g_pad // self.d_shards
+            bidx = meta_np["bundle_idx"][:self.num_features]
+            by_shard = [np.sort(np.flatnonzero(bidx // sgc == d))
+                        for d in range(self.d_shards)]
+            sf = np.full((self.d_shards,
+                          max(1, max(len(l) for l in by_shard))), -1,
+                         np.int32)
+            for d, l in enumerate(by_shard):
+                sf[d, :len(l)] = l
+            self.meta["scatter_feat"] = (
+                put_global(sf, self._rep_sharding) if self._multiproc
+                else jnp.asarray(sf))
         timer.add("layout", time.perf_counter() - _t_layout)
 
         self.params = GrowerParams(
@@ -675,6 +720,7 @@ class TPUTreeLearner:
             quant_round=str(config.tpu_quant_round),
             quant_refit=(quantized
                          and bool(config.tpu_quant_refit_leaves)),
+            hist_agg=self.hist_agg,
         )
         # quantized leaf refit: the driver must fetch out["leaf_output"]
         # and override the record-replayed leaf values at tree build
@@ -706,6 +752,24 @@ class TPUTreeLearner:
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _resolve_hist_agg(config: Config, strategy: str,
+                          d_shards: int) -> str:
+        """Effective data-axis histogram aggregation: 'psum' | 'scatter'.
+
+        tpu_hist_agg=auto picks scatter whenever the data axis spans more
+        than one device: the reduce-scatter moves half the psum's ICI
+        receive bytes, the per-shard histogram pool shrinks by the data-
+        shard factor, and the split search stops being repeated P times —
+        with int8/int16 decisions bit-identical to psum (associative
+        int32 sums + the shared tie-break).  Everywhere without a real
+        data axis (serial, pure feature sharding, one data shard) the
+        collective degenerates and psum is the plain path."""
+        if strategy in ("data", "voting", "data_feature") and d_shards > 1:
+            return ("scatter" if str(config.tpu_hist_agg)
+                    in ("auto", "scatter") else "psum")
+        return "psum"
+
     @staticmethod
     def _resolve_hist_impl(config: Config, num_bins: int,
                            precision: str) -> Tuple[str, int]:

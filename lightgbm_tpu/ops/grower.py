@@ -37,12 +37,27 @@ Distribution — the same round body runs under shard_map in three sharded
 modes, mirroring the reference's parallel tree learners (SURVEY.md §2.3):
 
 * `data_axis` (DataParallelTreeLearner, data_parallel_tree_learner.cpp:
-  149-163): rows sharded; the [K, F, B, 3] smaller-child histograms are
-  psum-reduced so every shard sees GLOBAL histograms and makes identical
-  split decisions, while partitioning only its local rows.  XLA lowers the
-  psum to reduce-scatter + all-gather over ICI — the hand-rolled
-  Network::ReduceScatter + HistogramBinEntry::SumReducer disappear into
-  the compiler.
+  149-163): rows sharded; the [K, F, B, 3] smaller-child histograms
+  aggregate over ICI in one of two modes (GrowerParams.hist_agg):
+  - "psum": every shard receives the full GLOBAL histograms and makes
+    identical split decisions while partitioning only its local rows.
+    XLA lowers the psum to reduce-scatter + all-gather — but the
+    all-gather half replicates the whole [K, F, B, 3] aggregate to
+    every shard, the pool stores all F features P times across the
+    mesh, and the split search repeats P times.
+  - "scatter": stop after the reduce-scatter (`lax.psum_scatter`) —
+    each shard keeps only its CONTIGUOUS F/P feature slice of the
+    aggregated histograms, exactly the reference's
+    Network::ReduceScatter leaving worker i its own feature block
+    (data_parallel_tree_learner.cpp:149-163).  The pool, sibling
+    subtraction, EFB expansion, sparse zero-bin fixes, and CEGB
+    charges all operate on the slice; the split search runs only over
+    it; and the global winner is ONE tiny best-split record: an
+    all_gather of per-shard bests + the shared deterministic tie-break
+    (the SyncUpGlobalBestSplit analog, parallel_tree_learner.h:
+    190-213).  Per-shard pool HBM and psum receive volume both drop
+    ~P×.  Integer (int8/int16) psum_scatter sums stay associative, so
+    scatter decisions are BIT-IDENTICAL to psum at any shard count.
 * `feature_axis` (FeatureParallelTreeLearner, feature_parallel_tree_
   learner.cpp:23-75): BINS REPLICATED (like the reference's all-data-on-
   all-machines feature mode), search sharded; each shard histograms +
@@ -85,8 +100,8 @@ import numpy as np
 from .histogram import (build_histogram_batched_t, build_histogram_sparse,
                         build_histogram_t, key_words, pack_stats,
                         quant_limit, quantize_values, unpack2d)
-from .split import (K_MIN_SCORE, SplitResult, finalize_split, leaf_output,
-                    leaf_split_gain, per_feature_best_split,
+from .split import (K_MIN_SCORE, SplitResult, argbest, finalize_split,
+                    leaf_output, leaf_split_gain, per_feature_best_split,
                     per_feature_best_split_categorical,
                     MISSING_NAN, MISSING_ZERO)
 
@@ -183,6 +198,13 @@ class GrowerParams(NamedTuple):
     # each leaf's rows (LightGBM quantized training's renew-leaf): split
     # DECISIONS stay integer-exact, leaf values regain float precision
     quant_refit: bool = False
+    # data-axis histogram aggregation (see the module docstring):
+    # "psum" replicates the full aggregate on every shard; "scatter"
+    # reduce-scatters (lax.psum_scatter) so each shard keeps only its
+    # F/P feature slice of the pool and search, syncing the winner as
+    # one best-split record.  In voting mode "scatter" applies to the
+    # voted [k, B, 3] aggregation instead (the pool is local anyway).
+    hist_agg: str = "psum"
 
 
 def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
@@ -281,15 +303,49 @@ def make_grower(params: GrowerParams, num_features: int,
                 "stochastic or nearest")
     K = max(1, min(int(params.split_batch), L - 1))
 
+    if params.hist_agg not in ("psum", "scatter"):
+        raise ValueError(f"hist_agg={params.hist_agg!r}; expected psum or "
+                         "scatter (the learner resolves 'auto' upstream)")
+    # scatter aggregation: active only with a real (>1) data axis.  In
+    # plain data / data_feature modes the POOL is scattered (each shard
+    # holds its G/P column slice); voting keeps the pool local and
+    # scatters only the voted [k, B, 3] aggregation inside select()
+    scatter_on = (params.hist_agg == "scatter" and data_axis is not None
+                  and num_shards > 1)
+    pool_scatter = scatter_on and not voting_k
+    vote_scatter = scatter_on and bool(voting_k)
+    if pool_scatter and G % num_shards != 0:
+        raise ValueError(
+            f"hist_agg=scatter needs the histogram column count {G} padded "
+            f"to a multiple of the data-shard count {num_shards}")
+    # per-shard column slice and (non-bundle) feature slice widths; with
+    # EFB the features of a column slice are resolved through the static
+    # meta["scatter_feat"] table instead (columns != features there)
+    SG = G // num_shards if pool_scatter else G
+    SF = F // num_shards if (pool_scatter and not params.has_bundles) else F
+    # the one sparse reconstruction input the scattered slice cannot
+    # derive locally: dense_ref's histogram (the leaf-total source) may
+    # live on another shard, so exact per-leaf totals are carried in
+    # state and threaded into select explicitly
+    sparse_tot = pool_scatter and params.has_sparse
+
     def preduce_scalar(x):
         return jax.lax.psum(x, data_axis) if data_axis else x
 
-    def preduce_hist(x):
-        # plain data-parallel aggregates full histograms; voting keeps the
-        # pool LOCAL and aggregates only voted features inside select()
-        if data_axis and not voting_k:
-            return jax.lax.psum(x, data_axis)
-        return x
+    def agg_hist(x):
+        """Aggregate LOCAL (per-shard) histograms over the data axis.
+        x's feature/column axis is axis -3 ([..., G, B, 3]).  psum
+        replicates the full aggregate; scatter (reduce-scatter) leaves
+        this shard only its contiguous G/P column slice — shard d holds
+        columns [d*SG, (d+1)*SG).  Voting keeps the pool LOCAL and
+        aggregates only voted features inside select()."""
+        if not data_axis or voting_k:
+            return x
+        if pool_scatter:
+            return jax.lax.psum_scatter(x, data_axis,
+                                        scatter_dimension=x.ndim - 3,
+                                        tiled=True)
+        return jax.lax.psum(x, data_axis)
 
     split_kw = dict(l1=params.l1, l2=params.l2,
                     max_delta_step=params.max_delta_step,
@@ -402,6 +458,9 @@ def make_grower(params: GrowerParams, num_features: int,
             ax = None
             meta_local = meta
             bins_hist_t = bins_t
+        # this shard's position on the data axis: under scatter it owns
+        # histogram columns [dax*SG, (dax+1)*SG) after the reduce-scatter
+        dax = jax.lax.axis_index(data_axis) if scatter_on else None
 
         FG = feature_mask.shape[0]  # global feature width
 
@@ -414,8 +473,12 @@ def make_grower(params: GrowerParams, num_features: int,
             nonempty = jnp.sum(samp, axis=-1, keepdims=True) > 0
             return jnp.where(nonempty, samp, feature_mask)
 
-        def expand_bundles(hist_g, sg, sh, cnt):
-            """[G, B, 3] bundle histograms -> [F, B, 3] feature histograms.
+        def expand_bundles(hist_g, sg, sh, cnt, fmeta=None, col_base=0):
+            """[G', B, 3] bundle histograms -> [F', B, 3] feature
+            histograms for the features described by `fmeta` (the full
+            meta_local by default; a scatter_feat-gathered slice under
+            scatter aggregation, where hist_g holds only this shard's
+            column slice and col_base is its first global column).
 
             Each bundled feature's bins live at bin_offset+1..+num_bin-1 of
             its bundle column; its bin 0 (the shared all-default bin) is
@@ -423,15 +486,18 @@ def make_grower(params: GrowerParams, num_features: int,
             FixHistogram trick (reference src/io/dataset.cpp:1044-1063)."""
             if not params.has_bundles:
                 return hist_g
-            bi = meta_local["bundle_idx"]                 # [F]
-            off = meta_local["bin_offset"]                # [F]
-            fix = meta_local["needs_fix"] > 0             # [F]
+            if fmeta is None:
+                fmeta = meta_local
+            bi = jnp.clip(fmeta["bundle_idx"] - col_base, 0,
+                          hist_g.shape[0] - 1)             # [F'] local col
+            off = fmeta["bin_offset"]                      # [F']
+            fix = fmeta["needs_fix"] > 0                   # [F']
             iota_b = jnp.arange(B, dtype=jnp.int32)
             src = jnp.clip(off[:, None] + iota_b[None, :], 0, B - 1)
-            hist_f = hist_g[bi[:, None], src]             # [F, B, 3]
+            hist_f = hist_g[bi[:, None], src]              # [F', B, 3]
             # bundled features: mask bins outside their range, then
             # reconstruct bin 0 from totals
-            nbv = meta_local["num_bin"][:, None]
+            nbv = fmeta["num_bin"][:, None]
             in_range = (iota_b[None, :] >= 1) & (iota_b[None, :] < nbv)
             keep = jnp.where(fix[:, None], in_range,
                              jnp.ones_like(in_range))
@@ -488,11 +554,52 @@ def make_grower(params: GrowerParams, num_features: int,
             return jnp.where(gain_vec > K_MIN_SCORE / 2, gain_vec - delta,
                              gain_vec)
 
+        # meta entries that are NOT per-feature [F'] vectors and must be
+        # skipped when gathering a search slice's meta
+        NONFEAT_META = ("sparse_idx", "sparse_bin", "hist_perm",
+                        "scatter_feat", "cegb_paid")
+
+        def sync_best(res: SplitResult, gfeat, axis) -> SplitResult:
+            """Global best split from per-shard bests: all_gather ONE tiny
+            best-split record per shard over `axis` and pick the winner
+            with the shared deterministic tie-break (split.argbest:
+            highest gain, then lowest feature id, then lowest threshold
+            bin) — the SyncUpGlobalBestSplit analog
+            (parallel_tree_learner.h:190-213).  `gfeat` is this shard's
+            winning feature id in the frame common to all shards on
+            `axis`, and becomes the returned feature."""
+            gains = jax.lax.all_gather(res.gain, axis)             # [P]
+            feats = jax.lax.all_gather(
+                jnp.asarray(gfeat).astype(jnp.int32), axis)
+            thrs = jax.lax.all_gather(res.threshold, axis)
+            winner = argbest(gains, feats, thrs)
+            own = jax.lax.axis_index(axis) == winner
+
+            def pick(x):
+                return jax.lax.psum(
+                    jnp.where(own, x, jnp.zeros_like(x)), axis)
+
+            return SplitResult(
+                gain=gains[winner],
+                feature=feats[winner],
+                threshold=thrs[winner].astype(jnp.int32),
+                default_left=pick(res.default_left.astype(jnp.int32)) > 0,
+                left_sum_g=pick(res.left_sum_g),
+                left_sum_h=pick(res.left_sum_h),
+                left_count=pick(res.left_count),
+                left_output=pick(res.left_output),
+                right_output=pick(res.right_output),
+                is_cat=pick(res.is_cat.astype(jnp.int32)) > 0,
+                cat_mask=pick(res.cat_mask))
+
         def select(hist, sg, sh, cnt, min_c, max_c, fmask,
-                   delta) -> SplitResult:
+                   delta, sp_tot=None) -> SplitResult:
             """Best split across all (global) features for one leaf; the
             returned feature index is GLOBAL in every mode.  vmapped over
-            children by the round body.  fmask/delta are global-width."""
+            children by the round body.  fmask/delta are global-width.
+            sp_tot is the leaf's exact [3] histogram-dtype totals, threaded
+            in only under scatter aggregation with sparse storage (the
+            slice cannot derive them from dense_ref locally)."""
             fmask_local = fslice(fmask) if feature_axis else fmask
             delta_local = (fslice(delta) if feature_axis else delta) \
                 if params.has_cegb else None
@@ -523,13 +630,39 @@ def make_grower(params: GrowerParams, num_features: int,
                 kk = min(voting_k, F)
                 _, sel = jax.lax.top_k(score, kk)
                 sel = sel.astype(jnp.int32)
+                if vote_scatter:
+                    # reduce-scatter the voted aggregation: pad the voted
+                    # set to a shard multiple (extras duplicate sel[0]
+                    # with a zeroed mask, so the searched candidate set
+                    # is unchanged), psum_scatter the [kp, B, 3] block so
+                    # each shard receives only its kp/P slice, search it,
+                    # and sync the winner as one best-split record
+                    kp = -(-kk // num_shards) * num_shards
+                    if kp > kk:
+                        sel_p = jnp.concatenate(
+                            [sel, jnp.broadcast_to(sel[:1], (kp - kk,))])
+                        vmask = jnp.zeros(kp, jnp.float32).at[:kk].set(1.0)
+                    else:
+                        sel_p, vmask = sel, jnp.ones(kk, jnp.float32)
+                    sel_hist = jax.lax.psum_scatter(
+                        hist[sel_p], data_axis, scatter_dimension=0,
+                        tiled=True)                        # [kp/P, B, 3]
+                    W = kp // num_shards
+                    sel_loc = jax.lax.dynamic_slice_in_dim(sel_p,
+                                                           dax * W, W)
+                    fmask_sel = (fmask_local[sel_loc]
+                                 * jax.lax.dynamic_slice_in_dim(
+                                     vmask, dax * W, W))
+                else:
+                    sel_loc, sel_hist = sel, None
+                    fmask_sel = fmask_local[sel]
                 # aggregate ONLY the voted features' histograms — RAW
                 # (zero bins reconstructed after the psum from GLOBAL
                 # totals); the 2-D COO tables are not per-feature rows
-                sel_meta = {k: v[sel] for k, v in meta_local.items()
-                            if k not in ("sparse_idx", "sparse_bin",
-                                         "hist_perm")}
-                sel_hist = jax.lax.psum(hist[sel], data_axis)
+                sel_meta = {k: v[sel_loc] for k, v in meta_local.items()
+                            if k not in NONFEAT_META}
+                if sel_hist is None:
+                    sel_hist = jax.lax.psum(hist[sel], data_axis)
                 if params.has_sparse:
                     sel_hist = fix_sparse_bins(
                         sel_hist, sel_meta["is_sparse"] > 0,
@@ -537,60 +670,105 @@ def make_grower(params: GrowerParams, num_features: int,
                         jax.lax.psum(loc, data_axis))
                 gain_sel, fin = combined_search(dequant(sel_hist), sg, sh,
                                                 cnt, sel_meta,
-                                                fmask_local[sel],
+                                                fmask_sel,
                                                 split_kw, min_c, max_c)
                 if params.has_cegb:
-                    gain_sel = apply_delta(gain_sel, delta_local[sel])
-                bi = jnp.argmax(gain_sel).astype(jnp.int32)
+                    gain_sel = apply_delta(gain_sel, delta_local[sel_loc])
+                # shared tie-break: lowest GLOBAL feature id among equal
+                # gains (a plain argmax would inherit the vote ranking)
+                bi = argbest(gain_sel, sel_loc)
                 res = fin(bi)
-                return res._replace(feature=sel[bi], gain=gain_sel[bi])
+                # f32 downcast at the state boundary, like finalize_split
+                res = res._replace(feature=sel_loc[bi],
+                                   gain=gain_sel[bi].astype(jnp.float32))
+                if vote_scatter:
+                    res = sync_best(res, sel_loc[bi], data_axis)
+                return res
 
             # the leaf-cost boundary: integer histograms rescale to f32
-            # stats HERE, once per leaf — everything upstream (psum, pool,
-            # sibling subtraction) was exact int32
+            # stats HERE, once per leaf — everything upstream (psum or
+            # psum_scatter, pool, sibling subtraction) was exact int32
             hist = dequant(hist)
-            hist = expand_bundles(hist, sg, sh, cnt)
-            hist = expand_sparse(hist)
-            gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_local,
-                                            fmask_local, split_kw,
-                                            min_c, max_c)
-            if params.has_cegb:
-                gain_vec = apply_delta(gain_vec, delta_local)
-            bf = jnp.argmax(gain_vec).astype(jnp.int32)
-            res = fin(bf)
-            if params.has_cegb:
-                res = res._replace(gain=gain_vec[bf])
+            if pool_scatter:
+                # scattered slice: this shard holds only the aggregated
+                # histogram columns [dax*SG, (dax+1)*SG) — search the
+                # features living there against the GLOBAL leaf totals,
+                # then sync the winner as one tiny best-split record
+                if params.has_bundles:
+                    # the features of this shard's column slice, via the
+                    # static assignment table (bundle columns != features;
+                    # entries sorted ascending, -1 = padding)
+                    sfeat = jax.lax.dynamic_index_in_dim(
+                        meta["scatter_feat"], dax, 0, keepdims=False)
+                    sidx = jnp.maximum(sfeat, 0)
+                    fmask_s = (fmask_local[sidx]
+                               * (sfeat >= 0).astype(jnp.float32))
+                    meta_s = {k: v[sidx] for k, v in meta_local.items()
+                              if k not in NONFEAT_META}
+                    delta_s = (delta_local[sidx] if params.has_cegb
+                               else None)
+                    hist = expand_bundles(hist, sg, sh, cnt, meta_s,
+                                          col_base=dax * SG)
+                else:
+                    def dslice(a):
+                        return jax.lax.dynamic_slice_in_dim(
+                            a, dax * SF, SF)
+
+                    sfeat = dax * SF + jnp.arange(SF, dtype=jnp.int32)
+                    fmask_s = dslice(fmask_local)
+                    meta_s = {k: dslice(v) for k, v in meta_local.items()
+                              if k not in NONFEAT_META}
+                    delta_s = (dslice(delta_local) if params.has_cegb
+                               else None)
+                    if params.has_sparse:
+                        # zero-bin reconstruction on the slice from the
+                        # threaded exact leaf totals (dense_ref's column
+                        # may live on another shard)
+                        hist = fix_sparse_bins(hist,
+                                               meta_s["is_sparse"] > 0,
+                                               meta_s["default_bin"],
+                                               sp_tot)
+                gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_s,
+                                                fmask_s, split_kw,
+                                                min_c, max_c)
+                if params.has_cegb:
+                    gain_vec = apply_delta(gain_vec, delta_s)
+                # per-shard best: slice entries ascend in feature id, so
+                # first-max argmax = lowest feature id within the shard
+                bf = jnp.argmax(gain_vec).astype(jnp.int32)
+                res = fin(bf)
+                # f32 downcast at the state boundary, like finalize_split
+                res = res._replace(gain=gain_vec[bf].astype(jnp.float32))
+                # cross-shard winner in the feature-frame-LOCAL id space
+                # (global when no feature axis; the feature sync below
+                # lifts it to global otherwise)
+                res = sync_best(res, sfeat[bf], data_axis)
+            else:
+                hist = expand_bundles(hist, sg, sh, cnt)
+                hist = expand_sparse(hist)
+                gain_vec, fin = combined_search(hist, sg, sh, cnt,
+                                                meta_local, fmask_local,
+                                                split_kw, min_c, max_c)
+                if params.has_cegb:
+                    gain_vec = apply_delta(gain_vec, delta_local)
+                bf = jnp.argmax(gain_vec).astype(jnp.int32)
+                res = fin(bf)
+                if params.has_cegb:
+                    res = res._replace(gain=gain_vec[bf])
             if feature_axis:
-                # global best = argmax over per-shard bests (replaces
-                # SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213);
-                # first-max-wins over shards + contiguous feature sharding
-                # reproduces the serial lowest-feature tie-break
-                gains = jax.lax.all_gather(res.gain, feature_axis)  # [P]
-                winner = jnp.argmax(gains).astype(jnp.int32)
-                own = (ax == winner)
-
-                def pick(x):
-                    return jax.lax.psum(
-                        jnp.where(own, x, jnp.zeros_like(x)), feature_axis)
-
-                res = SplitResult(
-                    gain=gains[winner],
-                    feature=(winner * F + pick(res.feature)).astype(jnp.int32),
-                    threshold=pick(res.threshold).astype(jnp.int32),
-                    default_left=pick(res.default_left.astype(jnp.int32)) > 0,
-                    left_sum_g=pick(res.left_sum_g),
-                    left_sum_h=pick(res.left_sum_h),
-                    left_count=pick(res.left_count),
-                    left_output=pick(res.left_output),
-                    right_output=pick(res.right_output),
-                    is_cat=pick(res.is_cat.astype(jnp.int32)) > 0,
-                    cat_mask=pick(res.cat_mask))
+                # global best over feature shards (replaces
+                # SyncUpGlobalBestSplit, parallel_tree_learner.h:190-213)
+                # with the same shared tie-break; contiguous feature
+                # sharding keeps ax*F + local ids ascending, so the
+                # winner matches the serial lowest-feature rule exactly
+                res = sync_best(res, ax * F + res.feature, feature_axis)
             return res
 
         vselect = jax.vmap(select,
                            in_axes=(0, 0, 0, 0, 0, 0,
                                     0 if bynode else None,
-                                    0 if params.has_cegb else None))
+                                    0 if params.has_cegb else None,
+                                    0 if sparse_tot else None))
 
         # ---- root ----------------------------------------------------
         g = grad * row_mask
@@ -672,17 +850,20 @@ def make_grower(params: GrowerParams, num_features: int,
             sp_idx_t = sp_bin_t = None
 
         def merge_sparse_hist(dense_h, leaf_vec, slot_ids):
-            """[.., Gd, B, 3] dense hist -> [.., G, B, 3] feature hist:
-            append the sparse groups' O(nnz) gather contraction and
-            reorder by the static feature->slot permutation.  Under data
-            sharding the contraction runs on this shard's entries and
-            psums like the dense part (zero-bin reconstruction happens
-            AFTER the psum, in select, from global totals)."""
+            """[.., Gd, B, 3] LOCAL dense hist -> [.., G, B, 3] LOCAL
+            feature hist: append the sparse groups' O(nnz) gather
+            contraction and reorder by the static feature->slot
+            permutation.  The caller aggregates the MERGED tensor over
+            the data axis (psum is elementwise, so aggregating after the
+            merge is value-identical to the old per-part psums — and
+            scatter needs the full feature-ordered axis to slice);
+            zero-bin reconstruction happens AFTER the aggregation, in
+            select, from global totals."""
             if not params.has_sparse:
                 return dense_h
-            sp = preduce_hist(build_histogram_sparse(
+            sp = build_histogram_sparse(
                 sp_idx_t, sp_bin_t, stats, leaf_vec,
-                slot_ids, B, precision))          # [k, Gs, B, 3]
+                slot_ids, B, precision)           # [k, Gs, B, 3]
             merged = jnp.concatenate([dense_h, sp], axis=-3)
             return jnp.take(merged, meta["hist_perm"], axis=-3)
         if params.hist_impl.startswith("pallas"):
@@ -690,18 +871,27 @@ def make_grower(params: GrowerParams, num_features: int,
             # leaf ids): the xla scan at pallas-sized short blocks would
             # round-trip a materialized one-hot per block through HBM
             root_slots = jnp.full(K, -1, jnp.int32).at[0].set(0)
-            root_hist = preduce_hist(build_histogram_batched_t(
+            root_local = build_histogram_batched_t(
                 bins_blocks, stats_blocks,
                 jnp.zeros((nb, block), jnp.int32), root_slots, B,
                 precision, impl=params.hist_impl,
-                packed_rows=params.packed_bins)[0])
+                packed_rows=params.packed_bins)[0]
         else:
-            root_hist = preduce_hist(
-                build_histogram_t(bins_blocks, stats_blocks, B, precision))
+            root_local = build_histogram_t(bins_blocks, stats_blocks, B,
+                                           precision)
         if params.has_sparse:
-            root_hist = merge_sparse_hist(
-                root_hist[None], jnp.zeros(n_pad, jnp.int32),
+            root_local = merge_sparse_hist(
+                root_local[None], jnp.zeros(n_pad, jnp.int32),
                 jnp.zeros(1, jnp.int32))[0]
+        if sparse_tot:
+            # exact per-leaf totals in the ACCUMULATION dtype, reduced
+            # from the pre-scatter local histograms (dense_ref's column
+            # slice may land on another shard): sum over bins locally,
+            # psum the [3] vector — associative for int, exact-in-
+            # practice for f64 like every other histogram reduction
+            tot_root = preduce_scalar(
+                jnp.sum(root_local[meta["dense_ref"][0]], axis=0))
+        root_hist = agg_hist(root_local)
         big = jnp.float32(1e30)
         if bynode:
             key, k_root = jax.random.split(key)
@@ -729,7 +919,8 @@ def make_grower(params: GrowerParams, num_features: int,
             used0 = jnp.zeros(FG, jnp.float32)
             delta0 = None
         root_split = select(root_hist, sum_g, sum_h, cnt, -big, big,
-                            root_fmask, delta0)
+                            root_fmask, delta0,
+                            tot_root if sparse_tot else None)
 
         RW = REC_WIDTH + (CB if params.has_cat else 0)
         # the pool stores histograms in the ACCUMULATION dtype: an f32
@@ -743,7 +934,9 @@ def make_grower(params: GrowerParams, num_features: int,
                   else jnp.int32 if quantized else jnp.float32)
         state = {
             "leaf_ids": jnp.zeros(n_pad, jnp.int32),
-            "pool": jnp.zeros((L, G, B, 3), hist_t).at[0].set(root_hist),
+            # under scatter aggregation the pool holds ONLY this shard's
+            # G/P column slice — the P× per-shard HBM saving
+            "pool": jnp.zeros((L, SG, B, 3), hist_t).at[0].set(root_hist),
             "leaf_sum_g": jnp.zeros(L, jnp.float32).at[0].set(sum_g),
             "leaf_sum_h": jnp.zeros(L, jnp.float32).at[0].set(sum_h),
             "leaf_cnt": jnp.zeros(L, jnp.float32).at[0].set(cnt),
@@ -779,6 +972,12 @@ def make_grower(params: GrowerParams, num_features: int,
             state["used"] = used0
             if params.has_cegb_lazy:
                 state["paid"] = paid0
+        if sparse_tot:
+            # exact [L, 3] per-leaf totals in the accumulation dtype: the
+            # sparse zero-bin source the scattered slices cannot derive
+            # from dense_ref locally; maintained like the pool (smaller
+            # child summed+psum'd, sibling by subtraction)
+            state["leaf_tot"] = jnp.zeros((L, 3), hist_t).at[0].set(tot_root)
 
         def cand_gains(state):
             depth_ok = jnp.logical_or(
@@ -989,18 +1188,21 @@ def make_grower(params: GrowerParams, num_features: int,
                                      leaf_ids)
 
             # ---- histograms: all K smaller children in one contraction,
-            # siblings by subtraction ----
+            # siblings by subtraction (on the aggregated slice) ----
             smaller_is_left = lc <= rc
             smaller_ids = jnp.where(
                 do_k, jnp.where(smaller_is_left, sel, new_ids), -1)
-            hist_small = preduce_hist(build_histogram_batched_t(
+            h_local = build_histogram_batched_t(
                 bins_blocks, stats_blocks, leaf_ids.reshape(nb, block),
                 smaller_ids, B, precision,
                 impl=params.hist_impl,
-                packed_rows=params.packed_bins))             # [K, F, B, 3]
-            hist_small = merge_sparse_hist(hist_small, leaf_ids,
-                                           smaller_ids)
-            parent_hist = state["pool"][sel]                 # [K, F, B, 3]
+                packed_rows=params.packed_bins)              # [K, F, B, 3]
+            h_local = merge_sparse_hist(h_local, leaf_ids, smaller_ids)
+            if sparse_tot:
+                tot_small = preduce_scalar(jnp.sum(
+                    h_local[:, meta["dense_ref"][0]], axis=1))   # [K, 3]
+            hist_small = agg_hist(h_local)               # [K, F/P, B, 3]
+            parent_hist = state["pool"][sel]             # [K, F/P, B, 3]
             hist_large = parent_hist - hist_small
             sl = smaller_is_left[:, None, None, None]
             hist_left = jnp.where(sl, hist_small, hist_large)
@@ -1022,6 +1224,18 @@ def make_grower(params: GrowerParams, num_features: int,
 
             # ---- best splits for all 2K children -----------------------
             new_state = dict(state)
+            if sparse_tot:
+                tot_parent = state["leaf_tot"][sel]          # [K, 3]
+                tot_large = tot_parent - tot_small
+                sl3 = smaller_is_left[:, None]
+                tot_left = jnp.where(sl3, tot_small, tot_large)
+                tot_right = jnp.where(sl3, tot_large, tot_small)
+                lt = scatter_set(state["leaf_tot"], sel, tot_left, do_k)
+                new_state["leaf_tot"] = scatter_set(lt, new_ids, tot_right,
+                                                    do_k)
+                tot_children = jnp.concatenate([tot_left, tot_right])
+            else:
+                tot_children = None
             if bynode:
                 nkey, k_nodes = jax.random.split(state["key"])
                 child_masks = bynode_masks(k_nodes, (2 * Kr,))
@@ -1083,7 +1297,7 @@ def make_grower(params: GrowerParams, num_features: int,
                 jnp.concatenate([lc, rc]),
                 jnp.concatenate([l_min, r_min]),
                 jnp.concatenate([l_max, r_max]),
-                child_masks, delta)
+                child_masks, delta, tot_children)
 
             new_state["leaf_ids"] = leaf_ids
             new_state["pool"] = pool
@@ -1162,15 +1376,29 @@ def make_grower(params: GrowerParams, num_features: int,
             nan_excl = (mt == MISSING_NAN) & (iota_b == nb_f - 1)
             mask_b = ((iota_b <= thr) & (iota_b < nb_f)
                       & (~nan_excl)).astype(jnp.float32)
+            # the forced feature's pooled column may live on another
+            # shard: feature sharding slices the pool by F, scatter
+            # aggregation further by SG — the owning shard contributes
+            # its sums, everyone else zeros, one psum over the sliced
+            # axes broadcasts the result (feat is compile-time constant,
+            # so the slice indices stay static)
+            f_loc = feat
+            own = None
+            axes = ()
             if feature_axis:
-                own = (feat // F) == ax
-                col_hist = state["pool"][p, feat % F]        # [B, 3]
-                sums = jnp.sum(col_hist * mask_b[:, None], axis=0)
+                own = (f_loc // F) == ax
+                f_loc = f_loc % F
+                axes += (feature_axis,)
+            if pool_scatter:
+                own_d = (f_loc // SG) == dax
+                f_loc = f_loc % SG
+                own = own_d if own is None else (own & own_d)
+                axes += (data_axis,)
+            col_hist = state["pool"][p, f_loc]               # [B, 3]
+            sums = jnp.sum(col_hist * mask_b[:, None], axis=0)
+            if axes:
                 sums = jax.lax.psum(
-                    jnp.where(own, sums, jnp.zeros_like(sums)), feature_axis)
-            else:
-                col_hist = state["pool"][p, feat]
-                sums = jnp.sum(col_hist * mask_b[:, None], axis=0)
+                    jnp.where(own, sums, jnp.zeros_like(sums)), axes)
             if data_axis and voting_k:
                 # voting keeps the pool local: forced stats need the
                 # global sums

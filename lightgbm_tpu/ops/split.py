@@ -38,6 +38,33 @@ MISSING_ZERO = 1
 MISSING_NAN = 2
 
 
+def argbest(gain: jnp.ndarray, feature: jnp.ndarray,
+            threshold: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Winner index among candidate splits with the SHARED deterministic
+    tie-break: highest gain, ties by lowest global feature id, then by
+    lowest threshold bin.
+
+    This is the one rule every cross-candidate winner selection uses —
+    the serial/psum per-leaf argmax (features ascending, so plain
+    first-max argmax already implements it), the feature-parallel and
+    scatter-mode all_gather-of-per-shard-bests syncs, and the voting
+    top-k search (whose candidates arrive in VOTE order, where a plain
+    argmax would inherit the vote ranking and make equal-gain decisions
+    depend on the shard count).  Mirrors the reference's
+    ArrayArgs::ArgMax lowest-index semantics lifted to (feature, bin)
+    keys.  All comparisons are exact (f32 equality on identically
+    computed gains; int keys), so the winner is invariant to the lane
+    order of the gathered candidates."""
+    elig = gain >= jnp.max(gain)
+    big = jnp.int32(2 ** 31 - 1)
+    f = jnp.where(elig, feature.astype(jnp.int32), big)
+    elig = elig & (feature == jnp.min(f))
+    if threshold is not None:
+        t = jnp.where(elig, threshold.astype(jnp.int32), big)
+        elig = elig & (threshold == jnp.min(t))
+    return jnp.argmax(elig).astype(jnp.int32)
+
+
 def _threshold_l1(s, l1):
     reg = jnp.maximum(0.0, jnp.abs(s) - l1)
     return jnp.sign(s) * reg

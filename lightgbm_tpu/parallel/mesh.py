@@ -129,3 +129,35 @@ def make_mesh(num_data_shards: int = 1, num_feature_shards: int = 1,
 def shard_rows(n: int, num_shards: int) -> int:
     """Rows per shard, padded so every shard is equal-size."""
     return (n + num_shards - 1) // num_shards
+
+
+# --------------------------------------------------------------------------
+# Aggregation cost model (tpu_hist_agg): predicted per-shard ICI receive
+# bytes for the two histogram aggregation modes.  Bandwidth-optimal ring
+# algorithms (the form XLA lowers to on ICI, and the reference's own
+# Network::ReduceScatter / recursive-halving implementations,
+# src/network/network.cpp:68-318) move:
+#
+#   all-reduce (psum)          2 * (P-1)/P * nbytes   per shard
+#       = reduce-scatter + all-gather; every shard RECEIVES the whole
+#       aggregated array again in the second phase
+#   reduce-scatter (scatter)       (P-1)/P * nbytes   per shard
+#       = the first phase alone; each shard keeps only its 1/P slice
+#
+# so scatter halves the wire traffic AND shrinks what lands in HBM by P.
+# tools/perf_probe.py comm prints these next to measured wall times; the
+# PERF_NOTES round-9 bytes-moved model cites them.
+# --------------------------------------------------------------------------
+
+def allreduce_recv_bytes(nbytes: int, shards: int) -> int:
+    """Per-shard receive bytes of a ring all-reduce (psum) of `nbytes`."""
+    if shards <= 1:
+        return 0
+    return 2 * (shards - 1) * nbytes // shards
+
+
+def reduce_scatter_recv_bytes(nbytes: int, shards: int) -> int:
+    """Per-shard receive bytes of a ring reduce-scatter (psum_scatter)."""
+    if shards <= 1:
+        return 0
+    return (shards - 1) * nbytes // shards

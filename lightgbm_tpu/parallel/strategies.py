@@ -8,12 +8,15 @@ device learner IS the base grower and each strategy is a shard_map wrapping
 of the same grower body over a `jax.sharding.Mesh` axis:
 
   serial   — plain jit, one device
-  data     — rows sharded over 'data'; full-histogram psum
+  data     — rows sharded over 'data'; histogram aggregation per
+             GrowerParams.hist_agg: full psum, or reduce-scattered
+             feature slices + best-split sync
              (DataParallelTreeLearner, data_parallel_tree_learner.cpp:149)
-  feature  — features sharded over 'feature'; all_gather + argmax of
-             per-shard bests (FeatureParallelTreeLearner,
+  feature  — features sharded over 'feature'; all_gather + shared
+             tie-break of per-shard bests (FeatureParallelTreeLearner,
              feature_parallel_tree_learner.cpp:23-75)
-  voting   — rows sharded; top-k voted features' histograms psum'ed
+  voting   — rows sharded; top-k voted features' histograms psum'ed (or
+             psum_scatter'ed under hist_agg=scatter)
              (VotingParallelTreeLearner, voting_parallel_tree_learner.cpp)
 
 All four present the SAME call signature
@@ -118,6 +121,11 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
             meta_spec[k] = P()
         meta_spec["sparse_idx"] = P("data")
         meta_spec["sparse_bin"] = P("data")
+    scatter = params.hist_agg == "scatter"
+    if scatter and params.has_bundles:
+        # static shard -> feature-ids table for the scattered EFB search
+        # (bundle columns != features); tiny, replicated
+        meta_spec["scatter_feat"] = P()
     if strategy in ("data", "voting"):
         nshards = mesh.shape["data"]
         grow = make_grower(
@@ -127,10 +135,14 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
             debug_hist=debug_hist)
         out_specs = {**base_out, "leaf_ids": P("data")}
         if debug_hist:
-            # voting keeps pools local -> stack shards on axis 0; plain
-            # data mode psums before the pool, so every shard holds the
-            # same full histogram
-            out_specs["root_hist"] = (P("data") if strategy == "voting"
+            # voting keeps pools local -> stack shards on axis 0; data
+            # mode under psum replicates the full histogram on every
+            # shard, under scatter each shard holds its contiguous
+            # feature slice (stacking over 'data' reassembles the global
+            # histogram — and the per-shard slice width IS the
+            # no-global-histogram assertion hook for tests)
+            out_specs["root_hist"] = (P("data")
+                                      if strategy == "voting" or scatter
                                       else P())
         fn = shard_map(
             grow, mesh=mesh,
@@ -180,8 +192,11 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         # histograms psum over 'data', bests all_gather over 'feature'
         out_specs = {**base_out, "leaf_ids": P("data")}
         if debug_hist:
-            # psum'd over data already; stack feature slices to global
-            out_specs["root_hist"] = P("feature")
+            # stack feature slices to global; under scatter each feature
+            # shard's slice is further scattered over 'data' (feature-
+            # major, data-minor — exactly the global feature order)
+            out_specs["root_hist"] = (P(("feature", "data")) if scatter
+                                      else P("feature"))
         fn = shard_map(
             grow, mesh=mesh,
             in_specs=(P(None, "data"), P("data"), P("data"), P("data"),

@@ -1,0 +1,413 @@
+"""Sharded histogram aggregation (tpu_hist_agg=scatter): psum_scatter
+feature slices, per-shard split search, best-split sync.
+
+The contract under test (ops/grower.py, parallel/strategies.py):
+
+* scatter and psum make IDENTICAL split decisions — bitwise for the
+  quantized precisions (int8/int16: associative int32 sums + the shared
+  tie-break), decision-parity for f32/hilo (different reduction orders);
+* no shard ever materializes the global histogram: the per-shard pool /
+  root histogram is the F/P feature slice (the no-global-histogram
+  assertion, via the debug_hist root_hist shard shapes);
+* the shared deterministic tie-break (split.argbest: highest gain, then
+  lowest global feature id, then lowest bin) makes equal-gain decisions
+  identical across psum, scatter, feature, and voting paths at every
+  shard count;
+* F not divisible by the shard count pads transparently (trivial
+  padding features can never split).
+
+Runs on the 8-virtual-device CPU mesh (tests/conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.models.learner import TPUTreeLearner
+from lightgbm_tpu.ops import grower as G
+from lightgbm_tpu.ops.split import argbest
+
+
+def _problem(n=4096, f=10, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def _grow_records(X, y, grad_seed=3, **cfg):
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 15,
+              "min_data_in_leaf": 5, "tpu_block_rows": 512,
+              "verbosity": -1}
+    params.update(cfg)
+    config = Config(params)
+    td = TrainingData.from_matrix(X, y, config)
+    learner = TPUTreeLearner(config, td)
+    r = np.random.default_rng(grad_seed)
+    grad = r.normal(size=learner.n).astype(np.float32)
+    hess = np.abs(r.normal(size=learner.n)).astype(np.float32) + 0.1
+    tree, leaf_ids, out = learner.train(jnp.asarray(grad),
+                                        jnp.asarray(hess))
+    return (np.asarray(jax.device_get(out["records"])),
+            np.asarray(jax.device_get(leaf_ids)), learner)
+
+
+def _train_model_text(X, y, rounds=3, **cfg):
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 5, "tpu_block_rows": 512,
+              "verbosity": -1, "tpu_shape_buckets": 0}
+    params.update(cfg)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=rounds,
+                    keep_training_booster=True)
+    text = bst.model_to_string().split("\nparameters:")[0]
+    return text, bst
+
+
+class TestResolution:
+    def test_auto_is_scatter_on_a_real_data_axis(self):
+        X, y = _problem(n=1024)
+        _, _, l = _grow_records(X, y, tree_learner="data", num_machines=4)
+        assert l.hist_agg == "scatter"
+        assert l.params.hist_agg == "scatter"
+
+    def test_serial_and_feature_stay_psum(self):
+        X, y = _problem(n=1024)
+        _, _, ls = _grow_records(X, y)
+        assert ls.hist_agg == "psum"
+        _, _, lf = _grow_records(X, y, tree_learner="feature",
+                                 num_machines=2)
+        assert lf.hist_agg == "psum"
+
+    def test_explicit_psum_honored(self):
+        X, y = _problem(n=1024)
+        _, _, l = _grow_records(X, y, tree_learner="data", num_machines=4,
+                                tpu_hist_agg="psum")
+        assert l.hist_agg == "psum"
+
+    def test_bad_value_rejected(self):
+        X, y = _problem(n=512)
+        config = Config({"objective": "binary", "tpu_hist_agg": "ring"})
+        td = TrainingData.from_matrix(X, y, config)
+        with pytest.raises(ValueError, match="tpu_hist_agg"):
+            TPUTreeLearner(config, td)
+
+
+class TestRecordsBitwise:
+    """int8 grower records bitwise-identical: serial vs scatter at 2/4/8
+    shards vs psum — the PR-4 cross-shard-count guarantee must survive
+    the scattered topology (associative int32 psum_scatter + shared
+    tie-break)."""
+
+    def test_scatter_matches_serial_and_psum(self):
+        X, y = _problem()
+        q = {"tpu_hist_precision": "int8"}
+        rec_s, leaf_s, _ = _grow_records(X, y, **q)
+        for shards in (2, 4, 8):
+            rec_c, leaf_c, l = _grow_records(
+                X, y, tree_learner="data", num_machines=shards, **q)
+            assert l.hist_agg == "scatter"
+            np.testing.assert_array_equal(rec_s, rec_c)
+            np.testing.assert_array_equal(leaf_s, leaf_c)
+        rec_p, leaf_p, _ = _grow_records(
+            X, y, tree_learner="data", num_machines=4,
+            tpu_hist_agg="psum", **q)
+        np.testing.assert_array_equal(rec_s, rec_p)
+
+
+class TestNoGlobalHistogram:
+    """The acceptance hook: under scatter each shard's root histogram /
+    pool slice is [G/P, B, 3] — the global histogram never materializes
+    on any one shard (per-shard pool HBM drops by the data-axis
+    factor)."""
+
+    def test_per_shard_slice_is_f_over_p(self):
+        from lightgbm_tpu.parallel.strategies import make_strategy_grower
+
+        X, y = _problem(n=2048, f=8)
+        config = Config({"objective": "binary", "max_bin": 63,
+                         "num_leaves": 15, "min_data_in_leaf": 5,
+                         "tpu_block_rows": 512, "verbosity": -1,
+                         "tree_learner": "data", "num_machines": 4})
+        td = TrainingData.from_matrix(X, y, config)
+        l = TPUTreeLearner(config, td)
+        grow = make_strategy_grower(l.params, l.f_pad, "data", l.mesh,
+                                    num_columns=l.g_pad, debug_hist=True)
+        r = np.random.default_rng(0)
+        grad = jnp.asarray(r.normal(size=l.n_pad).astype(np.float32))
+        hess = jnp.asarray(
+            np.abs(r.normal(size=l.n_pad)).astype(np.float32))
+        out = grow(l.bins_t, grad, hess, l._ones_mask,
+                   jnp.ones(l.f_pad, jnp.float32), l.meta,
+                   jax.random.PRNGKey(0))
+        rh = out["root_hist"]
+        # global reassembly is [G, B, 3]; each ADDRESSABLE SHARD holds
+        # only its G/P slice
+        assert rh.shape[0] == l.g_pad
+        shard_rows = {s.data.shape[0] for s in rh.addressable_shards}
+        assert shard_rows == {l.g_pad // 4}, shard_rows
+        # and the stacked slices ARE the psum histogram
+        grow_p = make_strategy_grower(
+            l.params._replace(hist_agg="psum"), l.f_pad, "data", l.mesh,
+            num_columns=l.g_pad, debug_hist=True)
+        out_p = grow_p(l.bins_t, grad, hess, l._ones_mask,
+                       jnp.ones(l.f_pad, jnp.float32), l.meta,
+                       jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(rh), np.asarray(
+            out_p["root_hist"]), rtol=2e-4, atol=2e-4)
+
+
+class TestPaddingEdges:
+    """F not divisible by P: the learner pads the feature axis to a
+    shard multiple; padding features are trivial and can never split."""
+
+    @pytest.mark.parametrize("f", [9, 13])
+    def test_int8_bitwise_with_padding(self, f):
+        X, y = _problem(n=4096, f=f)
+        q = {"tpu_hist_precision": "int8"}
+        rec_s, leaf_s, _ = _grow_records(X, y, **q)
+        rec_c, leaf_c, l = _grow_records(
+            X, y, tree_learner="data", num_machines=8, **q)
+        assert l.f_pad % 8 == 0 and l.f_pad >= f
+        np.testing.assert_array_equal(rec_s, rec_c)
+        np.testing.assert_array_equal(leaf_s, leaf_c)
+
+
+class TestFloatDecisionParity:
+    """f32/hilo: psum vs scatter reduction orders differ by design, so
+    the bar is decision parity (the same 0.85 agreement bound the psum
+    mode holds against serial), not bitwise equality."""
+
+    def test_f32_scatter_vs_psum(self):
+        X, y = _problem()
+        kw = dict(tree_learner="data", num_machines=8,
+                  tpu_hist_precision="f32")
+        rec_c, _, _ = _grow_records(X, y, **kw)
+        rec_p, _, _ = _grow_records(X, y, tpu_hist_agg="psum", **kw)
+        np.testing.assert_array_equal(rec_c[:, G.REC_DID_SPLIT],
+                                      rec_p[:, G.REC_DID_SPLIT])
+        done = rec_c[:, G.REC_DID_SPLIT] > 0.5
+        cols = [G.REC_LEAF, G.REC_FEATURE, G.REC_THRESHOLD]
+        agree = (rec_c[done][:, cols].astype(np.int64)
+                 == rec_p[done][:, cols].astype(np.int64)).mean()
+        assert agree >= 0.85, f"decision agreement {agree:.0%}"
+
+
+class TestTieBreak:
+    """Duplicated columns force exact gain ties: every path must pick the
+    LOWEST feature id (the shared argbest rule), at every shard count."""
+
+    def _tie_problem(self):
+        rng = np.random.default_rng(11)
+        X = rng.normal(size=(2048, 8))
+        X[:, 5] = X[:, 0]  # exact duplicate -> bitwise-equal gains
+        y = (X[:, 0] > 0.3).astype(np.float64)
+        return X, y
+
+    def _tie_records(self, X, y, **cfg):
+        # like _grow_records, but with y-DERIVED gradients (logistic at
+        # score 0) so the duplicated pair 0/5 carries the dominant gain
+        # and the 0-vs-5 tie is actually exercised at the winner level —
+        # random gradients would leave both duplicates losing every leaf
+        params = {"objective": "binary", "max_bin": 63, "num_leaves": 15,
+                  "min_data_in_leaf": 5, "tpu_block_rows": 512,
+                  "verbosity": -1}
+        params.update(cfg)
+        config = Config(params)
+        td = TrainingData.from_matrix(X, y, config)
+        learner = TPUTreeLearner(config, td)
+        yp = np.zeros(learner.n, np.float32)
+        yp[:len(y)] = y
+        grad = (0.5 - yp).astype(np.float32)
+        hess = np.full(learner.n, 0.25, np.float32)
+        _, _, out = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+        return np.asarray(jax.device_get(out["records"]))
+
+    @pytest.mark.parametrize("cfg", [
+        {},                                                # serial argmax
+        {"tree_learner": "data", "num_machines": 4},       # scatter sync
+        {"tree_learner": "data", "num_machines": 4,
+         "tpu_hist_agg": "psum"},                          # psum argmax
+        {"tree_learner": "feature", "num_machines": 4},    # feature sync
+        {"tree_learner": "voting", "num_machines": 4,
+         "top_k": 6},                                      # voting argbest
+    ])
+    def test_lowest_feature_wins(self, cfg):
+        X, y = self._tie_problem()
+        rec = self._tie_records(X, y, tpu_hist_precision="int16", **cfg)
+        done = rec[:, G.REC_DID_SPLIT] > 0.5
+        feats = rec[done][:, G.REC_FEATURE].astype(np.int64)
+        # feature 5 is a bitwise duplicate of feature 0: the winner of
+        # any 0-vs-5 tie must be 0, so 5 may never appear
+        assert 5 not in feats, feats
+        assert 0 in feats
+
+    def test_argbest_unit(self):
+        g = jnp.asarray([1.0, 3.0, 3.0, 2.0])
+        f = jnp.asarray([7, 4, 2, 0], jnp.int32)
+        t = jnp.asarray([1, 1, 9, 0], jnp.int32)
+        assert int(argbest(g, f, t)) == 2          # max gain, lowest feat
+        f2 = jnp.asarray([7, 2, 2, 0], jnp.int32)
+        assert int(argbest(g, f2, t)) == 1         # feat tie -> lowest bin
+        assert int(argbest(g, f2)) == 1            # no bins: first lowest
+
+
+@pytest.mark.slow
+class TestModelFileBitwise:
+    """End-to-end acceptance sweep: scatter model files bitwise-equal to
+    psum AND serial for int8/int16 at 1/2/4/8 shards (refit off: the
+    refit leaf psum is the one f32 reduction whose shard-order ulps may
+    reach the model)."""
+
+    @pytest.mark.parametrize("prec", ["int8", "int16"])
+    def test_sweep(self, prec):
+        X, y = _problem()
+        q = {"tpu_hist_precision": prec, "tpu_quant_refit_leaves": False}
+        ref, _ = _train_model_text(X, y, **q)
+        for shards in (1, 2, 4, 8):
+            cfg = dict(q)
+            if shards > 1:
+                cfg.update(tree_learner="data", num_machines=shards)
+            got_sc, b = _train_model_text(X, y, **cfg)
+            assert got_sc == ref, f"{prec} scatter@{shards} != serial"
+            if shards > 1:
+                assert b._driver.learner.hist_agg == "scatter"
+                got_ps, _ = _train_model_text(
+                    X, y, tpu_hist_agg="psum", **cfg)
+                assert got_ps == ref, f"{prec} psum@{shards} != serial"
+
+
+@pytest.mark.slow
+class TestVotingScatter:
+    """Voting mode: the voted [k, B, 3] aggregation scatters instead of
+    the (local) pool; decisions must bit-match the psum voting path."""
+
+    def test_int16_model_bitwise_vs_psum(self):
+        X, y = _problem(f=12)
+        kw = dict(tree_learner="voting", num_machines=8, top_k=5,
+                  tpu_hist_precision="int16",
+                  tpu_quant_refit_leaves=False)
+        m_sc, b = _train_model_text(X, y, **kw)
+        assert b._driver.learner.hist_agg == "scatter"
+        m_ps, _ = _train_model_text(X, y, tpu_hist_agg="psum", **kw)
+        assert m_sc == m_ps
+
+    def test_topk_smaller_than_shards_pads(self):
+        # kk=2 < P=8: the voted set pads with masked duplicates
+        X, y = _problem(f=12)
+        kw = dict(tree_learner="voting", num_machines=8, top_k=2,
+                  tpu_hist_precision="int16",
+                  tpu_quant_refit_leaves=False)
+        m_sc, _ = _train_model_text(X, y, **kw)
+        m_ps, _ = _train_model_text(X, y, tpu_hist_agg="psum", **kw)
+        assert m_sc == m_ps
+
+
+@pytest.mark.slow
+class TestDataFeature2D:
+    """2-D mesh: the scatter slice composes under the feature axis —
+    histograms psum_scatter over 'data' within each feature shard, then
+    the winner syncs over 'data' and 'feature' in turn."""
+
+    def test_int8_bitwise_vs_serial(self):
+        X, y = _problem(f=12)
+        q = {"tpu_hist_precision": "int8",
+             "tpu_quant_refit_leaves": False}
+        ref, _ = _train_model_text(X, y, **q)
+        got, b = _train_model_text(
+            X, y, tree_learner="data_feature", num_machines=8,
+            tpu_feature_shards=2, **q)
+        assert b._driver.learner.hist_agg == "scatter"
+        assert got == ref
+
+    def test_f32_decision_parity_vs_psum(self):
+        X, y = _problem(f=12)
+        kw = dict(tree_learner="data_feature", num_machines=8,
+                  tpu_feature_shards=2, tpu_hist_precision="f32")
+        rec_c, _, _ = _grow_records(X, y, **kw)
+        rec_p, _, _ = _grow_records(X, y, tpu_hist_agg="psum", **kw)
+        done = rec_c[:, G.REC_DID_SPLIT] > 0.5
+        cols = [G.REC_LEAF, G.REC_FEATURE, G.REC_THRESHOLD]
+        agree = (rec_c[done][:, cols].astype(np.int64)
+                 == rec_p[done][:, cols].astype(np.int64)).mean()
+        assert agree >= 0.85
+
+
+@pytest.mark.slow
+class TestBundlesScatter:
+    """EFB + scatter: bundle COLUMNS scatter; each shard searches exactly
+    the features bundled into its column slice (scatter_feat table) and
+    expands them from the local slice."""
+
+    def _bundle_problem(self):
+        rng = np.random.default_rng(0)
+        n = 3000
+        cat = rng.integers(0, 30, size=n)
+        onehot = np.zeros((n, 30))
+        onehot[np.arange(n), cat] = 1.0
+        dense = rng.normal(size=(n, 4))
+        X = np.column_stack([onehot, dense])
+        y = ((cat % 3 == 0).astype(float) + 0.5 * dense[:, 0]
+             + 0.3 * rng.normal(size=n) > 0.6).astype(float)
+        return X, y
+
+    def test_int16_model_bitwise_vs_psum(self):
+        X, y = self._bundle_problem()
+        kw = dict(tree_learner="data", num_machines=8,
+                  tpu_hist_precision="int16",
+                  tpu_quant_refit_leaves=False, min_data_in_leaf=10)
+        m_sc, b = _train_model_text(X, y, **kw)
+        l = b._driver.learner
+        assert l.params.has_bundles, "EFB did not engage"
+        assert l.hist_agg == "scatter"
+        assert "scatter_feat" in l.meta
+        sf = np.asarray(l.meta["scatter_feat"])
+        assert sf.shape[0] == 8
+        # every real feature appears exactly once across the shard table
+        real = np.sort(sf[sf >= 0])
+        np.testing.assert_array_equal(real, np.arange(l.num_features))
+        m_ps, _ = _train_model_text(X, y, tpu_hist_agg="psum", **kw)
+        assert m_sc == m_ps
+
+    def test_hilo_decision_parity_vs_psum(self):
+        X, y = self._bundle_problem()
+        kw = dict(tree_learner="data", num_machines=4,
+                  min_data_in_leaf=10)
+        m_sc, _ = _train_model_text(X, y, **kw)
+        m_ps, _ = _train_model_text(X, y, tpu_hist_agg="psum", **kw)
+        assert m_sc == m_ps  # held exactly on this fixture
+
+
+@pytest.mark.slow
+class TestSparseScatter:
+    """Sparse COO storage + scatter: zero-bin reconstruction on the
+    slice rides the exact threaded leaf totals; deterministic f64 must
+    bit-match serial-sparse."""
+
+    def test_f64_model_bitwise_vs_serial(self):
+        rng = np.random.default_rng(7)
+        n = 2048
+        X = np.zeros((n, 12))
+        X[:, :4] = rng.normal(size=(n, 4))
+        for f in range(4, 12):
+            nzr = rng.choice(n, size=80, replace=False)
+            X[nzr, f] = rng.normal(size=80) + 1.0
+        y = (X[:, 0] + 2.0 * X[:, 5] > 0).astype(np.float64)
+        kw = dict(enable_bundle=False, deterministic=True,
+                  tpu_sparse_threshold=0.2, tpu_block_rows=256,
+                  num_leaves=7, max_bin=16, rounds=2)
+        try:
+            m_ser, b1 = _train_model_text(X, y, **kw)
+            assert b1._driver.learner.params.has_sparse
+            m_sc, b2 = _train_model_text(
+                X, y, tree_learner="data", num_machines=8, **kw)
+            assert b2._driver.learner.hist_agg == "scatter"
+            assert b2._driver.learner.params.has_sparse
+            assert m_sc == m_ser
+        finally:
+            jax.config.update("jax_enable_x64", False)

@@ -274,10 +274,105 @@ def run_ingest_sweep(X, y, bins=255):
               f"(sketch {sk:5.2f}s bin {bn:5.2f}s)", flush=True)
 
 
+def run_comm_sweep(shard_counts, reps=10):
+    """Histogram-aggregation sweep: psum (all-reduce) vs psum_scatter
+    (reduce-scatter) wall time over (shards, F, B, K, precision), with
+    the predicted per-shard ICI receive bytes printed next to the
+    measured wall so the scatter win stays legible even on the CPU
+    container (where the "collective" is a memcpy and the wall mostly
+    tracks bytes touched).  The array is the grower's aggregation
+    payload: the [K, F, B, 3] smaller-child histograms in the
+    accumulation dtype (int32 for int8/int16, f32 for hilo/f32).
+
+        SHARDS=2,4,8 python tools/perf_probe.py comm
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from lightgbm_tpu.parallel.mesh import (allreduce_recv_bytes,
+                                            reduce_scatter_recv_bytes)
+    from lightgbm_tpu.parallel.strategies import shard_map
+
+    devices = jax.devices()
+    rng = np.random.default_rng(0)
+    print(f"{len(devices)} {devices[0].platform} devices; per-shard "
+          "receive bytes predicted by the ring cost model "
+          "(parallel/mesh.py)", flush=True)
+    header = (f"{'shards':>6s} {'F':>5s} {'B':>4s} {'K':>3s} {'prec':>5s} "
+              f"{'payload':>9s} {'pred psum':>10s} {'pred scat':>10s} "
+              f"{'psum ms':>8s} {'scatter ms':>10s} {'ratio':>6s}")
+    print(header, flush=True)
+    for p in shard_counts:
+        if p > len(devices):
+            print(f"{p:6d}  SKIP (only {len(devices)} devices)", flush=True)
+            continue
+        mesh = Mesh(np.array(devices[:p]), ("data",))
+        for F, B, K in ((32, 64, 16), (32, 256, 25), (256, 256, 25)):
+            # pad F to the shard count like the learner does
+            Fp = -(-F // p) * p
+            for prec in ("int8", "hilo"):
+                dt = jnp.int32 if prec in ("int8", "int16") else jnp.float32
+                h = jnp.asarray(
+                    rng.integers(0, 1000, size=(K, Fp, B, 3)), dtype=dt)
+                nbytes = h.size * h.dtype.itemsize
+
+                def f_psum(x):
+                    return jax.lax.psum(x, "data")
+
+                def f_scat(x):
+                    return jax.lax.psum_scatter(x, "data",
+                                                scatter_dimension=1,
+                                                tiled=True)
+
+                fns = {}
+                fns["psum"] = jax.jit(shard_map(
+                    f_psum, mesh=mesh, in_specs=P(), out_specs=P(),
+                    check_vma=False))
+                fns["scatter"] = jax.jit(shard_map(
+                    f_scat, mesh=mesh, in_specs=P(),
+                    out_specs=P(None, "data"), check_vma=False))
+                walls = {}
+                for name, fn in fns.items():
+                    jax.block_until_ready(fn(h))  # compile
+                    t0 = time.time()
+                    for _ in range(reps):
+                        out = fn(h)
+                    jax.block_until_ready(out)
+                    walls[name] = (time.time() - t0) / reps * 1e3
+                mb = 1.0 / (1024 * 1024)
+                print(f"{p:6d} {Fp:5d} {B:4d} {K:3d} {prec:>5s} "
+                      f"{nbytes * mb:8.1f}M "
+                      f"{allreduce_recv_bytes(nbytes, p) * mb:9.1f}M "
+                      f"{reduce_scatter_recv_bytes(nbytes, p) * mb:9.1f}M "
+                      f"{walls['psum']:8.2f} {walls['scatter']:10.2f} "
+                      f"{walls['psum'] / max(walls['scatter'], 1e-9):6.2f}",
+                      flush=True)
+
+
 def main():
+    arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "comm":
+        # no dataset needed.  Default: a virtual CPU mesh sized to the
+        # sweep (must pin BEFORE the first jax import); COMM_BACKEND=tpu
+        # keeps the attached accelerator mesh for real ICI numbers
+        shard_counts = [int(s) for s in
+                        os.environ.get("SHARDS", "2,4,8").split(",")]
+        if os.environ.get("COMM_BACKEND", "cpu") != "tpu":
+            import importlib.util as _ilu
+
+            spec = _ilu.spec_from_file_location(
+                "_lgbm_backend_boot",
+                os.path.join(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))),
+                    "lightgbm_tpu", "utils", "backend.py"))
+            mod = _ilu.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            mod.pin_cpu_backend(force_device_count=max(shard_counts))
+        run_comm_sweep(shard_counts)
+        return
     n = int(os.environ.get("N", 1_000_000))
     X, y = make_data(n)
-    arg = sys.argv[1] if len(sys.argv) > 1 else ""
     if arg == "hist":
         run_hist_sweep(X, y, bins=int(os.environ.get("BINS", 255)))
         return
