@@ -78,10 +78,41 @@ def hist_rows_per_sec(bins_np, num_bins, precision, reps=3):
     bins_tb, stats, n_use = bench_hist_operands(bins_np, precision, block)
     fn = jax.jit(lambda b, s: build_histogram_t(b, s, num_bins, precision))
     host_sync(fn(bins_tb, stats))  # compile
-    t0 = time.time()
-    for _ in range(reps):
+    rates = []
+    for _ in range(max(reps, 3)):
+        t0 = time.time()
         host_sync(fn(bins_tb, stats))
-    return n_use * reps / max(time.time() - t0, 1e-9)
+        rates.append(n_use / max(time.time() - t0, 1e-9))
+    return rates
+
+
+def spread(rates):
+    """(median, min) of a repeat series — every timed metric reports its
+    own variance (VERDICT item 7) instead of a single unqualified
+    number."""
+    return float(np.median(rates)), float(np.min(rates))
+
+
+def prior_bench_record():
+    """(filename, parsed record) of the newest committed BENCH_r*.json —
+    the baseline the compile_s / n_programs regression note compares
+    against."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    files = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                   key=lambda p: [int(s) for s in re.findall(r"\d+", p)])
+    for path in reversed(files):
+        try:
+            with open(path) as fh:
+                rec = json.load(fh)
+            parsed = rec.get("parsed", rec)
+            if isinstance(parsed, dict) and "compile_s" in parsed:
+                return os.path.basename(path), parsed
+        except (OSError, ValueError):
+            continue
+    return None, None
 
 
 def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
@@ -146,6 +177,14 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
             cache_state = "warm" if os.listdir(eff_dir) else "cold"
         except OSError:
             cache_state = "cold"
+    # retrace audit: every ledgered jit site records its compiled
+    # programs, so the round carries n_programs beside compile_s — a
+    # future PR that doubles the program zoo fails the regression note
+    # loudly instead of silently inflating the compile tail
+    from lightgbm_tpu.utils.compile_ledger import LEDGER
+
+    LEDGER.enable()
+    LEDGER.reset()
     bst = Booster(params=params, train_set=ds)
     # snapshot ingest phases NOW: later valid-set constructs would
     # double-count sketch/binning
@@ -158,22 +197,34 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         bst.update()
     host_sync(bst._driver.train_scores.scores)
     compile_s = time.time() - t_compile
+    n_programs_train = LEDGER.n_programs()
 
+    # >=3 timed segments so the headline carries its own variance
+    # (median beside min); segments hold >=2 iters so the per-segment
+    # host_sync doesn't serialize every single dispatch
+    seg_iters = max(round(bench_iters / 3), 2)
+    seg_rates = []
     t0 = time.time()
-    for _ in range(bench_iters):
-        bst.update()
-    host_sync(bst._driver.train_scores.scores)
+    for _ in range(3):
+        ts = time.time()
+        for _ in range(seg_iters):
+            bst.update()
+        host_sync(bst._driver.train_scores.scores)
+        seg_rates.append(seg_iters / max(time.time() - ts, 1e-9))
     train_s = time.time() - t0
-    iters_per_sec = bench_iters / train_s
+    bench_iters = 3 * seg_iters
+    iters_per_sec, iters_per_sec_min = spread(seg_rates)
 
     # prediction throughput: full-forest raw predict rows/s on the path
     # the configuration would actually use (device bin-space traversal on
     # TPU, native walker otherwise)
     bst.predict(X_eval, raw_score=True)  # warm (pack + compile)
-    t_pred = time.time()
+    pred_rates = []
     for _ in range(3):
+        t_pred = time.time()
         bst.predict(X_eval, raw_score=True)
-    predict_rows_per_sec = 3 * n_eval / (time.time() - t_pred)
+        pred_rates.append(n_eval / max(time.time() - t_pred, 1e-9))
+    predict_rows_per_sec, predict_rows_per_sec_min = spread(pred_rates)
     # sanity AUC BEFORE the eval-overhead block: its extra update() calls
     # would otherwise make the recorded train_auc describe a model
     # trained more than bench_iters iterations
@@ -203,17 +254,21 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
 
     import threading as _threading
 
-    workers = [_threading.Thread(target=_hammer)
-               for _ in range(serve_threads)]
-    t_serve = time.time()
-    for w in workers:
-        w.start()
-    for w in workers:
-        w.join()
-    serve_s = time.time() - t_serve
-    if serve_errors:
-        raise serve_errors[0]
-    serve_rows_per_sec = serve_threads * serve_reqs * serve_rows / serve_s
+    serve_rates = []
+    for _ in range(3):
+        workers = [_threading.Thread(target=_hammer)
+                   for _ in range(serve_threads)]
+        t_serve = time.time()
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        serve_s = max(time.time() - t_serve, 1e-9)
+        if serve_errors:
+            raise serve_errors[0]
+        serve_rates.append(serve_threads * serve_reqs * serve_rows
+                           / serve_s)
+    serve_rows_per_sec, serve_rows_per_sec_min = spread(serve_rates)
     serve_p99_ms = sess.stats()["latency_p99_ms"]
     sess.close()
 
@@ -227,23 +282,46 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     bst.update()
     bst.eval_valid()  # warm (replay + compile)
     host_sync(bst._driver.train_scores.scores)
-    eval_iters = 3
-    t_eval = time.time()
-    for _ in range(eval_iters):
+    eval_walls = []
+    for _ in range(3):
+        t_eval = time.time()
         bst.update()
         bst.eval_valid()
-    host_sync(bst._driver.train_scores.scores)
-    eval_ms_per_iter = max(
-        (time.time() - t_eval) / eval_iters - train_s / bench_iters,
-        0.0) * 1e3
+        host_sync(bst._driver.train_scores.scores)
+        eval_walls.append(time.time() - t_eval)
+    eval_med, _ = spread(eval_walls)
+    eval_ms_per_iter = max(eval_med - train_s / bench_iters, 0.0) * 1e3
 
     # histogram-kernel throughput at the quantized vs shipping precision:
     # rows bounded so the probe stays a footnote next to the training loop
     hist_rows = min(n_rows, 262144)
     hist_bins = bst._driver.learner.num_bins
     bins_np = np.asarray(ds._inner.bins[:hist_rows])
-    hist_int8 = hist_rows_per_sec(bins_np, hist_bins, "int8")
-    hist_hilo = hist_rows_per_sec(bins_np, hist_bins, "hilo")
+    hist_int8, hist_int8_min = spread(
+        hist_rows_per_sec(bins_np, hist_bins, "int8"))
+    hist_hilo, hist_hilo_min = spread(
+        hist_rows_per_sec(bins_np, hist_bins, "hilo"))
+    n_programs = LEDGER.n_programs()
+    ledger_sites = {a["site"]: a["programs"] for a in LEDGER.report()}
+
+    # regression note: compile_s / n_programs against the newest
+    # committed BENCH_r*.json (same-shape comparisons only make sense
+    # between degraded rounds or between TPU rounds; the note carries the
+    # prior platform so readers can judge)
+    prior_name, prior = prior_bench_record()
+    compile_note = None
+    if prior is not None:
+        note = {"vs": prior_name,
+                "prior_compile_s": prior.get("compile_s"),
+                "prior_platform": prior.get("platform")}
+        if prior.get("n_programs") is not None:
+            note["prior_n_programs"] = prior.get("n_programs")
+        try:
+            note["compile_s_ratio"] = round(
+                compile_s / float(prior["compile_s"]), 3)
+        except (KeyError, TypeError, ZeroDivisionError):
+            pass
+        compile_note = note
 
     # sanity: the model must actually learn (pred captured above, at
     # exactly bench_iters + warmup iterations)
@@ -270,12 +348,20 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "vs_baseline": (round(iters_per_sec / BASELINE_ITERS_PER_SEC, 3)
                         if comparable else 0.0),
         "train_auc": round(float(auc), 4),
+        # every timed metric: median of >=3 repeats, worst repeat beside
+        # it (the _min twin) so each record carries its own variance
+        "timing_repeats": 3,
+        "iters_per_sec_min": round(iters_per_sec_min, 3),
         "predict_rows_per_sec": round(predict_rows_per_sec, 0),
+        "predict_rows_per_sec_min": round(predict_rows_per_sec_min, 0),
         "serve_rows_per_sec": round(serve_rows_per_sec, 0),
+        "serve_rows_per_sec_min": round(serve_rows_per_sec_min, 0),
         "serve_p99_ms": round(serve_p99_ms, 1),
         "eval_ms_per_iter": round(eval_ms_per_iter, 1),
         "hist_int8_rows_per_sec": round(hist_int8, 0),
+        "hist_int8_rows_per_sec_min": round(hist_int8_min, 0),
         "hist_hilo_rows_per_sec": round(hist_hilo, 0),
+        "hist_hilo_rows_per_sec_min": round(hist_hilo_min, 0),
         "ingest_rows_per_sec": round(ingest_rows_per_sec, 0),
         "bench_iters": bench_iters,
         "data_gen_s": round(data_s, 1),
@@ -284,8 +370,16 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "bin_s": round(phases.get("binning", 0.0), 2),
         "layout_s": round(phases.get("layout", 0.0), 2),
         "compile_s": round(compile_s, 1),
+        # compiled XLA programs recorded by the ledgered jit sites: the
+        # train+warmup lifecycle count, then the whole round (predict +
+        # serve shapes included)
+        "n_programs_train": n_programs_train,
+        "n_programs": n_programs,
+        "ledger_sites": ledger_sites,
         "platform": jax.devices()[0].platform,
     }
+    if compile_note is not None:
+        out["compile_vs_prior"] = compile_note
     if params["tpu_shape_buckets"]:
         out["tpu_shape_buckets"] = params["tpu_shape_buckets"]
     if cache_dir:

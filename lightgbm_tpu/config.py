@@ -311,6 +311,28 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # below this row count the auto mode keeps score replay on the host
     # walker (jit dispatch + compile dominate tiny valid sets)
     "tpu_predict_min_rows": ("int", 4096, ()),
+    # launch-shape bucket policy (ops/predict.py BUCKET_POLICIES) shared
+    # by training-time score replay, the chunked device predict path,
+    # serving warmup enumeration, and bench — every layer quantizes its
+    # launch shapes through the SAME ladder, so warmup can pre-compile
+    # exactly the set a request can trigger.
+    #   wide - rows pad on a x4 ladder from a 4096 floor, depth trip
+    #          counts floor at 8, and the grower's frontier ramp steps
+    #          x4: strictly fewer distinct programs (a full predict-size
+    #          sweep compiles 3 instead of 7 at the default chunk), at up
+    #          to 4x padded rows on small batches
+    #   fine - the pre-round-6 shapes: pow2 rows from a 1024 floor, exact
+    #          pow2 depth buckets, x2 ramp — lowest small-batch predict
+    #          latency, most programs
+    "tpu_bucket_policy": ("str", "wide", ()),
+    # donate the per-iteration score buffers and the [L, G/P, B, 3]
+    # histogram pool to XLA (jit donate_argnums): the pool is threaded
+    # through the grower and rewritten in place across iterations instead
+    # of being re-allocated per tree, and the score update reuses the old
+    # scores buffer.  Outputs are bit-identical with donation on or off;
+    # turn off when debugging with retained references to per-iteration
+    # device arrays (donated buffers are deleted at dispatch)
+    "tpu_donate_buffers": ("bool", True, ()),
     # device-parallel dataset ingest (ops/binning.py): raw rows are
     # quantized on the accelerator in streamed chunks (host key prep for
     # chunk i+1 overlaps device binning of chunk i) and the [n, F] bin

@@ -166,7 +166,11 @@ class _ScoreState:
                     else s.T.astype(np.float32)
             else:
                 scores += s.reshape(1, -1)
-        self.scores = jnp.asarray(scores)
+        # .copy() forces an XLA-owned buffer: on CPU, asarray of
+        # aligned host memory is zero-copy, and this buffer is later
+        # DONATED by the train step — donating a numpy-aliased buffer
+        # corrupts the heap (XLA rewrites memory numpy owns)
+        self.scores = jnp.asarray(scores).copy()
 
     def add_constant(self, val: float, class_id: int):
         self.scores = self.scores.at[class_id].add(np.float32(val))
@@ -464,13 +468,27 @@ class GBDT:
             inits = [self._boost_from_average(k)
                      for k in range(self.num_tree_per_iteration)]
             base_scores = self.train_scores.scores
+            if getattr(self.learner, "_donate", False):
+                # the step donates the scores buffer (arg 1); at class 0
+                # base_scores IS that buffer, so snapshot a copy — a
+                # donated-then-read alias would either spam copy warnings
+                # or (multiclass) read a deleted buffer at class 1
+                base_scores = jnp.copy(base_scores)
+            pool = getattr(self.learner, "_pool", None)
             for k in range(self.num_tree_per_iteration):
                 refresh = bag is not None and (self.iter_ % bag["freq"] == 0)
                 (records, scores, leaf_ids, leaf_out, self._key,
-                 self._bag_key) = self._train_step(
+                 self._bag_key, pool) = self._train_step(
                     base_scores, self.train_scores.scores,
-                    self._key, self._bag_key, k, refresh, **extra)
+                    self._key, self._bag_key, pool, k, refresh, **extra)
                 self.train_scores.scores = scores
+                if pool is not None:
+                    # write the donated pool back IMMEDIATELY: the step
+                    # deleted the previous buffer, so deferring this past
+                    # a raising later class would leave learner._pool
+                    # pointing at a deleted array and break every
+                    # subsequent update()
+                    self.learner._pool = pool
                 # quantized leaf refit: the host Tree must take its leaf
                 # values from the refitted device vector, not the records
                 self._pending.append((
@@ -862,7 +880,7 @@ class GBDT:
             if pack_cache is not None:
                 pack_cache["packed"] = (tables_dev, depth)
         vals = forest_leaf_values(tables_dev, bins_dev, self._meta_dev(),
-                                  depth)
+                                  depth, policy=self.bucket_policy())
         return vals[0]
 
     def _replay_scores_device(self, state: "_ScoreState", data: TrainingData,
@@ -902,11 +920,13 @@ class GBDT:
                             [sub, jnp.zeros((chunk - (hi - lo),
                                              sub.shape[1]), sub.dtype)])
                     parts.append(forest_class_scores(
-                        tables_dev, sub, md, k, depth, scale)[:, :hi - lo])
+                        tables_dev, sub, md, k, depth, scale,
+                        policy=self.bucket_policy())[:, :hi - lo])
                 scores = jnp.concatenate(parts, axis=1)
             else:
                 scores = forest_class_scores(tables_dev, bins_dev, md, k,
-                                             depth, scale)
+                                             depth, scale,
+                                             policy=self.bucket_policy())
             for kk in range(k):
                 state.add(kk, scores[kk])
         return True
@@ -989,6 +1009,14 @@ class GBDT:
         return max(int(self.config.tpu_predict_chunk_rows)
                    if self.config is not None else 65536, 1024)
 
+    def bucket_policy(self) -> str:
+        """Launch-shape bucket policy (tpu_bucket_policy) — the ONE
+        quantization ladder shared by score replay, chunked predict, and
+        the serving warmup enumeration (ops/predict.py
+        BUCKET_POLICIES)."""
+        return (str(self.config.tpu_bucket_policy)
+                if self.config is not None else "wide")
+
     def _chunked_device_scores(self, tables, meta_dev, k: int, depth: int,
                                n: int, get_bins) -> np.ndarray:
         """[k, n] f64 host scores from the packed device forest, chunked
@@ -1002,16 +1030,20 @@ class GBDT:
             rows = hi - lo
             bins = get_bins(lo, hi)
             # pad every launch to a bucketed row count (row_bucket: full
-            # chunks for multi-chunk predicts, pow2 below that) so
-            # repeated predicts of varying batch sizes reuse a handful of
-            # compiled programs instead of one per distinct n
-            target = chunk if n > chunk else row_bucket(rows, chunk)
+            # chunks for multi-chunk predicts, the policy's geometric
+            # ladder below that) so repeated predicts of varying batch
+            # sizes reuse a handful of compiled programs instead of one
+            # per distinct n
+            policy = self.bucket_policy()
+            target = (chunk if n > chunk
+                      else row_bucket(rows, chunk, policy=policy))
             if rows < target:
                 bins = np.concatenate(
                     [bins, np.zeros((target - rows, bins.shape[1]),
                                     np.int32)])
             scores = forest_class_scores(tables, jnp.asarray(bins),
-                                         meta_dev, k, depth)
+                                         meta_dev, k, depth,
+                                         policy=policy)
             out[:, lo:hi] = np.asarray(
                 jax.device_get(scores), np.float64)[:, :rows]
         return out

@@ -20,9 +20,11 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.bin_mapper import MissingType
 from ..io.dataset import TrainingData
-from ..ops.grower import GrowerParams, pad_rows, resolve_split_batch
+from ..ops.grower import (GrowerParams, canonical_params, mode_flags_np,
+                          pad_rows, pool_dtype, resolve_split_batch)
 from ..parallel.mesh import make_mesh, put_global, put_local
 from ..parallel.strategies import (bins_sharding, make_strategy_grower,
+                                   pool_partition_spec,
                                    resolve_tree_learner, rows_sharding)
 from ..utils import timer
 from ..utils.log import Log
@@ -159,7 +161,8 @@ class TPUTreeLearner:
                              ("tpu_hist_precision", ("hilo", "bf16", "f32",
                                                      "f64", "int8", "int16")),
                              ("tpu_quant_round", ("stochastic", "nearest")),
-                             ("tpu_hist_agg", ("auto", "psum", "scatter"))):
+                             ("tpu_hist_agg", ("auto", "psum", "scatter")),
+                             ("tpu_bucket_policy", ("fine", "wide"))):
             if str(getattr(config, key)) not in allowed:
                 raise ValueError(f"{key}={getattr(config, key)!r}; "
                                  f"expected one of {allowed}")
@@ -626,6 +629,16 @@ class TPUTreeLearner:
         # multi-host mesh: every array entering the sharded grower must be
         # a GLOBAL jax.Array; cache the shardings train() re-uses per tree
         self._multiproc = self.mesh is not None and jax.process_count() > 1
+        # traced mode switches (ops/grower.py MF_*): the real boolean/
+        # scalar mode values ride this meta vector so ONE compiled grow
+        # program serves every combination; the GrowerParams fields they
+        # replace are canonicalized out of the grower cache key below
+        meta_cast["mode_flags"] = mode_flags_np(
+            quant_round=str(config.tpu_quant_round),
+            quant_refit=(quantized
+                         and bool(config.tpu_quant_refit_leaves)),
+            cegb_tradeoff=float(config.cegb_tradeoff),
+            cegb_penalty_split=float(config.cegb_penalty_split))
         if self._multiproc:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -720,6 +733,11 @@ class TPUTreeLearner:
             quant_round=str(config.tpu_quant_round),
             quant_refit=(quantized
                          and bool(config.tpu_quant_refit_leaves)),
+            # the bucket policy's compile-time lever on the grow program:
+            # "wide" ramps the frontier pre-rounds x4 (half the unrolled
+            # rounds, bit-identical trees)
+            ramp_step=(4 if str(config.tpu_bucket_policy) == "wide"
+                       else 2),
             hist_agg=self.hist_agg,
         )
         # quantized leaf refit: the driver must fetch out["leaf_output"]
@@ -746,9 +764,42 @@ class TPUTreeLearner:
                 self._cegb_paid = jnp.zeros((self.f_pad, self.n_pad),
                                             jnp.bool_)
                 self.meta["cegb_paid"] = self._cegb_paid
+        # buffer donation (tpu_donate_buffers): the grower's histogram
+        # pool and the step's score buffers are donated to XLA so they
+        # are rewritten in place across iterations.  Multi-process runs
+        # keep donation off (global-array donation across the gloo CPU
+        # test backend is unvalidated); voting keeps its pool shard-LOCAL
+        # so only the score buffers donate there.
+        self._donate = (bool(config.tpu_donate_buffers)
+                        and not self._multiproc)
+        self._external_pool = self._donate and strategy != "voting"
+        if self._external_pool:
+            # the pool MUST be XLA-owned (jnp.zeros, never
+            # jnp.asarray(np.zeros(...))): on the CPU backend a
+            # device_put of aligned host memory is ZERO-COPY — the
+            # buffer aliases numpy-owned pages, and donating it lets
+            # XLA rewrite/free memory it does not own (intermittent,
+            # alignment-dependent heap corruption; reproduced on
+            # jaxlib 0.4.x)
+            shape = (self.params.num_leaves, self.g_pad, B, 3)
+            pdt = jnp.dtype(pool_dtype(precision))
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding
+
+                self._pool = jnp.zeros(shape, pdt, device=NamedSharding(
+                    self.mesh, pool_partition_spec(
+                        strategy, self.hist_agg == "scatter")))
+            else:
+                self._pool = jnp.zeros(shape, pdt)
+        else:
+            self._pool = None
+        # the grower cache key is the CANONICAL params (the mode-flag-
+        # folded fields normalized away): every run whose structural axes
+        # match reuses one grow program, whatever its mode values
         self.grow = make_strategy_grower(
-            self.params, self.f_pad, strategy, self.mesh,
-            voting_k=int(config.top_k), num_columns=self.g_pad)
+            canonical_params(self.params), self.f_pad, strategy, self.mesh,
+            voting_k=int(config.top_k), num_columns=self.g_pad,
+            external_pool=self._external_pool)
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
 
     # ------------------------------------------------------------------
@@ -952,6 +1003,10 @@ class TPUTreeLearner:
             # come from the same snapshot, like the reference's single
             # Boosting() call per iteration (gbdt.cpp:150-158); `scores`
             # accumulates the per-class deltas within the iteration.
+            # class_id and refresh_bag are TRACED (shape-stability: one
+            # compiled step serves every class and both sides of the
+            # bagging_freq boundary — previously each was a static key
+            # multiplying the program count)
             grad, hess = grad_fn(grad_scores)
             g = grad[class_id] if grad.ndim == 2 else grad
             h = hess[class_id] if hess.ndim == 2 else hess
@@ -959,8 +1014,8 @@ class TPUTreeLearner:
             h = jnp.zeros(n_pad, jnp.float32).at[:n].set(h[:n])
 
             key, kf = jax.random.split(key)
-            if refresh_bag:  # static: bagging_freq boundary
-                bag_key = jax.random.split(bag_key)[0]
+            bag_key = jnp.where(jnp.asarray(refresh_bag),
+                                jax.random.split(bag_key)[0], bag_key)
             mask = ones_mask
             if goss_on:
                 # GOSS on device (reference goss.hpp:91-139 BaggingHelper):
@@ -1008,20 +1063,31 @@ class TPUTreeLearner:
             new_scores = scores.at[class_id, :].add(delta[:n])
             return new_scores, leaf_ids[:n]
 
+        external_pool = self._external_pool
+        donate = self._donate
+
         def make_step(pre_fn, post_fn):
-            # ONE step body shared by both modes: pre -> grow -> post
-            def step(grad_scores, scores, key, bag_key, class_id,
+            # ONE step body shared by both modes: pre -> grow -> post.
+            # `pool` is the donated histogram-pool buffer (None when
+            # donation is off): grow rewrites it in place and the caller
+            # threads the returned buffer into the next call.
+            def step(grad_scores, scores, key, bag_key, pool, class_id,
                      refresh_bag, goss_on=False):
                 g, h, mask, fmask, k_node, key, bag_key = pre_fn(
                     grad_scores, key, bag_key, class_id=class_id,
                     refresh_bag=refresh_bag, goss_on=goss_on)
-                out = grow(bins_t, g, h, mask, fmask, meta, k_node)
+                if external_pool:
+                    out = grow(bins_t, g, h, mask, fmask, meta, k_node,
+                               pool)
+                    pool = out["pool"]
+                else:
+                    out = grow(bins_t, g, h, mask, fmask, meta, k_node)
                 new_scores, lids = post_fn(scores, out["records"],
                                            out["leaf_ids"],
                                            out["leaf_output"],
                                            class_id=class_id)
                 return (out["records"], new_scores, lids,
-                        out["leaf_output"], key, bag_key)
+                        out["leaf_output"], key, bag_key, pool)
             return step
 
         if int(self.config.tpu_shape_buckets) > 0 \
@@ -1036,14 +1102,32 @@ class TPUTreeLearner:
             # outputs (leaf_ids on the 'data' axis) would reshard across
             # the program boundary, which the CPU-collectives test
             # backend aborts on — and multi-chip wants the fusion anyway.
-            pre_j = jax.jit(_pre, static_argnames=("class_id",
-                                                   "refresh_bag", "goss_on"))
-            post_j = jax.jit(_post, static_argnames=("class_id",))
+            # Only goss_on stays static (its sort is structural work);
+            # the grower's own ledgered jit donates the pool here and
+            # post donates the scores buffer.  pre/post stay OFF the
+            # ledger by design: they are per-objective closures (label
+            # arrays captured) that re-trace per Booster in milliseconds
+            # — the ledger tracks the programs that dominate compile wall
+            # (grower, fused step, predict/binning/histogram kernels).
+            pre_j = jax.jit(_pre, static_argnames=("goss_on",))
+            post_j = jax.jit(_post,
+                             donate_argnums=((0,) if donate else ()))
             return make_step(pre_j, post_j)
         # exact-shape mode (tpu_shape_buckets=0): ONE fused program —
-        # the round-3 hardware-validated hot path, bit-identical
-        return jax.jit(make_step(_pre, _post),
-                       static_argnames=("class_id", "refresh_bag", "goss_on"))
+        # the round-3 hardware-validated hot path, bit-identical.
+        # Donation at the fused boundary: scores (arg 1) and the pool
+        # (arg 4) are rewritten in place by XLA.  The fused jit is the
+        # ledger site here (the grower's own jit is traced inline).
+        from ..utils.compile_ledger import ledger_jit
+
+        dn = []
+        if donate:
+            dn.append(1)
+        if external_pool:
+            dn.append(4)
+        return ledger_jit(make_step(_pre, _post), site="learner.step",
+                          static_argnames=("goss_on",),
+                          donate_argnums=tuple(dn))
 
     def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
               row_mask: Optional[jnp.ndarray] = None
@@ -1090,9 +1174,15 @@ class TPUTreeLearner:
         else:
             mask = self._ones_mask if row_mask is None else \
                 self.pad_vector(row_mask) * self._ones_mask
-            out = self.grow(self.bins_t, self.pad_vector(grad),
-                            self.pad_vector(hess), mask, fmask, self.meta,
-                            key)
+            if self._external_pool:
+                out = self.grow(self.bins_t, self.pad_vector(grad),
+                                self.pad_vector(hess), mask, fmask,
+                                self.meta, key, self._pool)
+                self._pool = out["pool"]
+            else:
+                out = self.grow(self.bins_t, self.pad_vector(grad),
+                                self.pad_vector(hess), mask, fmask,
+                                self.meta, key)
         if self.params.has_cegb:
             # harvest the updated state for the NEXT tree (async device
             # arrays; no host sync)

@@ -33,7 +33,6 @@ materialized on the host unless a host consumer asks (see
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -42,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from ..io.bin_mapper import BinMapper, BinType, MissingType, sort_keys
+from ..utils.compile_ledger import ledger_jit
 
 _NAN_KEY = np.int64(np.iinfo(np.int64).max)
 _NAN_KEY_HI = np.int32(_NAN_KEY >> 32)
@@ -60,7 +60,8 @@ def split_keys(keys: np.ndarray):
             (keys & np.int64(0xFFFFFFFF)).astype(np.uint32))
 
 
-@partial(jax.jit, static_argnames=("has_cat", "out_dtype"))
+@ledger_jit(site="binning.chunk",
+            static_argnames=("has_cat", "out_dtype"))
 def _bin_chunk_kernel(vhi, vlo, cv, t: Dict[str, jnp.ndarray],
                       has_cat: bool, out_dtype: str):
     """[chunk, F] key planes (+ category codes) -> [chunk, F] bin ids.

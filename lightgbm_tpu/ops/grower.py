@@ -91,12 +91,14 @@ bit-identical for ANY shard count — the fast deterministic mode.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compile_ledger import ledger_jit
 from .histogram import (build_histogram_batched_t, build_histogram_sparse,
                         build_histogram_t, key_words, pack_stats,
                         quant_limit, quantize_values, unpack2d)
@@ -107,7 +109,16 @@ from .split import (K_MIN_SCORE, SplitResult, argbest, finalize_split,
 
 
 class GrowerParams(NamedTuple):
-    """Static (compile-time) grower configuration."""
+    """Static (compile-time) grower configuration.
+
+    Shape-stability discipline (ROADMAP item 3): every field here keys a
+    DISTINCT compiled program, so only genuinely structural axes belong —
+    operand shapes/dtypes (num_bins, precision, split_batch, sparse/EFB
+    storage), kernel choice (hist_impl, partition_impl), and collective
+    topology (hist_agg).  Branchless-free boolean switches ride the
+    traced `meta["mode_flags"]` vector instead (quantized rounding mode,
+    leaf refit, CEGB penalty scalars): one `grow` program serves every
+    value of those, bit-identically to the old per-mode closures."""
     num_leaves: int
     num_bins: int          # padded bin-axis size B
     block_rows: int
@@ -137,9 +148,6 @@ class GrowerParams(NamedTuple):
     # is_tree_level=false, serial_tree_learner.cpp:271-319); Bernoulli
     # form of the reference's exact-count sample, like the GOSS sampler
     feature_fraction_bynode: float = 1.0
-    # CEGB (reference cost_effective_gradient_boosting.hpp:21-80): gains
-    # are charged tradeoff * (split penalty + coupled per-feature penalty
-    # for features not yet used anywhere in the model)
     # bins stored packed two-rows-per-byte (reference dense_nbits_bin.hpp,
     # max_bin<=16): halves the histogram row sweep's DMA traffic
     packed_bins: bool = False
@@ -198,6 +206,12 @@ class GrowerParams(NamedTuple):
     # each leaf's rows (LightGBM quantized training's renew-leaf): split
     # DECISIONS stay integer-exact, leaf values regain float precision
     quant_refit: bool = False
+    # frontier-ramp growth factor for the K' pre-round widths (1, s,
+    # s^2, ...): any s >= 2 keeps s^(i-1) >= 2^(i-1) (the frontier bound
+    # after i-1 rounds), so the tree stays BIT-IDENTICAL to the plain
+    # loop at any step.  s=4 halves the unrolled pre-round count — the
+    # "wide" bucket policy's compile-time lever for the grow program
+    ramp_step: int = 2
     # data-axis histogram aggregation (see the module docstring):
     # "psum" replicates the full aggregate on every shard; "scatter"
     # reduce-scatters (lax.psum_scatter) so each shard keeps only its
@@ -226,12 +240,60 @@ def resolve_split_batch(split_batch: int, num_leaves: int) -> int:
     return max(1, num_leaves // 16) if num_leaves < 192 else 25
 
 
+# ---- traced mode switches (meta["mode_flags"]) ---------------------------
+# Layout of the f32 [MF_WIDTH] vector: boolean mode switches and penalty
+# scalars whose branches are branchless-cheap ride the TRACED program
+# instead of keying distinct compiled closures.  Callers that omit the
+# vector (direct grower tests) fall back to the static GrowerParams fields
+# as trace-time constants — the selected values are bit-identical either
+# way, so one `grow` program serves every combination.
+MF_STOCHASTIC, MF_QUANT_REFIT, MF_CEGB_TRADEOFF, MF_CEGB_SPLIT = range(4)
+MF_WIDTH = 4
+
+# the folded fields and their canonical (cache-key) values
+_FOLDED_FIELDS = dict(quant_round="stochastic", quant_refit=False,
+                      cegb_tradeoff=1.0, cegb_penalty_split=0.0)
+
+
+def canonical_params(params: GrowerParams) -> GrowerParams:
+    """Normalize the mode-flag-folded fields so every structurally
+    identical configuration maps onto ONE cached grower program.  Only
+    for callers that supply meta["mode_flags"] (the learner does): the
+    grower never reads the folded fields then."""
+    return params._replace(**_FOLDED_FIELDS)
+
+
+def mode_flags_np(quant_round: str = "stochastic",
+                  quant_refit: bool = False,
+                  cegb_tradeoff: float = 1.0,
+                  cegb_penalty_split: float = 0.0) -> np.ndarray:
+    """Build the meta["mode_flags"] vector for the given mode values."""
+    return np.asarray(
+        [1.0 if str(quant_round) == "stochastic" else 0.0,
+         1.0 if quant_refit else 0.0,
+         float(cegb_tradeoff), float(cegb_penalty_split)], np.float32)
+
+
+def pool_dtype(precision: str):
+    """Histogram pool / accumulation dtype for `precision` — the single
+    definition shared with the learner's donated-pool allocation."""
+    return (jnp.float64 if precision == "f64"
+            else jnp.int32 if precision in ("int8", "int16")
+            else jnp.float32)
+
+
+# meta entries that are NOT per-feature [F'] vectors and must be skipped
+# by feature-axis slicing and by search-slice meta gathers
+NONFEAT_META = ("sparse_idx", "sparse_bin", "hist_perm",
+                "scatter_feat", "cegb_paid", "mode_flags")
+
+
 def make_grower(params: GrowerParams, num_features: int,
                 data_axis: Optional[str] = None,
                 feature_axis: Optional[str] = None,
                 voting_k: int = 0, num_shards: int = 1, jit: bool = True,
                 num_columns: Optional[int] = None,
-                debug_hist: bool = False):
+                debug_hist: bool = False, external_pool: bool = False):
     """Build the whole-tree grower for fixed shapes/params.
 
     num_features is the LOCAL feature count: with `feature_axis` set it is
@@ -247,7 +309,33 @@ def make_grower(params: GrowerParams, num_features: int,
     histograms psum over `data`, per-shard bests all_gather+argmax over
     `feature`, and the scalar leaf sums reduce over `data` only (rows are
     replicated across feature shards).
-    """
+
+    Growers are MEMOIZED on every argument: two calls with identical
+    configuration return the SAME (jitted) callable, so a second learner
+    of the same shape reuses the first one's compiled executables instead
+    of re-tracing a fresh closure — the retrace-elimination half of
+    ROADMAP item 3 (the zoo was never the one big program, but every
+    Booster construction silently re-compiling it).
+
+    external_pool=True adds an 8th `pool` argument (the [L, G/P, B, 3]
+    histogram pool in `pool_dtype(precision)`, donated when jit=True):
+    the grower zeroes and refills it IN PLACE and returns it as
+    out["pool"], so XLA aliases one pool allocation across iterations
+    instead of allocating a fresh pool per tree."""
+    return _build_grower(params, num_features, data_axis, feature_axis,
+                         voting_k, num_shards, jit, num_columns,
+                         debug_hist, external_pool)
+
+
+# bounded: the key includes dataset-shape-derived fields (block_rows,
+# num_features), so an unbounded cache would pin one compiled grower per
+# distinct shape for the process lifetime in long-lived sweep/serving
+# processes.  64 spans any realistic concurrent working set; eviction
+# only costs a re-trace on the next same-shaped construction.
+@functools.lru_cache(maxsize=64)
+def _build_grower(params, num_features, data_axis, feature_axis,
+                  voting_k, num_shards, jit, num_columns, debug_hist,
+                  external_pool):
     if voting_k and not data_axis:
         raise ValueError("voting requires a data axis")
     if voting_k and feature_axis:
@@ -306,6 +394,13 @@ def make_grower(params: GrowerParams, num_features: int,
     if params.hist_agg not in ("psum", "scatter"):
         raise ValueError(f"hist_agg={params.hist_agg!r}; expected psum or "
                          "scatter (the learner resolves 'auto' upstream)")
+    if external_pool and voting_k:
+        raise ValueError("external (donated) histogram pools do not "
+                         "compose with voting (its pool is shard-LOCAL "
+                         "by design and cannot be a global array)")
+    if params.ramp_step < 2:
+        raise ValueError(f"ramp_step={params.ramp_step}; the frontier "
+                         "bound needs a growth factor >= 2")
     # scatter aggregation: active only with a real (>1) data axis.  In
     # plain data / data_feature modes the POOL is scattered (each shard
     # holds its G/P column slice); voting keeps the pool local and
@@ -434,7 +529,20 @@ def make_grower(params: GrowerParams, num_features: int,
              row_mask: jnp.ndarray,     # [n_pad] f32 (bagging x padding)
              feature_mask: jnp.ndarray,  # [F] f32 ([F_global] w/ feature_axis)
              meta: Dict[str, jnp.ndarray],
-             key: jnp.ndarray):         # PRNG key (per-node sampling)
+             key: jnp.ndarray,          # PRNG key (per-node sampling)
+             pool_buf: Optional[jnp.ndarray] = None):  # donated pool
+        #                                 (external_pool only; see above)
+        # traced mode switches: present whenever the learner built the
+        # meta (one program serves every value); direct callers without
+        # the vector fall back to the static params fields as trace-time
+        # constants — bit-identical selected values either way
+        mf = meta.get("mode_flags")
+
+        def mode_flag(idx: int, static_val: float) -> jnp.ndarray:
+            if mf is not None:
+                return mf[idx]
+            return jnp.float32(static_val)
+
         # rows come from grad, NOT bins_t: with packed (4-bit) storage the
         # bin matrix holds two rows per byte
         n_pad = grad.shape[0]
@@ -449,7 +557,8 @@ def make_grower(params: GrowerParams, num_features: int,
             def fslice(a):
                 return jax.lax.dynamic_slice_in_dim(a, ax * F, F)
 
-            meta_local = {k: fslice(v) for k, v in meta.items()}
+            meta_local = {k: (v if k in NONFEAT_META else fslice(v))
+                          for k, v in meta.items()}
             # bins arrive REPLICATED [F_global, n] (the reference's
             # all-data-on-all-machines feature mode): histogram only this
             # shard's feature slice; the partition reads the full matrix
@@ -537,6 +646,13 @@ def make_grower(params: GrowerParams, num_features: int,
             return fix_sparse_bins(hist, meta_local["is_sparse"] > 0,
                                    meta_local["default_bin"], totals)
 
+        # CEGB penalty scalars ride the traced mode-flag vector: changing
+        # cegb_tradeoff / cegb_penalty_split between runs no longer keys
+        # a fresh compiled program (the per-feature penalties were always
+        # traced via meta["cegb_coupled"/"cegb_lazy"])
+        cegb_tradeoff = mode_flag(MF_CEGB_TRADEOFF, params.cegb_tradeoff)
+        cegb_split_pen = mode_flag(MF_CEGB_SPLIT, params.cegb_penalty_split)
+
         def cegb_delta(used, cnt, unpaid=None):
             """[M, FG] per-leaf gain charge (DetlaGain,
             cost_effective_gradient_boosting.hpp:50-62): the split
@@ -544,20 +660,15 @@ def make_grower(params: GrowerParams, num_features: int,
             acquisition penalty for features the model has not used yet,
             and (lazy mode) the per-row on-demand cost for rows that
             have not paid for the feature."""
-            d = (params.cegb_penalty_split * cnt[:, None]
+            d = (cegb_split_pen * cnt[:, None]
                  + meta["cegb_coupled"][None, :] * (1.0 - used)[None, :])
             if unpaid is not None:
                 d = d + meta["cegb_lazy"][None, :] * unpaid
-            return params.cegb_tradeoff * d
+            return cegb_tradeoff * d
 
         def apply_delta(gain_vec, delta):
             return jnp.where(gain_vec > K_MIN_SCORE / 2, gain_vec - delta,
                              gain_vec)
-
-        # meta entries that are NOT per-feature [F'] vectors and must be
-        # skipped when gathering a search slice's meta
-        NONFEAT_META = ("sparse_idx", "sparse_bin", "hist_perm",
-                        "scatter_feat", "cegb_paid")
 
         def sync_best(res: SplitResult, gfeat, axis) -> SplitResult:
             """Global best split from per-shard bests: all_gather ONE tiny
@@ -794,10 +905,18 @@ def make_grower(params: GrowerParams, num_features: int,
             seed_a, seed_b = key_words(jax.random.fold_in(key, 0x5154))
             row0 = (jax.lax.axis_index(data_axis) * n_pad if data_axis
                     else 0)
-            g_q = quantize_values(g, g_scale, qmax, params.quant_round,
-                                  seed_a, seed_b, row0, salt=0x9E3779B9)
-            h_q = quantize_values(h, h_scale, qmax, params.quant_round,
-                                  seed_a, seed_b, row0, salt=0x85EBCA6B)
+            # rounding mode as a traced flag: stochastic and nearest are
+            # both elementwise-cheap, so ONE program serves either (the
+            # old static `mode` keyed a distinct compile per value)
+            sto = mode_flag(MF_STOCHASTIC,
+                            1.0 if params.quant_round == "stochastic"
+                            else 0.0)
+            g_q = quantize_values(g, g_scale, qmax, "stochastic",
+                                  seed_a, seed_b, row0, salt=0x9E3779B9,
+                                  stochastic=sto)
+            h_q = quantize_values(h, h_scale, qmax, "stochastic",
+                                  seed_a, seed_b, row0, salt=0x85EBCA6B,
+                                  stochastic=sto)
             qscale = jnp.stack([g_scale, h_scale, jnp.float32(1.0)])
 
             def dequant(hh):
@@ -930,13 +1049,24 @@ def make_grower(params: GrowerParams, num_features: int,
         # keeps f64 HistogramBinEntry end to end (bin.h:33-40).  Int
         # precisions keep the pool in int32 so sibling subtraction stays
         # EXACT (and reduction-order invariant) until select() rescales.
-        hist_t = (jnp.float64 if precision == "f64"
-                  else jnp.int32 if quantized else jnp.float32)
+        hist_t = pool_dtype(precision)
+        if external_pool:
+            # donated scratch: the buffer arrives holding the PREVIOUS
+            # iteration's pool, so zero it in place before seeding the
+            # root slot — XLA aliases the donated input buffer, so one
+            # pool allocation serves every iteration
+            if pool_buf.shape != (L, SG, B, 3) or pool_buf.dtype != hist_t:
+                raise ValueError(
+                    f"external pool must be {(L, SG, B, 3)} {hist_t}; got "
+                    f"{pool_buf.shape} {pool_buf.dtype}")
+            pool0 = pool_buf.at[:].set(0).at[0].set(root_hist)
+        else:
+            pool0 = jnp.zeros((L, SG, B, 3), hist_t).at[0].set(root_hist)
         state = {
             "leaf_ids": jnp.zeros(n_pad, jnp.int32),
             # under scatter aggregation the pool holds ONLY this shard's
             # G/P column slice — the P× per-shard HBM saving
-            "pool": jnp.zeros((L, SG, B, 3), hist_t).at[0].set(root_hist),
+            "pool": pool0,
             "leaf_sum_g": jnp.zeros(L, jnp.float32).at[0].set(sum_g),
             "leaf_sum_h": jnp.zeros(L, jnp.float32).at[0].set(sum_h),
             "leaf_cnt": jnp.zeros(L, jnp.float32).at[0].set(cnt),
@@ -1283,7 +1413,7 @@ def make_grower(params: GrowerParams, num_features: int,
                 # ([L, F] SplitInfo, splits_per_leaf_) does not exist in
                 # the batched-frontier design
                 newly = used - prev_used
-                credit = (params.cegb_tradeoff
+                credit = (cegb_tradeoff
                           * meta["cegb_coupled"][state["bs_feat"]]
                           * newly[state["bs_feat"]])
                 live = state["bs_gain"] > K_MIN_SCORE / 2
@@ -1456,20 +1586,27 @@ def make_grower(params: GrowerParams, num_features: int,
             # is bit-identical — only the dead-slot contraction work goes.
             # bynode is excluded: its per-child RNG draw shapes follow the
             # round width, which would change the sampled masks.
+            # ramp_step > 2 (the "wide" bucket policy) still covers the
+            # frontier (s^i >= 2^i) with fewer unrolled pre-rounds — the
+            # grow program's own compile-time lever.
             kr = 1
             while kr < K:
                 state = body(state, round_k=kr)
-                kr *= 2
+                kr *= int(params.ramp_step)
 
         state = jax.lax.while_loop(cond, body, state)
-        if quantized and params.quant_refit:
+        if quantized:
             # leaf-value refit: the tree STRUCTURE came from integer
             # histograms; the final outputs come from the true f32
             # grad/hess sums over each leaf's rows, so leaf values carry
             # no quantization error (LightGBM quantized training's
             # renew-leaf).  f32 psum here is the one reduction whose
             # shard-order ulps can reach the model — turn refit off for
-            # strictly bitwise cross-shard model files.
+            # strictly bitwise cross-shard model files.  The on/off
+            # switch is a TRACED flag (two [L] scatters + a psum are
+            # branchless-cheap), so refit on/off shares one program.
+            refit_on = mode_flag(MF_QUANT_REFIT,
+                                 1.0 if params.quant_refit else 0.0)
             rg = preduce_scalar(
                 jnp.zeros(L, jnp.float32).at[state["leaf_ids"]].add(g))
             rh = preduce_scalar(
@@ -1478,8 +1615,9 @@ def make_grower(params: GrowerParams, num_features: int,
                 leaf_output(rg, rh + jnp.float32(2e-15), params.l1,
                             params.l2, params.max_delta_step),
                 state["leaf_min"], state["leaf_max"])
-            state["leaf_output"] = jnp.where(state["leaf_cnt"] > 0,
-                                             refit, state["leaf_output"])
+            state["leaf_output"] = jnp.where(
+                (state["leaf_cnt"] > 0) & (refit_on > 0),
+                refit, state["leaf_output"])
         out = {
             "records": state["records"][:L - 1],  # [L-1, W], REC_* indices
             "leaf_ids": state["leaf_ids"],
@@ -1487,6 +1625,10 @@ def make_grower(params: GrowerParams, num_features: int,
             "leaf_cnt": state["leaf_cnt"],
             "leaf_sum_h": state["leaf_sum_h"],
         }
+        if external_pool:
+            # the (donated, in-place) pool rides back to the caller so
+            # the next iteration rewrites the same allocation
+            out["pool"] = state["pool"]
         if params.has_cegb:
             # cross-tree CEGB state (the learner threads it into the next
             # tree's meta, matching the reference's learner-lifetime
@@ -1505,7 +1647,13 @@ def make_grower(params: GrowerParams, num_features: int,
             out["root_hist"] = root_hist
         return out
 
-    return jax.jit(grow) if jit else grow
+    if not jit:
+        return grow
+    # the grower's own jit site rides the compile ledger so
+    # `tools/perf_probe.py retrace` can attribute every compiled program;
+    # with an external pool the 8th arg is donated (in-place reuse)
+    jit_kw = {"donate_argnums": (7,)} if external_pool else {}
+    return ledger_jit(grow, site="grower.grow", **jit_kw)
 
 
 # record-row field indices (see `rec` stack in make_grower.body); rows are

@@ -43,6 +43,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.compile_ledger import ledger_jit
+
 # --------------------------------------------------------------------------
 # Quantized-gradient support (tpu_hist_precision=int16|int8)
 # --------------------------------------------------------------------------
@@ -118,8 +120,8 @@ def key_words(key: jnp.ndarray):
 
 
 def quantize_values(x: jnp.ndarray, scale, qmax: int, mode: str,
-                    seed_a=0, seed_b=0, row_offset=0, salt: int = 0
-                    ) -> jnp.ndarray:
+                    seed_a=0, seed_b=0, row_offset=0, salt: int = 0,
+                    stochastic=None) -> jnp.ndarray:
     """f32 [n] -> int32 grid values in [-qmax, qmax]: x ~= result * scale.
 
     mode="stochastic" rounds floor(q) up with probability frac(q) —
@@ -127,15 +129,25 @@ def quantize_values(x: jnp.ndarray, scale, qmax: int, mode: str,
     seed words; the randomness comes from `hashed_uniform` over GLOBAL
     row indices (row_offset = this shard's first global row), so the
     rounded values are identical under any row sharding.
-    mode="nearest" is plain round-half-to-even."""
+    mode="nearest" is plain round-half-to-even.
+
+    `stochastic` (optional TRACED scalar, >0 = stochastic) folds the
+    rounding-mode switch into the program instead of keying a distinct
+    compile on `mode`: both roundings are elementwise-cheap, so ONE
+    program serves either value (the grower passes its traced mode flag
+    here; `mode` is ignored then).  Each selected branch is bit-identical
+    to the corresponding static `mode`."""
     q = jnp.clip(x / scale, -float(qmax), float(qmax))
-    if mode == "nearest":
+    if stochastic is None and mode == "nearest":
         return jnp.rint(q).astype(jnp.int32)
     fl = jnp.floor(q)
     idx = (jnp.arange(x.shape[0], dtype=jnp.uint32)
            + jnp.asarray(row_offset).astype(jnp.uint32))
     r = hashed_uniform(idx, seed_a, seed_b, salt)
-    return (fl + (r < (q - fl))).astype(jnp.int32)
+    sto = (fl + (r < (q - fl))).astype(jnp.int32)
+    if stochastic is None:
+        return sto
+    return jnp.where(stochastic > 0, sto, jnp.rint(q).astype(jnp.int32))
 
 
 def bench_hist_operands(bins_np: np.ndarray, precision: str, block: int,
@@ -218,7 +230,8 @@ def _unpack_hist(raw: jnp.ndarray, precision: str) -> jnp.ndarray:
     return jnp.stack([g, h, c], axis=-1)
 
 
-@functools.partial(jax.jit, static_argnames=("num_bins", "block_rows", "precision"))
+@ledger_jit(site="histogram.build",
+            static_argnames=("num_bins", "block_rows", "precision"))
 def build_histogram(bins: jnp.ndarray, stats: jnp.ndarray, num_bins: int,
                     block_rows: int = 16384, precision: str = "hilo"
                     ) -> jnp.ndarray:

@@ -27,7 +27,6 @@ the callers' fixed row chunking.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -36,48 +35,88 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils.compile_ledger import ledger_jit
+
 # table keys that are [T, num_internal_nodes] int32
 _NODE_KEYS = ("split_feature", "threshold", "decision_type",
               "left_child", "right_child", "cat_start", "cat_width")
 
+# ---- launch-shape bucket policy -------------------------------------------
+# The ONE quantization rule shared by training-time score replay, the
+# chunked predict path, serving warmup enumeration, and bench — so every
+# layer agrees on which launch shapes can exist and a warmup can
+# pre-compile exactly the set a request can trigger.
+#
+#   "wide" (tpu_bucket_policy default): rows pad to {4096, 16384, chunk}
+#     (x4 steps from a 4096 floor), depth trip counts to powers of two
+#     floored at 8.  Strictly fewer programs than "fine" — the compile
+#     bill for a full predict-size sweep drops from 7 programs to 3 at
+#     the default 65536 chunk — at the cost of up to 4x padded rows on
+#     small batches (predict work is row-linear, compile is per-shape).
+#   "fine": the pre-round-6 behavior — power-of-two rows from 1024,
+#     exact power-of-two depth buckets.  Pick it when small-batch
+#     predict latency matters more than cold-start compiles.
+BUCKET_POLICIES = ("fine", "wide")
+_ROW_FLOOR = {"fine": 1024, "wide": 4096}
+_ROW_STEP = {"fine": 2, "wide": 4}
+_DEPTH_FLOOR = {"fine": 1, "wide": 8}
 
-def _depth_bucket(depth: int) -> int:
-    """Round the fori_loop trip count up to a power of two so growing
-    trees reuse a handful of compiled programs instead of one per depth."""
-    d = max(int(depth), 1)
+
+def _check_policy(policy: str) -> str:
+    if policy not in BUCKET_POLICIES:
+        raise ValueError(f"tpu_bucket_policy={policy!r}; expected one of "
+                         f"{BUCKET_POLICIES}")
+    return policy
+
+
+def _depth_bucket(depth: int, policy: str = "wide") -> int:
+    """Round the fori_loop trip count up to a power of two (floored at 8
+    under the wide policy) so growing trees reuse a handful of compiled
+    programs instead of one per depth."""
+    _check_policy(policy)
+    d = max(int(depth), _DEPTH_FLOOR[policy])
     return 1 << (d - 1).bit_length()
 
 
-def row_bucket(rows: int, chunk: int, min_rows: int = 1024) -> int:
+def row_bucket(rows: int, chunk: int, min_rows: int = 0,
+               policy: str = "wide") -> int:
     """Padded row count for one device-predict launch.
 
-    The row-axis analog of `_depth_bucket`: launches are padded up to a
-    power of two (floored at `min_rows`, capped at the caller's chunk
-    size) so predicts of arbitrary batch sizes reuse a handful of
-    compiled programs instead of one per distinct n.  Every
-    `forest_leaf_values`/`forest_class_scores` caller that wants a
-    bounded compile cache must pad through this ONE formula — the
-    serving batcher sizes its warmup sweep from it."""
+    The row-axis analog of `_depth_bucket`: launches are padded up to
+    the next bucket of the policy's geometric ladder (floored at the
+    policy's minimum, capped at the caller's chunk size) so predicts of
+    arbitrary batch sizes reuse a handful of compiled programs instead
+    of one per distinct n.  Every `forest_leaf_values` /
+    `forest_class_scores` caller that wants a bounded compile cache must
+    pad through this ONE formula — the serving warmup enumerates its
+    sweep from it."""
+    _check_policy(policy)
     rows = max(int(rows), 1)
     if rows >= chunk:
         return chunk
-    return min(chunk, max(min_rows, 1 << (rows - 1).bit_length()))
+    floor = max(int(min_rows), _ROW_FLOOR[policy])
+    step = _ROW_STEP[policy]
+    b = floor
+    while b < rows:
+        b *= step
+    return min(chunk, b)
 
 
-def predict_row_buckets(max_rows: int, chunk: int,
-                        min_rows: int = 1024) -> List[int]:
+def predict_row_buckets(max_rows: int, chunk: int, min_rows: int = 0,
+                        policy: str = "wide") -> List[int]:
     """Ascending distinct launch shapes `row_bucket` can produce for
     predicts of 1..max_rows rows — the exact set a serving warmup must
     pre-compile so no request size triggers a cold jit."""
+    _check_policy(policy)
     out: List[int] = []
-    b = min_rows
+    b = max(int(min_rows), _ROW_FLOOR[policy])
     while True:
         bucket = min(b, chunk)
         if bucket not in out:
             out.append(bucket)
         if b >= max_rows or bucket >= chunk:
             break
-        b <<= 1
+        b *= _ROW_STEP[policy]
     return out
 
 
@@ -155,9 +194,8 @@ def device_tables(tables: Dict[str, np.ndarray]) -> Dict[str, jnp.ndarray]:
     return {k: jnp.asarray(v) for k, v in tables.items()}
 
 
-@partial(jax.jit, static_argnames=("depth", "has_cat"))
-def _leaf_values_kernel(tables, bins, num_bin, default_bin, missing_type,
-                        depth: int, has_cat: bool):
+def _leaf_values_impl(tables, bins, num_bin, default_bin, missing_type,
+                      depth: int, has_cat: bool):
     """[T, n] f32 leaf values: every tree walked over every row.
 
     bins is [n, F] int32 (the TrainingData.device_bins layout); the
@@ -205,12 +243,20 @@ def _leaf_values_kernel(tables, bins, num_bin, default_bin, missing_type,
     return jnp.take_along_axis(tables["leaf_value"], leaf, axis=1)
 
 
-@partial(jax.jit, static_argnames=("depth", "has_cat", "k"))
+# the standalone jitted entry; `_class_scores_kernel` inlines the impl
+# directly so the ledger never counts an under-trace call as a program
+_leaf_values_kernel = ledger_jit(
+    _leaf_values_impl, site="predict.leaf_values",
+    static_argnames=("depth", "has_cat"))
+
+
+@ledger_jit(site="predict.class_scores",
+            static_argnames=("depth", "has_cat", "k"))
 def _class_scores_kernel(tables, bins, num_bin, default_bin, missing_type,
                          scale, depth: int, has_cat: bool, k: int):
     """[k, n] f32 per-class raw scores: tree i adds to class i % k."""
-    vals = _leaf_values_kernel(tables, bins, num_bin, default_bin,
-                               missing_type, depth, has_cat) * scale
+    vals = _leaf_values_impl(tables, bins, num_bin, default_bin,
+                             missing_type, depth, has_cat) * scale
     T = vals.shape[0]
     if k == 1:
         return vals.sum(axis=0, keepdims=True)
@@ -226,24 +272,27 @@ def feature_meta_dev(meta) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
 
 
 def forest_leaf_values(tables_dev: Dict[str, jnp.ndarray], bins_dev,
-                       meta_dev, depth: int) -> jnp.ndarray:
+                       meta_dev, depth: int,
+                       policy: str = "wide") -> jnp.ndarray:
     """[T, n] device leaf values.  `bins_dev` is [n, F] int32 (the
     TrainingData.device_bins layout); `meta_dev` the
     (num_bin, default_bin, missing_type) triple from `feature_meta_dev`."""
     nb, db, mt = meta_dev
     has_cat = int(tables_dev["cat_words"].shape[0]) > 1
     return _leaf_values_kernel(tables_dev, bins_dev, nb, db, mt,
-                               _depth_bucket(depth), has_cat)
+                               _depth_bucket(depth, policy), has_cat)
 
 
 def forest_class_scores(tables_dev: Dict[str, jnp.ndarray], bins_dev,
                         meta_dev, k: int, depth: int,
-                        scale: float = 1.0) -> jnp.ndarray:
+                        scale: float = 1.0,
+                        policy: str = "wide") -> jnp.ndarray:
     """[k, n] device per-class raw scores (tree i -> class i % k)."""
     nb, db, mt = meta_dev
     has_cat = int(tables_dev["cat_words"].shape[0]) > 1
     return _class_scores_kernel(tables_dev, bins_dev, nb, db, mt,
-                                jnp.float32(scale), _depth_bucket(depth),
+                                jnp.float32(scale),
+                                _depth_bucket(depth, policy),
                                 has_cat, int(k))
 
 

@@ -56,13 +56,16 @@ def shard_map(f, **kwargs):
     return _shard_map_impl(f, **kwargs)
 
 
+import functools
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.grower import GrowerParams, make_grower
+from ..utils.compile_ledger import ledger_jit
 
 META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty",
              "is_categorical", "cegb_coupled", "cegb_lazy", "bundle_idx",
-             "bin_offset", "needs_fix")
+             "bin_offset", "needs_fix", "mode_flags")
 
 _CANON = {
     "serial": "serial",
@@ -86,21 +89,66 @@ def resolve_tree_learner(name: str) -> str:
         raise ValueError(f"unknown tree_learner {name!r}") from None
 
 
+def pool_partition_spec(strategy: str, scatter: bool) -> P:
+    """Partition spec of the GLOBAL [L, G, B, 3] histogram pool under
+    `strategy` — the donated external pool's placement.  The column axis
+    shards exactly like the slices the grower keeps per shard: the full
+    width under psum (replicated), the contiguous G/P slice under
+    scatter, the feature slice under feature sharding (feature-major /
+    data-minor in the 2-D mesh)."""
+    if strategy in ("data", "voting"):
+        return P(None, "data") if scatter else P()
+    if strategy == "feature":
+        return P(None, "feature")
+    if strategy == "data_feature":
+        return (P(None, ("feature", "data")) if scatter
+                else P(None, "feature"))
+    return P()
+
+
 def make_strategy_grower(params: GrowerParams, num_features: int,
                          strategy: str, mesh: Optional[Mesh] = None,
                          voting_k: int = 20,
                          num_columns: Optional[int] = None,
-                         debug_hist: bool = False):
+                         debug_hist: bool = False,
+                         external_pool: bool = False):
     """Grower for `strategy`; num_features is the GLOBAL (padded) count;
     num_columns the bin-matrix column count (< num_features under EFB).
 
     debug_hist adds a "root_hist" output (the GPU_DEBUG_COMPARE analog,
     reference gpu_tree_learner.cpp:995-1020): per-shard LOCAL in voting
     mode (out axis 0 stacks shards), psum'd/replicated in data mode, the
-    feature slice stacked to global width in feature modes."""
+    feature slice stacked to global width in feature modes.
+
+    external_pool adds the donated 8th `pool` argument (ops/grower.py
+    make_grower) — the global [L, G, B, 3] pool placed per
+    `pool_partition_spec` and rewritten in place every call.  Strategy
+    growers are memoized like the base grower: an identical configuration
+    returns the SAME jitted callable, so repeat Booster constructions
+    reuse compiled executables instead of re-tracing."""
+    return _build_strategy_grower(params, num_features, strategy, mesh,
+                                  voting_k, num_columns, debug_hist,
+                                  external_pool)
+
+
+def _strategy_jit(fn, strategy: str, external_pool: bool):
+    """The ledgered jit site for one sharded strategy (donating the
+    external pool when present)."""
+    kw = {"donate_argnums": (7,)} if external_pool else {}
+    return ledger_jit(fn, site=f"grower.{strategy}", **kw)
+
+
+# bounded like ops/grower.py:_build_grower: the key pins Mesh/device
+# objects and shape-derived params, so cap retention instead of growing
+# one compiled strategy grower per distinct shape forever
+@functools.lru_cache(maxsize=64)
+def _build_strategy_grower(params, num_features, strategy, mesh,
+                           voting_k, num_columns, debug_hist,
+                           external_pool):
     if strategy == "serial" or mesh is None:
         return make_grower(params, num_features, num_columns=num_columns,
-                           debug_hist=debug_hist)
+                           debug_hist=debug_hist,
+                           external_pool=external_pool)
 
     meta_spec = {k: P() for k in META_KEYS}
     base_out = {"records": P(), "leaf_output": P(), "leaf_cnt": P(),
@@ -126,14 +174,17 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         # static shard -> feature-ids table for the scattered EFB search
         # (bundle columns != features); tiny, replicated
         meta_spec["scatter_feat"] = P()
+    pool_spec = pool_partition_spec(strategy, scatter)
     if strategy in ("data", "voting"):
         nshards = mesh.shape["data"]
         grow = make_grower(
             params, num_features, data_axis="data",
             voting_k=(voting_k if strategy == "voting" else 0),
             num_shards=nshards, jit=False, num_columns=num_columns,
-            debug_hist=debug_hist)
+            debug_hist=debug_hist, external_pool=external_pool)
         out_specs = {**base_out, "leaf_ids": P("data")}
+        if external_pool:
+            out_specs["pool"] = pool_spec
         if debug_hist:
             # voting keeps pools local -> stack shards on axis 0; data
             # mode under psum replicates the full histogram on every
@@ -144,13 +195,16 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
             out_specs["root_hist"] = (P("data")
                                       if strategy == "voting" or scatter
                                       else P())
+        in_specs = (P(None, "data"), P("data"), P("data"), P("data"),
+                    P(), meta_spec, P())
+        if external_pool:
+            in_specs = in_specs + (pool_spec,)
         fn = shard_map(
             grow, mesh=mesh,
-            in_specs=(P(None, "data"), P("data"), P("data"), P("data"),
-                      P(), meta_spec, P()),
+            in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False)
-        return jax.jit(fn)
+        return _strategy_jit(fn, strategy, external_pool)
     if strategy == "feature":
         nshards = mesh.shape["feature"]
         if num_features % nshards != 0:
@@ -159,7 +213,8 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
                 f"of the feature-shard count {nshards}")
         f_local = num_features // nshards
         grow = make_grower(params, f_local, feature_axis="feature",
-                           jit=False, debug_hist=debug_hist)
+                           jit=False, debug_hist=debug_hist,
+                           external_pool=external_pool)
         # bins REPLICATED (P()), like the reference feature-parallel mode
         # where every machine holds all data (feature_parallel_tree_
         # learner.cpp:55-71): each shard histograms only its own feature
@@ -167,14 +222,19 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         # per-split column broadcast is needed — the only collective left
         # is the all_gather of per-shard best gains
         out_specs = {**base_out, "leaf_ids": P()}
+        if external_pool:
+            out_specs["pool"] = pool_spec
         if debug_hist:
             out_specs["root_hist"] = P("feature")
+        in_specs = (P(), P(), P(), P(), P(), meta_spec, P())
+        if external_pool:
+            in_specs = in_specs + (pool_spec,)
         fn = shard_map(
             grow, mesh=mesh,
-            in_specs=(P(), P(), P(), P(), P(), meta_spec, P()),
+            in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False)
-        return jax.jit(fn)
+        return _strategy_jit(fn, strategy, external_pool)
     if strategy == "data_feature":
         f_shards = mesh.shape["feature"]
         d_shards = mesh.shape["data"]
@@ -185,25 +245,31 @@ def make_strategy_grower(params: GrowerParams, num_features: int,
         f_local = num_features // f_shards
         grow = make_grower(params, f_local, data_axis="data",
                            feature_axis="feature", num_shards=d_shards,
-                           jit=False, debug_hist=debug_hist)
+                           jit=False, debug_hist=debug_hist,
+                           external_pool=external_pool)
         # rows shard over 'data'; the bin matrix is [F_global, n_local]
         # per device (features replicated within a data shard so the
         # partition reads the full matrix, like the 1-D feature mode);
         # histograms psum over 'data', bests all_gather over 'feature'
         out_specs = {**base_out, "leaf_ids": P("data")}
+        if external_pool:
+            out_specs["pool"] = pool_spec
         if debug_hist:
             # stack feature slices to global; under scatter each feature
             # shard's slice is further scattered over 'data' (feature-
             # major, data-minor — exactly the global feature order)
             out_specs["root_hist"] = (P(("feature", "data")) if scatter
                                       else P("feature"))
+        in_specs = (P(None, "data"), P("data"), P("data"), P("data"),
+                    P(), meta_spec, P())
+        if external_pool:
+            in_specs = in_specs + (pool_spec,)
         fn = shard_map(
             grow, mesh=mesh,
-            in_specs=(P(None, "data"), P("data"), P("data"), P("data"),
-                      P(), meta_spec, P()),
+            in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False)
-        return jax.jit(fn)
+        return _strategy_jit(fn, strategy, external_pool)
     raise ValueError(f"unknown strategy {strategy!r}")
 
 

@@ -28,7 +28,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..config import Config, parse_tristate
-from ..ops.predict import predict_row_buckets, row_bucket
+from ..ops.predict import _depth_bucket, predict_row_buckets, row_bucket
 from .stats import ServingStats
 
 
@@ -46,6 +46,9 @@ class ModelEntry:
         drv._materialize()
         self.num_feature = booster.num_feature()
         self.chunk = drv.predict_chunk_rows()
+        # the driver's own bucket policy governs every launch this entry
+        # makes, so warmup must enumerate with the SAME ladder
+        self.policy = drv.bucket_policy()
         self.max_batch_rows = int(config.serving_max_batch_rows)
         # serving pins the device predictor: 'auto' (native walker on CPU
         # hosts) would defeat the bounded-compile/warmup contract, so it
@@ -71,15 +74,43 @@ class ModelEntry:
         bi = self.booster.best_iteration
         return bi if bi is not None and bi >= 0 else -1
 
-    def warmup(self) -> int:
-        """Pre-compile every launch shape; returns the bucket count."""
+    def warm_signature(self):
+        """Everything that keys this entry's predict programs: two
+        entries with equal signatures trigger byte-identical jit cache
+        keys for every warmup launch, so the registry runs the warmup
+        sweep ONCE per signature — loading a second same-shaped model
+        adds zero compiled programs AND zero warmup wall."""
+        if not self.device_on:
+            return None
+        drv = self.booster._driver
+        ni = self.default_num_iteration()
+        total, _ = drv._model_subset(-1 if ni is None else ni)
+        tables = drv._packed_forest().device(total)
+        shapes = tuple(sorted((k, tuple(v.shape), str(v.dtype))
+                              for k, v in tables.items()))
+        return (self.chunk, self.max_batch_rows, self.policy,
+                self.num_feature, max(drv.num_tree_per_iteration, 1),
+                _depth_bucket(drv._packed_forest().depth, self.policy),
+                shapes)
+
+    def warmup(self, precompiled: bool = False) -> int:
+        """Pre-compile every launch shape; returns the bucket count.
+
+        precompiled=True (another resident entry already warmed an equal
+        `warm_signature`) skips the device launches and only registers
+        the shapes with the stats accounting — the programs exist in the
+        jit cache, so this entry's first real predicts are warm."""
         if not self.device_on:
             return 0
-        buckets = predict_row_buckets(self.max_batch_rows, self.chunk)
+        buckets = predict_row_buckets(self.max_batch_rows, self.chunk,
+                                      policy=self.policy)
         ni = self.default_num_iteration()
         for b in buckets:
-            self.predict(np.zeros((b, self.num_feature), np.float64),
-                         num_iteration=ni, warmup=True)
+            if precompiled:
+                self.stats.note_shape((self.key, ni, b), warmup=True)
+            else:
+                self.predict(np.zeros((b, self.num_feature), np.float64),
+                             num_iteration=ni, warmup=True)
         return len(buckets)
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
@@ -93,7 +124,7 @@ class ModelEntry:
             return self.booster.predict(X, raw_score=raw_score,
                                         num_iteration=ni, device="cpu")
         n = int(X.shape[0])
-        bucket = row_bucket(n, self.chunk)
+        bucket = row_bucket(n, self.chunk, policy=self.policy)
         if not warmup:
             # a batch wider than the predict chunk runs ceil(n/chunk)
             # padded launches inside _chunked_device_scores — account
@@ -132,6 +163,9 @@ class ModelRegistry:
         self._entries: "OrderedDict[str, ModelEntry]" = OrderedDict()
         self._latest: Dict[str, str] = {}   # name -> current key
         self._counts: Dict[str, int] = {}   # name -> loads so far
+        # warm signatures already compiled in this process: a second
+        # same-shaped model load skips the warmup device launches
+        self._warmed: set = set()
 
     # ------------------------------------------------------------------
     def load(self, name: str, model_file: Optional[str] = None,
@@ -174,7 +208,20 @@ class ModelRegistry:
                 ver = str(self._counts[name])
         entry = ModelEntry(name, ver, booster, self.config, self.stats)
         if bool(self.config.serving_warmup):
-            entry.warmup()
+            # dedupe warmup compiles across models sharing a launch-shape
+            # signature (depth bucket, k, table shapes, policy, ...): the
+            # jit cache is process-wide, so a second same-shaped model's
+            # sweep would only re-execute programs that already exist
+            sig = entry.warm_signature()
+            with self._lock:
+                seen = sig is not None and sig in self._warmed
+            entry.warmup(precompiled=seen)
+            # marked warmed only AFTER the sweep succeeds: a failed (or
+            # concurrent, still-compiling) warmup must not make future
+            # same-shaped loads skip theirs and serve cold compiles
+            if sig is not None:
+                with self._lock:
+                    self._warmed.add(sig)
         with self._lock:
             self._entries[entry.key] = entry
             self._entries.move_to_end(entry.key)

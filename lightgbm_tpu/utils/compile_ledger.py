@@ -1,0 +1,206 @@
+"""Retrace audit: a ledger of every XLA program this process compiles.
+
+Compile latency is the single biggest wall-clock lever for training
+restarts and serving cold starts (ROADMAP item 3: the one real TPU bench
+spent 155 s compiling vs ~12 s/iter training).  The enemy is not one big
+program but the *zoo*: every jit site that keys a new trace on a static
+argument or a fresh closure silently multiplies the compile bill, and
+nothing counted them — compile_s only showed the total.
+
+`ledger_jit` wraps a `jax.jit` site so each DISTINCT compiled program
+(new entry in the jit's own executable cache) is recorded once with:
+
+* the site name (one per wrapped jit call site),
+* the first-call wall time (lowering + XLA compile + first execution —
+  for the big grower programs this is compile-dominated),
+* a compact signature of the triggering call (static args + input
+  shapes/dtypes), so `tools/perf_probe.py retrace` can attribute WHICH
+  mode/shape variant added a program.
+
+Overhead discipline: when the ledger is disabled (the default) the
+wrapper costs one attribute check per call and computes nothing; when
+enabled, cache growth is detected via the jit's own `_cache_size()` so
+no per-call signature hashing happens on cache hits.  The wrapper is
+transparent — `lower`, `_cache_size`, etc. delegate to the underlying
+jitted callable, so call sites and tests that poke at jit internals
+keep working.
+
+The module-level `LEDGER` singleton is the process-wide audit surface:
+
+    from lightgbm_tpu.utils.compile_ledger import LEDGER
+    LEDGER.enable(); LEDGER.reset()
+    ... train / predict / serve ...
+    LEDGER.n_programs()        # the n_programs bench metric
+    LEDGER.report()            # per-site breakdown
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import jax
+
+
+def _describe_leaf(x: Any) -> str:
+    """Compact aval-or-value description of one argument leaf."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return f"{dtype}[{','.join(str(d) for d in shape)}]"
+    if isinstance(x, (bool, int, float, str, type(None))):
+        return repr(x)
+    if callable(x):
+        return getattr(x, "__name__", "<fn>")
+    return type(x).__name__
+
+
+def call_signature(args: tuple, kwargs: dict) -> str:
+    """One-line signature of a jit call: static values + array avals.
+
+    Dict args (the grower's meta) list key=aval pairs so mode/shape
+    variants are attributable from the retrace report alone."""
+    parts: List[str] = []
+    for a in args:
+        if isinstance(a, dict):
+            inner = ",".join(f"{k}={_describe_leaf(v)}"
+                             for k, v in sorted(a.items(), key=lambda kv: kv[0]))
+            parts.append("{" + inner + "}")
+        elif isinstance(a, (tuple, list)):
+            parts.append("(" + ",".join(_describe_leaf(v) for v in a) + ")")
+        else:
+            parts.append(_describe_leaf(a))
+    for k in sorted(kwargs):
+        parts.append(f"{k}={_describe_leaf(kwargs[k])}")
+    return "(" + ", ".join(parts) + ")"
+
+
+class CompileLedger:
+    """Thread-safe registry of compiled programs across all wrapped sites."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._enabled = False
+        self._programs: List[Dict] = []
+
+    # -- control -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, on: bool = True) -> None:
+        self._enabled = bool(on)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._programs = []
+
+    # -- recording (called by LedgeredJit) ------------------------------
+    def record(self, site: str, signature: str, wall_s: float) -> None:
+        with self._lock:
+            self._programs.append({"site": site, "signature": signature,
+                                   "first_call_s": wall_s,
+                                   "t": time.time()})
+
+    # -- reading --------------------------------------------------------
+    def n_programs(self, site: Optional[str] = None) -> int:
+        """Programs compiled while enabled (optionally for one site)."""
+        with self._lock:
+            if site is None:
+                return len(self._programs)
+            return sum(1 for p in self._programs if p["site"] == site)
+
+    def programs(self) -> List[Dict]:
+        with self._lock:
+            return [dict(p) for p in self._programs]
+
+    def report(self) -> List[Dict]:
+        """Per-site rollup sorted by total first-call wall, descending."""
+        agg: Dict[str, Dict] = {}
+        for p in self.programs():
+            a = agg.setdefault(p["site"], {"site": p["site"], "programs": 0,
+                                           "first_call_s": 0.0,
+                                           "signatures": []})
+            a["programs"] += 1
+            a["first_call_s"] += p["first_call_s"]
+            a["signatures"].append(p["signature"])
+        return sorted(agg.values(), key=lambda a: -a["first_call_s"])
+
+    def format_report(self) -> str:
+        lines = [f"{'site':<28s} {'programs':>8s} {'first-call s':>12s}"]
+        total_n = total_s = 0
+        for a in self.report():
+            lines.append(f"{a['site']:<28s} {a['programs']:>8d} "
+                         f"{a['first_call_s']:>12.2f}")
+            total_n += a["programs"]
+            total_s += a["first_call_s"]
+        lines.append(f"{'TOTAL (n_programs)':<28s} {total_n:>8d} "
+                     f"{total_s:>12.2f}")
+        return "\n".join(lines)
+
+
+LEDGER = CompileLedger()
+
+
+class LedgeredJit:
+    """`jax.jit` plus per-program ledger recording.
+
+    New-program detection uses the jitted callable's own `_cache_size()`
+    (the executable cache the jit keys on static args + avals), so the
+    ledger can never disagree with what jax actually compiled.  When
+    `_cache_size` is unavailable (older jax), every call while enabled
+    falls back to signature bookkeeping in the ledger itself.
+    """
+
+    def __init__(self, fn, site: Optional[str] = None, **jit_kwargs):
+        self._fn = jax.jit(fn, **jit_kwargs)
+        self.site = site or getattr(fn, "__name__", "<fn>")
+        self._seen_sigs = set()
+        # serializes the (cache-size, call, cache-size) window while the
+        # ledger is ENABLED: without it, a thread's cache-hit call that
+        # overlaps another thread's compile observes the cache growing
+        # and double-records the program.  The disabled path (default,
+        # production serving) never touches the lock.
+        self._lock = threading.Lock()
+
+    def _cache_len(self) -> Optional[int]:
+        try:
+            return int(self._fn._cache_size())
+        except Exception:
+            return None
+
+    def __call__(self, *args, **kwargs):
+        if not LEDGER.enabled:
+            return self._fn(*args, **kwargs)
+        with self._lock:
+            before = self._cache_len()
+            t0 = time.perf_counter()
+            out = self._fn(*args, **kwargs)
+            after = self._cache_len()
+            if before is None:
+                sig = call_signature(args, kwargs)
+                if sig not in self._seen_sigs:
+                    self._seen_sigs.add(sig)
+                    LEDGER.record(self.site, sig,
+                                  time.perf_counter() - t0)
+            elif after is not None and after > before:
+                LEDGER.record(self.site, call_signature(args, kwargs),
+                              time.perf_counter() - t0)
+        return out
+
+    def __getattr__(self, name):
+        # transparent delegation (lower/_cache_size/clear_cache/...)
+        return getattr(self._fn, name)
+
+
+def ledger_jit(fn=None, *, site: Optional[str] = None, **jit_kwargs):
+    """Drop-in `jax.jit` replacement that records programs in LEDGER.
+
+    Usable as a decorator (`@ledger_jit(site=..., static_argnames=...)`)
+    or a call (`ledger_jit(f, site=...)`)."""
+    if fn is None:
+        def deco(f):
+            return LedgeredJit(f, site=site, **jit_kwargs)
+        return deco
+    return LedgeredJit(fn, site=site, **jit_kwargs)

@@ -1,0 +1,338 @@
+"""Shape-stable programs (ROADMAP item 3 / ISSUE 6): the compile ledger,
+grower memoization, buffer donation, and the launch-shape bucket policy.
+
+The contract under test:
+
+* a canonical binary train + predict + serve lifecycle on the default
+  configuration compiles an EXACT, small set of ledgered programs;
+* re-running an identical training in-process compiles nothing new (the
+  grower/strategy memoization reuses the jitted executables);
+* buffer donation (tpu_donate_buffers) is bit-invisible: model files are
+  identical with donation on or off, serial and sharded, and the int8
+  cross-shard-count bitwise guarantee survives with donation enabled
+  (the existing slow shard sweeps in test_sharded_agg/test_quantized now
+  run WITH donation by default — this file keeps a fast 1/2-shard gate);
+* the serving registry dedupes warmup across same-shaped models: loading
+  a second model with an equal warm signature adds ZERO compiled
+  programs (asserted on the predict kernel's own jit cache);
+* the `wide` bucket policy produces strictly fewer launch shapes than
+  `fine`, through the ONE shared ladder in ops/predict.py;
+* `tools/perf_probe.py retrace` (the tier-1 retrace smoke at the bottom)
+  keeps the lifecycle's n_programs under a hard bound, so a PR that
+  doubles the program zoo fails loudly instead of silently inflating
+  compile_s.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.models.learner import TPUTreeLearner
+from lightgbm_tpu.ops.grower import (GrowerParams, canonical_params,
+                                     make_grower, mode_flags_np)
+from lightgbm_tpu.ops.predict import (_depth_bucket, predict_row_buckets,
+                                      row_bucket)
+from lightgbm_tpu.utils.compile_ledger import LEDGER, ledger_jit
+
+
+def _data(n=3100, f=9, seed=11):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.4 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+# deliberately off-beat shapes (47 bins, 13 leaves) so no other test
+# module warms these jit caches first — the exact-count assertions
+# depend on this file doing the first compile of its own configuration
+P_LIFE = {"objective": "binary", "num_leaves": 13, "max_bin": 47,
+          "min_data_in_leaf": 5, "tpu_block_rows": 512, "verbosity": -1}
+
+
+@pytest.fixture
+def ledger():
+    LEDGER.enable()
+    LEDGER.reset()
+    try:
+        yield LEDGER
+    finally:
+        LEDGER.enable(False)
+
+
+class TestLedgerUnit:
+    def test_counts_programs_not_calls(self, ledger):
+        calls = []
+
+        @ledger_jit(site="unit.f", static_argnames=("k",))
+        def f(x, k: int):
+            calls.append(1)
+            return x * k
+
+        f(jnp.ones(8), k=2)
+        f(jnp.ones(8), k=2)          # cache hit: not a new program
+        f(jnp.ones(8), k=3)          # new static value: new program
+        f(jnp.ones(16), k=3)         # new aval: new program
+        assert ledger.n_programs("unit.f") == 3
+        rep = {a["site"]: a["programs"] for a in ledger.report()}
+        assert rep["unit.f"] == 3
+
+    def test_disabled_ledger_records_nothing(self):
+        LEDGER.enable(False)
+        LEDGER.reset()
+
+        @ledger_jit(site="unit.g")
+        def g(x):
+            return x + 1
+
+        g(jnp.ones(4))  # compiles, but the disabled ledger records nothing
+        assert LEDGER.n_programs() == 0
+
+    def test_wrapper_delegates_jit_internals(self):
+        f = ledger_jit(lambda x: x * 2, site="unit.h")
+        f(jnp.ones(4))
+        # transparent delegation: the serving tests poke _cache_size()
+        assert f._cache_size() >= 1
+
+
+class TestBucketPolicy:
+    def test_wide_ladder_is_strictly_smaller(self):
+        chunk = 65536
+        wide = predict_row_buckets(chunk, chunk, policy="wide")
+        fine = predict_row_buckets(chunk, chunk, policy="fine")
+        assert wide == [4096, 16384, 65536]
+        assert fine == [1024, 2048, 4096, 8192, 16384, 32768, 65536]
+        assert len(wide) < len(fine)
+        # row_bucket lands every n on its policy's ladder
+        for n in (1, 100, 4096, 4097, 20000, 65536, 70000):
+            assert row_bucket(n, chunk, policy="wide") in wide
+            assert row_bucket(n, chunk, policy="fine") in fine
+            assert row_bucket(n, chunk, policy="wide") >= min(n, chunk)
+
+    def test_depth_bucket_floors(self):
+        assert [_depth_bucket(d, "wide") for d in (1, 3, 8, 9, 17)] == \
+            [8, 8, 8, 16, 32]
+        assert [_depth_bucket(d, "fine") for d in (1, 3, 8, 9, 17)] == \
+            [1, 4, 8, 16, 32]
+
+    def test_unknown_policy_rejected(self):
+        with pytest.raises(ValueError, match="tpu_bucket_policy"):
+            row_bucket(10, 1024, policy="chunky")
+        X, y = _data(600, 4)
+        config = Config({"objective": "binary",
+                         "tpu_bucket_policy": "chunky"})
+        td = TrainingData.from_matrix(X, y, config)
+        with pytest.raises(ValueError, match="tpu_bucket_policy"):
+            TPUTreeLearner(config, td)
+
+    def test_wide_ramp_step_halves_preround_count(self):
+        X, y = _data(1200, 6, seed=3)
+        cfg = dict(P_LIFE, tpu_split_batch=8)
+        config_w = Config(dict(cfg, tpu_bucket_policy="wide"))
+        lw = TPUTreeLearner(config_w,
+                            TrainingData.from_matrix(X, y, config_w))
+        config_f = Config(dict(cfg, tpu_bucket_policy="fine"))
+        lf = TPUTreeLearner(config_f,
+                            TrainingData.from_matrix(X, y, config_f))
+        assert lw.params.ramp_step == 4 and lf.params.ramp_step == 2
+
+
+class TestCanonicalParams:
+    def test_folded_fields_share_one_grower(self):
+        base = dict(num_leaves=7, num_bins=16, block_rows=256,
+                    precision="hilo", l1=0.0, l2=1.0, max_delta_step=0.0,
+                    min_data_in_leaf=1.0, min_sum_hessian=1e-3,
+                    min_gain_to_split=0.0, max_depth=0)
+        a = GrowerParams(**base, quant_round="stochastic",
+                         cegb_tradeoff=1.0)
+        b = GrowerParams(**base, quant_round="nearest", cegb_tradeoff=3.0)
+        assert canonical_params(a) == canonical_params(b)
+        # memoized: the SAME jitted callable comes back
+        ga = make_grower(canonical_params(a), 4)
+        gb = make_grower(canonical_params(b), 4)
+        assert ga is gb
+
+    def test_mode_flags_vector(self):
+        mf = mode_flags_np(quant_round="nearest", quant_refit=True,
+                           cegb_tradeoff=2.0, cegb_penalty_split=0.5)
+        np.testing.assert_array_equal(mf, [0.0, 1.0, 2.0, 0.5])
+
+
+class TestLifecycleProgramCounts:
+    def test_exact_counts_and_train_twice_compiles_nothing(self, ledger):
+        """The canonical binary train + predict + serve lifecycle on the
+        default (serial, bucketed) configuration: EXACT ledgered program
+        counts, and an identical re-train reuses every executable."""
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _data()
+        ds = lgb.Dataset(X, label=y, params=P_LIFE)
+        bst = lgb.train(P_LIFE, ds, num_boost_round=3,
+                        keep_training_booster=True)
+        # ONE grow program for the whole training run
+        assert ledger.n_programs("grower.grow") == 1
+        after_train = ledger.n_programs()
+
+        # identical second training: the memoized grower (and every
+        # other ledgered site) reuses its compiled executables
+        ds2 = lgb.Dataset(X, label=y, params=P_LIFE)
+        lgb.train(P_LIFE, ds2, num_boost_round=3,
+                  keep_training_booster=True)
+        assert ledger.n_programs() == after_train, (
+            "a second identical train() compiled new programs:\n"
+            + ledger.format_report())
+
+        # serve: warmup compiles exactly the wide policy's bucket ladder
+        # (one 4096-row bucket) for the class-scores kernel
+        sess = ServingSession(params={"serving_max_batch_rows": 4096,
+                                      "verbosity": -1})
+        sess.load("m", booster=bst)
+        got = sess.predict("m", X[:37], raw_score=True)
+        # tpu_predict_device pinned per call: an unqualified device="tpu"
+        # on a CPU host would auto-veto to the native walker and the
+        # comparison would be device-kernel vs f64 walker ulps
+        np.testing.assert_array_equal(
+            got, bst.predict(X[:37], raw_score=True, device="tpu",
+                             tpu_predict_device="true"))
+        sess.close()
+
+        sites = {a["site"]: a["programs"] for a in ledger.report()}
+        assert sites == {"grower.grow": 1, "predict.class_scores": 1}, \
+            ledger.format_report()
+        # the regression gate the tier-1 smoke enforces: the whole
+        # lifecycle stays a countable handful of programs
+        assert ledger.n_programs() <= 4
+
+
+class TestDonationBitwise:
+    def _model_text(self, X, y, **cfg):
+        params = dict(P_LIFE, tpu_shape_buckets=0)
+        params.update(cfg)
+        ds = lgb.Dataset(X, label=y, params=params)
+        bst = lgb.train(params, ds, num_boost_round=3,
+                        keep_training_booster=True)
+        return bst.model_to_string().split("\nparameters:")[0]
+
+    def test_donation_is_bit_invisible_serial(self):
+        X, y = _data(2048, 8, seed=5)
+        on = self._model_text(X, y, tpu_donate_buffers=True)
+        off = self._model_text(X, y, tpu_donate_buffers=False)
+        assert on == off
+
+    def test_int8_shard_bitwise_with_donation(self):
+        """The PR-4/PR-5 guarantee with donation enabled: int8 model
+        files bit-identical serial vs 2/4-shard scatter (the full
+        1/2/4/8 sweep stays in test_sharded_agg's slow tier, which now
+        also runs with donation by default)."""
+        X, y = _data(2048, 8, seed=9)
+        # refit off like the slow shard sweeps: the refit leaf psum is
+        # the one f32 reduction whose shard-order ulps may reach values
+        q = dict(tpu_hist_precision="int8", tpu_donate_buffers=True,
+                 tpu_quant_refit_leaves=False)
+        serial = self._model_text(X, y, **q)
+        for shards in (2,):
+            sharded = self._model_text(X, y, tree_learner="data",
+                                       num_machines=shards, **q)
+            assert serial == sharded, f"int8 mismatch at {shards} shards"
+        # and donation itself changed nothing
+        off = self._model_text(X, y, **{**q, "tpu_donate_buffers": False})
+        assert serial == off
+
+    def test_quant_round_mode_rides_one_program(self, ledger):
+        """The traced rounding-mode flag: nearest vs stochastic share
+        ONE grow program (previously distinct static closures) and still
+        produce different (mode-correct) models."""
+        X, y = _data(1600, 7, seed=13)
+        # refit off: refit recomputes leaf values from TRUE f32 sums, so
+        # with identical structures the two modes' models could coincide
+        params = dict(P_LIFE, tpu_hist_precision="int16",
+                      tpu_quant_refit_leaves=False)
+
+        def run(round_mode):
+            p = dict(params, tpu_quant_round=round_mode)
+            ds = lgb.Dataset(X, label=y, params=p)
+            bst = lgb.train(p, ds, num_boost_round=2,
+                            keep_training_booster=True)
+            return bst.model_to_string().split("\nparameters:")[0]
+
+        a = run("stochastic")
+        grower_programs = ledger.n_programs("grower.grow")
+        b = run("nearest")
+        assert ledger.n_programs("grower.grow") == grower_programs, \
+            "flipping tpu_quant_round compiled a NEW grow program"
+        assert a != b  # the traced flag actually changes the rounding
+
+
+class TestServingWarmupDedupe:
+    def test_second_same_shaped_model_adds_zero_programs(self):
+        from lightgbm_tpu.ops.predict import _class_scores_kernel
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _data(1500, 6, seed=21)
+
+        def train_one():
+            p = dict(P_LIFE)
+            ds = lgb.Dataset(X, label=y, params=p)
+            return lgb.train(p, ds, num_boost_round=3,
+                             keep_training_booster=True)
+
+        b1, b2 = train_one(), train_one()
+        sess = ServingSession(params={"serving_max_batch_rows": 2048,
+                                      "verbosity": -1})
+        sess.load("m1", booster=b1)
+        before = _class_scores_kernel._cache_size()
+        st1 = sess.stats()
+        sess.load("m2", booster=b2)  # equal warm signature
+        assert _class_scores_kernel._cache_size() == before, \
+            "a same-shaped second model compiled new predict programs"
+        # the dedupe also skipped the warmup device launches, but the
+        # shape accounting still covers m2: its first real predict is a
+        # cache HIT, not a miss
+        assert sess.stats()["compiles_warmup"] > st1["compiles_warmup"]
+        got = sess.predict("m2", X[:33], raw_score=True)
+        np.testing.assert_array_equal(
+            got, b2.predict(X[:33], raw_score=True, device="tpu",
+                            tpu_predict_device="true"))
+        assert sess.stats()["compile_cache_misses"] == 0
+        assert _class_scores_kernel._cache_size() == before
+        sess.close()
+
+
+class TestRetraceSmoke:
+    """The tier-1 wiring for `tools/perf_probe.py retrace`: the canonical
+    lifecycle audit runs as a fast smoke, so a future PR that doubles
+    n_programs fails HERE instead of silently inflating compile_s in the
+    next bench round."""
+
+    def test_retrace_lifecycle_bounds(self):
+        import importlib.util
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "_perf_probe", os.path.join(root, "tools", "perf_probe.py"))
+        probe = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(probe)
+        try:
+            phases, total = probe.run_retrace(n=2000, f=6, leaves=7,
+                                              bins=31, iters=2)
+        finally:
+            LEDGER.enable(False)
+        # an identical second train compiles NOTHING
+        labels = list(phases)
+        deltas = {}
+        prev = 0
+        for label in labels:
+            deltas[label] = phases[label] - prev
+            prev = phases[label]
+        assert deltas["second identical train"] == 0, phases
+        # a same-shaped second serving model adds at most the batcher's
+        # own bucket (it must not re-compile the first model's shapes)
+        assert deltas["serve (2 same-shaped models)"] <= 1, phases
+        # the hard regression gate: the whole lifecycle is a handful of
+        # programs — double the zoo and this fails loudly
+        assert total <= 6, (phases, total)

@@ -347,7 +347,15 @@ class TestWarmupBoundsCompiles:
         sess.load("m", booster=bst)
         try:
             st0 = sess.stats()
-            assert st0["compiles_warmup"] >= 3  # 1024/2048/4096 buckets
+            # warmup pre-compiles exactly the policy's bucket ladder
+            # (one bucket at the default "wide" policy and 4096 max
+            # rows; 1024/2048/4096 under "fine")
+            from lightgbm_tpu.ops.predict import predict_row_buckets
+
+            drv = bst._driver
+            expect = len(predict_row_buckets(4096, drv.predict_chunk_rows(),
+                                             policy=drv.bucket_policy()))
+            assert st0["compiles_warmup"] == expect
             assert st0["compile_cache_misses"] == 0
             from lightgbm_tpu.ops.predict import _class_scores_kernel
 

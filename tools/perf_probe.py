@@ -350,8 +350,86 @@ def run_comm_sweep(shard_counts, reps=10):
                       flush=True)
 
 
+def run_retrace(n=20000, f=10, leaves=31, bins=63, iters=3):
+    """Retrace audit: run a canonical train + retrain + predict + serve
+    lifecycle with the CompileLedger enabled and print, per phase, how
+    many XLA programs were compiled and where (per-site breakdown with
+    call signatures) — the tool that attributes compile_s growth to the
+    jit site/mode variant that caused it.
+
+        N=20000 python tools/perf_probe.py retrace
+    """
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.booster import Booster
+    from lightgbm_tpu.serving import ServingSession
+    from lightgbm_tpu.utils.backend import host_sync
+    from lightgbm_tpu.utils.compile_ledger import LEDGER
+
+    X, y = make_data(n, f=f)
+    p = {"objective": "binary", "num_leaves": leaves, "max_bin": bins,
+         "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1}
+    LEDGER.enable()
+    LEDGER.reset()
+    phases = []
+
+    def phase(label):
+        phases.append((label, LEDGER.n_programs()))
+
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = Booster(params=p, train_set=ds)
+    for _ in range(iters):
+        bst.update()
+    host_sync(bst._driver.train_scores.scores)
+    phase(f"ingest + train ({iters} iters)")
+
+    # the retrace-elimination contract: an identical second training run
+    # reuses every cached executable — any program compiled here is a
+    # regression (a jit site keyed on a fresh closure or static value)
+    ds2 = lgb.Dataset(X, label=y, params=p)
+    bst2 = Booster(params=p, train_set=ds2)
+    for _ in range(iters):
+        bst2.update()
+    host_sync(bst2._driver.train_scores.scores)
+    phase("second identical train")
+
+    for sz in (1, 100, 4096, min(n, 20000)):
+        # tpu_predict_device pinned: 'auto' on a CPU host vetoes to the
+        # native walker and the sweep would audit zero device launches
+        bst.predict(X[:sz], raw_score=True, device="tpu",
+                    tpu_predict_device="true")
+    phase("predict sweep (1..n rows)")
+
+    sess = ServingSession(params={"serving_max_batch_rows": 4096,
+                                  "verbosity": -1})
+    sess.load("a", booster=bst)
+    sess.load("b", booster=bst2)  # same-shaped: must add ZERO programs
+    sess.predict("a", X[:100])
+    sess.predict("b", X[:100])
+    sess.close()
+    phase("serve (2 same-shaped models)")
+
+    prev = 0
+    print(f"{'phase':<36s} {'new programs':>12s}")
+    for label, count in phases:
+        print(f"{label:<36s} {count - prev:>12d}", flush=True)
+        prev = count
+    print()
+    print(LEDGER.format_report(), flush=True)
+    if os.environ.get("RETRACE_SIGNATURES"):
+        for prog in LEDGER.programs():
+            print(f"  {prog['site']:<24s} {prog['first_call_s']:7.2f}s "
+                  f"{prog['signature'][:120]}", flush=True)
+    return dict(phases), LEDGER.n_programs()
+
+
 def main():
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "retrace":
+        run_retrace(n=int(os.environ.get("N", 20000)),
+                    leaves=int(os.environ.get("LEAVES", 31)),
+                    bins=int(os.environ.get("BINS", 63)),
+                    iters=int(os.environ.get("ITERS", 3)))
+        return
     if arg == "comm":
         # no dataset needed.  Default: a virtual CPU mesh sized to the
         # sweep (must pin BEFORE the first jax import); COMM_BACKEND=tpu
