@@ -292,6 +292,42 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     eval_med, _ = spread(eval_walls)
     eval_ms_per_iter = max(eval_med - train_s / bench_iters, 0.0) * 1e3
 
+    # robustness cost (ISSUE 7): interval-checkpointed training vs plain
+    # training over equal segments -> checkpoint_overhead_pct, plus the
+    # wall to rebuild a training booster from the newest bundle
+    # (resume_s) — tracked beside the perf metrics so fault tolerance
+    # never silently taxes the hot loop
+    import shutil as _shutil
+    import tempfile as _tempfile
+
+    from lightgbm_tpu.utils.checkpoint import (CheckpointManager,
+                                               restore_checkpoint,
+                                               save_checkpoint)
+
+    ck_iters = max(seg_iters, 2)
+    t0 = time.time()
+    for _ in range(ck_iters):
+        bst.update()
+    host_sync(bst._driver.train_scores.scores)
+    plain_s = max(time.time() - t0, 1e-9)
+    ck_dir = _tempfile.mkdtemp(prefix="bench-ckpt-")
+    try:
+        manager = CheckpointManager(ck_dir, keep=2)
+        t0 = time.time()
+        for _ in range(ck_iters):
+            bst.update()
+            save_checkpoint(bst, manager)
+        host_sync(bst._driver.train_scores.scores)
+        ck_s = max(time.time() - t0, 1e-9)
+        checkpoint_overhead_pct = max(ck_s - plain_s, 0.0) / plain_s * 100.0
+        t0 = time.time()
+        bst_resumed = Booster(params=params, train_set=ds)
+        restore_checkpoint(bst_resumed, manager)
+        resume_s = time.time() - t0
+        del bst_resumed
+    finally:
+        _shutil.rmtree(ck_dir, ignore_errors=True)
+
     # histogram-kernel throughput at the quantized vs shipping precision:
     # rows bounded so the probe stays a footnote next to the training loop
     hist_rows = min(n_rows, 262144)
@@ -358,6 +394,8 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "serve_rows_per_sec_min": round(serve_rows_per_sec_min, 0),
         "serve_p99_ms": round(serve_p99_ms, 1),
         "eval_ms_per_iter": round(eval_ms_per_iter, 1),
+        "checkpoint_overhead_pct": round(checkpoint_overhead_pct, 2),
+        "resume_s": round(resume_s, 2),
         "hist_int8_rows_per_sec": round(hist_int8, 0),
         "hist_int8_rows_per_sec_min": round(hist_int8_min, 0),
         "hist_hilo_rows_per_sec": round(hist_hilo, 0),

@@ -26,6 +26,7 @@ from .basic import Dataset, Booster
 from .utils.log import LightGBMError
 from .engine import train, cv, CVBooster
 from .callback import (
+    checkpoint,
     early_stopping,
     log_evaluation,
     print_evaluation,
@@ -52,6 +53,7 @@ __all__ = [
     "train",
     "cv",
     "CVBooster",
+    "checkpoint",
     "early_stopping",
     "log_evaluation",
     "print_evaluation",

@@ -484,6 +484,27 @@ class Booster:
                           config=Config(self.params) if self.params else None)
         return out
 
+    # -- fault tolerance (utils/checkpoint.py) -------------------------
+    def save_checkpoint(self, directory: str, keep: int = 3) -> str:
+        """Write one atomic training checkpoint (model + PRNG streams +
+        score buffers) into `directory`; returns the checkpoint path.
+        `lgb.train` does this automatically when `tpu_checkpoint_dir`
+        is configured."""
+        from .utils.checkpoint import CheckpointManager, save_checkpoint
+
+        return save_checkpoint(self, CheckpointManager(directory, keep=keep))
+
+    def resume_from_checkpoint(self, directory: str) -> Optional[int]:
+        """Restore this (freshly-constructed, same dataset + params)
+        training booster from the newest valid checkpoint in
+        `directory`; returns the restored iteration, or None when no
+        valid checkpoint exists.  Continued training is bit-identical
+        to a never-interrupted run."""
+        from .utils.checkpoint import CheckpointManager, restore_checkpoint
+
+        state = restore_checkpoint(self, CheckpointManager(directory))
+        return None if state is None else int(state["iteration"])
+
     # -- model IO ------------------------------------------------------
     def save_model(self, filename: str, num_iteration: Optional[int] = None,
                    start_iteration: int = 0) -> "Booster":

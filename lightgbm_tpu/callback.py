@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import collections
 import math
-from typing import Any, Callable, Dict, List
+from typing import Any, Callable, Dict, List, Optional
 
 CallbackEnv = collections.namedtuple(
     "CallbackEnv",
@@ -154,10 +154,15 @@ class _EarlyStopping:
     `first_metric_only` restricts triggering to the first metric) without
     its code shape: state lives in per-series `_SeriesState` objects
     created on the first evaluated iteration.
+
+    snapshot_state/restore_state (keyed "early_stopping") ride the
+    training checkpoint bundle so a resumed run keeps the best-so-far
+    rounds and stops at the SAME iteration an uninterrupted run would.
     """
 
     order = 30
     before_iteration = False
+    state_key = "early_stopping"
 
     def __init__(self, stopping_rounds: int, first_metric_only: bool,
                  verbose: bool):
@@ -219,6 +224,67 @@ class _EarlyStopping:
                            "Did not meet early stopping. Best iteration is:")
 
 
+    # -- checkpoint round trip (utils/checkpoint.py) -------------------
+    def snapshot_state(self) -> Dict[str, Any]:
+        return {
+            "primed": self.primed,
+            "active": self.active,
+            "first_metric": self.first_metric,
+            "series": [{"maximize": s.maximize, "value": s.value,
+                        "round": s.round,
+                        "snapshot": ([list(e) for e in s.snapshot]
+                                     if s.snapshot is not None else None)}
+                       for s in self.series],
+        }
+
+    def restore_state(self, state: Dict[str, Any]) -> None:
+        self.primed = bool(state.get("primed", False))
+        self.active = bool(state.get("active", True))
+        self.first_metric = str(state.get("first_metric", ""))
+        self.series = []
+        for d in state.get("series", []):
+            s = _SeriesState(maximize=bool(d["maximize"]))
+            s.value = float(d["value"])
+            s.round = int(d["round"])
+            s.snapshot = ([tuple(e) for e in d["snapshot"]]
+                          if d.get("snapshot") is not None else None)
+            self.series.append(s)
+
+
 def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                    verbose: bool = True) -> Callable:
     return _EarlyStopping(stopping_rounds, first_metric_only, verbose)
+
+
+class _Checkpoint:
+    """Write an atomic training checkpoint every `interval` iterations
+    (and at the final one).  Runs AFTER early stopping (order) so a
+    bundle never snapshots a half-evaluated iteration; sibling callbacks
+    exposing snapshot_state/restore_state (early stopping) ride the
+    bundle via `peers`."""
+
+    order = 40
+    before_iteration = False
+
+    def __init__(self, directory: Optional[str] = None, interval: int = 1,
+                 keep: int = 3, manager=None):
+        from .utils.checkpoint import CheckpointManager
+
+        if manager is None:
+            manager = CheckpointManager(directory, keep=keep)
+        self.manager = manager
+        self.interval = max(int(interval), 1)
+        self.peers: list = []  # sibling callbacks; engine.train fills it
+
+    def __call__(self, env: CallbackEnv) -> None:
+        from .utils.checkpoint import save_checkpoint
+
+        done = env.iteration + 1
+        if done % self.interval == 0 or done == env.end_iteration:
+            save_checkpoint(env.model, self.manager, callbacks=self.peers)
+
+
+def checkpoint(directory: str, interval: int = 1, keep: int = 3) -> Callable:
+    """Create the atomic-checkpoint callback (the engine adds one
+    automatically when `tpu_checkpoint_dir` is configured)."""
+    return _Checkpoint(directory, interval=interval, keep=keep)

@@ -151,6 +151,32 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "serving_port": ("int", 18080, ()),
     # rolling latency samples kept for the p50/p95/p99 stats
     "serving_stats_window": ("int", 4096, ()),
+    # circuit breaker on the device predict path: this many consecutive
+    # device failures OPEN the breaker (requests go straight to the
+    # native walker, no device attempts)
+    "serving_breaker_failures": ("int", 3, ()),
+    # how long an OPEN breaker waits before letting ONE half-open probe
+    # try the device path again (success closes it, failure re-opens)
+    "serving_breaker_cooldown_ms": ("float", 2000.0, ()),
+    # --- fault tolerance (utils/checkpoint.py + numeric guardrails) ---
+    # atomic training checkpoints: bundle directory (empty = off).  Each
+    # checkpoint holds the model string (with its bin-mapper trailer),
+    # PRNG stream states, and the f32 score buffers, written via
+    # temp-file + fsync + rename with a CRC'd manifest; resume with
+    # lgb.train(..., resume=True) is BIT-IDENTICAL to an uninterrupted
+    # run for quantized (int8/int16) precisions at any shard count
+    "tpu_checkpoint_dir": ("str", "", ()),
+    # boosting iterations between checkpoints
+    "tpu_checkpoint_interval": ("int", 1, ()),
+    # newest valid checkpoints retained (older ones are deleted)
+    "tpu_checkpoint_keep": ("int", 3, ()),
+    # numeric guardrails: per-iteration isfinite check on the updated
+    # train scores plus an int32 histogram-headroom sentinel for
+    # quantized precisions.  off = no checks (default; keeps the train
+    # loop fully async); warn = log and continue; raise = roll the
+    # poisoned iteration back and raise; skip = roll it back, re-bag,
+    # and keep training (drops the iteration)
+    "tpu_guard_numerics": ("str", "off", ()),
     # --- objective ---
     "num_class": ("int", 1, ("num_classes",)),
     "is_unbalance": ("bool", False, ("unbalance", "unbalanced_sets")),

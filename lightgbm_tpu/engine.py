@@ -30,7 +30,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
           callbacks: Optional[List[Callable]] = None,
           early_stopping_rounds: Optional[int] = None,
           verbose_eval: Union[bool, int] = True,
-          evals_result: Optional[Dict] = None) -> Booster:
+          evals_result: Optional[Dict] = None,
+          resume: bool = False) -> Booster:
     params = copy.deepcopy(params)
     if feature_name != "auto":
         train_set.set_feature_name(feature_name)
@@ -107,40 +108,117 @@ def train(params: Dict[str, Any], train_set: Dataset,
         from .callback import record_evaluation
         callbacks.append(record_evaluation(evals_result))
 
+    # fault tolerance: atomic interval checkpoints (tpu_checkpoint_dir)
+    # plus resume=True restart from the newest VALID bundle (torn/
+    # corrupt checkpoints are skipped with a warning).  The checkpoint
+    # callback is appended unless the caller supplied their own.
+    ckpt_dir = str(params.get("tpu_checkpoint_dir", "") or "")
+    ckpt_manager = None
+    from .callback import _Checkpoint
+
+    ckpt_cb = next((cb for cb in callbacks if isinstance(cb, _Checkpoint)),
+                   None)
+    if ckpt_cb is None and ckpt_dir:
+        ckpt_cb = _Checkpoint(
+            ckpt_dir,
+            interval=int(params.get("tpu_checkpoint_interval", 1) or 1),
+            keep=int(params.get("tpu_checkpoint_keep", 3) or 3))
+        callbacks.append(ckpt_cb)
+    if ckpt_cb is not None:
+        ckpt_manager = ckpt_cb.manager
+        ckpt_cb.peers = [cb for cb in callbacks if cb is not ckpt_cb]
+    if resume and ckpt_manager is None:
+        raise ValueError("resume=True needs tpu_checkpoint_dir (or an "
+                         "explicit checkpoint callback)")
+
     cb_before = [cb for cb in callbacks if getattr(cb, "before_iteration", False)]
     cb_after = [cb for cb in callbacks if not getattr(cb, "before_iteration", False)]
     cb_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cb_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    start_iteration = 0
+    if resume:
+        from .utils.checkpoint import restore_checkpoint
+
+        restored = restore_checkpoint(booster, ckpt_manager,
+                                      callbacks=callbacks)
+        if restored is not None:
+            # the stored iteration counts init_model trees too; the loop
+            # below counts only NEW rounds (restore_checkpoint already
+            # set best_iteration)
+            start_iteration = (int(restored["iteration"])
+                               - int(restored.get("num_init_iteration", 0)))
 
     # training snapshots (reference GBDT::Train, gbdt.cpp:290-294: every
     # snapshot_freq iterations the model is saved as <out>.snapshot_iter_N)
     snapshot_freq = int(params.get("snapshot_freq", -1) or -1)
     snapshot_out = str(params.get("output_model", "LightGBM_model.txt"))
 
-    evaluation_result_list: List = []
-    for i in range(num_boost_round):
-        for cb in cb_before:
-            cb(CallbackEnv(model=booster, params=params, iteration=i,
-                           begin_iteration=0, end_iteration=num_boost_round,
-                           evaluation_result_list=None))
-        booster.update(fobj=fobj)
-        if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-            booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+    # graceful preemption: with checkpointing configured, SIGTERM (the
+    # TPU-preemption signal) becomes a KeyboardInterrupt so the atomic-
+    # iteration rollback + final checkpoint flush below run before exit
+    import threading as _threading
 
-        evaluation_result_list: List = []
-        if valid_sets:
-            if is_valid_contain_train:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
+    prev_sigterm = None
+    if ckpt_manager is not None and \
+            _threading.current_thread() is _threading.main_thread():
+        import signal as _signal
+
+        def _on_sigterm(signum, frame):
+            raise KeyboardInterrupt("SIGTERM")
+
         try:
-            for cb in cb_after:
+            prev_sigterm = _signal.signal(_signal.SIGTERM, _on_sigterm)
+        except (ValueError, OSError):  # pragma: no cover - exotic host
+            prev_sigterm = None
+
+    evaluation_result_list: List = []
+    try:
+        for i in range(start_iteration, num_boost_round):
+            for cb in cb_before:
                 cb(CallbackEnv(model=booster, params=params, iteration=i,
-                               begin_iteration=0, end_iteration=num_boost_round,
-                               evaluation_result_list=evaluation_result_list))
-        except EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            evaluation_result_list = e.best_score
-            break
+                               begin_iteration=0,
+                               end_iteration=num_boost_round,
+                               evaluation_result_list=None))
+            booster.update(fobj=fobj)
+            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
+
+            evaluation_result_list: List = []
+            if valid_sets:
+                if is_valid_contain_train:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in cb_after:
+                    cb(CallbackEnv(model=booster, params=params, iteration=i,
+                                   begin_iteration=0,
+                                   end_iteration=num_boost_round,
+                                   evaluation_result_list=evaluation_result_list))
+            except EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                evaluation_result_list = e.best_score
+                break
+    except BaseException:
+        # interrupt/device failure: the partial iteration was already
+        # rolled back inside update(); flush a final checkpoint so the
+        # run restarts from the last COMPLETE iteration, then re-raise
+        if ckpt_manager is not None:
+            from .utils.checkpoint import flush_checkpoint
+
+            flush_checkpoint(booster, ckpt_manager, callbacks=callbacks)
+        raise
+    finally:
+        if prev_sigterm is not None:
+            import signal as _signal
+
+            _signal.signal(_signal.SIGTERM, prev_sigterm)
+    if ckpt_manager is not None:
+        # early stop (or a zero-round run) can end between interval
+        # marks: one final bundle covers the completed state
+        from .utils.checkpoint import flush_checkpoint
+
+        flush_checkpoint(booster, ckpt_manager, callbacks=callbacks)
 
     booster.best_score = {}
     for item in evaluation_result_list:

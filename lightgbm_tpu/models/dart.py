@@ -106,9 +106,17 @@ class DART(GBDT):
         scale = k / (k + 1.0) if not cfg.xgboost_dart_mode \
             else k / (k + float(cfg.learning_rate))
         K = self.num_tree_per_iteration
+        undo = getattr(self, "_dart_undo", None)
         for i in self._drop_idx:
             for c in range(K):
-                self.models[i * K + c].apply_shrinkage(scale)
+                tree = self.models[i * K + c]
+                if undo is not None:
+                    # copy-undo record for atomic-iteration rollback:
+                    # apply_shrinkage zero-clamps, so scaling back is lossy
+                    undo.append((tree,
+                                 tree.leaf_value[:tree.num_leaves].copy(),
+                                 tree.shrinkage, None, None))
+                tree.apply_shrinkage(scale)
             if not cfg.uniform_drop:
                 j = i - self.num_init_iteration
                 if not cfg.xgboost_dart_mode:
@@ -116,16 +124,69 @@ class DART(GBDT):
                 else:
                     self.sum_weight -= self.tree_weight[j] / \
                         (k + float(cfg.learning_rate))
+                if undo is not None:
+                    undo.append((None, (), None, j, self.tree_weight[j]))
                 self.tree_weight[j] *= scale
         # leaf values changed in place: the RAW-value predictor tables
         # are stale (the binned walker packs per call and cannot be)
         self._invalidate_tables()
         self._apply_iters_to_scores(self._drop_idx, 1.0)
 
+    # -- atomic-iteration rollback / checkpoint hooks ------------------
+    def _snapshot_extra(self):
+        # _normalize mutates EXISTING trees in place (apply_shrinkage
+        # clamps tiny values to zero, so scaling is not invertible); it
+        # appends copy-undo records to this ledger, which _restore_extra
+        # replays on rollback
+        self._dart_undo = []
+        return {"dart": (list(self._drop_idx), len(self.tree_weight),
+                         float(self.sum_weight),
+                         self._drop_rng.bit_generator.state)}
+
+    def _restore_extra(self, snap):
+        drop_idx, n_weights, sum_weight, rng_state = snap["dart"]
+        for tree, leaf_values, shrinkage, j, weight in \
+                reversed(self._dart_undo):
+            if tree is not None:
+                tree.leaf_value[:len(leaf_values)] = leaf_values
+                tree.shrinkage = shrinkage
+            if weight is not None and j is not None \
+                    and j < len(self.tree_weight):
+                self.tree_weight[j] = weight
+        self._dart_undo = []
+        self._drop_idx = drop_idx
+        del self.tree_weight[n_weights:]
+        self.sum_weight = sum_weight
+        self._drop_rng.bit_generator.state = rng_state
+
+    def _has_skip_lever(self):
+        return True  # the drop selection stream always varies the retry
+
+    def _advance_streams_for_skip(self):
+        super()._advance_streams_for_skip()
+        # _iter_restore rewound the drop stream with everything else;
+        # burn one draw so the retry selects a different drop set
+        self._drop_rng.random()
+
+    def _capture_extra_state(self):
+        return {"dart": {"tree_weight": [float(w) for w in self.tree_weight],
+                         "sum_weight": float(self.sum_weight),
+                         "drop_rng": self._drop_rng.bit_generator.state}}
+
+    def _restore_extra_state(self, extra):
+        d = (extra or {}).get("dart")
+        if not d:
+            return
+        self.tree_weight = [float(w) for w in d["tree_weight"]]
+        self.sum_weight = float(d["sum_weight"])
+        rng = np.random.default_rng(0)
+        rng.bit_generator.state = d["drop_rng"]
+        self._drop_rng = rng
+
     # ------------------------------------------------------------------
-    def train_one_iter(self, grad=None, hess=None) -> bool:
-        if self._stopped:
-            return True
+    def _train_one_iter_impl(self, grad, hess, snap) -> bool:
+        # base-class wrapper (train_one_iter) owns the stall check,
+        # rollback snapshot, fault point, and numeric guard
         self._materialize()
         self._dropping_trees()
         ret = self._train_one_iter_sync(grad, hess)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from ..config import Config
 from ..io.bin_mapper import BinMapper, MissingType
 from ..io.dataset import TrainingData
+from ..utils import faultline
 from ..ops.predict import (PackedForest, feature_meta_dev, device_tables,
                            forest_class_scores, forest_leaf_values,
                            pack_trees, row_bucket)
@@ -33,6 +34,34 @@ from .objectives import (Objective, create_objective,
 from .tree import Tree
 
 K_EPSILON = 1e-15
+
+
+def quant_headroom_check(precision: str, total_rows: int, mode: str) -> int:
+    """int32 histogram-accumulator headroom sentinel (quantized mode).
+
+    `quant_limit` already narrows the gradient grid so a worst-case bin
+    cannot overflow int32, which means overflow is impossible but the
+    effective quantization mantissa silently shrinks with the global row
+    count.  The sentinel makes that visible: warn when the grid has
+    narrowed below the dtype's own range, raise (under
+    tpu_guard_numerics=raise) once the grid has lost two bits of the
+    dtype's range (floor capped at 128, i.e. 7 effective bits, for wide
+    dtypes) — at that point quantized split decisions are mostly noise.
+    The floor is precision-relative: a flat 128 would make int8 (dtype
+    max 127) raise on ANY narrowing."""
+    from ..ops.histogram import _INT_TYPE_MAX, quant_limit
+    from ..utils.log import LightGBMError, Log
+
+    q = quant_limit(precision, total_rows)
+    full = _INT_TYPE_MAX[precision]
+    if q < full:
+        msg = (f"int32 histogram headroom: {total_rows} rows narrow the "
+               f"{precision} gradient grid to +-{q} (dtype max +-{full})")
+        if mode == "raise" and q < min(128, full // 4):
+            raise LightGBMError(
+                msg + "; use a wider precision or fewer global rows")
+        Log.warning(msg)
+    return q
 
 # model-string trailer carrying the bin-mapper snapshot (written by
 # save_model_to_string, parsed back by from_model_string)
@@ -258,6 +287,19 @@ class GBDT:
         self._bag_key = jax.random.PRNGKey(int(config.bagging_seed))
         self._train_step = None
         self._bag_cfg = self._bagging_config()
+        # numeric guardrails (tpu_guard_numerics=off|warn|raise|skip):
+        # validated here so a typo fails at init, not mid-run; the
+        # quantized headroom sentinel is a one-time init check
+        self._guard = str(config.tpu_guard_numerics).strip().lower()
+        if self._guard not in ("off", "warn", "raise", "skip"):
+            raise ValueError("tpu_guard_numerics must be off|warn|raise|"
+                             f"skip, got {self._guard!r}")
+        self._guard_streak = 0
+        self._guard_skips_total = 0
+        if self._guard != "off" \
+                and str(config.tpu_hist_precision) in ("int8", "int16"):
+            quant_headroom_check(str(config.tpu_hist_precision),
+                                 train_data.num_data, self._guard)
         if self.learner.params.has_cegb and self._goss_cfg is not None:
             raise NotImplementedError(
                 "CEGB penalties do not compose with GOSS yet")
@@ -447,20 +489,61 @@ class GBDT:
         return mask
 
     _cached_bag_mask = None
+    # guardrail defaults for drivers that never ran init() (file-loaded
+    # predict-only boosters)
+    _guard = "off"
+    _guard_streak = 0
+    _guard_skips_total = 0
+    _GUARD_MAX_STREAK = 5
+    # set by a skip-mode rollback: the retry must draw a FRESH bagging
+    # mask even off the bagging_freq boundary, or it would replay the
+    # poisoned iteration bit-identically
+    _force_bag_refresh = False
 
     # ------------------------------------------------------------------
     def train_one_iter(self, grad: Optional[jnp.ndarray] = None,
                        hess: Optional[jnp.ndarray] = None) -> bool:
         """One boosting iteration; True when training has stalled.
 
-        Fast path: one fused async device dispatch per class and NO
-        host<->device sync; host Tree objects materialize lazily at
-        eval/predict/save time (`_materialize`)."""
+        The iteration applies ATOMICALLY: SIGTERM / KeyboardInterrupt /
+        an XLA runtime error (or an armed `grow_step` fault) anywhere
+        inside rolls the partial iteration back — scores, PRNG streams,
+        pending trees and counters return to their pre-iteration state
+        before the exception re-raises — so the booster stays usable
+        (predict / continue-training / checkpoint-flush) after an
+        interrupt.  tpu_guard_numerics adds a per-iteration isfinite
+        check on the updated scores (warn | raise | skip; skip =
+        rollback + re-bag)."""
         if self._stopped:
             return True
+        snap = self._iter_snapshot()
+        try:
+            action = faultline.fire("grow_step", iteration=self.iter_)
+            ret = self._train_one_iter_impl(grad, hess, snap)
+        except BaseException:
+            self._iter_restore(snap)
+            raise
+        if action == "poison":
+            # fault harness: NaN-poison this iteration's scores so the
+            # guardrail modes below are exercised deterministically
+            self.train_scores.scores = (self.train_scores.scores
+                                        + jnp.float32(np.nan))
+        if self._guard != "off" and not ret and not self._scores_finite():
+            return self._poisoned_iteration(snap)
+        self._guard_streak = 0
+        self._force_bag_refresh = False  # the skip retry (if any) is done
+        return ret
+
+    def _train_one_iter_impl(self, grad, hess, snap) -> bool:
         if (grad is None or hess is None) and self._train_step is not None:
-            ctx = timer.PHASE("train_dispatch")
-            ctx.__enter__()
+            return self._train_one_iter_fused(snap)
+        return self._train_one_iter_sync(grad, hess)
+
+    def _train_one_iter_fused(self, snap) -> bool:
+        """Fast path: one fused async device dispatch per class and NO
+        host<->device sync; host Tree objects materialize lazily at
+        eval/predict/save time (`_materialize`)."""
+        with timer.PHASE("train_dispatch"):
             bag = self._bag_cfg
             extra = {}
             if self._goss_cfg is not None:
@@ -474,9 +557,19 @@ class GBDT:
                 # donated-then-read alias would either spam copy warnings
                 # or (multiclass) read a deleted buffer at class 1
                 base_scores = jnp.copy(base_scores)
+                if snap is not None \
+                        and snap["scores"] is self.train_scores.scores:
+                    # the pre-iteration buffer is about to be DONATED;
+                    # the copy (bitwise equal — no boost-from-average
+                    # constant was added this iteration, or the buffers
+                    # would already differ) becomes the live rollback
+                    # snapshot
+                    snap["scores"] = base_scores
             pool = getattr(self.learner, "_pool", None)
             for k in range(self.num_tree_per_iteration):
-                refresh = bag is not None and (self.iter_ % bag["freq"] == 0)
+                refresh = bag is not None and (
+                    self.iter_ % bag["freq"] == 0
+                    or self._force_bag_refresh)
                 (records, scores, leaf_ids, leaf_out, self._key,
                  self._bag_key, pool) = self._train_step(
                     base_scores, self.train_scores.scores,
@@ -496,9 +589,161 @@ class GBDT:
                     leaf_out if self.learner.refits_leaves else None,
                     k, inits[k]))
             self.iter_ += 1
-            ctx.__exit__(None, None, None)
+        return False
+
+    # -- atomic-iteration rollback -------------------------------------
+    def _iter_snapshot(self) -> Dict:
+        """Cheap pre-iteration capture for atomic rollback: array
+        REFERENCES (jax arrays are immutable; the one donation hazard is
+        patched inside the fused path) plus host RNG/counter state."""
+        snap = {
+            "scores": (self.train_scores.scores
+                       if self.train_scores is not None else None),
+            "valid": [vs.scores for vs in self.valid_scores],
+            "key": getattr(self, "_key", None),
+            "bag_key": getattr(self, "_bag_key", None),
+            "pending": len(self._pending),
+            "models": len(self.models),
+            "bfa": list(getattr(self, "_boosted_from_average", [])),
+            "bag_mask": self._cached_bag_mask,
+            "bag_rng": (self._bag_rng.bit_generator.state
+                        if self._bag_rng is not None else None),
+            "feature_rng": (self.learner._feature_rng.bit_generator.state
+                            if self.learner is not None and
+                            getattr(self.learner, "_feature_rng", None)
+                            is not None else None),
+            "iter": self.iter_,
+            "stopped": self._stopped,
+            "shrinkage": self.shrinkage_rate,
+        }
+        snap.update(self._snapshot_extra())
+        return snap
+
+    def _snapshot_extra(self) -> Dict:
+        return {}
+
+    def _restore_extra(self, snap: Dict) -> None:
+        pass
+
+    def _iter_restore(self, snap: Dict) -> None:
+        """Roll a partially-applied iteration back to its snapshot."""
+        if self.train_scores is not None and snap["scores"] is not None:
+            self.train_scores.scores = snap["scores"]
+        for vs, s in zip(self.valid_scores, snap["valid"]):
+            vs.scores = s
+        if snap["key"] is not None:
+            self._key = snap["key"]
+        if snap["bag_key"] is not None:
+            self._bag_key = snap["bag_key"]
+        del self._pending[snap["pending"]:]
+        del self.models[snap["models"]:]
+        if snap["bfa"]:
+            self._boosted_from_average = snap["bfa"]
+        self._cached_bag_mask = snap["bag_mask"]
+        if snap["bag_rng"] is not None:
+            self._bag_rng.bit_generator.state = snap["bag_rng"]
+        if snap["feature_rng"] is not None:
+            self.learner._feature_rng.bit_generator.state = \
+                snap["feature_rng"]
+        self.iter_ = snap["iter"]
+        self._stopped = snap["stopped"]
+        self.shrinkage_rate = snap["shrinkage"]
+        # a failed DONATING dispatch may have consumed the threaded
+        # histogram pool; it is per-iteration scratch, so zeros restore
+        # it bit-equivalently
+        pool = (getattr(self.learner, "_pool", None)
+                if self.learner is not None else None)
+        try:
+            deleted = pool is not None and pool.is_deleted()
+        except AttributeError:  # pragma: no cover - old jaxlib
+            deleted = False
+        if deleted:
+            self.learner.reset_pool()
+        self._invalidate_tables()
+        self._restore_extra(snap)
+
+    # -- numeric guardrails (tpu_guard_numerics) -----------------------
+    def _scores_finite(self) -> bool:
+        """One all-isfinite reduction over the train scores, piggybacked
+        after the iteration's own device pass.  Forces one device sync
+        per iteration — the cost of guarding, paid only when armed."""
+        if self.train_scores is None:
+            return True
+        return bool(jax.device_get(
+            jnp.isfinite(self.train_scores.scores).all()))
+
+    def _poisoned_iteration(self, snap: Dict) -> bool:
+        from ..utils.log import LightGBMError, Log
+
+        it = snap["iter"]
+        if self._guard == "warn":
+            Log.warning(f"non-finite training scores after iteration {it} "
+                        "(tpu_guard_numerics=warn): continuing")
             return False
-        return self._train_one_iter_sync(grad, hess)
+        if self._guard == "raise":
+            self._iter_restore(snap)  # leave the booster usable
+            raise LightGBMError(
+                f"non-finite training scores after iteration {it} "
+                "(tpu_guard_numerics=raise); the poisoned iteration was "
+                "rolled back")
+        # skip: drop the iteration but KEEP the advanced PRNG streams so
+        # the retry re-bags instead of replaying the same poison.  With
+        # no stochastic lever at all the retry would be a bit-identical
+        # replay — raise immediately instead of burning the streak.
+        if not self._has_skip_lever():
+            self._iter_restore(snap)
+            raise LightGBMError(
+                f"non-finite training scores after iteration {it} and no "
+                "stochastic lever to re-bag (tpu_guard_numerics=skip "
+                "needs bagging/GOSS/feature_fraction/quantized rounding "
+                "to vary the retry)")
+        keys = (getattr(self, "_key", None), getattr(self, "_bag_key", None))
+        bag_rng = (self._bag_rng.bit_generator.state
+                   if self._bag_rng is not None else None)
+        feat_rng = (self.learner._feature_rng.bit_generator.state
+                    if self.learner is not None and
+                    getattr(self.learner, "_feature_rng", None) is not None
+                    else None)
+        self._iter_restore(snap)
+        if keys[0] is not None:
+            self._key = keys[0]
+        if keys[1] is not None:
+            self._bag_key = keys[1]
+        if bag_rng is not None:
+            self._bag_rng.bit_generator.state = bag_rng
+        if feat_rng is not None:
+            self.learner._feature_rng.bit_generator.state = feat_rng
+        self._advance_streams_for_skip()
+        self._guard_streak += 1
+        self._guard_skips_total += 1
+        if self._guard_streak > self._GUARD_MAX_STREAK:
+            raise LightGBMError(
+                f"{self._guard_streak} consecutive poisoned iterations "
+                "under tpu_guard_numerics=skip; giving up")
+        Log.warning(f"dropped poisoned iteration {it} "
+                    "(tpu_guard_numerics=skip): rolled back, re-bagging")
+        return False
+
+    def _has_skip_lever(self) -> bool:
+        """Does a skip-mode retry differ at all from the dropped
+        iteration?  Without a stochastic lever the replay is
+        bit-identical and skipping is pointless."""
+        if self._bag_cfg is not None or self._goss_cfg is not None:
+            return True
+        if self.config is not None \
+                and float(self.config.feature_fraction) < 1.0:
+            return True
+        return (self.learner is not None
+                and getattr(self.learner, "params", None) is not None
+                and self.learner.params.precision in ("int8", "int16"))
+
+    def _advance_streams_for_skip(self) -> None:
+        """Make the skip retry actually differ: force a fresh bagging
+        mask even off the bagging_freq boundary (the fused step only
+        consumes _bag_key on refresh; the sync path only redraws when
+        the cached mask is gone)."""
+        self._cached_bag_mask = None
+        self._force_bag_refresh = True
 
     def _train_one_iter_sync(self, grad=None, hess=None) -> bool:
         """Synchronous path: custom fobj gradients or renew objectives."""
@@ -598,7 +843,13 @@ class GBDT:
                 if len(self.models) < self.num_tree_per_iteration:
                     tree.as_constant_tree(init)
                     self.models.append(tree)
-        self.iter_ = len(self.models) // max(self.num_tree_per_iteration, 1)
+        # iter_ counts NEW boosting rounds (the index bagging refresh,
+        # GOSS warmup, and DART's drop bookkeeping key on) — init_model
+        # trees live in models but not in iter_, or a mid-train
+        # materialize (checkpoint, eval) would shift the bagging
+        # schedule of a continuation run
+        self.iter_ = (len(self.models) // max(self.num_tree_per_iteration, 1)
+                      - self.num_init_iteration)
 
     def train_one_iter_custom(self, grad: np.ndarray, hess: np.ndarray) -> bool:
         return self.train_one_iter(jnp.asarray(grad), jnp.asarray(hess))
@@ -683,6 +934,150 @@ class GBDT:
 
     def current_score_for_fobj(self) -> np.ndarray:
         return self.train_scores.numpy()
+
+    # ------------------------------------------------------------------
+    # checkpoint/resume (utils/checkpoint.py): the driver-level bundle
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _key_words(key) -> List[int]:
+        """PRNG key -> raw uint32 words (JSON-able)."""
+        arr = key
+        try:
+            if jnp.issubdtype(arr.dtype, jax.dtypes.prng_key):
+                arr = jax.random.key_data(arr)
+        except (AttributeError, TypeError):  # pragma: no cover - old jax
+            pass
+        return [int(w) for w in
+                np.ravel(np.asarray(jax.device_get(arr))).astype(np.uint32)]
+
+    @staticmethod
+    def _words_to_key(words, like):
+        """uint32 words -> a key matching `like`'s representation."""
+        arr = jnp.asarray(np.asarray(words, np.uint32).reshape(-1))
+        try:
+            if jnp.issubdtype(like.dtype, jax.dtypes.prng_key):
+                return jax.random.wrap_key_data(arr)
+        except (AttributeError, TypeError):  # pragma: no cover - old jax
+            pass
+        return arr
+
+    def capture_train_state(self) -> Tuple[Dict, Dict]:
+        """The restart bundle's driver half: a JSON-able state dict plus
+        the f32 score arrays.  Pairs with `restore_train_state`; the
+        model string (trees + mapper trailer) travels separately."""
+        if self.train_data is None or self.learner is None \
+                or self.train_scores is None:
+            raise ValueError("checkpointing needs a live training context "
+                             "(predict-only/file-loaded boosters have "
+                             "nothing to resume)")
+        self._materialize()
+        state = {
+            "iteration": int(self.current_iteration()),
+            "num_init_iteration": int(self.num_init_iteration),
+            "stopped": bool(self._stopped),
+            "boosted_from_average": [
+                bool(b) for b in getattr(self, "_boosted_from_average", [])],
+            "key": self._key_words(self._key),
+            "bag_key": self._key_words(self._bag_key),
+            "bag_rng": self._bag_rng.bit_generator.state,
+            "feature_rng": (self.learner._feature_rng.bit_generator.state
+                            if getattr(self.learner, "_feature_rng", None)
+                            is not None else None),
+            "valid_names": list(self.valid_names),
+            "guard_skips": int(self._guard_skips_total),
+        }
+        arrays = {"train_scores": np.asarray(
+            jax.device_get(self.train_scores.scores), np.float32)}
+        for name, vs in zip(self.valid_names, self.valid_scores):
+            arrays[f"valid_scores/{name}"] = np.asarray(
+                jax.device_get(vs.scores), np.float32)
+        if self._cached_bag_mask is not None:
+            arrays["bag_mask"] = np.asarray(
+                jax.device_get(self._cached_bag_mask), np.float32)
+        extra = self._capture_extra_state()
+        if extra:
+            state["extra"] = extra
+        return state, arrays
+
+    def _capture_extra_state(self) -> Dict:
+        return {}
+
+    def _restore_extra_state(self, extra: Dict) -> None:
+        pass
+
+    def restore_train_state(self, model_text: str, state: Dict,
+                            arrays: Dict) -> None:
+        """Rebuild this (freshly-initialized) driver to the checkpointed
+        iteration: trees rebind through the bitwise `from_model_string`
+        path onto the LIVE training mappers, the f32 score buffers
+        restore byte-for-byte (replaying trees through the forest kernel
+        would re-round the f32 accumulation in a different order), and
+        every PRNG stream resumes mid-sequence — so continued training
+        is bit-identical to a never-interrupted run."""
+        if self.train_data is None or self.learner is None:
+            raise ValueError("restore needs a booster constructed with "
+                             "the training dataset")
+        self._materialize()
+        other = GBDT.from_model_string(model_text)
+        if other.num_tree_per_iteration != self.num_tree_per_iteration:
+            raise ValueError(
+                "checkpoint has different num_tree_per_iteration")
+        for tree in other.models:
+            if tree.num_leaves > 1:
+                self._rebind_tree(tree)
+        self.models = list(other.models)
+        self._pending = []
+        k = max(self.num_tree_per_iteration, 1)
+        total = len(self.models) // k
+        if int(state.get("iteration", total)) != total:
+            raise ValueError(
+                f"checkpoint iteration {state.get('iteration')} does not "
+                f"match its model ({total} iterations)")
+        self.num_init_iteration = int(state.get("num_init_iteration", 0))
+        # iter_ counts NEW rounds only (see _materialize_inner)
+        self.iter_ = total - self.num_init_iteration
+        # .copy() forces an XLA-owned buffer (the fused step DONATES the
+        # scores; donating a numpy-aliased zero-copy upload corrupts the
+        # heap — same rule as _ScoreState)
+        self.train_scores.scores = jnp.asarray(
+            np.asarray(arrays["train_scores"], np.float32)).copy()
+        meta = self.learner.meta_np
+        for name, vs, vd in zip(self.valid_names, self.valid_scores,
+                                self.valid_sets):
+            a = arrays.get(f"valid_scores/{name}")
+            if a is not None:
+                vs.scores = jnp.asarray(np.asarray(a, np.float32)).copy()
+                continue
+            # a valid set the checkpointed run did not have: replay the
+            # restored model onto it (bitwise matters for TRAIN state;
+            # eval-only scores may take the batched path)
+            if not self._replay_scores_device(vs, vd, self.models):
+                for i, tree in enumerate(self.models):
+                    vs.add(i % k, jnp.asarray(
+                        _predict_binned(tree, vd.bins, meta)
+                        .astype(np.float32)))
+        self._key = self._words_to_key(state["key"], self._key)
+        self._bag_key = self._words_to_key(state["bag_key"], self._bag_key)
+        if state.get("bag_rng") is not None:
+            rng = np.random.default_rng(0)
+            rng.bit_generator.state = state["bag_rng"]
+            self._bag_rng = rng
+        if state.get("feature_rng") is not None and \
+                getattr(self.learner, "_feature_rng", None) is not None:
+            rng = np.random.default_rng(0)
+            rng.bit_generator.state = state["feature_rng"]
+            self.learner._feature_rng = rng
+        bfa = state.get("boosted_from_average")
+        if bfa:
+            self._boosted_from_average = [bool(b) for b in bfa]
+        self._stopped = bool(state.get("stopped", False))
+        self._guard_skips_total = int(state.get("guard_skips", 0))
+        mask = arrays.get("bag_mask")
+        self._cached_bag_mask = (
+            None if mask is None
+            else jnp.asarray(np.asarray(mask, np.float32)))
+        self._invalidate_tables()
+        self._restore_extra_state(state.get("extra") or {})
 
     # ------------------------------------------------------------------
     def eval(self, name: str, valid_idx: int, feval=None, booster=None
@@ -845,6 +1240,7 @@ class GBDT:
         one-shot copy for replay over the TRAINING bins, which the
         learner already holds in its own layout — caching a second
         full-size copy there would pin 4x-uint8 HBM for one pass."""
+        faultline.fire("h2d_copy", rows=data.num_data)
         if cache:
             return data.device_bins()
         if data._device_bins is not None:  # already resident: reuse
@@ -1028,6 +1424,7 @@ class GBDT:
         for lo in range(0, max(n, 1), chunk):
             hi = min(lo + chunk, n)
             rows = hi - lo
+            faultline.fire("h2d_copy", rows=rows)
             bins = get_bins(lo, hi)
             # pad every launch to a bucketed row count (row_bucket: full
             # chunks for multi-chunk predicts, the policy's geometric

@@ -774,25 +774,18 @@ class TPUTreeLearner:
                         and not self._multiproc)
         self._external_pool = self._donate and strategy != "voting"
         if self._external_pool:
-            # the pool MUST be XLA-owned (jnp.zeros, never
-            # jnp.asarray(np.zeros(...))): on the CPU backend a
-            # device_put of aligned host memory is ZERO-COPY — the
-            # buffer aliases numpy-owned pages, and donating it lets
-            # XLA rewrite/free memory it does not own (intermittent,
-            # alignment-dependent heap corruption; reproduced on
-            # jaxlib 0.4.x)
             shape = (self.params.num_leaves, self.g_pad, B, 3)
             pdt = jnp.dtype(pool_dtype(precision))
+            sharding = None
             if self.mesh is not None:
                 from jax.sharding import NamedSharding
 
-                self._pool = jnp.zeros(shape, pdt, device=NamedSharding(
-                    self.mesh, pool_partition_spec(
-                        strategy, self.hist_agg == "scatter")))
-            else:
-                self._pool = jnp.zeros(shape, pdt)
+                sharding = NamedSharding(self.mesh, pool_partition_spec(
+                    strategy, self.hist_agg == "scatter"))
+            self._pool_spec = (shape, pdt, sharding)
         else:
-            self._pool = None
+            self._pool_spec = None
+        self.reset_pool()
         # the grower cache key is the CANONICAL params (the mode-flag-
         # folded fields normalized away): every run whose structural axes
         # match reuses one grow program, whatever its mode values
@@ -801,6 +794,27 @@ class TPUTreeLearner:
             voting_k=int(config.top_k), num_columns=self.g_pad,
             external_pool=self._external_pool)
         self._feature_rng = np.random.default_rng(int(config.feature_fraction_seed))
+
+    def reset_pool(self) -> None:
+        """(Re)create the donated histogram-pool buffer as zeros.
+
+        The pool MUST be XLA-owned (jnp.zeros, never
+        jnp.asarray(np.zeros(...))): on the CPU backend a device_put of
+        aligned host memory is ZERO-COPY — the buffer aliases
+        numpy-owned pages, and donating it lets XLA rewrite/free memory
+        it does not own (intermittent, alignment-dependent heap
+        corruption; reproduced on jaxlib 0.4.x).
+
+        Also the recovery path after a failed DONATING dispatch consumed
+        the threaded buffer (gbdt._iter_restore): the pool is
+        per-iteration scratch that the grower rewrites wholesale, so a
+        zeros replacement is bit-equivalent."""
+        if self._pool_spec is None:
+            self._pool = None
+            return
+        shape, pdt, sharding = self._pool_spec
+        self._pool = (jnp.zeros(shape, pdt, device=sharding)
+                      if sharding is not None else jnp.zeros(shape, pdt))
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -67,11 +67,11 @@ class RF(GBDT):
         self._rf_hess = np.asarray(jax.device_get(h), np.float32)
         self._train_step = None  # running-average updates: sync path
 
-    def train_one_iter(self, grad=None, hess=None) -> bool:
+    def _train_one_iter_impl(self, grad, hess, snap) -> bool:
+        # base-class wrapper (train_one_iter) owns the stall check,
+        # rollback snapshot, fault point, and numeric guard
         if grad is not None or hess is not None:
             raise ValueError("RF mode does not support custom gradients")
-        if self._stopped:
-            return True
         mask = self.bagging_mask(self.iter_)
         K = self.num_tree_per_iteration
         it = self.iter_ + self.num_init_iteration

@@ -27,9 +27,10 @@ Quick start::
 from .batcher import MicroBatcher, ServingQueueFull, ServingTimeout
 from .registry import ModelEntry, ModelRegistry
 from .server import ServingSession, serve_forever, serve_http
-from .stats import ServingStats
+from .stats import CircuitBreaker, ServingStats
 
 __all__ = [
+    "CircuitBreaker",
     "MicroBatcher",
     "ModelEntry",
     "ModelRegistry",

@@ -29,7 +29,8 @@ import numpy as np
 
 from ..config import Config, parse_tristate
 from ..ops.predict import _depth_bucket, predict_row_buckets, row_bucket
-from .stats import ServingStats
+from ..utils import faultline
+from .stats import CircuitBreaker, ServingStats
 
 
 class ModelEntry:
@@ -64,6 +65,13 @@ class ModelEntry:
                           and booster.num_trees() > 0)
         if self.device_on:
             drv._packed_forest()  # pack + upload the forest tables once
+        # circuit breaker on the device path: threshold failures open it
+        # (requests short-circuit to the native walker), a timed
+        # half-open probe retries the device path
+        self.breaker = CircuitBreaker(
+            threshold=int(config.serving_breaker_failures),
+            cooldown_s=float(config.serving_breaker_cooldown_ms) / 1e3,
+            stats=stats)
 
     # ------------------------------------------------------------------
     def default_num_iteration(self) -> int:
@@ -116,15 +124,23 @@ class ModelEntry:
     def predict(self, X: np.ndarray, raw_score: bool = False,
                 num_iteration: int = -1, warmup: bool = False) -> np.ndarray:
         """The batch runner: one device predict with launch-shape
-        accounting, native-walker fallback on device failure."""
+        accounting.  A device failure serves THIS batch via the native
+        host walker and feeds the circuit breaker; past the failure
+        threshold the breaker opens and requests short-circuit to the
+        walker (zero device attempts) until a timed half-open probe
+        finds the device path healthy again."""
         ni = -1 if num_iteration is None else int(num_iteration)
         if not self.device_on:
             if not warmup:
                 self.stats.note_batch(X.shape[0], X.shape[0])
-            return self.booster.predict(X, raw_score=raw_score,
-                                        num_iteration=ni, device="cpu")
+            return self._native_predict(X, raw_score, ni)
         n = int(X.shape[0])
         bucket = row_bucket(n, self.chunk, policy=self.policy)
+        if not warmup and not self.breaker.allow():
+            # breaker open: no device launch happens, so account this
+            # batch like the native path (unpadded rows)
+            self.stats.note_batch(n, n)
+            return self._native_predict(X, raw_score, ni)
         if not warmup:
             # a batch wider than the predict chunk runs ceil(n/chunk)
             # padded launches inside _chunked_device_scores — account
@@ -133,23 +149,35 @@ class ModelEntry:
             self.stats.note_batch(n, launches * bucket, launches=launches)
         self.stats.note_shape((self.key, ni, bucket), warmup=warmup)
         try:
-            return self.booster.predict(X, raw_score=raw_score,
-                                        num_iteration=ni, device="tpu",
-                                        tpu_predict_device="true")
+            if not warmup:
+                faultline.fire("serve_dispatch", model=self.key)
+            out = self.booster.predict(X, raw_score=raw_score,
+                                       num_iteration=ni, device="tpu",
+                                       tpu_predict_device="true")
         except Exception:
             # count a fallback only when the host walker actually
             # serves it — a data error raises identically on both paths
             # and must not inflate the device-failure signal
-            out = self.booster.predict(X, raw_score=raw_score,
-                                       num_iteration=ni, device="cpu")
+            out = self._native_predict(X, raw_score, ni)
             self.stats.count("device_fallbacks")
+            if not warmup:
+                self.breaker.record_failure()
             return out
+        if not warmup:
+            self.breaker.record_success()
+        return out
+
+    def _native_predict(self, X: np.ndarray, raw_score: bool,
+                        ni: int) -> np.ndarray:
+        return self.booster.predict(X, raw_score=raw_score,
+                                    num_iteration=ni, device="cpu")
 
     def describe(self) -> Dict:
         return {"key": self.key, "name": self.name, "version": self.version,
                 "num_feature": self.num_feature,
                 "num_trees": self.booster.num_trees(),
-                "device": bool(self.device_on)}
+                "device": bool(self.device_on),
+                "breaker": self.breaker.state}
 
 
 class ModelRegistry:

@@ -14,6 +14,7 @@ warmup contract promises stays at zero for request sizes within
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, Hashable
 
 import numpy as np
@@ -22,8 +23,65 @@ _COUNTERS = (
     "requests_total", "rows_total", "batches_total", "requests_shed",
     "requests_timeout", "device_fallbacks", "compile_cache_hits",
     "compile_cache_misses", "compiles_warmup", "models_loaded",
-    "models_evicted",
+    "models_evicted", "breaker_open", "breaker_halfopen_probes",
 )
+
+
+class CircuitBreaker:
+    """Failure threshold -> open -> timed half-open probe -> closed.
+
+    Guards one model entry's DEVICE predict path: `serving_breaker_failures`
+    consecutive device failures open the breaker (requests short-circuit
+    to the native walker with zero device attempts); after
+    `serving_breaker_cooldown_ms` ONE half-open probe retries the device
+    path — success closes the breaker, failure re-opens it for another
+    cooldown.  This replaces the old per-request fallback's two failure
+    modes: hammering a dead device on every request, and (the sticky
+    variant) never retrying a recovered one.  Transitions count into the
+    shared ServingStats (`breaker_open`, `breaker_halfopen_probes`)."""
+
+    def __init__(self, threshold: int = 3, cooldown_s: float = 2.0,
+                 stats: "ServingStats" = None):
+        self._lock = threading.Lock()
+        self.threshold = max(int(threshold), 1)
+        self.cooldown_s = max(float(cooldown_s), 0.0)
+        self.stats = stats
+        self.state = "closed"
+        self._failures = 0
+        self._entered_at = 0.0  # when the current open/half_open began
+
+    def allow(self) -> bool:
+        """May this request try the device path?"""
+        with self._lock:
+            if self.state == "closed":
+                return True
+            # open -> half_open probe after the cooldown; a half_open
+            # whose probe never reported back (a data error can raise
+            # through BOTH paths before record_failure runs) re-probes
+            # after another cooldown instead of wedging the device path
+            # off forever
+            if time.monotonic() - self._entered_at >= self.cooldown_s:
+                self.state = "half_open"
+                self._entered_at = time.monotonic()
+                if self.stats is not None:
+                    self.stats.count("breaker_halfopen_probes")
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = "closed"
+            self._failures = 0
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self.state == "half_open" or self._failures >= self.threshold:
+                if self.state != "open" and self.stats is not None:
+                    self.stats.count("breaker_open")
+                self.state = "open"
+                self._entered_at = time.monotonic()
+                self._failures = 0
 
 
 class ServingStats:

@@ -1,0 +1,306 @@
+"""Atomic iteration-granular training checkpoints.
+
+A checkpoint is one directory (``ckpt-<iteration>``) holding the full
+restart bundle:
+
+* ``model.txt``   — the model string with its ``tpu_bin_mappers:`` and
+  ``pandas_categorical:`` trailers (the same bytes ``save_model`` would
+  write), so trees rebind into bin space EXACTLY on restore;
+* ``state.json``  — the driver's non-array training state: iteration
+  counter, bagging/quantization PRNG key words, numpy bit-generator
+  states, boost-from-average init scores + flags, early-stop callback
+  snapshots, a params fingerprint;
+* ``arrays.npz``  — the f32 score buffers (train + per-valid-set) and
+  the cached bagging mask.  Restoring the scores byte-for-byte is what
+  makes a resumed run produce the *bit-identical* model an
+  uninterrupted run would have: replaying trees through the forest
+  kernel would re-round the f32 accumulation in a different order.
+
+Write protocol (torn-write safe on POSIX): every file lands in a
+hidden temp directory first, each file is flushed + fsync'd, the
+manifest (CRC32 + byte count per file) is written last, the temp
+directory is atomically renamed into place, and the parent directory
+is fsync'd.  A crash at ANY point leaves either a complete previous
+checkpoint or an ignorable temp/corrupt directory — `load_latest`
+walks newest-first and skips (with a warning) anything whose manifest
+is missing, unparseable, or whose CRCs don't match.
+
+Retention keeps the newest `keep` valid checkpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import faultline
+from .log import Log
+
+MANIFEST = "manifest.json"
+_PREFIX = "ckpt-"
+_TMP_PREFIX = ".tmp-ckpt-"
+FORMAT_VERSION = 1
+
+
+def _fsync_dir(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # non-POSIX / exotic fs: rename is still atomic
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_file(path: str, data: bytes) -> None:
+    """Write + fsync one payload file, honoring the `checkpoint_write`
+    fault point: ``truncate`` writes half the bytes (a torn write the
+    manifest CRC will catch), ``raise`` aborts mid-bundle."""
+    action = faultline.fire("checkpoint_write", path=os.path.basename(path))
+    if action == "truncate":
+        data = data[:len(data) // 2]
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+class CheckpointManager:
+    """Atomic write + validated read + keep-last-N retention over one
+    checkpoint directory."""
+
+    def __init__(self, directory: str, keep: int = 3):
+        if not directory:
+            raise ValueError("checkpoint directory must be non-empty")
+        self.directory = str(directory)
+        self.keep = max(int(keep), 1)
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- naming --------------------------------------------------------
+    @staticmethod
+    def _name(iteration: int) -> str:
+        return f"{_PREFIX}{int(iteration):08d}"
+
+    @staticmethod
+    def _iteration_of(name: str) -> Optional[int]:
+        if not name.startswith(_PREFIX):
+            return None
+        try:
+            return int(name[len(_PREFIX):])
+        except ValueError:
+            return None
+
+    def checkpoints(self) -> List[Tuple[int, str]]:
+        """(iteration, path) of every checkpoint-named dir, newest first
+        (validity NOT checked here)."""
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            it = self._iteration_of(name)
+            if it is not None:
+                out.append((it, os.path.join(self.directory, name)))
+        out.sort(reverse=True)
+        return out
+
+    def latest_iteration(self) -> Optional[int]:
+        cks = self.checkpoints()
+        return cks[0][0] if cks else None
+
+    # -- write ---------------------------------------------------------
+    def save(self, iteration: int, model_text: str, state: Dict,
+             arrays: Dict[str, np.ndarray]) -> str:
+        """Write one atomic checkpoint bundle; returns its path.
+        Re-saving an iteration that already has a VALID checkpoint is a
+        no-op (the flush-on-exit path may race a just-written interval
+        checkpoint)."""
+        final = os.path.join(self.directory, self._name(iteration))
+        if os.path.isdir(final) and self.validate(final):
+            return final
+        tmp = os.path.join(self.directory,
+                           f"{_TMP_PREFIX}{int(iteration):08d}-{os.getpid()}")
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            import io as _io
+
+            payloads: Dict[str, bytes] = {
+                "model.txt": model_text.encode("utf-8"),
+                "state.json": json.dumps(state, sort_keys=True).encode(),
+            }
+            buf = _io.BytesIO()
+            np.savez(buf, **arrays)
+            payloads["arrays.npz"] = buf.getvalue()
+            manifest = {"format": FORMAT_VERSION, "iteration": int(iteration),
+                        "files": {}}
+            for name, data in payloads.items():
+                # the manifest records the INTENDED bytes: an injected
+                # (or real) torn write then fails CRC validation exactly
+                # like a crash mid-write would
+                manifest["files"][name] = {"crc32": zlib.crc32(data),
+                                           "bytes": len(data)}
+                _write_file(os.path.join(tmp, name), data)
+            with open(os.path.join(tmp, MANIFEST), "w") as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            _fsync_dir(tmp)
+            if os.path.isdir(final):  # stale invalid leftover
+                shutil.rmtree(final, ignore_errors=True)
+            os.replace(tmp, final)
+            _fsync_dir(self.directory)
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        self._retain()
+        return final
+
+    def _retain(self) -> None:
+        """Keep the newest `keep` checkpoints; drop older ones and any
+        stale temp directories."""
+        for it, path in self.checkpoints()[self.keep:]:
+            shutil.rmtree(path, ignore_errors=True)
+        try:
+            for name in os.listdir(self.directory):
+                if name.startswith(_TMP_PREFIX):
+                    shutil.rmtree(os.path.join(self.directory, name),
+                                  ignore_errors=True)
+        except OSError:
+            pass
+
+    # -- read ----------------------------------------------------------
+    def validate(self, path: str) -> bool:
+        """Manifest present, parseable, and every listed file's CRC32 +
+        size match."""
+        try:
+            with open(os.path.join(path, MANIFEST)) as f:
+                manifest = json.load(f)
+            files = manifest["files"]
+            for name, meta in files.items():
+                with open(os.path.join(path, name), "rb") as f:
+                    data = f.read()
+                if len(data) != int(meta["bytes"]) \
+                        or zlib.crc32(data) != int(meta["crc32"]):
+                    return False
+            return {"model.txt", "state.json", "arrays.npz"} <= set(files)
+        except (OSError, ValueError, KeyError, TypeError):
+            return False
+
+    def load_latest(self) -> Optional[Tuple[int, str, Dict, Dict, str]]:
+        """Newest VALID checkpoint as (iteration, model_text, state,
+        arrays, path); torn/corrupt checkpoints are skipped with a
+        warning.  None when no valid checkpoint exists."""
+        for it, path in self.checkpoints():
+            if not self.validate(path):
+                Log.warning(f"skipping corrupt/torn checkpoint {path} "
+                            "(manifest missing or CRC mismatch)")
+                continue
+            try:
+                with open(os.path.join(path, "model.txt"),
+                          encoding="utf-8") as f:
+                    model_text = f.read()
+                with open(os.path.join(path, "state.json")) as f:
+                    state = json.load(f)
+                with np.load(os.path.join(path, "arrays.npz"),
+                             allow_pickle=False) as z:
+                    arrays = {k: z[k] for k in z.files}
+            except (OSError, ValueError, KeyError) as exc:
+                Log.warning(f"skipping unreadable checkpoint {path}: {exc}")
+                continue
+            return it, model_text, state, arrays, path
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Booster-level bundle assembly
+# ---------------------------------------------------------------------------
+def _params_fingerprint(params: Dict) -> int:
+    """Stable fingerprint of the training params a bitwise resume
+    depends on (everything: cheap, and any difference is suspect)."""
+    try:
+        text = json.dumps({str(k): str(v) for k, v in params.items()},
+                          sort_keys=True)
+    except (TypeError, ValueError):
+        text = str(sorted(str(k) for k in params))
+    return zlib.crc32(text.encode())
+
+
+def _callback_states(callbacks) -> Dict:
+    out = {}
+    for cb in callbacks or []:
+        key = getattr(cb, "state_key", None)
+        snap = getattr(cb, "snapshot_state", None)
+        if key and callable(snap):
+            out[str(key)] = snap()
+    return out
+
+
+def save_checkpoint(booster, manager: CheckpointManager,
+                    callbacks=None) -> str:
+    """Write one atomic checkpoint of a live training booster."""
+    state, arrays = booster._driver.capture_train_state()
+    state["best_iteration"] = int(booster.best_iteration)
+    state["params_fingerprint"] = _params_fingerprint(booster.params)
+    cb_states = _callback_states(callbacks)
+    if cb_states:
+        state["callbacks"] = cb_states
+    model_text = booster.model_to_string(num_iteration=-1)
+    return manager.save(state["iteration"], model_text, state, arrays)
+
+
+def restore_checkpoint(booster, manager: CheckpointManager,
+                       callbacks=None) -> Optional[Dict]:
+    """Restore a booster from the newest valid checkpoint; returns the
+    restored state dict (with "iteration") or None when no valid
+    checkpoint exists.  The booster must have been constructed with the
+    SAME training dataset and params as the checkpointed run for the
+    bitwise-resume guarantee to hold; a params fingerprint mismatch
+    warns but proceeds."""
+    found = manager.load_latest()
+    if found is None:
+        return None
+    it, model_text, state, arrays, path = found
+    fp = _params_fingerprint(booster.params)
+    if state.get("params_fingerprint") not in (None, fp):
+        Log.warning(
+            f"resuming from {path} with different training params; the "
+            "resumed model will NOT be bit-identical to an uninterrupted "
+            "run")
+    booster._driver.restore_train_state(model_text, state, arrays)
+    booster.best_iteration = int(state.get("best_iteration", -1))
+    for cb in callbacks or []:
+        key = getattr(cb, "state_key", None)
+        restore = getattr(cb, "restore_state", None)
+        saved = (state.get("callbacks") or {}).get(str(key)) if key else None
+        if saved is not None and callable(restore):
+            restore(saved)
+    Log.info(f"resumed training from checkpoint {path} "
+             f"(iteration {state['iteration']})")
+    return state
+
+
+def flush_checkpoint(booster, manager: CheckpointManager,
+                     callbacks=None) -> Optional[str]:
+    """Best-effort final checkpoint (interrupt/exit path): skips when a
+    VALID newest checkpoint already covers the current iteration (a torn
+    same-iteration bundle must not suppress the flush); never lets a
+    checkpoint failure mask the original exception."""
+    try:
+        cks = manager.checkpoints()
+        if cks and cks[0][0] == booster.current_iteration() \
+                and manager.validate(cks[0][1]):
+            return None
+        return save_checkpoint(booster, manager, callbacks=callbacks)
+    except BaseException as exc:  # noqa: BLE001 - must not mask the cause
+        Log.warning(f"final checkpoint flush failed: "
+                    f"{type(exc).__name__}: {exc}")
+        return None

@@ -1,0 +1,148 @@
+"""Fault-injection harness: named points the runtime fires on its hot
+paths and tests (or `tools/perf_probe.py faults`) can arm.
+
+The production code calls `fire("<point>")` at each instrumented site;
+unarmed points take NO lock — one GIL-atomic dict increment — so the
+hot serving/predict paths never serialize on the harness.  Arming a
+point
+makes the matching `fire` either raise (simulating a device/runtime
+error at exactly that site) or return an action string the site knows
+how to apply:
+
+* ``raise``    — raise the armed exception (default `FaultInjected`);
+  the site's normal error handling (iteration rollback, serving
+  fallback, checkpoint-write recovery) must contain it.
+* ``poison``   — the site corrupts its own output (the `grow_step`
+  point NaN-poisons the iteration's scores) so the numeric guardrails
+  (`tpu_guard_numerics`) can be exercised deterministically.
+* ``truncate`` — the site writes only half its payload (the
+  `checkpoint_write` point produces a torn file whose manifest CRC
+  cannot match) so recovery-from-corruption paths are testable.
+
+Points are process-global and thread-safe; `reset()` disarms
+everything.  Hit counters count every `fire` since the last reset, so
+"arm at the k-th hit" addresses a specific iteration/request without
+the site threading indices through.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional
+
+POINTS = ("grow_step", "h2d_copy", "checkpoint_write", "serve_dispatch")
+
+_ACTIONS = ("raise", "poison", "truncate")
+
+
+class FaultInjected(RuntimeError):
+    """The default exception an armed ``raise`` point throws."""
+
+
+class _Spec:
+    __slots__ = ("action", "exc", "at", "times")
+
+    def __init__(self, action: str, exc, at: int, times: int):
+        self.action = action
+        self.exc = exc
+        self.at = int(at)
+        self.times = int(times)
+
+
+_lock = threading.Lock()
+_armed: Dict[str, List[_Spec]] = {}
+_hits: Dict[str, int] = {}
+
+
+def _check_point(point: str) -> None:
+    if point not in POINTS:
+        raise ValueError(f"unknown fault point {point!r}; known: {POINTS}")
+
+
+def arm(point: str, action: str = "raise", exc=None, at: int = 1,
+        times: int = 1) -> None:
+    """Arm `point`: starting at its `at`-th hit from now, apply `action`
+    for the next `times` hits.  `exc` (an exception instance or class)
+    overrides the default `FaultInjected` for ``raise`` actions."""
+    _check_point(point)
+    if action not in _ACTIONS:
+        raise ValueError(f"unknown fault action {action!r}; known: {_ACTIONS}")
+    if exc is None:
+        exc = FaultInjected(f"injected fault at {point!r}")
+    with _lock:
+        base = _hits.get(point, 0)
+        _armed.setdefault(point, []).append(
+            _Spec(action, exc, base + max(int(at), 1), max(int(times), 1)))
+
+
+def disarm(point: Optional[str] = None) -> None:
+    with _lock:
+        if point is None:
+            _armed.clear()
+        else:
+            _armed.pop(point, None)
+
+
+def reset() -> None:
+    """Disarm everything and zero the hit counters."""
+    with _lock:
+        _armed.clear()
+        _hits.clear()
+
+
+def hits(point: str) -> int:
+    _check_point(point)
+    with _lock:
+        return _hits.get(point, 0)
+
+
+def fire(point: str, **info) -> Optional[str]:
+    """One hit on `point`.  Raises when an armed ``raise`` spec matches;
+    otherwise returns the matched action string ("poison"/"truncate")
+    or None.  `info` kwargs are attached to raised FaultInjected
+    exceptions for diagnostics.
+
+    Unarmed fast path: no lock.  The counter update is a single dict
+    store (GIL-atomic in CPython); exact hit accounting under heavy
+    cross-thread contention only matters while a point is armed, and
+    armed points take the locked path."""
+    if point not in _armed:
+        _hits[point] = _hits.get(point, 0) + 1
+        return None
+    with _lock:
+        hit = _hits.get(point, 0) + 1
+        _hits[point] = hit
+        specs = _armed.get(point)
+        if not specs:
+            return None
+        matched = None
+        for spec in specs:
+            if spec.times > 0 and hit >= spec.at:
+                spec.times -= 1
+                matched = spec
+                break
+        if matched is not None and not any(s.times > 0 for s in specs):
+            del _armed[point]
+    if matched is None:
+        return None
+    if matched.action == "raise":
+        exc = matched.exc
+        if isinstance(exc, type):
+            exc = exc(f"injected fault at {point!r}")
+        if isinstance(exc, FaultInjected) and info:
+            exc.args = (f"{exc.args[0] if exc.args else point} "
+                        f"({', '.join(f'{k}={v}' for k, v in info.items())})",)
+        raise exc
+    return matched.action
+
+
+@contextlib.contextmanager
+def armed(point: str, action: str = "raise", exc=None, at: int = 1,
+          times: int = 1):
+    """Context-managed arm/disarm of one point."""
+    arm(point, action=action, exc=exc, at=at, times=times)
+    try:
+        yield
+    finally:
+        disarm(point)

@@ -267,6 +267,36 @@ class TestDonationBitwise:
         assert a != b  # the traced flag actually changes the rounding
 
 
+class TestCheckpointRetrace:
+    def test_checkpointed_train_and_resume_add_zero_programs(
+            self, ledger, tmp_path):
+        """Bench hygiene (ISSUE 7): interval checkpointing is pure host
+        IO + device_get — a checkpointed train (and a resumed one) must
+        add ZERO programs to the CompileLedger beyond what the identical
+        un-checkpointed train compiles."""
+        X, y = _data(1400, 6, seed=17)
+        ds = lgb.Dataset(X, label=y, params=P_LIFE)
+        lgb.train(P_LIFE, ds, num_boost_round=3,
+                  keep_training_booster=True)
+        base = ledger.n_programs()
+
+        p = dict(P_LIFE, tpu_checkpoint_dir=str(tmp_path),
+                 tpu_checkpoint_interval=1)
+        ds2 = lgb.Dataset(X, label=y, params=p)
+        lgb.train(p, ds2, num_boost_round=3, keep_training_booster=True)
+        assert ledger.n_programs() == base, (
+            "checkpointing compiled new programs:\n"
+            + ledger.format_report())
+
+        ds3 = lgb.Dataset(X, label=y, params=p)
+        bst = lgb.train(p, ds3, num_boost_round=5,
+                        keep_training_booster=True, resume=True)
+        assert bst.num_trees() == 5
+        assert ledger.n_programs() == base, (
+            "checkpoint resume compiled new programs:\n"
+            + ledger.format_report())
+
+
 class TestServingWarmupDedupe:
     def test_second_same_shaped_model_adds_zero_programs(self):
         from lightgbm_tpu.ops.predict import _class_scores_kernel

@@ -1,0 +1,555 @@
+"""Fault-tolerant training (ISSUE 7): atomic checkpoint/resume, the
+fault-injection harness, numeric guardrails, and the serving circuit
+breaker.
+
+The load-bearing guarantee under test: a training run interrupted at an
+arbitrary iteration (injected device error, KeyboardInterrupt, SIGTERM)
+and resumed from the newest VALID checkpoint produces a model
+byte-identical to a never-interrupted run — serial and data-sharded,
+float and quantized precisions.  Model comparisons strip the trailing
+`parameters:` block (it legitimately embeds `tpu_checkpoint_dir`);
+every tree byte and the mapper trailer are compared.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.models.gbdt import quant_headroom_check
+from lightgbm_tpu.utils import faultline
+from lightgbm_tpu.utils.checkpoint import CheckpointManager
+from lightgbm_tpu.utils.log import LightGBMError
+
+P = {"objective": "binary", "num_leaves": 13, "max_bin": 47,
+     "min_data_in_leaf": 5, "bagging_fraction": 0.8, "bagging_freq": 1,
+     "verbosity": -1}
+
+
+def _data(n=1500, f=6, seed=11):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.4 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def _model(bst) -> str:
+    """Model bytes minus the parameters block (which embeds the
+    checkpoint dir and so differs between runs by construction)."""
+    return bst.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+
+
+def _train(params, X, y, rounds, **kw):
+    ds = lgb.Dataset(X, label=y, params=params)
+    return lgb.train(params, ds, num_boost_round=rounds,
+                     keep_training_booster=True, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+X, Y = _data()
+
+
+class TestFaultline:
+    def test_unknown_point_and_action_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faultline.arm("nope")
+        with pytest.raises(ValueError, match="unknown fault action"):
+            faultline.arm("grow_step", action="explode")
+
+    def test_at_and_times_addressing(self):
+        faultline.arm("grow_step", action="poison", at=2, times=2)
+        assert faultline.fire("grow_step") is None
+        assert faultline.fire("grow_step") == "poison"
+        assert faultline.fire("grow_step") == "poison"
+        assert faultline.fire("grow_step") is None  # exhausted + disarmed
+        assert faultline.hits("grow_step") == 4
+
+    def test_raise_carries_context(self):
+        faultline.arm("h2d_copy")
+        with pytest.raises(faultline.FaultInjected, match="rows=7"):
+            faultline.fire("h2d_copy", rows=7)
+
+    def test_armed_context_manager(self):
+        with faultline.armed("serve_dispatch"):
+            with pytest.raises(faultline.FaultInjected):
+                faultline.fire("serve_dispatch")
+        assert faultline.fire("serve_dispatch") is None
+
+
+class TestCheckpointManager:
+    def test_atomic_bundle_and_retention(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for it in (1, 2, 3):
+            mgr.save(it, f"model-{it}", {"iteration": it},
+                     {"train_scores": np.full((1, 4), it, np.float32)})
+        names = sorted(os.listdir(tmp_path))
+        assert names == ["ckpt-00000002", "ckpt-00000003"]
+        it, text, state, arrays, _ = mgr.load_latest()
+        assert (it, text, state["iteration"]) == (3, "model-3", 3)
+        np.testing.assert_array_equal(arrays["train_scores"],
+                                      np.full((1, 4), 3, np.float32))
+
+    def test_torn_manifest_and_truncated_payload_skipped(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=10)
+        for it in (1, 2, 3):
+            mgr.save(it, f"model-{it}", {"iteration": it},
+                     {"a": np.zeros(2, np.float32)})
+        # newest: unparseable manifest; second: torn payload
+        with open(tmp_path / "ckpt-00000003" / "manifest.json", "w") as f:
+            f.write("{torn")
+        p = tmp_path / "ckpt-00000002" / "model.txt"
+        p.write_bytes(p.read_bytes()[:3])
+        it, text, _, _, _ = mgr.load_latest()
+        assert (it, text) == (1, "model-1")
+
+    def test_injected_truncation_fails_crc(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        with faultline.armed("checkpoint_write", action="truncate"):
+            mgr.save(1, "model body text", {"iteration": 1},
+                     {"a": np.zeros(2, np.float32)})
+        assert mgr.load_latest() is None  # torn write -> CRC mismatch
+
+    def test_injected_raise_leaves_no_final_checkpoint(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        with faultline.armed("checkpoint_write", action="raise"):
+            with pytest.raises(faultline.FaultInjected):
+                mgr.save(1, "m", {"iteration": 1},
+                         {"a": np.zeros(2, np.float32)})
+        assert mgr.load_latest() is None
+        mgr.save(2, "m2", {"iteration": 2}, {"a": np.zeros(2, np.float32)})
+        assert mgr.load_latest()[0] == 2  # temp debris cleaned, dir usable
+
+
+class TestCheckpointResume:
+    def test_checkpointing_is_bit_invisible(self, tmp_path):
+        base = _model(_train(P, X, Y, 6))
+        p = dict(P, tpu_checkpoint_dir=str(tmp_path),
+                 tpu_checkpoint_interval=1, tpu_checkpoint_keep=2)
+        bst = _train(p, X, Y, 6)
+        assert _model(bst) == base
+        assert sorted(os.listdir(tmp_path)) == \
+            ["ckpt-00000005", "ckpt-00000006"]
+
+    def test_round_trip_state_parity(self, tmp_path):
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        for _ in range(3):
+            bst.update()
+        bst.save_checkpoint(str(tmp_path))
+        state_a, arrays_a = bst._driver.capture_train_state()
+
+        ds2 = lgb.Dataset(X, label=Y, params=p)
+        bst2 = Booster(params=p, train_set=ds2)
+        assert bst2.resume_from_checkpoint(str(tmp_path)) == 3
+        assert _model(bst2) == _model(bst)
+        state_b, arrays_b = bst2._driver.capture_train_state()
+        assert state_a == state_b
+        for k in arrays_a:
+            np.testing.assert_array_equal(arrays_a[k], arrays_b[k])
+
+    @pytest.mark.parametrize("precision", ["hilo", "int8", "int16"])
+    def test_resume_matches_uninterrupted_serial(self, tmp_path, precision):
+        p = dict(P, tpu_hist_precision=precision)
+        base = _model(_train(p, X, Y, 6))
+        pc = dict(p, tpu_checkpoint_dir=str(tmp_path),
+                  tpu_checkpoint_interval=1)
+        _train(pc, X, Y, 3)
+        resumed = _train(pc, X, Y, 6, resume=True)
+        assert _model(resumed) == base
+
+    def test_resume_matches_uninterrupted_int8_2shard(self, tmp_path):
+        p = dict(P, tpu_hist_precision="int8", tree_learner="data",
+                 num_machines=2, tpu_quant_refit_leaves=False)
+        base = _model(_train(p, X, Y, 5))
+        pc = dict(p, tpu_checkpoint_dir=str(tmp_path))
+        _train(pc, X, Y, 2)
+        assert _model(_train(pc, X, Y, 5, resume=True)) == base
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("precision", ["int8", "int16"])
+    def test_resume_matches_uninterrupted_4shard(self, tmp_path, precision):
+        p = dict(P, tpu_hist_precision=precision, tree_learner="data",
+                 num_machines=4, tpu_quant_refit_leaves=False)
+        base = _model(_train(p, X, Y, 5))
+        pc = dict(p, tpu_checkpoint_dir=str(tmp_path))
+        _train(pc, X, Y, 2)
+        assert _model(_train(pc, X, Y, 5, resume=True)) == base
+
+    def test_resume_without_checkpoints_trains_from_scratch(self, tmp_path):
+        p = dict(P, tpu_checkpoint_dir=str(tmp_path / "empty"))
+        bst = _train(p, X, Y, 4, resume=True)
+        assert bst.num_trees() == 4
+
+    def test_resume_needs_checkpoint_dir(self):
+        with pytest.raises(ValueError, match="tpu_checkpoint_dir"):
+            _train(dict(P), X, Y, 2, resume=True)
+
+    def test_early_stopping_state_rides_the_bundle(self, tmp_path):
+        Xv, Yv = _data(600, 6, seed=99)
+        p = dict(P, tpu_checkpoint_dir=str(tmp_path))
+
+        def run(rounds, resume=False):
+            ds = lgb.Dataset(X, label=Y, params=p)
+            vd = ds.create_valid(Xv, label=Yv)
+            return lgb.train(p, ds, num_boost_round=rounds,
+                             valid_sets=[vd], early_stopping_rounds=2,
+                             verbose_eval=False, resume=resume,
+                             keep_training_booster=True)
+
+        full = run(12)
+        import shutil
+
+        shutil.rmtree(tmp_path)
+        run(4)  # interrupted run: 4 iterations, checkpointed
+        resumed = run(12, resume=True)
+        assert resumed.best_iteration == full.best_iteration
+        assert _model(resumed) == _model(full)
+
+
+class TestInterruptSafety:
+    def test_device_error_rolls_back_and_retrain_is_bitwise(self):
+        base = _model(_train(P, X, Y, 5))
+        ds = lgb.Dataset(X, label=Y, params=P)
+        bst = Booster(params=P, train_set=ds)
+        faultline.arm("grow_step", action="raise", at=3)
+        errors = 0
+        while bst.current_iteration() < 5:
+            try:
+                bst.update()
+            except faultline.FaultInjected:
+                errors += 1
+                # rolled back to the last COMPLETE iteration, usable
+                assert bst.current_iteration() == 2
+                assert np.isfinite(
+                    bst.predict(X[:16], raw_score=True)).all()
+        assert errors == 1
+        assert _model(bst) == base
+
+    def test_interrupt_flushes_checkpoint_and_resume_is_bitwise(
+            self, tmp_path):
+        base = _model(_train(P, X, Y, 6))
+        p = dict(P, tpu_checkpoint_dir=str(tmp_path),
+                 tpu_checkpoint_interval=2)
+        faultline.arm("grow_step", action="raise",
+                      exc=KeyboardInterrupt("simulated preemption"), at=4)
+        with pytest.raises(KeyboardInterrupt):
+            _train(p, X, Y, 6)
+        # iterations 0..2 completed; the flush wrote the off-interval 3
+        assert CheckpointManager(str(tmp_path)).latest_iteration() == 3
+        assert _model(_train(p, X, Y, 6, resume=True)) == base
+
+    def test_sigterm_flushes_checkpoint(self, tmp_path):
+        if threading.current_thread() is not threading.main_thread():
+            pytest.skip("signal handlers need the main thread")
+        p = dict(P, tpu_checkpoint_dir=str(tmp_path),
+                 tpu_checkpoint_interval=100)  # only the flush writes
+
+        class KillAt:
+            order = 0
+            before_iteration = True
+
+            def __call__(self, env):
+                if env.iteration == 3:
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+        with pytest.raises(KeyboardInterrupt):
+            _train(p, X, Y, 6, callbacks=[KillAt()])
+        assert CheckpointManager(str(tmp_path)).latest_iteration() == 3
+        assert signal.getsignal(signal.SIGTERM) in (
+            signal.SIG_DFL, signal.default_int_handler)  # handler restored
+
+    @pytest.mark.parametrize("point", ["grow_step", "h2d_copy",
+                                       "checkpoint_write"])
+    def test_booster_usable_after_interrupt_at_each_point(self, point,
+                                                          tmp_path):
+        p = dict(P, tpu_checkpoint_dir=str(tmp_path / point),
+                 tpu_predict_device="true", tpu_predict_min_rows=1)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        bst.update()
+        faultline.arm(point, action="raise",
+                      exc=KeyboardInterrupt("simulated"))
+        interrupted = False
+        try:
+            bst.update()                       # fires grow_step
+            bst.save_checkpoint(str(tmp_path / point))  # checkpoint_write
+            bst.predict(X[:64], raw_score=True,
+                        device="tpu", tpu_predict_device="true")  # h2d
+        except KeyboardInterrupt:
+            interrupted = True
+        faultline.reset()
+        assert interrupted, point
+        # after the interrupt the booster predicts AND keeps training
+        assert np.isfinite(bst.predict(X[:16], raw_score=True)).all()
+        before = bst.current_iteration()
+        bst.update()
+        assert bst.current_iteration() == before + 1
+
+
+class TestRollbackEdgeCases:
+    def test_dart_normalize_undone_on_rollback(self):
+        """DART's _normalize mutates EXISTING trees in place
+        (apply_shrinkage); a rolled-back iteration must undo that or the
+        model is permanently corrupted."""
+        p = dict(P, boosting="dart", skip_drop=0.0, drop_rate=0.5,
+                 bagging_freq=0, bagging_fraction=1.0,
+                 tpu_guard_numerics="raise")
+        base = _model(_train(dict(p, tpu_guard_numerics="off"), X, Y, 5))
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        faultline.arm("grow_step", action="poison", at=3)
+        done = errors = 0
+        while done < 5:
+            try:
+                bst.update()
+                done += 1
+            except LightGBMError:
+                errors += 1
+        assert errors == 1
+        assert _model(bst) == base, \
+            "dropped trees stayed rescaled after rollback"
+
+    def test_resume_with_init_model_trains_remaining_rounds(self, tmp_path):
+        # bagging_freq=5 (refresh off-boundary) also covers the iter_
+        # semantics: a mid-train materialize (checkpoint save) must not
+        # shift the new-round counter by the init model's iterations, or
+        # the continuation's bagging schedule drifts
+        base = dict(P, bagging_freq=5)
+        init = _train(base, X, Y, 3)
+        init_str = init.model_to_string(num_iteration=-1)
+
+        def cont(params, rounds, **kw):
+            ds = lgb.Dataset(X, label=Y, params=params)
+            return lgb.train(params, ds, num_boost_round=rounds,
+                             init_model=lgb.Booster(model_str=init_str),
+                             keep_training_booster=True, **kw)
+
+        full = cont(dict(base), 6)
+        assert full.num_trees() == 9
+        p = dict(base, tpu_checkpoint_dir=str(tmp_path))
+        cont(p, 3)  # interrupted: 3 of 6 continuation rounds
+        resumed = cont(p, 6, resume=True)
+        assert resumed.num_trees() == 9  # 3 init + 6 continuation
+        assert _model(resumed) == _model(full)
+
+    def test_flush_rewrites_torn_same_iteration_bundle(self, tmp_path):
+        from lightgbm_tpu.utils.checkpoint import flush_checkpoint
+
+        ds = lgb.Dataset(X, label=Y, params=P)
+        bst = Booster(params=P, train_set=ds)
+        bst.update()
+        bst.update()
+        mgr = CheckpointManager(str(tmp_path))
+        bst.save_checkpoint(str(tmp_path))
+        name = mgr.checkpoints()[0][1]
+        with open(os.path.join(name, "manifest.json"), "w") as f:
+            f.write("{torn")
+        flush_checkpoint(bst, mgr)
+        found = mgr.load_latest()
+        assert found is not None and found[0] == 2
+
+
+class TestNumericGuardrails:
+    def _poisoned(self, mode, rounds=4, at=2):
+        p = dict(P, tpu_guard_numerics=mode)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        faultline.arm("grow_step", action="poison", at=at)
+        raised = None
+        try:
+            for _ in range(rounds):
+                bst.update()
+        except LightGBMError as exc:
+            raised = exc
+        return bst, raised
+
+    def test_off_mode_propagates_silently(self):
+        bst, raised = self._poisoned("off")
+        assert raised is None
+        assert not np.isfinite(
+            bst._driver.train_scores.numpy()).all()
+
+    def test_warn_mode_continues(self, capsys):
+        bst, raised = self._poisoned("warn")
+        assert raised is None
+        assert "tpu_guard_numerics=warn" in capsys.readouterr().out
+
+    def test_raise_mode_rolls_back_then_raises(self):
+        bst, raised = self._poisoned("raise")
+        assert raised is not None and "non-finite" in str(raised)
+        # the poisoned iteration was rolled back: booster stays usable
+        assert bst.current_iteration() == 1
+        assert np.isfinite(bst.predict(X[:16], raw_score=True)).all()
+
+    def test_skip_mode_drops_the_iteration_and_recovers(self):
+        bst, raised = self._poisoned("skip", rounds=5)
+        assert raised is None
+        assert bst._driver._guard_skips_total == 1
+        assert bst.current_iteration() == 4  # one update was dropped
+        assert np.isfinite(bst._driver.train_scores.numpy()).all()
+        assert np.isfinite(bst.predict(X[:16], raw_score=True)).all()
+
+    def test_skip_mode_caps_consecutive_poison(self):
+        p = dict(P, tpu_guard_numerics="skip")
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        faultline.arm("grow_step", action="poison", at=1, times=50)
+        with pytest.raises(LightGBMError, match="consecutive poisoned"):
+            for _ in range(20):
+                bst.update()
+
+    def test_skip_rebags_off_the_refresh_boundary(self):
+        """A poisoned iteration that is NOT a bagging_freq boundary must
+        still draw a FRESH mask on retry — otherwise the replay is
+        bit-identical and the streak cap aborts deterministically."""
+        p = dict(P, tpu_guard_numerics="skip", bagging_freq=5)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        faultline.arm("grow_step", action="poison", at=3)
+        for _ in range(6):
+            bst.update()
+        assert bst._driver._guard_skips_total == 1
+        assert np.isfinite(bst._driver.train_scores.numpy()).all()
+
+    def test_skip_without_stochastic_lever_raises_immediately(self):
+        p = dict(P, tpu_guard_numerics="skip", bagging_freq=0,
+                 bagging_fraction=1.0)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        faultline.arm("grow_step", action="poison", at=2)
+        with pytest.raises(LightGBMError, match="no stochastic lever"):
+            for _ in range(4):
+                bst.update()
+        # raised after ONE detection, not after burning the streak
+        assert bst._driver._guard_skips_total == 0
+        assert np.isfinite(bst.predict(X[:16], raw_score=True)).all()
+
+    def test_unknown_guard_mode_rejected(self):
+        ds = lgb.Dataset(X, label=Y, params=P)
+        with pytest.raises(ValueError, match="tpu_guard_numerics"):
+            Booster(params=dict(P, tpu_guard_numerics="explode"),
+                    train_set=ds)
+
+    def test_quant_headroom_sentinel(self, capsys):
+        # int16 narrows past ~65k rows: warn
+        q = quant_headroom_check("int16", 200_000, "warn")
+        assert q < 32767
+        assert "histogram headroom" in capsys.readouterr().out
+        # raise mode only fires once fewer than 7 bits of grid remain
+        quant_headroom_check("int16", 10_000_000, "warn")
+        with pytest.raises(LightGBMError, match="headroom"):
+            quant_headroom_check("int16", 100_000_000, "raise")
+        # no narrowing -> silent
+        capsys.readouterr()
+        quant_headroom_check("int16", 1000, "warn")
+        assert "headroom" not in capsys.readouterr().out
+        # int8's floor is precision-relative: a mild narrowing of an
+        # essentially full grid must NOT raise (dtype max is only 127)
+        assert quant_headroom_check("int8", 20_000_000, "raise") > 31
+
+
+class TestServingBreaker:
+    def _session(self, bst, **over):
+        from lightgbm_tpu.serving import ServingSession
+
+        params = {"serving_max_batch_rows": 512, "verbosity": -1,
+                  "serving_breaker_failures": 2,
+                  "serving_breaker_cooldown_ms": 80.0}
+        params.update(over)
+        sess = ServingSession(params=params)
+        sess.load("m", booster=bst)
+        return sess
+
+    def test_open_halfopen_close_cycle(self):
+        bst = _train(P, X, Y, 4)
+        ref = bst.predict(X[:40], raw_score=True, device="cpu")
+        sess = self._session(bst)
+        try:
+            faultline.arm("serve_dispatch", action="raise", times=10)
+            # every request is served correctly via the walker fallback
+            for _ in range(3):
+                np.testing.assert_allclose(
+                    sess.predict("m", X[:40], raw_score=True), ref,
+                    rtol=0, atol=1e-6)
+            st = sess.stats()
+            assert st["breaker_open"] >= 1
+            # request 3 short-circuited: only 2 device attempts failed
+            assert st["device_fallbacks"] == 2
+            assert [m["breaker"] for m in sess.models()] == ["open"]
+            # OPEN: no device dispatch attempts at all
+            h0 = faultline.hits("serve_dispatch")
+            sess.predict("m", X[:40], raw_score=True)
+            assert faultline.hits("serve_dispatch") == h0
+            # cooldown elapses, fault cleared: half-open probe closes it
+            time.sleep(0.12)
+            faultline.disarm()
+            np.testing.assert_allclose(
+                sess.predict("m", X[:40], raw_score=True), ref,
+                rtol=0, atol=1e-6)
+            st = sess.stats()
+            assert st["breaker_halfopen_probes"] >= 1
+            assert [m["breaker"] for m in sess.models()] == ["closed"]
+        finally:
+            sess.close()
+
+    def test_failed_probe_reopens(self):
+        bst = _train(P, X, Y, 4)
+        sess = self._session(bst, serving_breaker_cooldown_ms=40.0)
+        try:
+            faultline.arm("serve_dispatch", action="raise", times=100)
+            for _ in range(2):
+                sess.predict("m", X[:20], raw_score=True)
+            assert [m["breaker"] for m in sess.models()] == ["open"]
+            time.sleep(0.06)
+            sess.predict("m", X[:20], raw_score=True)  # probe fails
+            st = sess.stats()
+            assert st["breaker_halfopen_probes"] >= 1
+            assert [m["breaker"] for m in sess.models()] == ["open"]
+            assert st["breaker_open"] >= 2  # re-opened after the probe
+        finally:
+            sess.close()
+
+    def test_stuck_halfopen_probe_self_heals(self):
+        """A probe that never reports back (a data error raises through
+        BOTH predict paths before record_failure runs) must not wedge
+        the breaker in half_open forever."""
+        from lightgbm_tpu.serving import CircuitBreaker
+
+        br = CircuitBreaker(threshold=1, cooldown_s=0.03)
+        br.record_failure()
+        assert br.state == "open"
+        time.sleep(0.04)
+        assert br.allow()               # the probe...
+        assert br.state == "half_open"
+        assert not br.allow()           # ...is exclusive while pending
+        # probe vanished without record_success/record_failure: after
+        # another cooldown a new probe is allowed
+        time.sleep(0.04)
+        assert br.allow()
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_fallback_results_stay_correct_under_injection(self):
+        bst = _train(P, X, Y, 4)
+        ref = bst.predict(X[:64], raw_score=True, device="cpu")
+        sess = self._session(bst)
+        try:
+            faultline.arm("serve_dispatch", action="raise", times=1000)
+            for _ in range(5):
+                np.testing.assert_allclose(
+                    sess.predict("m", X[:64], raw_score=True), ref,
+                    rtol=0, atol=1e-6)
+        finally:
+            sess.close()

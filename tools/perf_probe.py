@@ -422,8 +422,132 @@ def run_retrace(n=20000, f=10, leaves=31, bins=63, iters=3):
     return dict(phases), LEDGER.n_programs()
 
 
+def run_faults(n=4000, f=6, iters=5):
+    """Chaos sweep (ISSUE 7): arm every fault-injection point against
+    every relevant handling mode and print one outcome line each — the
+    operational proof that an injected device error, torn checkpoint
+    write, NaN gradient, or serving-dispatch failure ends in a usable
+    booster / recovered checkpoint / breaker-guarded fallback rather
+    than a dead run.
+
+        N=4000 python tools/perf_probe.py faults
+    """
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.booster import Booster
+    from lightgbm_tpu.serving import ServingSession
+    from lightgbm_tpu.utils import faultline
+    from lightgbm_tpu.utils.checkpoint import CheckpointManager
+    from lightgbm_tpu.utils.log import LightGBMError
+
+    X, y = make_data(n, f=f)
+    base_params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+                   "learning_rate": 0.1, "min_data_in_leaf": 20,
+                   "verbosity": -1}
+
+    def outcome(point, mode, text):
+        print(f"{point:<18s} {mode:<6s} {text}", flush=True)
+
+    print(f"{'point':<18s} {'mode':<6s} outcome", flush=True)
+
+    # grow_step x guard modes: a NaN-poisoned iteration under each policy
+    for mode in ("off", "warn", "raise", "skip"):
+        faultline.reset()
+        p = dict(base_params, tpu_guard_numerics=mode)
+        bst = Booster(params=p, train_set=lgb.Dataset(X, label=y, params=p))
+        faultline.arm("grow_step", action="poison", at=2)
+        try:
+            for _ in range(iters):
+                bst.update()
+            finite = bool(np.isfinite(
+                bst.predict(X[:64], raw_score=True)).all())
+            skips = bst._driver._guard_skips_total
+            outcome("grow_step/poison", mode,
+                    f"trained {bst.current_iteration()} iters, "
+                    f"predict finite={finite}, skipped={skips}")
+        except LightGBMError as exc:
+            usable = bool(np.isfinite(
+                bst.predict(X[:64], raw_score=True)).all())
+            outcome("grow_step/poison", mode,
+                    f"raised LightGBMError ({str(exc)[:40]}...), "
+                    f"booster usable={usable}")
+
+    # grow_step raise: injected device error -> rollback -> retrain
+    faultline.reset()
+    bst = Booster(params=dict(base_params),
+                  train_set=lgb.Dataset(X, label=y, params=base_params))
+    faultline.arm("grow_step", action="raise", at=3)
+    errors = 0
+    while bst.current_iteration() < iters:
+        try:
+            bst.update()
+        except faultline.FaultInjected:
+            errors += 1
+    outcome("grow_step/raise", "-",
+            f"{errors} injected error(s) rolled back, retrained to "
+            f"{bst.current_iteration()} iters")
+
+    # h2d_copy raise: device predict falls to an exception the caller
+    # sees; the booster itself stays intact
+    faultline.reset()
+    faultline.arm("h2d_copy", action="raise")
+    try:
+        bst.predict(X[:256], raw_score=True, device="tpu",
+                    tpu_predict_device="true")
+        outcome("h2d_copy/raise", "-", "NOT reached (no device launch)")
+    except faultline.FaultInjected:
+        faultline.reset()
+        ok = bool(np.isfinite(bst.predict(X[:64], raw_score=True)).all())
+        outcome("h2d_copy/raise", "-",
+                f"predict raised, booster usable={ok}")
+
+    # checkpoint_write truncate: torn bundle is skipped, prior one loads
+    faultline.reset()
+    d = tempfile.mkdtemp(prefix="faults-ckpt-")
+    try:
+        bst.save_checkpoint(d)
+        good = CheckpointManager(d).latest_iteration()
+        bst.update()
+        faultline.arm("checkpoint_write", action="truncate")
+        bst.save_checkpoint(d)
+        loaded = CheckpointManager(d).load_latest()
+        outcome("checkpoint_write", "trunc",
+                f"torn bundle skipped, recovered iteration="
+                f"{loaded[0] if loaded else None} (good={good})")
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+    # serve_dispatch raise: breaker opens, walker serves, probe closes
+    faultline.reset()
+    sess = ServingSession(params={"serving_max_batch_rows": 512,
+                                  "verbosity": -1,
+                                  "serving_breaker_failures": 2,
+                                  "serving_breaker_cooldown_ms": 50.0})
+    sess.load("m", booster=bst)
+    faultline.arm("serve_dispatch", action="raise", times=10)
+    for _ in range(3):
+        sess.predict("m", X[:64], raw_score=True)
+    st = sess.stats()
+    time.sleep(0.08)
+    faultline.reset()
+    sess.predict("m", X[:64], raw_score=True)
+    st2 = sess.stats()
+    outcome("serve_dispatch", "raise",
+            f"fallbacks={st['device_fallbacks']} "
+            f"opened={st['breaker_open']} "
+            f"probes={st2['breaker_halfopen_probes']} "
+            f"final={[m['breaker'] for m in sess.models()]}")
+    sess.close()
+
+
 def main():
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
+    if arg == "faults":
+        run_faults(n=int(os.environ.get("N", 4000)),
+                   iters=int(os.environ.get("ITERS", 5)))
+        return
     if arg == "retrace":
         run_retrace(n=int(os.environ.get("N", 20000)),
                     leaves=int(os.environ.get("LEAVES", 31)),
