@@ -325,6 +325,44 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         restore_checkpoint(bst_resumed, manager)
         resume_s = time.time() - t0
         del bst_resumed
+
+        # ISSUE 8: elastic resume — the same bundle restored onto a
+        # DIFFERENT shard topology (2-way data mesh when the backend has
+        # the devices; degenerates to same-topology resume on 1 device,
+        # still timing the elastic validation path)
+        p_el = dict(params)
+        if len(jax.devices()) >= 2:
+            p_el.update(tree_learner="data", num_machines=2)
+        t0 = time.time()
+        bst_el = Booster(params=p_el, train_set=ds)
+        restore_checkpoint(bst_el, manager)
+        resume_elastic_s = time.time() - t0
+        del bst_el
+
+        # ISSUE 8: watchdog recovery — injected collective hang ->
+        # structured timeout -> final-checkpoint flush -> rebuild +
+        # resume + one boosting iteration (the full degrade-and-recover
+        # cycle a hung peer costs)
+        from lightgbm_tpu.parallel.collective import CollectiveTimeout
+        from lightgbm_tpu.parallel.metric_sync import sync_sums
+        from lightgbm_tpu.utils import faultline as _faultline
+        from lightgbm_tpu.utils.checkpoint import flush_checkpoint
+
+        _faultline.reset()
+        _faultline.arm("collective_sync", action="hang")
+        t0 = time.time()
+        try:
+            sync_sums([1.0])
+        except CollectiveTimeout:
+            pass
+        _faultline.reset()
+        flush_checkpoint(bst, manager)
+        bst_rec = Booster(params=params, train_set=ds)
+        restore_checkpoint(bst_rec, manager)
+        bst_rec.update()
+        host_sync(bst_rec._driver.train_scores.scores)
+        collective_timeout_recovery_s = time.time() - t0
+        del bst_rec
     finally:
         _shutil.rmtree(ck_dir, ignore_errors=True)
 
@@ -396,6 +434,9 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "eval_ms_per_iter": round(eval_ms_per_iter, 1),
         "checkpoint_overhead_pct": round(checkpoint_overhead_pct, 2),
         "resume_s": round(resume_s, 2),
+        "resume_elastic_s": round(resume_elastic_s, 2),
+        "collective_timeout_recovery_s": round(
+            collective_timeout_recovery_s, 2),
         "hist_int8_rows_per_sec": round(hist_int8, 0),
         "hist_int8_rows_per_sec_min": round(hist_int8_min, 0),
         "hist_hilo_rows_per_sec": round(hist_hilo, 0),

@@ -488,21 +488,27 @@ class Booster:
     def save_checkpoint(self, directory: str, keep: int = 3) -> str:
         """Write one atomic training checkpoint (model + PRNG streams +
         score buffers) into `directory`; returns the checkpoint path.
-        `lgb.train` does this automatically when `tpu_checkpoint_dir`
-        is configured."""
-        from .utils.checkpoint import CheckpointManager, save_checkpoint
+        In a jax.distributed group every host writes its local bundle
+        and rank 0 commits the global topology manifest after the
+        all-hosts-durable barrier.  `lgb.train` does this automatically
+        when `tpu_checkpoint_dir` is configured."""
+        from .utils.checkpoint import make_manager, save_checkpoint
 
-        return save_checkpoint(self, CheckpointManager(directory, keep=keep))
+        return save_checkpoint(self, make_manager(directory, keep=keep))
 
     def resume_from_checkpoint(self, directory: str) -> Optional[int]:
-        """Restore this (freshly-constructed, same dataset + params)
-        training booster from the newest valid checkpoint in
-        `directory`; returns the restored iteration, or None when no
-        valid checkpoint exists.  Continued training is bit-identical
-        to a never-interrupted run."""
-        from .utils.checkpoint import CheckpointManager, restore_checkpoint
+        """Restore this (freshly-constructed, same training data)
+        booster from the newest valid checkpoint in `directory`;
+        returns the restored iteration, or None when no valid
+        checkpoint exists.  The shard/host topology may DIFFER from the
+        checkpointed run's (elastic resume): global score buffers are
+        re-sharded onto the live mesh, and continued int8/int16
+        training stays bit-identical to a never-interrupted run.  A
+        material params mismatch names the differing keys (warning, or
+        error under `tpu_resume_strict`)."""
+        from .utils.checkpoint import make_manager, restore_checkpoint
 
-        state = restore_checkpoint(self, CheckpointManager(directory))
+        state = restore_checkpoint(self, make_manager(directory))
         return None if state is None else int(state["iteration"])
 
     # -- model IO ------------------------------------------------------

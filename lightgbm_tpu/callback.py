@@ -268,10 +268,13 @@ class _Checkpoint:
 
     def __init__(self, directory: Optional[str] = None, interval: int = 1,
                  keep: int = 3, manager=None):
-        from .utils.checkpoint import CheckpointManager
+        from .utils.checkpoint import make_manager
 
         if manager is None:
-            manager = CheckpointManager(directory, keep=keep)
+            # host-aware: in a jax.distributed group each process writes
+            # its own host-<k>/ bundles and rank 0 commits the global
+            # manifest after the all-hosts-durable barrier
+            manager = make_manager(directory, keep=keep)
         self.manager = manager
         self.interval = max(int(interval), 1)
         self.peers: list = []  # sibling callbacks; engine.train fills it

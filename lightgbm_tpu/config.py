@@ -170,6 +170,33 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "tpu_checkpoint_interval": ("int", 1, ()),
     # newest valid checkpoints retained (older ones are deleted)
     "tpu_checkpoint_keep": ("int", 3, ()),
+    # collective watchdog (parallel/collective.py): seconds a host-level
+    # collective (metric sync, distributed bin finding, multihost
+    # rendezvous, checkpoint barrier) may block before a structured
+    # CollectiveTimeout rolls the iteration back and flushes a final
+    # checkpoint — a hung peer degrades to a usable booster instead of
+    # silently hanging the group.  The setting is PROCESS-GLOBAL (the
+    # reference's Network config): -1 (default) leaves the current
+    # process policy untouched, 0 explicitly disables the deadline
+    # (block forever, the pre-watchdog behavior), >0 arms it.  Fault
+    # injection and retry stay live either way
+    "tpu_collective_timeout_s": ("float", -1.0, ()),
+    # bounded retries (exponential backoff) when a collective RAISES a
+    # transient transport error; timeouts and host drops never retry
+    # (after a missed deadline the group's collective streams are no
+    # longer aligned).  Process-global like the timeout: -1 leaves the
+    # current policy, 0 disables retry
+    "tpu_collective_retries": ("int", -1, ()),
+    # elastic resume: allow resuming a checkpoint taken at a different
+    # shard/host topology (P data shards -> P', including 1).  Scores
+    # are global f32 buffers and quantized rounding keys on the GLOBAL
+    # row index, so int8/int16 resumes stay bit-identical across
+    # topology changes; false refuses any topology delta
+    "tpu_resume_elastic": ("bool", True, ()),
+    # raise (instead of warn-and-proceed) when resume params differ
+    # from the checkpointed run's beyond the topology set; the
+    # differing keys are named either way
+    "tpu_resume_strict": ("bool", False, ()),
     # numeric guardrails: per-iteration isfinite check on the updated
     # train scores plus an int32 histogram-headroom sentinel for
     # quantized precisions.  off = no checks (default; keeps the train

@@ -199,13 +199,26 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 booster.best_iteration = e.best_iteration + 1
                 evaluation_result_list = e.best_score
                 break
-    except BaseException:
+    except BaseException as exc:
         # interrupt/device failure: the partial iteration was already
         # rolled back inside update(); flush a final checkpoint so the
         # run restarts from the last COMPLETE iteration, then re-raise
         if ckpt_manager is not None:
+            from .parallel.collective import CollectiveTimeout
             from .utils.checkpoint import flush_checkpoint
+            from .utils.log import Log
 
+            if isinstance(exc, CollectiveTimeout):
+                # a hung peer, not a local fault: tell the operator the
+                # run degraded by design — the flushed checkpoint is the
+                # rejoin point once the group is rebuilt
+                Log.warning(
+                    f"collective {exc.name!r} timed out "
+                    f"({exc.timeout_s:g}s) at iteration "
+                    f"{booster.current_iteration()}: rolled back to the "
+                    "last complete iteration, flushing a final "
+                    "checkpoint; restart the group and resume=True to "
+                    "rejoin (elastic: any shard/host count)")
             flush_checkpoint(booster, ckpt_manager, callbacks=callbacks)
         raise
     finally:

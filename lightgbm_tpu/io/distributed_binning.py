@@ -37,8 +37,12 @@ def ensure_distributed(config: Config) -> None:
     init_multihost is idempotent."""
     if (bool(config.pre_partition) and str(config.machines)
             and int(config.num_machines) > 1):
+        from ..parallel.collective import configure_from_config
         from ..parallel.mesh import init_multihost
 
+        # the rendezvous is the FIRST collective: arm the process-wide
+        # watchdog defaults before it (Network::Init ordering)
+        configure_from_config(config)
         init_multihost(str(config.machines),
                        int(config.local_listen_port),
                        int(config.num_machines))
@@ -131,6 +135,8 @@ def gather_row_samples(X_local: np.ndarray, quota: int,
     import jax
     from jax.experimental import multihost_utils
 
+    from ..parallel.collective import guarded_collective
+
     n = X_local.shape[0]
     if n > quota:
         rng = np.random.default_rng(seed)
@@ -139,14 +145,23 @@ def gather_row_samples(X_local: np.ndarray, quota: int,
             np.asarray(X_local, np.float64)[idx])
     else:
         samp = np.asarray(X_local, np.float64)
-    lens = np.asarray(multihost_utils.process_allgather(
-        np.asarray([samp.shape[0]], np.int64)))[:, 0]
-    mx = max(int(lens.max()), 1)
-    buf = np.zeros((mx, X_local.shape[1]), np.float64)
-    buf[:samp.shape[0]] = samp
-    g = np.asarray(multihost_utils.process_allgather(buf))  # [P, mx, F]
-    return np.concatenate(
-        [g[p, :int(lens[p])] for p in range(jax.process_count())])
+
+    def _gather() -> np.ndarray:
+        lens = np.asarray(multihost_utils.process_allgather(
+            np.asarray([samp.shape[0]], np.int64)))[:, 0]
+        mx = max(int(lens.max()), 1)
+        buf = np.zeros((mx, X_local.shape[1]), np.float64)
+        buf[:samp.shape[0]] = samp
+        g = np.asarray(multihost_utils.process_allgather(buf))  # [P, mx, F]
+        return np.concatenate(
+            [g[p, :int(lens[p])] for p in range(jax.process_count())])
+
+    # the lens+payload pair is ONE logical collective under the watchdog
+    # (a diverged host deadlocks the group's allgather — this module's
+    # historical failure mode); binning has its own fault point so chaos
+    # runs can target ingest separately from train-loop sync
+    return guarded_collective(_gather, name="gather_row_samples",
+                              point="binning_allgather")
 
 
 def find_mappers_multihost(X_local: np.ndarray, config: Config,
@@ -183,10 +198,14 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
         return merge_mapper_payloads([payload], nf)
     from jax.experimental import multihost_utils
 
+    from ..parallel.collective import guarded_collective
+
     local_n = int(local_total_rows if local_total_rows is not None
                   else X_local.shape[0])
-    global_rows = int(multihost_utils.process_allgather(
-        np.asarray([local_n], np.int64)).sum())
+    global_rows = int(guarded_collective(
+        lambda: multihost_utils.process_allgather(
+            np.asarray([local_n], np.int64)).sum(),
+        name="global_row_count", point="binning_allgather"))
     assignment = assign_features(nf, nproc)
     mine = assignment[jax.process_index()]
     from .dataset import _is_scipy_sparse
@@ -202,11 +221,16 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
 
     # fixed-width byte tensor: allgather needs identical shapes per host
     raw = payload.encode()
-    width = int(multihost_utils.process_allgather(
-        np.asarray([len(raw)], np.int64)).max())
-    buf = np.zeros(width, np.uint8)
-    buf[:len(raw)] = np.frombuffer(raw, np.uint8)
-    gathered = multihost_utils.process_allgather(buf)  # [nproc, width]
-    payloads = [bytes(row).rstrip(b"\x00").decode()
+
+    def _exchange() -> List[str]:
+        width = int(multihost_utils.process_allgather(
+            np.asarray([len(raw)], np.int64)).max())
+        buf = np.zeros(width, np.uint8)
+        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
+        gathered = multihost_utils.process_allgather(buf)  # [nproc, width]
+        return [bytes(row).rstrip(b"\x00").decode()
                 for row in np.asarray(gathered).reshape(nproc, width)]
+
+    payloads = guarded_collective(_exchange, name="mapper_exchange",
+                                  point="binning_allgather")
     return merge_mapper_payloads(payloads, nf)

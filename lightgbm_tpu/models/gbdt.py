@@ -296,6 +296,13 @@ class GBDT:
                              f"skip, got {self._guard!r}")
         self._guard_streak = 0
         self._guard_skips_total = 0
+        # collective watchdog defaults (Network::Init analog): armed
+        # process-wide so metric sync / checkpoint barriers / binning
+        # allgathers all share one deadline policy; no-clobber rule
+        # lives in configure_from_config
+        from ..parallel.collective import configure_from_config
+
+        configure_from_config(config)
         if self._guard != "off" \
                 and str(config.tpu_hist_precision) in ("int8", "int16"):
             quant_headroom_check(str(config.tpu_hist_precision),
@@ -961,6 +968,26 @@ class GBDT:
             pass
         return arr
 
+    def topology_snapshot(self) -> Dict:
+        """What the multihost group manifest records and elastic resume
+        validates/re-shards against (ISSUE 8).  "rows" is THIS process's
+        local row count — the global count under replicated/single-
+        process ingest.  Pure host metadata: NO device transfer, so the
+        flush path's global-commit retry can call it for free."""
+        if self.train_data is None or self.learner is None:
+            raise ValueError("topology snapshot needs a live training "
+                             "context")
+        return {
+            "rows": int(self.train_data.num_data),
+            "host_count": int(jax.process_count()),
+            "host_index": int(jax.process_index()),
+            "partitioned": bool(getattr(self.learner, "_partitioned",
+                                        False)),
+            "data_shards": int(getattr(self.learner, "d_shards", 1)),
+            "feature_shards": int(getattr(self.learner, "f_shards", 1)),
+            "tree_learner": str(self.config.tree_learner),
+        }
+
     def capture_train_state(self) -> Tuple[Dict, Dict]:
         """The restart bundle's driver half: a JSON-able state dict plus
         the f32 score arrays.  Pairs with `restore_train_state`; the
@@ -985,6 +1012,7 @@ class GBDT:
                             is not None else None),
             "valid_names": list(self.valid_names),
             "guard_skips": int(self._guard_skips_total),
+            "topology": self.topology_snapshot(),
         }
         arrays = {"train_scores": np.asarray(
             jax.device_get(self.train_scores.scores), np.float32)}
@@ -1036,15 +1064,28 @@ class GBDT:
         self.num_init_iteration = int(state.get("num_init_iteration", 0))
         # iter_ counts NEW rounds only (see _materialize_inner)
         self.iter_ = total - self.num_init_iteration
+        ts = np.asarray(arrays["train_scores"], np.float32)
+        want = (max(self.num_tree_per_iteration, 1),
+                int(self.train_data.num_data))
+        if tuple(ts.shape) != want:
+            raise ValueError(
+                f"checkpoint train-score buffer has shape {ts.shape} but "
+                f"the live training context needs {want}; the checkpoint "
+                "was taken over different data (elastic topology changes "
+                "are re-sharded upstream — this is a data mismatch)")
         # .copy() forces an XLA-owned buffer (the fused step DONATES the
         # scores; donating a numpy-aliased zero-copy upload corrupts the
         # heap — same rule as _ScoreState)
-        self.train_scores.scores = jnp.asarray(
-            np.asarray(arrays["train_scores"], np.float32)).copy()
+        self.train_scores.scores = jnp.asarray(ts).copy()
         meta = self.learner.meta_np
         for name, vs, vd in zip(self.valid_names, self.valid_scores,
                                 self.valid_sets):
             a = arrays.get(f"valid_scores/{name}")
+            if a is not None and tuple(np.asarray(a).shape) \
+                    != tuple(np.asarray(vs.scores.shape)):
+                # an elastic resume re-partitioned the valid rows: the
+                # stored slice no longer matches — replay instead
+                a = None
             if a is not None:
                 vs.scores = jnp.asarray(np.asarray(a, np.float32)).copy()
                 continue

@@ -296,8 +296,12 @@ class TPUTreeLearner:
                 # — decide from the GLOBAL nonzero fractions
                 from jax.experimental import multihost_utils
 
-                g = np.asarray(multihost_utils.process_allgather(
-                    np.concatenate([nz_counts, [n]]).astype(np.int32)))
+                from ..parallel.collective import guarded_collective
+
+                g = np.asarray(guarded_collective(
+                    lambda: multihost_utils.process_allgather(
+                        np.concatenate([nz_counts, [n]]).astype(np.int32)),
+                    name="sparse_global_fractions"))
                 tot = g.sum(axis=0)
                 nz_counts, denom = tot[:-1], int(tot[-1])
             nz_frac = nz_counts / max(denom, 1)
@@ -372,9 +376,13 @@ class TPUTreeLearner:
             # masked rows); n here is only THIS process's row count
             from jax.experimental import multihost_utils
 
+            from ..parallel.collective import guarded_collective
+
             shards_local = self.d_shards // jax.process_count()
-            ns = np.asarray(multihost_utils.process_allgather(
-                np.asarray([n], np.int32)))
+            ns = np.asarray(guarded_collective(
+                lambda: multihost_utils.process_allgather(
+                    np.asarray([n], np.int32)),
+                name="shard_rows_sync"))
             max_shard_rows = -(-int(ns.max()) // shards_local)
             self.n_pad = bucket_rows(max_shard_rows) * self.d_shards
             self._local_width = (self.n_pad // self.d_shards) * shards_local
@@ -490,9 +498,12 @@ class TPUTreeLearner:
                 if self._partitioned:
                     from jax.experimental import multihost_utils
 
-                    max_nnz = int(np.asarray(
-                        multihost_utils.process_allgather(
-                            np.asarray([max_nnz], np.int32))).max())
+                    from ..parallel.collective import guarded_collective
+
+                    max_nnz = int(np.asarray(guarded_collective(
+                        lambda: multihost_utils.process_allgather(
+                            np.asarray([max_nnz], np.int32)),
+                        name="sparse_table_width")).max())
                 M = max(128, -(-max_nnz // 128) * 128)
                 sp_rows = np.full((sl, Gs, M), rps, np.int32)
                 sp_bins = np.full((sl, Gs, M), B, np.int32)
@@ -1221,8 +1232,15 @@ class TPUTreeLearner:
             # global array cannot be device_get there
             from jax.experimental import multihost_utils
 
-            lids = multihost_utils.process_allgather(
-                out["leaf_ids"], tiled=True)[:self.n]
+            from ..parallel.collective import guarded_collective
+
+            # the per-iteration hot collective: a dead peer here is the
+            # canonical distributed-GBDT hang, so the watchdog matters
+            # most at this site
+            lids = guarded_collective(
+                lambda: multihost_utils.process_allgather(
+                    out["leaf_ids"], tiled=True),
+                name="leaf_id_allgather")[:self.n]
             return tree, jnp.asarray(lids), out
         return tree, out["leaf_ids"][:self.n], out
 

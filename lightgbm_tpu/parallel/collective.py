@@ -1,0 +1,216 @@
+"""Collective watchdogs: deadline + bounded retry around every
+host-level collective.
+
+The reference's socket collectives time out per-link (``Network``
+config ``time_out``, reference include/LightGBM/config.h network
+section) so one dead machine fails the group loudly.  The jax
+equivalents (`multihost_utils.process_allgather`,
+`jax.distributed.initialize`) block forever when a peer diverged or
+died — `io/distributed_binning.py`'s own docstring calls out the
+deadlocked-allgather failure mode — which turns one lost host into a
+silently hung pod.  `guarded_collective` restores the reference's
+semantics:
+
+* **deadline** — the transport runs on a watchdog thread; if it has
+  not returned after ``timeout_s`` a structured `CollectiveTimeout`
+  raises on the caller.  The abandoned thread keeps blocking in the
+  dead collective (jax gives no way to cancel it) — acceptable because
+  the caller's job is now to degrade: roll the iteration back
+  (`GBDT._iter_snapshot`), flush a final checkpoint, and surface a
+  usable booster before the process exits.
+* **bounded retry** — a collective that RAISES (transient DCN errors,
+  a preempted-and-restarted coordinator) is retried up to ``retries``
+  times with exponential backoff.  This leans on jax collectives
+  failing SYMMETRICALLY (a transport error surfaces the op's failure
+  on every rank, so all ranks retry the same op together); an error
+  genuinely local to one rank would desync the retried op against its
+  peers' next collective — set ``tpu_collective_retries=0`` on
+  transports without that property.  Timeouts and host-drops are NOT
+  retried under any setting: after a deadline expiry the group's
+  collective streams are provably no longer aligned, and re-entering
+  would desync ranks (the same reason the reference tears the whole
+  Network down on a link error).
+* **fault injection** — every call fires its faultline point (default
+  ``collective_sync``; the binning path uses ``binning_allgather``)
+  plus ``host_drop``, so chaos runs can kill host k at call-index i
+  deterministically (`faultline.arm(..., host=k, at=i,
+  absolute=True)`).  An armed ``hang`` simulates an unresponsive peer
+  through the real deadline machinery; an armed ``host_drop`` raise
+  becomes `HostDropped` on the addressed host (and, on its peers, the
+  hang->timeout they would observe in a real drop).
+
+Defaults are process-global (`configure`, the reference's
+Network::Init analog) and wired from `tpu_collective_timeout_s` /
+`tpu_collective_retries` at learner/dataset init; ``timeout_s=0``
+disables the deadline (today's block-forever behavior) while keeping
+injection and retry live.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..utils import faultline
+from ..utils.log import Log
+
+
+class CollectiveTimeout(RuntimeError):
+    """A host-level collective missed its watchdog deadline."""
+
+    def __init__(self, name: str, timeout_s: float, attempts: int,
+                 host: int):
+        self.name = name
+        self.timeout_s = float(timeout_s)
+        self.attempts = int(attempts)
+        self.host = int(host)
+        super().__init__(
+            f"collective {name!r} timed out after {timeout_s:g}s on host "
+            f"{host} (attempt {attempts}); a peer likely diverged or died "
+            "— rolling back to the last complete iteration")
+
+
+class HostDropped(faultline.FaultInjected):
+    """Injected death of this host at a collective call site."""
+
+
+_DEFAULTS: Dict[str, float] = {"timeout_s": 0.0, "retries": 1,
+                               "backoff_s": 0.25}
+_defaults_lock = threading.Lock()
+
+
+def configure(timeout_s: Optional[float] = None,
+              retries: Optional[int] = None,
+              backoff_s: Optional[float] = None) -> None:
+    """Set the process-global watchdog defaults (Network::Init analog).
+    Called at learner/dataset init from `tpu_collective_timeout_s` /
+    `tpu_collective_retries`; explicit per-call arguments win."""
+    with _defaults_lock:
+        if timeout_s is not None:
+            _DEFAULTS["timeout_s"] = max(float(timeout_s), 0.0)
+        if retries is not None:
+            _DEFAULTS["retries"] = max(int(retries), 0)
+        if backoff_s is not None:
+            _DEFAULTS["backoff_s"] = max(float(backoff_s), 0.0)
+
+
+def defaults() -> Dict[str, float]:
+    with _defaults_lock:
+        return dict(_DEFAULTS)
+
+
+def configure_from_config(config) -> None:
+    """Apply `tpu_collective_timeout_s`/`tpu_collective_retries` from a
+    Config.  The registry default -1 means UNSET — a booster
+    constructed without these params never disturbs the process policy
+    another live booster armed — while an explicit 0 really disables
+    (deadline off / no retries).  The single owner of that convention;
+    both wiring sites (GBDT init, distributed-dataset init) route
+    through here."""
+    t = float(config.tpu_collective_timeout_s)
+    r = int(config.tpu_collective_retries)
+    configure(timeout_s=t if t >= 0 else None,
+              retries=r if r >= 0 else None)
+
+
+def _run_with_deadline(fn: Callable, args, kwargs, name: str,
+                       timeout_s: float, attempt: int) -> Any:
+    """Run `fn` on a watchdog thread; raise CollectiveTimeout when it
+    misses the deadline.  The thread is a daemon: a genuinely hung
+    collective cannot be cancelled, only abandoned."""
+    box: list = []
+
+    def _target():
+        try:
+            box.append(("ok", fn(*args, **kwargs)))
+        except BaseException as exc:  # noqa: BLE001 - re-raised on caller
+            box.append(("err", exc))
+
+    t = threading.Thread(target=_target, daemon=True,
+                         name=f"collective-{name}")
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        raise CollectiveTimeout(name, timeout_s, attempt,
+                                faultline.host_index())
+    kind, val = box[0]
+    if kind == "err":
+        raise val
+    return val
+
+
+def guarded_collective(fn: Callable, *args,
+                       name: str = "collective",
+                       point: Optional[str] = "collective_sync",
+                       timeout_s: Optional[float] = None,
+                       retries: Optional[int] = None,
+                       backoff_s: Optional[float] = None,
+                       local: bool = False,
+                       **kwargs) -> Any:
+    """Run one host-level collective under the watchdog.
+
+    `local=True` marks a call that degenerated to an in-process
+    identity (world size 1): the deadline thread is skipped — an
+    identity cannot hang — but injection (hang -> simulated
+    CollectiveTimeout, host_drop -> HostDropped) and retry stay live so
+    single-process chaos runs exercise the same failure surface.
+    `timeout_s`/`retries`/`backoff_s` default to the `configure`d
+    process globals; timeout_s=0 disables the deadline."""
+    cfg = defaults()
+    timeout_s = cfg["timeout_s"] if timeout_s is None else float(timeout_s)
+    retries = int(cfg["retries"] if retries is None else retries)
+    backoff_s = float(cfg["backoff_s"] if backoff_s is None else backoff_s)
+    me = faultline.host_index()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            try:
+                drop = faultline.fire("host_drop", name=name, host=me)
+            except (HostDropped, KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException as exc:
+                # normalize ANY armed host_drop exception — including a
+                # custom exc= like ConnectionError — to the structured
+                # type: a dropped host is never a transient failure, so
+                # it must bypass the retry loop below
+                raise HostDropped(str(exc)) from None
+            if drop is not None:
+                raise HostDropped(
+                    f"injected host drop at collective {name!r} "
+                    f"(host {me})")
+            action = None
+            if point is not None:
+                action = faultline.fire(point, name=name, host=me)
+            if action == "hang":
+                if local or timeout_s <= 0:
+                    # nothing real can hang (identity call) or no
+                    # deadline is armed: simulate the expiry directly —
+                    # a real hang with timeout_s=0 would block forever,
+                    # which is exactly what the watchdog param exists
+                    # to prevent
+                    raise CollectiveTimeout(name, timeout_s, attempt, me)
+                # exercise the REAL deadline machinery: a sleeper that
+                # outlives the deadline stands in for the hung peer
+                slack = timeout_s + 1.0
+                return _run_with_deadline(
+                    lambda: time.sleep(slack), (), {}, name, timeout_s,
+                    attempt)
+            if local or timeout_s <= 0:
+                return fn(*args, **kwargs)
+            return _run_with_deadline(fn, args, kwargs, name, timeout_s,
+                                      attempt)
+        except (CollectiveTimeout, HostDropped, KeyboardInterrupt,
+                SystemExit):
+            raise
+        except Exception as exc:  # noqa: BLE001 - transient transport error
+            if attempt > retries:
+                raise
+            wait = backoff_s * (2 ** (attempt - 1))
+            Log.warning(
+                f"collective {name!r} failed on host {me} "
+                f"({type(exc).__name__}: {exc}); retry {attempt}/{retries} "
+                f"in {wait:g}s")
+            if wait > 0:
+                time.sleep(wait)

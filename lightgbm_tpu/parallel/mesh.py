@@ -24,6 +24,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 _distributed_initialized = False
+# a timed-out rendezvous cannot be re-entered: the watchdog abandons a
+# thread that may STILL complete jax.distributed.initialize later, and
+# jax refuses a second initialize() in the same process — so a failed
+# init is terminal for this process, recorded here to fail retries with
+# a structured message instead of jax's confusing "only once" error
+_distributed_init_failed: Optional[str] = None
 
 
 def init_multihost(machines: str = "", local_listen_port: int = 0,
@@ -45,9 +51,14 @@ def init_multihost(machines: str = "", local_listen_port: int = 0,
     Returns True if distributed init ran.  Single-process setups (CI, one
     host) skip it — the in-process virtual mesh covers them.
     """
-    global _distributed_initialized
+    global _distributed_initialized, _distributed_init_failed
     if _distributed_initialized:
         return True
+    if _distributed_init_failed is not None:
+        raise RuntimeError(
+            "a previous multi-host rendezvous failed in this process "
+            f"({_distributed_init_failed}); jax.distributed cannot be "
+            "re-initialized — restart the process to rejoin the group")
     entries = [m.strip() for m in str(machines).split(",") if m.strip()]
     if len(entries) <= 1 or num_machines <= 1:
         return False
@@ -70,9 +81,22 @@ def init_multihost(machines: str = "", local_listen_port: int = 0,
             "multi-host init: cannot determine this host's position in "
             "`machines`; set LIGHTGBM_TPU_HOST_IP or "
             "LIGHTGBM_TPU_PROCESS_ID")
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=len(entries),
-                               process_id=pid)
+    from .collective import guarded_collective
+
+    # the rendezvous is the group's first collective: a host that never
+    # shows up would otherwise hang every peer in initialize() forever.
+    # retries=0 — a torn partial rendezvous cannot be re-entered (the
+    # coordinator keeps half-joined state); the timeout surfaces it as
+    # a structured failure instead, and the failure is recorded as
+    # TERMINAL for this process (see _distributed_init_failed)
+    try:
+        guarded_collective(
+            jax.distributed.initialize, name="init_multihost", retries=0,
+            coordinator_address=coordinator, num_processes=len(entries),
+            process_id=pid)
+    except BaseException as exc:
+        _distributed_init_failed = f"{type(exc).__name__}: {exc}"
+        raise
     _distributed_initialized = True
     return True
 
@@ -129,6 +153,44 @@ def make_mesh(num_data_shards: int = 1, num_feature_shards: int = 1,
 def shard_rows(n: int, num_shards: int) -> int:
     """Rows per shard, padded so every shard is equal-size."""
     return (n + num_shards - 1) // num_shards
+
+
+# --------------------------------------------------------------------------
+# Elastic-resume placement (ISSUE 8): a checkpoint taken at P hosts holds
+# per-host slices of the GLOBAL row axis; resuming at P' hosts needs (a)
+# the global row offset of every checkpointed host to reassemble the
+# global buffers, and (b) this process's offset in the NEW topology to
+# slice its local rows back out.  Row order is process order in both
+# directions (the put_local contract), so a reassemble+slice round trip
+# is byte-exact.
+# --------------------------------------------------------------------------
+
+def row_offsets(rows_per_host: Sequence[int]) -> Tuple[np.ndarray, int]:
+    """Per-host global row offsets (process order) and the total count."""
+    rows = np.asarray(list(rows_per_host), np.int64)
+    offsets = np.concatenate([[0], np.cumsum(rows)[:-1]]).astype(np.int64)
+    return offsets, int(rows.sum())
+
+
+def local_row_offset(local_n: int) -> Tuple[int, int]:
+    """(this process's global row offset, global total rows) in the LIVE
+    topology — an allgather of the per-process local row counts, ridden
+    through the collective watchdog.  Identity (0, local_n) when the
+    process group is 1."""
+    import jax
+
+    if jax.process_count() == 1:
+        return 0, int(local_n)
+    from jax.experimental import multihost_utils
+
+    from .collective import guarded_collective
+
+    lens = np.asarray(guarded_collective(
+        lambda: multihost_utils.process_allgather(
+            np.asarray([int(local_n)], np.int64)),
+        name="row_offsets"))[:, 0]
+    offsets, total = row_offsets(lens)
+    return int(offsets[jax.process_index()]), total
 
 
 # --------------------------------------------------------------------------

@@ -37,6 +37,14 @@ config-driven and identical on all ranks; ad-hoc single-rank calls of
 ``Booster.eval*`` inside a live multi-process group would deadlock, the
 same contract as the reference's ``Network::Allreduce``.  Custom
 ``feval`` callables run host-local and are NOT reduced.
+
+Every entry point runs under the `collective.guarded_collective`
+watchdog (ISSUE 8): a hung peer becomes a structured
+`CollectiveTimeout` after `tpu_collective_timeout_s` instead of a
+silent group-wide hang, transient transport errors retry with backoff,
+and the ``collective_sync``/``host_drop`` fault points fire once per
+logical collective — ALSO on the world-size-1 identity path, so
+single-process chaos runs exercise the same failure surface.
 """
 
 from __future__ import annotations
@@ -44,6 +52,8 @@ from __future__ import annotations
 from typing import Sequence, Tuple
 
 import numpy as np
+
+from .collective import guarded_collective
 
 
 def process_count() -> int:
@@ -82,8 +92,9 @@ def sync_sums(vals: Sequence[float]) -> np.ndarray:
     """Elementwise sum across processes of a small f64 vector."""
     v = np.asarray(vals, np.float64)
     if process_count() == 1:
-        return v
-    return _allgather(v).sum(axis=0)
+        return guarded_collective(lambda: v, name="sync_sums", local=True)
+    return guarded_collective(lambda: _allgather(v).sum(axis=0),
+                              name="sync_sums")
 
 
 def sync_concat(*arrays: np.ndarray) -> Tuple[np.ndarray, ...]:
@@ -96,7 +107,10 @@ def sync_concat(*arrays: np.ndarray) -> Tuple[np.ndarray, ...]:
     length (they are parallel columns of one local table).
     """
     if process_count() == 1:
-        return tuple(np.asarray(a, np.float64).ravel() for a in arrays)
+        return guarded_collective(
+            lambda: tuple(np.asarray(a, np.float64).ravel()
+                          for a in arrays),
+            name="sync_concat", local=True)
     arrs = [np.ascontiguousarray(np.asarray(a, np.float64).ravel())
             for a in arrays]
     n_local = arrs[0].shape[0]
@@ -104,14 +118,21 @@ def sync_concat(*arrays: np.ndarray) -> Tuple[np.ndarray, ...]:
         if a.shape[0] != n_local:
             raise ValueError("sync_concat inputs must share the local "
                              f"length: {a.shape[0]} != {n_local}")
-    lens = _allgather(np.asarray([n_local], np.int64))[:, 0]
-    n_max = int(lens.max()) if len(lens) else 0
-    out = []
-    for a in arrs:
-        padded = np.zeros(n_max, np.float64)
-        padded[:n_local] = a
-        g = _allgather(padded)  # [P, n_max]
-        out.append(np.concatenate([g[p, :int(lens[p])]
-                                   for p in range(len(lens))])
-                   if n_max else np.zeros(0, np.float64))
-    return tuple(out)
+
+    def _merge() -> Tuple[np.ndarray, ...]:
+        lens = _allgather(np.asarray([n_local], np.int64))[:, 0]
+        n_max = int(lens.max()) if len(lens) else 0
+        out = []
+        for a in arrs:
+            padded = np.zeros(n_max, np.float64)
+            padded[:n_local] = a
+            g = _allgather(padded)  # [P, n_max]
+            out.append(np.concatenate([g[p, :int(lens[p])]
+                                       for p in range(len(lens))])
+                       if n_max else np.zeros(0, np.float64))
+        return tuple(out)
+
+    # one watchdog spans the whole ragged merge: its inner allgathers
+    # are one logical collective (ranks must enter/leave together), so
+    # a retry must redo the lens+payload sequence from the top
+    return guarded_collective(_merge, name="sync_concat")

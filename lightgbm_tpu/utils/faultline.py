@@ -18,22 +18,45 @@ how to apply:
 * ``truncate`` — the site writes only half its payload (the
   `checkpoint_write` point produces a torn file whose manifest CRC
   cannot match) so recovery-from-corruption paths are testable.
+* ``hang``     — the site simulates an unresponsive peer: the
+  collective watchdog (`parallel/collective.py`) turns it into a
+  deterministic `CollectiveTimeout` so hung-peer degradation paths are
+  testable without an actually-hung process.
 
 Points are process-global and thread-safe; `reset()` disarms
 everything.  Hit counters count every `fire` since the last reset, so
 "arm at the k-th hit" addresses a specific iteration/request without
 the site threading indices through.
+
+Distributed addressing (ISSUE 8): multihost chaos runs must be
+reproducible, so a spec can pin BOTH coordinates of a distributed
+event:
+
+* ``host=k``        — the spec only matches on the process whose
+  `host_index()` is k (every other host counts the hit but never
+  fires).  `host_index()` resolves, in order: an explicit
+  `set_host_index()` override (single-process chaos sweeps simulating
+  a fleet), the LIGHTGBM_TPU_FAULT_HOST env var, `jax.process_index()`
+  when jax is already imported, else 0.
+* ``absolute=True`` — `at` addresses the N-th hit since the last
+  `reset()` (an absolute per-process call index) instead of the N-th
+  hit after `arm()`.  Since every host runs the same program, the
+  (host, call-index) pair names one collective call in the whole
+  group's execution, independent of when the harness armed it.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import threading
 from typing import Dict, List, Optional
 
-POINTS = ("grow_step", "h2d_copy", "checkpoint_write", "serve_dispatch")
+POINTS = ("grow_step", "h2d_copy", "checkpoint_write", "serve_dispatch",
+          "collective_sync", "binning_allgather", "host_drop")
 
-_ACTIONS = ("raise", "poison", "truncate")
+_ACTIONS = ("raise", "poison", "truncate", "hang")
 
 
 class FaultInjected(RuntimeError):
@@ -41,18 +64,26 @@ class FaultInjected(RuntimeError):
 
 
 class _Spec:
-    __slots__ = ("action", "exc", "at", "times")
+    __slots__ = ("action", "exc", "at", "times", "host", "end")
 
-    def __init__(self, action: str, exc, at: int, times: int):
+    def __init__(self, action: str, exc, at: int, times: int,
+                 host: Optional[int] = None, end: Optional[int] = None):
         self.action = action
         self.exc = exc
         self.at = int(at)
         self.times = int(times)
+        self.host = None if host is None else int(host)
+        # exclusive upper hit bound (absolute specs only): the spec
+        # fires on hits [at, end) or NEVER — an absolute coordinate
+        # armed after its call has passed must not drift onto a later
+        # call, or the (host, call-index) pair stops naming one event
+        self.end = None if end is None else int(end)
 
 
 _lock = threading.Lock()
 _armed: Dict[str, List[_Spec]] = {}
 _hits: Dict[str, int] = {}
+_host_override: Optional[int] = None
 
 
 def _check_point(point: str) -> None:
@@ -60,10 +91,54 @@ def _check_point(point: str) -> None:
         raise ValueError(f"unknown fault point {point!r}; known: {POINTS}")
 
 
+def set_host_index(host: Optional[int]) -> None:
+    """Override this process's host identity for `host=`-addressed specs
+    (single-process chaos sweeps simulate a fleet by iterating it)."""
+    global _host_override
+    _host_override = None if host is None else int(host)
+
+
+def host_index() -> int:
+    """This process's position in the host group, for `host=` matching.
+    set_host_index() override > LIGHTGBM_TPU_FAULT_HOST env >
+    jax.process_index() (only when jax is already imported — the fault
+    harness must never force backend init) > 0."""
+    if _host_override is not None:
+        return _host_override
+    env = os.environ.get("LIGHTGBM_TPU_FAULT_HOST", "")
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            pass
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            from jax._src import xla_bridge
+
+            # only CONSULT an already-initialized backend: process_index
+            # would otherwise force backend init — fatal when the fault
+            # harness fires inside the multihost rendezvous itself
+            # (gloo collectives need the distributed client FIRST)
+            if not xla_bridge.backends_are_initialized():
+                return 0
+            return int(jax_mod.process_index())
+        except Exception:  # pragma: no cover - backend not ready
+            return 0
+    return 0
+
+
 def arm(point: str, action: str = "raise", exc=None, at: int = 1,
-        times: int = 1) -> None:
+        times: int = 1, host: Optional[int] = None,
+        absolute: bool = False) -> None:
     """Arm `point`: starting at its `at`-th hit from now, apply `action`
-    for the next `times` hits.  `exc` (an exception instance or class)
+    for the next `times` hits.  With `absolute=True` the window is
+    EXACT: hits `[at, at + times)` counted since the last `reset()` —
+    a coordinate that already passed never fires (it must not drift
+    onto a later call, or the (host, call-index) pair stops naming one
+    event).  `host=k` restricts the spec to the process whose
+    `host_index()` is k, so a multihost chaos run can kill host k at
+    call-index i reproducibly.  `exc` (an exception instance or class)
     overrides the default `FaultInjected` for ``raise`` actions."""
     _check_point(point)
     if action not in _ACTIONS:
@@ -71,9 +146,12 @@ def arm(point: str, action: str = "raise", exc=None, at: int = 1,
     if exc is None:
         exc = FaultInjected(f"injected fault at {point!r}")
     with _lock:
-        base = _hits.get(point, 0)
+        base = 0 if absolute else _hits.get(point, 0)
+        start = base + max(int(at), 1)
+        times = max(int(times), 1)
         _armed.setdefault(point, []).append(
-            _Spec(action, exc, base + max(int(at), 1), max(int(times), 1)))
+            _Spec(action, exc, start, times, host=host,
+                  end=start + times if absolute else None))
 
 
 def disarm(point: Optional[str] = None) -> None:
@@ -85,10 +163,13 @@ def disarm(point: Optional[str] = None) -> None:
 
 
 def reset() -> None:
-    """Disarm everything and zero the hit counters."""
+    """Disarm everything, zero the hit counters, and clear any host
+    override — the absolute (host, call-index) coordinate origin."""
+    global _host_override
     with _lock:
         _armed.clear()
         _hits.clear()
+        _host_override = None
 
 
 def hits(point: str) -> int:
@@ -116,13 +197,19 @@ def fire(point: str, **info) -> Optional[str]:
         specs = _armed.get(point)
         if not specs:
             return None
+        me = host_index()
         matched = None
         for spec in specs:
-            if spec.times > 0 and hit >= spec.at:
+            if spec.host is not None and spec.host != me:
+                continue  # addressed to another host: count, never fire
+            if spec.times > 0 and hit >= spec.at \
+                    and (spec.end is None or hit < spec.end):
                 spec.times -= 1
                 matched = spec
                 break
-        if matched is not None and not any(s.times > 0 for s in specs):
+        if matched is not None and not any(
+                s.times > 0 and (s.end is None or hit < s.end)
+                for s in specs):
             del _armed[point]
     if matched is None:
         return None

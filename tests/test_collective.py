@@ -1,0 +1,656 @@
+"""Distributed fault tolerance (ISSUE 8): collective watchdogs,
+deterministic (host, call-index) fault addressing, multihost-consistent
+checkpoint groups, and elastic resume across shard topologies.
+
+The load-bearing guarantees under test:
+
+* a hung host-level collective becomes a structured `CollectiveTimeout`
+  after the configured deadline — and an injected timeout mid-train
+  still ends in a flushed, valid checkpoint and a predict-usable
+  booster (the degradation path the reference's all-or-nothing
+  `Network::Allreduce` lacks);
+* a global checkpoint manifest only commits when EVERY host's bundle is
+  durable at the SAME iteration, and resume refuses torn or
+  mixed-iteration groups;
+* a checkpoint taken at P shards/hosts resumes at P' (including 1) with
+  int8/int16 models byte-identical to uninterrupted runs — scores are
+  global f32 buffers and quantized rounding keys on the GLOBAL row
+  index.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.parallel import collective
+from lightgbm_tpu.parallel.collective import (CollectiveTimeout,
+                                              HostDropped,
+                                              guarded_collective)
+from lightgbm_tpu.parallel.mesh import row_offsets
+from lightgbm_tpu.utils import faultline
+from lightgbm_tpu.utils.checkpoint import (CheckpointManager,
+                                           _params_fingerprint,
+                                           params_diff, save_checkpoint)
+
+P = {"objective": "binary", "num_leaves": 13, "max_bin": 47,
+     "min_data_in_leaf": 5, "bagging_fraction": 0.8, "bagging_freq": 1,
+     "verbosity": -1}
+
+
+def _data(n=1500, f=6, seed=11):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.4 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+X, Y = _data()
+
+
+def _model(bst) -> str:
+    return bst.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+
+
+def _train(params, rounds, **kw):
+    ds = lgb.Dataset(X, label=Y, params=params)
+    return lgb.train(params, ds, num_boost_round=rounds,
+                     keep_training_booster=True, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    faultline.reset()
+    collective.configure(timeout_s=0.0, retries=1, backoff_s=0.25)
+    yield
+    faultline.reset()
+    collective.configure(timeout_s=0.0, retries=1, backoff_s=0.25)
+
+
+# ---------------------------------------------------------------------------
+class TestGuardedCollective:
+    def test_passthrough(self):
+        assert guarded_collective(lambda a, b: a + b, 2, 3,
+                                  name="t") == 5
+
+    def test_deadline_expiry_is_structured(self):
+        with pytest.raises(CollectiveTimeout) as ei:
+            guarded_collective(lambda: time.sleep(5.0), name="slow",
+                               timeout_s=0.05)
+        assert ei.value.name == "slow"
+        assert ei.value.timeout_s == pytest.approx(0.05)
+        assert ei.value.attempts == 1
+
+    def test_transient_failure_retries_with_backoff(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) == 1:
+                raise OSError("transient DCN hiccup")
+            return "ok"
+
+        t0 = time.time()
+        assert guarded_collective(flaky, name="t", retries=2,
+                                  backoff_s=0.05) == "ok"
+        assert len(calls) == 2
+        assert time.time() - t0 >= 0.05  # backoff actually waited
+
+    def test_retry_budget_exhausts(self):
+        def broken():
+            raise OSError("still down")
+
+        with pytest.raises(OSError):
+            guarded_collective(broken, name="t", retries=1, backoff_s=0.0)
+
+    def test_injected_raise_is_retried_as_transient(self):
+        faultline.arm("collective_sync", action="raise", times=1)
+        assert guarded_collective(lambda: 7, name="t", retries=1,
+                                  backoff_s=0.0) == 7
+        assert faultline.hits("collective_sync") == 2  # one per attempt
+
+    def test_injected_hang_times_out_via_real_deadline(self):
+        faultline.arm("collective_sync", action="hang")
+        with pytest.raises(CollectiveTimeout):
+            guarded_collective(lambda: 1, name="t", timeout_s=0.05,
+                               retries=3)
+
+    def test_injected_hang_on_local_identity(self):
+        faultline.arm("collective_sync", action="hang")
+        with pytest.raises(CollectiveTimeout):
+            guarded_collective(lambda: 1, name="t", local=True)
+
+    def test_timeout_never_retries(self):
+        faultline.arm("collective_sync", action="hang", times=5)
+        with pytest.raises(CollectiveTimeout) as ei:
+            guarded_collective(lambda: 1, name="t", timeout_s=0.05,
+                               retries=5)
+        assert ei.value.attempts == 1
+
+    def test_host_drop_bypasses_retry(self):
+        faultline.arm("host_drop", action="raise", times=5)
+        with pytest.raises(HostDropped):
+            guarded_collective(lambda: 1, name="t", retries=5,
+                               backoff_s=0.0)
+        assert faultline.hits("host_drop") == 1
+
+    def test_host_drop_custom_exc_still_bypasses_retry(self):
+        """An armed host_drop with a custom exception type (e.g. a real
+        transport error class) must normalize to HostDropped, not slip
+        into the transient-retry branch."""
+        faultline.arm("host_drop", action="raise",
+                      exc=ConnectionError("peer died"))
+        with pytest.raises(HostDropped):
+            guarded_collective(lambda: 1, name="t", retries=5,
+                               backoff_s=0.0)
+        assert faultline.hits("host_drop") == 1
+
+    def test_configure_sets_process_defaults(self):
+        collective.configure(timeout_s=12.5, retries=4)
+        d = collective.defaults()
+        assert d["timeout_s"] == 12.5 and d["retries"] == 4
+
+    def test_default_params_booster_does_not_disarm_watchdog(self):
+        collective.configure(timeout_s=60.0)
+        ds = lgb.Dataset(X, label=Y, params=dict(P))
+        Booster(params=dict(P), train_set=ds)  # unset (-1): no clobber
+        assert collective.defaults()["timeout_s"] == 60.0
+        p2 = dict(P, tpu_collective_timeout_s=5.0)
+        Booster(params=p2, train_set=lgb.Dataset(X, label=Y, params=p2))
+        assert collective.defaults()["timeout_s"] == 5.0
+        # explicit 0 really disables (unlike the -1 unset default)
+        p3 = dict(P, tpu_collective_timeout_s=0)
+        Booster(params=p3, train_set=lgb.Dataset(X, label=Y, params=p3))
+        assert collective.defaults()["timeout_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+class TestFaultlineAddressing:
+    def test_host_addressed_spec_only_fires_on_that_host(self):
+        faultline.set_host_index(1)
+        faultline.arm("collective_sync", action="raise", host=0)
+        assert faultline.fire("collective_sync") is None  # host 1: no-op
+        faultline.set_host_index(0)
+        with pytest.raises(faultline.FaultInjected):
+            faultline.fire("collective_sync")
+
+    def test_absolute_call_index_is_arm_time_independent(self):
+        for _ in range(3):
+            faultline.fire("collective_sync")
+        # absolute index 2 already passed: the coordinate names ONE call
+        # in the execution, so a spec armed after it must never fire —
+        # not drift onto a later call like relative arming would
+        faultline.arm("collective_sync", action="raise", at=2,
+                      absolute=True, times=1)
+        for _ in range(4):
+            assert faultline.fire("collective_sync") is None
+
+    def test_absolute_addressing_reproducible_after_reset(self):
+        faultline.reset()
+        faultline.arm("collective_sync", action="raise", at=2,
+                      absolute=True)
+        assert faultline.fire("collective_sync") is None  # call 1
+        with pytest.raises(faultline.FaultInjected):
+            faultline.fire("collective_sync")             # call 2
+
+    def test_reset_clears_host_override(self):
+        faultline.set_host_index(3)
+        assert faultline.host_index() == 3
+        faultline.reset()
+        assert faultline.host_index() != 3 or \
+            os.environ.get("LIGHTGBM_TPU_FAULT_HOST") == "3"
+
+    def test_host_and_absolute_compose(self):
+        faultline.set_host_index(2)
+        faultline.arm("host_drop", action="raise", at=3, absolute=True,
+                      host=2)
+        faultline.arm("host_drop", action="raise", at=1, absolute=True,
+                      host=0)  # other host: must never fire here
+        assert faultline.fire("host_drop") is None
+        assert faultline.fire("host_drop") is None
+        with pytest.raises(faultline.FaultInjected):
+            faultline.fire("host_drop")
+
+
+# ---------------------------------------------------------------------------
+class TestWatchdogDegradation:
+    def test_timeout_mid_eval_leaves_booster_usable(self):
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        vX, vY = _data(400, 6, seed=5)
+        bst.add_valid(lgb.Dataset(vX, label=vY, reference=ds, params=p),
+                      "v")
+        for _ in range(3):
+            bst.update()
+        faultline.arm("collective_sync", action="hang")
+        with pytest.raises(CollectiveTimeout):
+            bst.eval_valid()
+        faultline.reset()
+        # degraded, not dead: predict, eval, and continued training work
+        assert np.isfinite(bst.predict(X[:64], raw_score=True)).all()
+        bst.update()
+        assert bst.current_iteration() == 4
+
+    def test_timeout_mid_train_flushes_checkpoint_then_bitwise_resume(
+            self, tmp_path):
+        base = _model(_train(dict(P), 6))
+        p = dict(P, tpu_checkpoint_dir=str(tmp_path),
+                 tpu_checkpoint_interval=1)
+        vX, vY = _data(400, 6, seed=5)
+
+        def run(rounds, arm_at=None, resume=False):
+            ds = lgb.Dataset(X, label=Y, params=p)
+            dv = lgb.Dataset(vX, label=vY, reference=ds, params=p)
+            if arm_at is not None:
+                # each iteration's eval syncs once per metric: the N-th
+                # collective call lands mid-train deterministically
+                faultline.arm("collective_sync", action="hang",
+                              at=arm_at, absolute=True)
+            return lgb.train(p, ds, num_boost_round=rounds,
+                             valid_sets=[dv], valid_names=["v"],
+                             keep_training_booster=True, resume=resume,
+                             verbose_eval=False)
+
+        with pytest.raises(CollectiveTimeout):
+            run(6, arm_at=4)
+        faultline.reset()
+        # the engine flushed a final checkpoint before re-raising
+        mgr = CheckpointManager(str(tmp_path))
+        found = mgr.load_latest()
+        assert found is not None and 1 <= found[0] < 6
+        # resume reproduces the uninterrupted bytes
+        assert _model(run(6, resume=True)) == base
+
+
+# ---------------------------------------------------------------------------
+def _fake_barrier(entries):
+    """A barrier stub standing in for process_allgather in a simulated
+    host group: returns the given per-host [iteration, crc, rows]
+    triples."""
+    return lambda vec: [np.asarray(e, np.int64) for e in entries]
+
+
+def _save_host_bundles(root, iteration, host_payloads, keep=3):
+    """Write one bundle per simulated host; returns the managers."""
+    mgrs = []
+    for k, (model_text, state, arrays) in enumerate(host_payloads):
+        m = CheckpointManager(str(root), keep=keep, host_index=k,
+                              host_count=len(host_payloads))
+        m.save(iteration, model_text, state, arrays)
+        mgrs.append(m)
+    return mgrs
+
+
+def _set_bundle_host_count(bundle_dir, hc):
+    """Stamp a saved bundle's recorded topology host_count (manifest
+    CRC refreshed) — simulates a bundle written by an hc-host group."""
+    import zlib as _zlib
+
+    st_path = os.path.join(str(bundle_dir), "state.json")
+    with open(st_path) as f:
+        st = json.load(f)
+    st.setdefault("topology", {})["host_count"] = hc
+    raw = json.dumps(st, sort_keys=True).encode()
+    with open(st_path, "wb") as f:
+        f.write(raw)
+    man_path = os.path.join(str(bundle_dir), "manifest.json")
+    with open(man_path) as f:
+        man = json.load(f)
+    man["files"]["state.json"] = {"crc32": _zlib.crc32(raw),
+                                  "bytes": len(raw)}
+    with open(man_path, "w") as f:
+        json.dump(man, f)
+
+
+def _bundle(rows, host, hosts, it=3):
+    state = {"iteration": it,
+             "topology": {"rows": rows, "host_count": hosts,
+                          "host_index": host, "partitioned": True}}
+    arrays = {"train_scores":
+              np.full((1, rows), float(host), np.float32)}
+    return f"model-{it}", state, arrays
+
+
+class TestMultihostCheckpointGroup:
+    def test_commit_requires_all_hosts_same_iteration(self, tmp_path):
+        mgrs = _save_host_bundles(tmp_path, 3,
+                                  [_bundle(100, 0, 2), _bundle(80, 1, 2)])
+        crc1 = mgrs[1].manifest_crc(mgrs[1].host_bundle_path(1, 3))
+        crc0 = mgrs[0].manifest_crc(mgrs[0].host_bundle_path(0, 3))
+        # mixed iterations at the barrier: the commit must refuse
+        with pytest.raises(ValueError, match="mixed-iteration"):
+            mgrs[0].commit_global(3, barrier=_fake_barrier(
+                [[3, crc0, 100], [2, crc1, 80]]))
+        assert mgrs[0].group_manifests() == []
+        # a consistent barrier commits (rank 0 only)
+        path = mgrs[0].commit_global(3, barrier=_fake_barrier(
+            [[3, crc0, 100], [3, crc1, 80]]))
+        assert path and os.path.exists(path)
+        assert mgrs[1].commit_global(3, barrier=_fake_barrier(
+            [[3, crc1, 80], [3, crc1, 80]])) is None  # non-zero rank
+
+    def test_group_validation_refuses_torn_sets(self, tmp_path):
+        mgrs = _save_host_bundles(tmp_path, 3,
+                                  [_bundle(100, 0, 2), _bundle(80, 1, 2)])
+        crcs = [m.manifest_crc(m.host_bundle_path(m.host_index, 3))
+                for m in mgrs]
+        mgrs[0].commit_global(3, barrier=_fake_barrier(
+            [[3, crcs[0], 100], [3, crcs[1], 80]]))
+        it, manifest = mgrs[0].load_latest_group()
+        assert it == 3 and mgrs[0].validate_group(manifest)
+        # tear host 1's bundle: the group must stop validating and
+        # load_latest_group must skip it
+        victim = os.path.join(mgrs[1].host_bundle_path(1, 3),
+                              "arrays.npz")
+        with open(victim, "r+b") as f:
+            f.truncate(8)
+        assert not mgrs[0].validate_group(manifest)
+        assert mgrs[0].load_latest_group() is None
+
+    def test_refuses_commit_on_torn_local_bundle(self, tmp_path):
+        """A torn local bundle still ENTERS the barrier (raising before
+        it would strand the healthy peers inside the allgather) and the
+        whole group refuses via the sentinel."""
+        m = CheckpointManager(str(tmp_path), host_index=0, host_count=2)
+        seen = []
+
+        def barrier(vec):
+            seen.append(np.asarray(vec).tolist())
+            return [vec, np.asarray([9, 123, 80], np.int64)]
+
+        with pytest.raises(ValueError, match="torn/missing"):
+            m.commit_global(9, barrier=barrier)
+        # this host reached the barrier and contributed the sentinel
+        assert seen == [[-1, 0, 0]]
+        assert m.group_manifests() == []
+
+    def test_group_manifest_retention(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep=2, host_index=0,
+                              host_count=1)
+        # host_count=1 writes flat; drive commit bookkeeping directly
+        for it in (1, 2, 3, 4):
+            m.save(it, f"model-{it}", {"iteration": it},
+                   {"train_scores": np.zeros((1, 4), np.float32)})
+            crc = m.manifest_crc(m.host_bundle_path(0, it))
+            m.commit_global(it, barrier=_fake_barrier([[it, crc, 4]]))
+        assert [it for it, _ in m.group_manifests()] == [4, 3]
+
+    def test_elastic_resume_from_partitioned_group(self, tmp_path):
+        """A 2-host partitioned checkpoint group resumes on ONE process
+        bitwise: global buffers reassemble in process order."""
+        base = _model(_train(dict(P), 6))
+        # build the "2-host" group from a real single-host checkpoint:
+        # slice its global arrays into per-host halves
+        solo = tmp_path / "solo"
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        for _ in range(3):
+            bst.update()
+        save_checkpoint(bst, CheckpointManager(str(solo)))
+        it, model_text, state, arrays, _ = \
+            CheckpointManager(str(solo)).load_latest()
+        n = arrays["train_scores"].shape[1]
+        n0 = n // 2
+        group = tmp_path / "group"
+        payloads = []
+        for k, (lo, hi) in enumerate(((0, n0), (n0, n))):
+            st = json.loads(json.dumps(state))  # deep copy
+            st["topology"] = {"rows": hi - lo, "host_count": 2,
+                              "host_index": k, "partitioned": True}
+            arr = {"train_scores":
+                   np.ascontiguousarray(arrays["train_scores"][:, lo:hi])}
+            if "bag_mask" in arrays:
+                arr["bag_mask"] = np.ascontiguousarray(
+                    arrays["bag_mask"][lo:hi])
+            payloads.append((model_text, st, arr))
+        mgrs = _save_host_bundles(group, it, payloads)
+        crcs = [m.manifest_crc(m.host_bundle_path(m.host_index, it))
+                for m in mgrs]
+        mgrs[0].commit_global(it, barrier=_fake_barrier(
+            [[it, crcs[0], n0], [it, crcs[1], n - n0]]),
+            topology=payloads[0][1]["topology"])
+        # resume on the live single-process topology: the loader must
+        # reassemble host slices into the global buffers
+        ds2 = lgb.Dataset(X, label=Y, params=p)
+        bst2 = Booster(params=p, train_set=ds2)
+        assert bst2.resume_from_checkpoint(str(group)) == 3
+        for _ in range(3):
+            bst2.update()
+        assert _model(bst2) == base
+
+    def test_malformed_group_manifest_is_skipped_not_fatal(self,
+                                                           tmp_path):
+        """A manifest that parses as JSON but has malformed hosts
+        entries must read as invalid (skip-with-warning), not crash the
+        resume — and an older valid group must still be found."""
+        mgrs = _save_host_bundles(tmp_path, 3,
+                                  [_bundle(100, 0, 2), _bundle(80, 1, 2)])
+        crcs = [m.manifest_crc(m.host_bundle_path(m.host_index, 3))
+                for m in mgrs]
+        mgrs[0].commit_global(3, barrier=_fake_barrier(
+            [[3, crcs[0], 100], [3, crcs[1], 80]]))
+        for bad in ({"iteration": 9, "host_count": 2, "hosts": 7},
+                    {"iteration": 9, "host_count": 2,
+                     "hosts": [{"index": 0}, {"index": 1}]},
+                    {"iteration": 9, "host_count": 2,
+                     "hosts": [0, 1]}):
+            assert mgrs[0].validate_group(bad) is False
+        # a newer malformed manifest on disk: walked past, older used
+        with open(tmp_path / "global-00000009.json", "w") as f:
+            json.dump({"iteration": 9, "host_count": 2, "hosts": 7}, f)
+        it, manifest = mgrs[0].load_latest_group()
+        assert it == 3
+
+    def test_stale_global_temp_files_are_swept(self, tmp_path):
+        m = CheckpointManager(str(tmp_path), keep=2, host_index=0,
+                              host_count=1)
+        debris = tmp_path / ".tmp-global-00000001.json-999"
+        debris.write_text("{}")
+        m.save(2, "model-2", {"iteration": 2},
+               {"train_scores": np.zeros((1, 4), np.float32)})
+        crc = m.manifest_crc(m.host_bundle_path(0, 2))
+        m.commit_global(2, barrier=_fake_barrier([[2, crc, 4]]))
+        assert not debris.exists()
+
+    def test_uncommitted_set_at_changed_host_count_falls_back(
+            self, tmp_path, monkeypatch):
+        """Uncommitted bundles written at P hosts cannot be used by a
+        P'-host group (no committed manifest to re-shard from): resume
+        must fall back to the older flat checkpoint, not hand each live
+        host a stale slice."""
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        ckdir = tmp_path / "grp"
+        for _ in range(2):
+            bst.update()
+        save_checkpoint(bst, CheckpointManager(str(ckdir)))  # flat @2
+        bst.update()
+        # "4-host" uncommitted bundle on host 0 at iteration 3
+        save_checkpoint(bst, CheckpointManager(str(ckdir / "host-00000")))
+        _set_bundle_host_count(ckdir / "host-00000" / "ckpt-00000003", 4)
+        mgr = CheckpointManager(str(ckdir), host_index=0, host_count=2)
+        monkeypatch.setattr(
+            CheckpointManager, "_default_barrier",
+            lambda self, vec: [vec, np.asarray([3, 0, 0], np.int64)])
+        ds2 = lgb.Dataset(X, label=Y, params=p)
+        bst2 = Booster(params=p, train_set=ds2)
+        from lightgbm_tpu.utils.checkpoint import restore_checkpoint
+        state = restore_checkpoint(bst2, mgr)
+        assert state is not None and int(state["iteration"]) == 2
+
+    def test_row_offsets_helper(self):
+        offs, total = row_offsets([100, 80, 120])
+        np.testing.assert_array_equal(offs, [0, 100, 180])
+        assert total == 300
+
+    def test_uncommitted_group_resumes_min_common_iteration(
+            self, tmp_path, monkeypatch):
+        """No committed global manifest: the hosts must agree on the
+        MIN-COMMON locally-valid iteration — each picking its own newest
+        would desync the group's collective streams."""
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        ckdir = tmp_path / "grp"
+        for _ in range(2):
+            bst.update()
+        save_checkpoint(bst, CheckpointManager(str(ckdir / "host-00000"),
+                                               keep=10))
+        bst.update()
+        save_checkpoint(bst, CheckpointManager(str(ckdir / "host-00000"),
+                                               keep=10))
+        for it in (2, 3):
+            _set_bundle_host_count(
+                ckdir / "host-00000" / f"ckpt-{it:08d}", 2)
+        # "host 1" (simulated at the barrier) only reached iteration 2
+        mgr = CheckpointManager(str(ckdir), keep=10, host_index=0,
+                                host_count=2)
+        monkeypatch.setattr(
+            CheckpointManager, "_default_barrier",
+            lambda self, vec: [vec, np.asarray([2, 0, 0], np.int64)])
+        ds2 = lgb.Dataset(X, label=Y, params=p)
+        bst2 = Booster(params=p, train_set=ds2)
+        from lightgbm_tpu.utils.checkpoint import restore_checkpoint
+        state = restore_checkpoint(bst2, mgr)
+        assert state is not None and int(state["iteration"]) == 2
+
+    def test_uncommitted_host_bundles_outrank_stale_flat_root(
+            self, tmp_path, monkeypatch):
+        """The group's newest durable state (uncommitted per-host
+        bundles) must win over an older flat root checkpoint left from
+        a single-host run the pod resumed from."""
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        ckdir = tmp_path / "grp"
+        for _ in range(2):
+            bst.update()
+        save_checkpoint(bst, CheckpointManager(str(ckdir)))  # flat @2
+        bst.update()
+        save_checkpoint(bst, CheckpointManager(str(ckdir / "host-00000")))
+        _set_bundle_host_count(ckdir / "host-00000" / "ckpt-00000003", 2)
+        mgr = CheckpointManager(str(ckdir), host_index=0, host_count=2)
+        monkeypatch.setattr(
+            CheckpointManager, "_default_barrier",
+            lambda self, vec: [vec, np.asarray([3, 0, 0], np.int64)])
+        ds2 = lgb.Dataset(X, label=Y, params=p)
+        bst2 = Booster(params=p, train_set=ds2)
+        from lightgbm_tpu.utils.checkpoint import restore_checkpoint
+        state = restore_checkpoint(bst2, mgr)
+        assert state is not None and int(state["iteration"]) == 3
+
+    def test_newer_flat_checkpoint_outranks_older_committed_group(
+            self, tmp_path):
+        """A committed group manifest must not shadow NEWER durable
+        progress (e.g. the pod run was elastically resumed single-host
+        and trained further before dying again)."""
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        ckdir = tmp_path / "grp"
+        for _ in range(2):
+            bst.update()
+        # committed "1-host group" at iteration 2: host dir + manifest
+        hmgr = CheckpointManager(str(ckdir), host_index=0, host_count=1)
+        save_checkpoint(bst, hmgr)
+        crc = hmgr.manifest_crc(hmgr.host_bundle_path(0, 2))
+        hmgr.commit_global(2, barrier=_fake_barrier([[2, crc, len(Y)]]))
+        # newer flat checkpoint at iteration 3
+        bst.update()
+        save_checkpoint(bst, CheckpointManager(str(ckdir)))
+        ds2 = lgb.Dataset(X, label=Y, params=p)
+        bst2 = Booster(params=p, train_set=ds2)
+        assert bst2.resume_from_checkpoint(str(ckdir)) == 3
+
+    def test_uncommitted_group_with_bundleless_host_refuses(
+            self, tmp_path, monkeypatch):
+        p = dict(P)
+        ds = lgb.Dataset(X, label=Y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        ckdir = tmp_path / "grp"
+        bst.update()
+        save_checkpoint(bst, CheckpointManager(str(ckdir / "host-00000")))
+        mgr = CheckpointManager(str(ckdir), host_index=0, host_count=2)
+        monkeypatch.setattr(
+            CheckpointManager, "_default_barrier",
+            lambda self, vec: [vec, np.asarray([-1, 0, 0], np.int64)])
+        ds2 = lgb.Dataset(X, label=Y, params=p)
+        bst2 = Booster(params=p, train_set=ds2)
+        from lightgbm_tpu.utils.checkpoint import restore_checkpoint
+        with pytest.raises(ValueError, match="cannot resume consistently"):
+            restore_checkpoint(bst2, mgr)
+
+
+# ---------------------------------------------------------------------------
+class TestElasticResume:
+    """Device-shard elastic resume: checkpoint at P data shards, resume
+    at P' — models must stay byte-identical for quantized precisions
+    (the dryrun sweeps the full (P, P') matrix; tier-1 covers one
+    direction each way)."""
+
+    @pytest.mark.parametrize("p1,p2", [(2, 4), (4, 1)])
+    def test_int8_bitwise_across_shard_counts(self, tmp_path, p1, p2):
+        q = dict(P, tpu_hist_precision="int8", tree_learner="data",
+                 tpu_quant_refit_leaves=False)
+        base = _model(_train(dict(q, num_machines=1), 6))
+        pc = dict(q, tpu_checkpoint_dir=str(tmp_path))
+        _train(dict(pc, num_machines=p1), 3)
+        resumed = _train(dict(pc, num_machines=p2), 6, resume=True)
+        assert _model(resumed) == base
+
+    def test_elastic_refused_when_disabled(self, tmp_path):
+        q = dict(P, tree_learner="data",
+                 tpu_checkpoint_dir=str(tmp_path))
+        _train(dict(q, num_machines=2), 3)
+        with pytest.raises(ValueError, match="tpu_resume_elastic"):
+            _train(dict(q, num_machines=4, tpu_resume_elastic=False), 6,
+                   resume=True)
+
+    def test_elastic_refusal_survives_material_mismatch(self, tmp_path):
+        """A co-occurring material param change must not smuggle a
+        refused re-shard past tpu_resume_elastic=false."""
+        q = dict(P, tree_learner="data",
+                 tpu_checkpoint_dir=str(tmp_path))
+        _train(dict(q, num_machines=2), 3)
+        with pytest.raises(ValueError, match="tpu_resume_elastic"):
+            _train(dict(q, num_machines=4, learning_rate=0.2,
+                        tpu_resume_elastic=False), 6, resume=True)
+
+    def test_material_params_mismatch_names_keys(self, tmp_path, capsys):
+        q = dict(P, tpu_checkpoint_dir=str(tmp_path))
+        _train(q, 3)
+        _train(dict(q, learning_rate=0.2), 6, resume=True)
+        captured = capsys.readouterr()
+        out = captured.out + captured.err
+        assert "learning_rate" in out and "0.2" in out
+
+    def test_strict_mode_raises_with_named_keys(self, tmp_path):
+        q = dict(P, tpu_checkpoint_dir=str(tmp_path))
+        _train(q, 3)
+        with pytest.raises(ValueError, match="learning_rate"):
+            _train(dict(q, learning_rate=0.2, tpu_resume_strict=True), 6,
+                   resume=True)
+
+    def test_params_diff_classification(self):
+        stored = {"learning_rate": "0.1", "num_machines": "4",
+                  "max_bin": "47"}
+        live = {"learning_rate": "0.1", "num_machines": "2",
+                "max_bin": "63"}
+        elastic, material = params_diff(stored, live)
+        assert [k for k, _, _ in elastic] == ["num_machines"]
+        assert [k for k, _, _ in material] == ["max_bin"]
+
+    def test_fingerprint_ignores_topology_keys(self):
+        a = _params_fingerprint({"learning_rate": 0.1, "num_machines": 4,
+                                 "workers": "a:1,b:2"})
+        b = _params_fingerprint({"learning_rate": 0.1, "num_machines": 1})
+        c = _params_fingerprint({"learning_rate": 0.2, "num_machines": 4})
+        assert a == b
+        assert a != c

@@ -130,6 +130,66 @@ class TestCheckpointManager:
         mgr.save(2, "m2", {"iteration": 2}, {"a": np.zeros(2, np.float32)})
         assert mgr.load_latest()[0] == 2  # temp debris cleaned, dir usable
 
+    def test_sigterm_during_retention_keeps_newest_valid(self, tmp_path,
+                                                         monkeypatch):
+        """SIGTERM (the engine maps it to KeyboardInterrupt) landing
+        inside keep-last-N pruning must never cost the newest valid
+        bundle: deletions run oldest-first and the newest is excluded
+        from the deletion list by construction."""
+        import shutil as _shutil
+
+        backlog = CheckpointManager(str(tmp_path), keep=10)
+        for it in (1, 2, 3, 4):
+            backlog.save(it, f"model-{it}", {"iteration": it},
+                         {"a": np.zeros(2, np.float32)})
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        deleted = []
+        real_rmtree = _shutil.rmtree
+
+        def dying_rmtree(path, **kw):
+            deleted.append(os.path.basename(str(path)))
+            raise KeyboardInterrupt("SIGTERM")
+
+        monkeypatch.setattr(_shutil, "rmtree", dying_rmtree)
+        with pytest.raises(KeyboardInterrupt):
+            mgr.save(5, "model-5", {"iteration": 5},
+                     {"a": np.zeros(2, np.float32)})
+        monkeypatch.setattr(_shutil, "rmtree", real_rmtree)
+        # the interrupt hit the OLDEST prune candidate, and the newest
+        # bundle (the one just written) survived, valid
+        assert deleted == ["ckpt-00000001"]
+        found = mgr.load_latest()
+        assert found is not None and found[0] == 5
+        assert mgr.validate(str(tmp_path / "ckpt-00000005"))
+
+    def test_interrupted_prune_recovers_on_next_save(self, tmp_path,
+                                                     monkeypatch):
+        """Leftover over-retention bundles from an interrupted prune are
+        collected by the next save's retention pass."""
+        import shutil as _shutil
+
+        backlog = CheckpointManager(str(tmp_path), keep=10)
+        for it in (1, 2, 3, 4):
+            backlog.save(it, f"model-{it}", {"iteration": it},
+                         {"a": np.zeros(2, np.float32)})
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        hits = []
+
+        def rmtree_once(path, **kw):
+            hits.append(path)
+            raise KeyboardInterrupt("SIGTERM")
+
+        monkeypatch.setattr(_shutil, "rmtree", rmtree_once)
+        with pytest.raises(KeyboardInterrupt):
+            mgr.save(5, "model-5", {"iteration": 5},
+                     {"a": np.zeros(2, np.float32)})
+        monkeypatch.undo()
+        mgr.save(6, "model-6", {"iteration": 6},
+                 {"a": np.zeros(2, np.float32)})
+        names = sorted(n for n in os.listdir(tmp_path)
+                       if n.startswith("ckpt-"))
+        assert names == ["ckpt-00000005", "ckpt-00000006"]
+
 
 class TestCheckpointResume:
     def test_checkpointing_is_bit_invisible(self, tmp_path):

@@ -542,9 +542,112 @@ def run_faults(n=4000, f=6, iters=5):
     sess.close()
 
 
+def run_faults_multihost(hosts=2, iters=4, n=1200):
+    """Distributed chaos sweep (ISSUE 8): a (point x armed-host x
+    live-host) grid over a SIMULATED host group, one outcome line per
+    cell — the operational proof that (a) a fault armed for host k at
+    absolute call-index i fires on host k and ONLY host k (the
+    reproducibility contract multihost chaos runs need), and (b) every
+    addressed fault degrades to a flushed checkpoint + bitwise resume
+    instead of a hung group.
+
+        HOSTS=2 python tools/perf_probe.py faults --multihost
+    """
+    import shutil
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.parallel.collective import (CollectiveTimeout,
+                                                  HostDropped,
+                                                  guarded_collective)
+    from lightgbm_tpu.utils import faultline
+    from lightgbm_tpu.utils.checkpoint import CheckpointManager
+
+    X, y = make_data(n, f=6)
+    base = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+            "learning_rate": 0.1, "min_data_in_leaf": 20,
+            "verbosity": -1, "tpu_collective_timeout_s": 5.0}
+
+    def outcome(point, h_armed, h_live, text):
+        print(f"{point:<18s} armed=h{h_armed} live=h{h_live} {text}",
+              flush=True)
+
+    print(f"{'point':<18s} {'armed':<8s} {'live':<7s} outcome", flush=True)
+
+    for point, action, exc_type in (
+            ("collective_sync", "hang", CollectiveTimeout),
+            ("host_drop", "raise", HostDropped)):
+        for h_armed in range(hosts):
+            for h_live in range(hosts):
+                faultline.reset()
+                faultline.set_host_index(h_live)
+                d = tempfile.mkdtemp(prefix="mh-faults-")
+                try:
+                    p = dict(base, tpu_checkpoint_dir=d)
+                    ds = lgb.Dataset(X, label=y, params=p)
+                    dv = lgb.Dataset(X[:256], label=y[:256],
+                                     reference=ds, params=p)
+                    # the metric sync is one collective per iteration:
+                    # absolute call-index 3 = iteration 3's eval
+                    faultline.arm(point, action=action, at=3,
+                                  absolute=True, host=h_armed)
+                    try:
+                        bst = lgb.train(p, ds, num_boost_round=iters,
+                                        valid_sets=[dv],
+                                        verbose_eval=False,
+                                        keep_training_booster=True)
+                        it = bst.current_iteration()
+                        tag = ("UNEXPECTED clean run"
+                               if h_armed == h_live else "not addressed")
+                        outcome(point, h_armed, h_live,
+                                f"{tag} -> trained {it} iters clean")
+                    except exc_type as exc:
+                        faultline.set_host_index(h_live)
+                        faultline.disarm()
+                        got = CheckpointManager(d).load_latest()
+                        ck_it = got[0] if got else None
+                        ds2 = lgb.Dataset(X, label=y, params=p)
+                        bst2 = lgb.train(p, ds2, num_boost_round=iters,
+                                         resume=True, verbose_eval=False,
+                                         keep_training_booster=True)
+                        outcome(point, h_armed, h_live,
+                                f"{type(exc).__name__} at call 3 -> "
+                                f"checkpoint@{ck_it} flushed, resumed "
+                                f"to {bst2.current_iteration()} iters")
+                finally:
+                    faultline.reset()
+                    shutil.rmtree(d, ignore_errors=True)
+
+    # binning_allgather: single-process ingest never reaches the
+    # multihost allgather, so the point is demonstrated at the transport
+    # wrapper — same watchdog, same addressing
+    for h_armed in range(hosts):
+        for h_live in range(hosts):
+            faultline.reset()
+            faultline.set_host_index(h_live)
+            faultline.arm("binning_allgather", action="hang",
+                          host=h_armed)
+            try:
+                guarded_collective(lambda: "mappers",
+                                   name="mapper_exchange",
+                                   point="binning_allgather", local=True)
+                outcome("binning_allgather", h_armed, h_live,
+                        "not addressed -> mapper exchange completed")
+            except CollectiveTimeout:
+                outcome("binning_allgather", h_armed, h_live,
+                        "CollectiveTimeout -> bin finding aborted "
+                        "cleanly")
+            finally:
+                faultline.reset()
+
+
 def main():
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
     if arg == "faults":
+        if "--multihost" in sys.argv[2:]:
+            run_faults_multihost(hosts=int(os.environ.get("HOSTS", 2)),
+                                 iters=int(os.environ.get("ITERS", 4)))
+            return
         run_faults(n=int(os.environ.get("N", 4000)),
                    iters=int(os.environ.get("ITERS", 5)))
         return
