@@ -124,12 +124,29 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     X, y = make_data(n_rows, N_FEATURES)
     data_s = time.time() - t_data
 
+    # telemetry (ISSUE 10): every timed segment below routes through the
+    # metrics registry (obs.timed / phase histograms) instead of ad-hoc
+    # stopwatches, so the numbers the bench prints are the numbers a
+    # Prometheus scrape of the same run would see.  BENCH_TELEMETRY=
+    # trace additionally writes a Chrome trace under BENCH_TRACE_DIR.
+    from lightgbm_tpu import obs
+
+    if os.environ.get("BENCH_TELEMETRY") or obs.mode() == "off":
+        bench_mode = os.environ.get("BENCH_TELEMETRY", "metrics")
+        if bench_mode == "off":
+            # the bench READS its segment walls back from the registry,
+            # so metrics is its floor — "off" would IndexError at the
+            # first readback
+            bench_mode = "metrics"
+        obs.configure(mode=bench_mode,
+                      trace_dir=os.environ.get("BENCH_TRACE_DIR") or None)
+
     # ingest phase split (sketch = bin finding, binning = value->bin,
     # layout = the learner's device-layout step, captured below after
-    # Booster construction)
+    # Booster construction) — accumulated in the registry as
+    # lgbm_phase_seconds_total{phase=...}
     from lightgbm_tpu.utils import timer as phase_timer
 
-    phase_timer.enable(True)
     phase_timer.reset()
     t_bin = time.time()
     ds = lgb.Dataset(X, label=y, params={"max_bin": max_bin})
@@ -189,29 +206,32 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     # snapshot ingest phases NOW: later valid-set constructs would
     # double-count sketch/binning
     phases = dict(phase_timer.summary())
-    phase_timer.enable(False)
     from lightgbm_tpu.utils.backend import host_sync
 
-    t_compile = time.time()
-    for _ in range(WARMUP_ITERS):
-        bst.update()
-    host_sync(bst._driver.train_scores.scores)
-    compile_s = time.time() - t_compile
+    def _segments(tag, k=3):
+        """The last k registry-recorded walls for one bench segment."""
+        return obs.REGISTRY.histogram_samples(
+            "lgbm_timed_seconds", name=tag)[-k:]
+
+    with obs.timed("bench/compile"):
+        for _ in range(WARMUP_ITERS):
+            bst.update()
+        host_sync(bst._driver.train_scores.scores)
+    compile_s = _segments("bench/compile", 1)[0]
     n_programs_train = LEDGER.n_programs()
 
     # >=3 timed segments so the headline carries its own variance
     # (median beside min); segments hold >=2 iters so the per-segment
     # host_sync doesn't serialize every single dispatch
     seg_iters = max(round(bench_iters / 3), 2)
-    seg_rates = []
-    t0 = time.time()
     for _ in range(3):
-        ts = time.time()
-        for _ in range(seg_iters):
-            bst.update()
-        host_sync(bst._driver.train_scores.scores)
-        seg_rates.append(seg_iters / max(time.time() - ts, 1e-9))
-    train_s = time.time() - t0
+        with obs.timed("bench/train_segment"):
+            for _ in range(seg_iters):
+                bst.update()
+            host_sync(bst._driver.train_scores.scores)
+    seg_walls = _segments("bench/train_segment")
+    seg_rates = [seg_iters / max(w, 1e-9) for w in seg_walls]
+    train_s = sum(seg_walls)
     bench_iters = 3 * seg_iters
     iters_per_sec, iters_per_sec_min = spread(seg_rates)
 
@@ -219,11 +239,10 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     # the configuration would actually use (device bin-space traversal on
     # TPU, native walker otherwise)
     bst.predict(X_eval, raw_score=True)  # warm (pack + compile)
-    pred_rates = []
     for _ in range(3):
-        t_pred = time.time()
-        bst.predict(X_eval, raw_score=True)
-        pred_rates.append(n_eval / max(time.time() - t_pred, 1e-9))
+        with obs.timed("bench/predict"):
+            bst.predict(X_eval, raw_score=True)
+    pred_rates = [n_eval / max(w, 1e-9) for w in _segments("bench/predict")]
     predict_rows_per_sec, predict_rows_per_sec_min = spread(pred_rates)
     # sanity AUC BEFORE the eval-overhead block: its extra update() calls
     # would otherwise make the recorded train_auc describe a model
@@ -456,6 +475,11 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "n_programs": n_programs,
         "ledger_sites": ledger_sites,
         "platform": jax.devices()[0].platform,
+        # ISSUE 10 satellite: the backend/degraded marker lives IN the
+        # record (it used to go only to stderr, so rounds 3-5's silent
+        # CPU fallback could not be audited post hoc from the JSON)
+        "backend": jax.devices()[0].platform,
+        "degraded": bool(degraded),
     }
     if compile_note is not None:
         out["compile_vs_prior"] = compile_note
@@ -465,8 +489,12 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         out["compile_cache"] = cache_state  # cold|warm; compile_s pairs
 
     if degraded:
-        out["degraded"] = ("tpu backend probe failed; reduced-size run on "
-                           "cpu fallback — value NOT comparable to baseline")
+        out["degraded_reason"] = (
+            "tpu backend probe failed; reduced-size run on cpu fallback "
+            "— value NOT comparable to baseline")
+    if obs.tracing_on():
+        obs.write_chrome_trace()
+        obs.flush()
     print(json.dumps(out))
 
 
@@ -519,6 +547,9 @@ def main():
             "value": 0.0,
             "unit": "iters/s",
             "vs_baseline": 0.0,
+            # even a crashed round records which backend it was on
+            "backend": platform or "none",
+            "degraded": bool(degraded),
             "error": f"{type(exc).__name__}: {exc}",
             "trace_tail": traceback.format_exc().strip().splitlines()[-3:],
         }))

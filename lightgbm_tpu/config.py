@@ -204,6 +204,27 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # poisoned iteration back and raise; skip = roll it back, re-bag,
     # and keep training (drops the iteration)
     "tpu_guard_numerics": ("str", "off", ()),
+    # --- observability (lightgbm_tpu/obs: metrics registry + span tracer) ---
+    # process-global telemetry mode.  "" (the registry default) means
+    # UNSET — a booster/dataset constructed without the param never
+    # disturbs a policy another layer armed (same convention as
+    # tpu_collective_timeout_s); the effective initial mode is "off"
+    # unless LIGHTGBM_TPU_TELEMETRY is set.  off = no instrumentation
+    # (the train loop pays one flag check per site); metrics = phase
+    # walls, counters and fixed-bucket histograms flow into the
+    # process-global registry (scraped as Prometheus text via the
+    # serving GET /metrics); trace = metrics PLUS nested structured
+    # spans (per-iteration train lifecycle, collectives, checkpoints,
+    # serving dispatch) exported as Chrome-trace-event JSON that loads
+    # in Perfetto, mirrored into jax.profiler.TraceAnnotation so the
+    # same names appear inside xprof device traces
+    "tpu_telemetry": ("str", "", ()),
+    # span/event sink for tpu_telemetry=trace: each host streams
+    # events-host<k>.jsonl incrementally (a dying run keeps everything
+    # up to the death) and train() dumps trace-host<k>.json on exit;
+    # merge a multihost run's streams with tools/trace_merge.py.
+    # "" = unset (in-memory span buffer only)
+    "tpu_trace_dir": ("str", "", ()),
     # --- objective ---
     "num_class": ("int", 1, ("num_classes",)),
     "is_unbalance": ("bool", False, ("unbalance", "unbalanced_sets")),

@@ -172,33 +172,54 @@ def train(params: Dict[str, Any], train_set: Dataset,
         except (ValueError, OSError):  # pragma: no cover - exotic host
             prev_sigterm = None
 
+    from . import obs
+
+    def _dump_trace() -> None:
+        # Chrome-trace dump + JSONL flush at end of train (success,
+        # early stop, or interrupt alike — the trace of a FAILED run is
+        # the one worth reading).  Runs AFTER the final checkpoint
+        # flush on every path so the checkpoint's own spans/events make
+        # the dump; no-op without tpu_trace_dir
+        if obs.tracing_on():
+            obs.write_chrome_trace()
+            obs.flush()
+
     evaluation_result_list: List = []
     try:
         for i in range(start_iteration, num_boost_round):
-            for cb in cb_before:
-                cb(CallbackEnv(model=booster, params=params, iteration=i,
-                               begin_iteration=0,
-                               end_iteration=num_boost_round,
-                               evaluation_result_list=None))
-            booster.update(fobj=fobj)
-            if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
-                booster.save_model(f"{snapshot_out}.snapshot_iter_{i + 1}")
-
-            evaluation_result_list: List = []
-            if valid_sets:
-                if is_valid_contain_train:
-                    evaluation_result_list.extend(booster.eval_train(feval))
-                evaluation_result_list.extend(booster.eval_valid(feval))
-            try:
-                for cb in cb_after:
+            # the per-round telemetry span covers callbacks + update +
+            # eval — under tpu_telemetry=trace the summed round spans
+            # account for >= 95% of the train-loop wall (asserted by
+            # tests/test_telemetry.py); obs.span is a shared null
+            # context manager when tracing is off
+            with obs.span("train/round", iteration=i):
+                for cb in cb_before:
                     cb(CallbackEnv(model=booster, params=params, iteration=i,
                                    begin_iteration=0,
                                    end_iteration=num_boost_round,
-                                   evaluation_result_list=evaluation_result_list))
-            except EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                evaluation_result_list = e.best_score
-                break
+                                   evaluation_result_list=None))
+                booster.update(fobj=fobj)
+                if snapshot_freq > 0 and (i + 1) % snapshot_freq == 0:
+                    booster.save_model(
+                        f"{snapshot_out}.snapshot_iter_{i + 1}")
+
+                evaluation_result_list: List = []
+                if valid_sets:
+                    if is_valid_contain_train:
+                        evaluation_result_list.extend(
+                            booster.eval_train(feval))
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+                try:
+                    for cb in cb_after:
+                        cb(CallbackEnv(
+                            model=booster, params=params, iteration=i,
+                            begin_iteration=0,
+                            end_iteration=num_boost_round,
+                            evaluation_result_list=evaluation_result_list))
+                except EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    evaluation_result_list = e.best_score
+                    break
     except BaseException as exc:
         # interrupt/device failure: the partial iteration was already
         # rolled back inside update(); flush a final checkpoint so the
@@ -220,6 +241,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     "checkpoint; restart the group and resume=True to "
                     "rejoin (elastic: any shard/host count)")
             flush_checkpoint(booster, ckpt_manager, callbacks=callbacks)
+        _dump_trace()
         raise
     finally:
         if prev_sigterm is not None:
@@ -232,6 +254,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
         from .utils.checkpoint import flush_checkpoint
 
         flush_checkpoint(booster, ckpt_manager, callbacks=callbacks)
+    _dump_trace()
 
     booster.best_score = {}
     for item in evaluation_result_list:

@@ -298,6 +298,12 @@ class TrainingData:
         alignment, reference dataset.h:501 CreateValid).
         """
         config = config or Config()
+        # arm the telemetry policy BEFORE the ingest phases run: the
+        # train set constructs ahead of the GBDT driver, and its
+        # sketch/binning spans must not be lost to ordering
+        from .. import obs
+
+        obs.configure_from_config(config)
         X = np.asarray(X, dtype=np.float64)
         if X.ndim != 2:
             raise ValueError("X must be 2-D")
@@ -447,7 +453,9 @@ class TrainingData:
         # same (collective) bin-finding path or the group hangs
         from .distributed_binning import (config_wants_distributed,
                                           ensure_distributed)
+        from .. import obs
 
+        obs.configure_from_config(config)
         ensure_distributed(config)
         skip_cache = config_wants_distributed(config)
         if reference is None and not skip_cache \

@@ -19,6 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..config import Config
 from ..io.bin_mapper import BinMapper, MissingType
 from ..io.dataset import TrainingData
@@ -303,6 +304,9 @@ class GBDT:
         from ..parallel.collective import configure_from_config
 
         configure_from_config(config)
+        # telemetry policy (tpu_telemetry / tpu_trace_dir) is process-
+        # global under the same no-clobber convention
+        obs.configure_from_config(config)
         if self._guard != "off" \
                 and str(config.tpu_hist_precision) in ("int8", "int16"):
             quant_headroom_check(str(config.tpu_hist_precision),
@@ -525,8 +529,9 @@ class GBDT:
             return True
         snap = self._iter_snapshot()
         try:
-            action = faultline.fire("grow_step", iteration=self.iter_)
-            ret = self._train_one_iter_impl(grad, hess, snap)
+            with obs.span("train/iteration", iteration=self.iter_):
+                action = faultline.fire("grow_step", iteration=self.iter_)
+                ret = self._train_one_iter_impl(grad, hess, snap)
         except BaseException:
             self._iter_restore(snap)
             raise
@@ -683,6 +688,12 @@ class GBDT:
         from ..utils.log import LightGBMError, Log
 
         it = snap["iter"]
+        # guard firings are rare and vital: count unconditionally, and
+        # leave a narrative event in the trace stream when one is open
+        obs.REGISTRY.inc("lgbm_guard_poisoned_total", mode=self._guard,
+                         help="non-finite-score iterations caught by "
+                              "tpu_guard_numerics")
+        obs.event("guard_poisoned", iteration=it, mode=self._guard)
         if self._guard == "warn":
             Log.warning(f"non-finite training scores after iteration {it} "
                         "(tpu_guard_numerics=warn): continuing")
@@ -768,17 +779,21 @@ class GBDT:
                 self.num_tree_per_iteration, -1)
 
         self._materialize()
-        mask = self.bagging_mask(self.iter_)
+        with obs.span("bagging"):
+            mask = self.bagging_mask(self.iter_)
         should_continue = False
         for k in range(self.num_tree_per_iteration):
             need = (self.objective is None
                     or self.objective.class_need_train(k))
             tree = None
             if need:
-                tree, leaf_ids, out = self.learner.train(grad[k], hess[k], mask)
+                with obs.span("grow", class_id=k):
+                    tree, leaf_ids, out = self.learner.train(
+                        grad[k], hess[k], mask)
             if tree is not None and tree.num_leaves > 1:
                 should_continue = True
-                self._renew_and_update(tree, leaf_ids, k, mask)
+                with obs.span("score_update", class_id=k):
+                    self._renew_and_update(tree, leaf_ids, k, mask)
                 if abs(init_scores[k]) > K_EPSILON:
                     tree.add_bias(init_scores[k])
             else:
@@ -1123,23 +1138,25 @@ class GBDT:
     # ------------------------------------------------------------------
     def eval(self, name: str, valid_idx: int, feval=None, booster=None
              ) -> List[Tuple]:
-        self._materialize()
-        out = []
-        if valid_idx < 0:
-            scores = self.train_scores.numpy()
-            metrics = self.metrics
-        else:
-            scores = self.valid_scores[valid_idx].numpy()
-            metrics = self.valid_metrics[valid_idx]
-        for m in metrics:
-            for metric_name, val in m.eval_all(scores, self.objective):
-                out.append((name, metric_name, val, m.higher_is_better))
-        if feval is not None:
-            ds = self.train_data if valid_idx < 0 else self.valid_sets[valid_idx]
-            res = feval(scores.reshape(-1), _FevalData(ds))
-            for item in (res if isinstance(res, list) else [res]):
-                out.append((name, item[0], item[1], item[2]))
-        return out
+        with obs.span("metric_eval", dataset=name):
+            self._materialize()
+            out = []
+            if valid_idx < 0:
+                scores = self.train_scores.numpy()
+                metrics = self.metrics
+            else:
+                scores = self.valid_scores[valid_idx].numpy()
+                metrics = self.valid_metrics[valid_idx]
+            for m in metrics:
+                for metric_name, val in m.eval_all(scores, self.objective):
+                    out.append((name, metric_name, val, m.higher_is_better))
+            if feval is not None:
+                ds = (self.train_data if valid_idx < 0
+                      else self.valid_sets[valid_idx])
+                res = feval(scores.reshape(-1), _FevalData(ds))
+                for item in (res if isinstance(res, list) else [res]):
+                    out.append((name, item[0], item[1], item[2]))
+            return out
 
     def eval_for_data(self, data: TrainingData, name: str, feval=None):
         """Metrics on an AD-HOC dataset without registering it as a valid

@@ -1032,7 +1032,10 @@ class TPUTreeLearner:
             # compiled step serves every class and both sides of the
             # bagging_freq boundary — previously each was a static key
             # multiplying the program count)
-            grad, hess = grad_fn(grad_scores)
+            # named_scope: the host-span vocabulary (boost / bagging /
+            # score_update) mirrored into xprof device traces
+            with jax.named_scope("boost"):
+                grad, hess = grad_fn(grad_scores)
             g = grad[class_id] if grad.ndim == 2 else grad
             h = hess[class_id] if hess.ndim == 2 else hess
             g = jnp.zeros(n_pad, jnp.float32).at[:n].set(g[:n])
@@ -1082,10 +1085,11 @@ class TPUTreeLearner:
             return g, h, mask, fmask, k_node, key, bag_key
 
         def _post(scores, records, leaf_ids, leaf_output, class_id):
-            any_split = records[0, 14] > 0.5  # REC_DID_SPLIT
-            delta = leaf_output[leaf_ids] * learning_rate
-            delta = jnp.where(any_split, delta, 0.0)
-            new_scores = scores.at[class_id, :].add(delta[:n])
+            with jax.named_scope("score_update"):
+                any_split = records[0, 14] > 0.5  # REC_DID_SPLIT
+                delta = leaf_output[leaf_ids] * learning_rate
+                delta = jnp.where(any_split, delta, 0.0)
+                new_scores = scores.at[class_id, :].add(delta[:n])
             return new_scores, leaf_ids[:n]
 
         external_pool = self._external_pool

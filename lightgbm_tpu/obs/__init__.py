@@ -1,0 +1,24 @@
+"""Unified telemetry: the metrics registry + structured span tracer.
+
+One import surface for every instrumented layer::
+
+    from ..obs import REGISTRY, span, timed, metrics_on, tracing_on
+
+* `REGISTRY` — process-global `MetricsRegistry` (counters, gauges,
+  fixed-bucket histograms; Prometheus text export).
+* `span(name, **tags)` — nested structured span (Chrome-trace/Perfetto
+  export, JSONL stream, jax TraceAnnotation mirror); null when
+  ``tpu_telemetry`` != trace.
+* `timed(name)` — registry-backed stopwatch (the bench's segment timer).
+* `configure` / `configure_from_config` — process-global policy from
+  ``tpu_telemetry`` (off | metrics | trace) and ``tpu_trace_dir``.
+
+See `obs.metrics` and `obs.trace` for the full contracts.
+"""
+
+from .metrics import (DEFAULT_SECONDS_BUCKETS, MetricsRegistry,  # noqa: F401
+                      REGISTRY, histogram_quantile)
+from .trace import (chrome_trace, configure, configure_from_config,  # noqa: F401
+                    event, events, flush, metrics_on, mode,
+                    reset_events, span, timed, trace_dir, tracing_on,
+                    write_chrome_trace)

@@ -985,19 +985,21 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                 slot_ids, B, precision)           # [k, Gs, B, 3]
             merged = jnp.concatenate([dense_h, sp], axis=-3)
             return jnp.take(merged, meta["hist_perm"], axis=-3)
-        if params.hist_impl.startswith("pallas"):
-            # reuse the batched VMEM kernel (slot 0 = the all-zero root
-            # leaf ids): the xla scan at pallas-sized short blocks would
-            # round-trip a materialized one-hot per block through HBM
-            root_slots = jnp.full(K, -1, jnp.int32).at[0].set(0)
-            root_local = build_histogram_batched_t(
-                bins_blocks, stats_blocks,
-                jnp.zeros((nb, block), jnp.int32), root_slots, B,
-                precision, impl=params.hist_impl,
-                packed_rows=params.packed_bins)[0]
-        else:
-            root_local = build_histogram_t(bins_blocks, stats_blocks, B,
-                                           precision)
+        with jax.named_scope("hist_build"):
+            if params.hist_impl.startswith("pallas"):
+                # reuse the batched VMEM kernel (slot 0 = the all-zero
+                # root leaf ids): the xla scan at pallas-sized short
+                # blocks would round-trip a materialized one-hot per
+                # block through HBM
+                root_slots = jnp.full(K, -1, jnp.int32).at[0].set(0)
+                root_local = build_histogram_batched_t(
+                    bins_blocks, stats_blocks,
+                    jnp.zeros((nb, block), jnp.int32), root_slots, B,
+                    precision, impl=params.hist_impl,
+                    packed_rows=params.packed_bins)[0]
+            else:
+                root_local = build_histogram_t(bins_blocks, stats_blocks,
+                                               B, precision)
         if params.has_sparse:
             root_local = merge_sparse_hist(
                 root_local[None], jnp.zeros(n_pad, jnp.int32),
@@ -1037,9 +1039,10 @@ def _build_grower(params, num_features, data_axis, feature_axis,
         else:
             used0 = jnp.zeros(FG, jnp.float32)
             delta0 = None
-        root_split = select(root_hist, sum_g, sum_h, cnt, -big, big,
-                            root_fmask, delta0,
-                            tot_root if sparse_tot else None)
+        with jax.named_scope("split_search"):
+            root_split = select(root_hist, sum_g, sum_h, cnt, -big, big,
+                                root_fmask, delta0,
+                                tot_root if sparse_tot else None)
 
         RW = REC_WIDTH + (CB if params.has_cat else 0)
         # the pool stores histograms in the ACCUMULATION dtype: an f32
@@ -1322,16 +1325,20 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             smaller_is_left = lc <= rc
             smaller_ids = jnp.where(
                 do_k, jnp.where(smaller_is_left, sel, new_ids), -1)
-            h_local = build_histogram_batched_t(
-                bins_blocks, stats_blocks, leaf_ids.reshape(nb, block),
-                smaller_ids, B, precision,
-                impl=params.hist_impl,
-                packed_rows=params.packed_bins)              # [K, F, B, 3]
-            h_local = merge_sparse_hist(h_local, leaf_ids, smaller_ids)
-            if sparse_tot:
-                tot_small = preduce_scalar(jnp.sum(
-                    h_local[:, meta["dense_ref"][0]], axis=1))   # [K, 3]
-            hist_small = agg_hist(h_local)               # [K, F/P, B, 3]
+            # named_scope: the telemetry span names (hist_build /
+            # split_search) appear inside xprof device traces too —
+            # trace-time metadata, zero runtime cost
+            with jax.named_scope("hist_build"):
+                h_local = build_histogram_batched_t(
+                    bins_blocks, stats_blocks, leaf_ids.reshape(nb, block),
+                    smaller_ids, B, precision,
+                    impl=params.hist_impl,
+                    packed_rows=params.packed_bins)          # [K, F, B, 3]
+                h_local = merge_sparse_hist(h_local, leaf_ids, smaller_ids)
+                if sparse_tot:
+                    tot_small = preduce_scalar(jnp.sum(
+                        h_local[:, meta["dense_ref"][0]], axis=1))  # [K, 3]
+                hist_small = agg_hist(h_local)           # [K, F/P, B, 3]
             parent_hist = state["pool"][sel]             # [K, F/P, B, 3]
             hist_large = parent_hist - hist_small
             sl = smaller_is_left[:, None, None, None]
@@ -1421,13 +1428,14 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                     jnp.where(live, credit, 0.0)
             else:
                 delta = None
-            ch = vselect(
-                jnp.concatenate([hist_left, hist_right], axis=0),
-                jnp.concatenate([lg, rg]), jnp.concatenate([lh, rh]),
-                jnp.concatenate([lc, rc]),
-                jnp.concatenate([l_min, r_min]),
-                jnp.concatenate([l_max, r_max]),
-                child_masks, delta, tot_children)
+            with jax.named_scope("split_search"):
+                ch = vselect(
+                    jnp.concatenate([hist_left, hist_right], axis=0),
+                    jnp.concatenate([lg, rg]), jnp.concatenate([lh, rh]),
+                    jnp.concatenate([lc, rc]),
+                    jnp.concatenate([l_min, r_min]),
+                    jnp.concatenate([l_max, r_max]),
+                    child_masks, delta, tot_children)
 
             new_state["leaf_ids"] = leaf_ids
             new_state["pool"] = pool

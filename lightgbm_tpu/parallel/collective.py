@@ -52,8 +52,22 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from .. import obs
 from ..utils import faultline
 from ..utils.log import Log
+
+# collective wait-time buckets: ICI syncs are sub-ms, DCN barriers can
+# legitimately take seconds
+_WAIT_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5,
+                 1.0, 5.0, 15.0, 60.0)
+
+
+def _note_wait(name: str, seconds: float) -> None:
+    if obs.metrics_on():
+        obs.REGISTRY.observe(
+            "lgbm_collective_wait_seconds", seconds, buckets=_WAIT_BUCKETS,
+            help="wall seconds blocked in host-level collectives",
+            name=name)
 
 
 class CollectiveTimeout(RuntimeError):
@@ -197,16 +211,42 @@ def guarded_collective(fn: Callable, *args,
                 return _run_with_deadline(
                     lambda: time.sleep(slack), (), {}, name, timeout_s,
                     attempt)
-            if local or timeout_s <= 0:
-                return fn(*args, **kwargs)
-            return _run_with_deadline(fn, args, kwargs, name, timeout_s,
-                                      attempt)
+            t_wait = time.perf_counter()
+            with obs.span(f"collective/{name}", attempt=attempt):
+                if local or timeout_s <= 0:
+                    result = fn(*args, **kwargs)
+                else:
+                    result = _run_with_deadline(fn, args, kwargs, name,
+                                                timeout_s, attempt)
+            _note_wait(name, time.perf_counter() - t_wait)
+            if attempt > 1:
+                # a retried collective that finally succeeded is a
+                # RECOVERY — the event PR 8's watchdogs had no way to
+                # surface after the fact
+                obs.REGISTRY.inc("lgbm_collective_recoveries_total",
+                                 name=name)
+                obs.event("collective_recovered", name=name,
+                          attempts=attempt)
+            return result
         except (CollectiveTimeout, HostDropped, KeyboardInterrupt,
-                SystemExit):
+                SystemExit) as exc:
+            if isinstance(exc, CollectiveTimeout):
+                obs.REGISTRY.inc(
+                    "lgbm_collective_timeouts_total",
+                    help="watchdog deadline expiries", name=name)
+                obs.event("collective_timeout", name=name,
+                          timeout_s=timeout_s, attempt=attempt)
+            elif isinstance(exc, HostDropped):
+                obs.REGISTRY.inc("lgbm_collective_host_drops_total",
+                                 name=name)
+                obs.event("host_dropped", name=name, host=me)
             raise
         except Exception as exc:  # noqa: BLE001 - transient transport error
             if attempt > retries:
                 raise
+            obs.REGISTRY.inc("lgbm_collective_retries_total",
+                             help="transient collective errors retried",
+                             name=name)
             wait = backoff_s * (2 ** (attempt - 1))
             Log.warning(
                 f"collective {name!r} failed on host {me} "

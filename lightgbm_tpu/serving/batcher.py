@@ -180,6 +180,7 @@ class MicroBatcher:
                 batch = []
                 take = 0
                 dropped = 0
+                t_pop = time.monotonic()
                 while dq and (not batch
                               or take + dq[0].n <= self.max_batch_rows):
                     r = dq.popleft()
@@ -187,6 +188,10 @@ class MicroBatcher:
                         dropped += r.n
                         r.done.set()
                         continue
+                    # queue wait = submit -> dispatch start: the number
+                    # that separates "the device is slow" from "the
+                    # queue is deep" when p99 climbs
+                    self.stats.record_queue_wait(t_pop - r.t_submit)
                     batch.append(r)
                     take += r.n
                 runner = self._runners[key]
@@ -201,17 +206,22 @@ class MicroBatcher:
             if batch:
                 self._run(runner, batch)
 
-    @staticmethod
-    def _run(runner, batch) -> None:
+    def _run(self, runner, batch) -> None:
+        from .. import obs
+
         X = batch[0].X if len(batch) == 1 else \
             np.concatenate([r.X for r in batch], axis=0)
+        t0 = time.monotonic()
         try:
-            out = runner(X)
+            with obs.span("serve/dispatch", rows=int(X.shape[0])):
+                out = runner(X)
         except BaseException as exc:  # delivered to every waiter
             for r in batch:
                 r.error = exc
                 r.done.set()
             return
+        finally:
+            self.stats.record_dispatch(time.monotonic() - t0)
         off = 0
         for r in batch:
             # axis-0 slice works for [n] and [n, k] outputs alike; padded

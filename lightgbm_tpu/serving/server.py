@@ -5,7 +5,10 @@ micro-batcher and one stats sink, and `session.predict(name, X)` is safe
 to call from any number of threads — requests coalesce in the batcher
 and run serialized on its worker.  The HTTP layer is a thin stdlib
 (`http.server`) translation of the same calls for non-Python clients;
-`python -m lightgbm_tpu serve` binds it.
+`python -m lightgbm_tpu serve` binds it.  `GET /metrics` exposes the
+process-global telemetry registry plus this session's serving metrics
+as Prometheus text — its latency histogram and the `/stats`
+percentiles derive from the same buckets.
 
 Error contract (mirrored into HTTP statuses):
 * unknown model                -> KeyError            -> 404
@@ -38,6 +41,9 @@ class ServingSession:
     def __init__(self, params: Optional[Dict] = None, start: bool = True):
         cfg = params if isinstance(params, Config) else Config(dict(params or {}))
         self.config = cfg
+        from .. import obs
+
+        obs.configure_from_config(cfg)  # tpu_telemetry / tpu_trace_dir
         self._stats = ServingStats(window=int(cfg.serving_stats_window))
         self.registry = ModelRegistry(cfg, self._stats)
         self.batcher = MicroBatcher(
@@ -62,6 +68,15 @@ class ServingSession:
 
     def stats(self) -> Dict:
         return self._stats.snapshot()
+
+    def metrics_text(self) -> str:
+        """Prometheus exposition text: the process-global registry
+        (train/collective/checkpoint/phase metrics) plus this session's
+        serving metrics.  The serving latency histogram here and the
+        `/stats` percentiles derive from the SAME buckets."""
+        from ..obs import REGISTRY
+
+        return REGISTRY.to_prometheus_text() + self._stats.to_prometheus_text()
 
     # ------------------------------------------------------------------
     def predict(self, name: str, X, raw_score: bool = False,
@@ -155,10 +170,23 @@ class _Handler(BaseHTTPRequestHandler):
         return obj
 
     # ------------------------------------------------------------------
+    def _text(self, code: int, text: str,
+              content_type: str = "text/plain; version=0.0.4") -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ------------------------------------------------------------------
     def do_GET(self) -> None:
         session = self.server.session
         if self.path == "/stats":
             self._json(200, session.stats())
+        elif self.path == "/metrics":
+            # Prometheus text-format scrape target (version 0.0.4)
+            self._text(200, session.metrics_text())
         elif self.path == "/models":
             self._json(200, {"models": session.models()})
         elif self.path == "/healthz":

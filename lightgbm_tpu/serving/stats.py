@@ -1,23 +1,26 @@
-"""Serving observability: rolling latency percentiles + counters.
+"""Serving observability: shared-histogram latency percentiles + counters.
 
 One `ServingStats` instance is shared by the whole serving stack
 (registry, batcher, session, HTTP endpoint).  Everything is O(1) per
-event under one lock: latencies land in a fixed ring buffer (percentiles
-are computed lazily at `snapshot()` time), batch fill is a running
-numerator/denominator, and the compile-cache accounting is a set of
-launch-shape keys — a shape first seen AFTER warmup is a
-`compile_cache_misses` increment, which is exactly the quantity the
-warmup contract promises stays at zero for request sizes within
-`serving_max_batch_rows`.
+event: counters and the latency/queue-wait/dispatch histograms live in a
+PRIVATE `obs.MetricsRegistry` (per-session, so concurrent sessions never
+cross-count), and the `/stats` percentiles are computed from the SAME
+fixed-bucket latency histogram the `GET /metrics` Prometheus endpoint
+exports — the two surfaces derive from one estimator
+(`obs.metrics.histogram_quantile`) and cannot disagree.  The
+compile-cache accounting is a set of launch-shape keys — a shape first
+seen AFTER warmup is a `compile_cache_misses` increment, which is
+exactly the quantity the warmup contract promises stays at zero for
+request sizes within `serving_max_batch_rows`.
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, Hashable
+from typing import Dict, Hashable, Tuple
 
-import numpy as np
+from ..obs.metrics import MetricsRegistry
 
 _COUNTERS = (
     "requests_total", "rows_total", "batches_total", "requests_shed",
@@ -25,6 +28,21 @@ _COUNTERS = (
     "compile_cache_misses", "compiles_warmup", "models_loaded",
     "models_evicted", "breaker_open", "breaker_halfopen_probes",
 )
+
+# serving latency buckets: sub-ms device hits through multi-second
+# timeout territory
+LATENCY_BUCKETS_S: Tuple[float, ...] = (
+    0.0002, 0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2,
+    0.5, 1.0, 2.0, 5.0, 10.0, 30.0)
+
+_LAT = "lgbm_serving_latency_seconds"
+_QWAIT = "lgbm_serving_queue_wait_seconds"
+_DISPATCH = "lgbm_serving_dispatch_seconds"
+
+
+def _prom_name(counter: str) -> str:
+    base = f"lgbm_serving_{counter}"
+    return base if base.endswith("_total") else base + "_total"
 
 
 class CircuitBreaker:
@@ -85,14 +103,18 @@ class CircuitBreaker:
 
 
 class ServingStats:
-    """Thread-safe serving counters + rolling latency window."""
+    """Thread-safe serving counters + bucketed latency distributions.
+
+    `window` is retained for API compatibility (it used to size a raw
+    ring buffer); percentiles now come from the fixed-bucket histogram
+    so the `/stats` numbers and the Prometheus `/metrics` export agree
+    by construction."""
 
     def __init__(self, window: int = 4096):
         self._lock = threading.Lock()
-        self._window = max(int(window), 16)
-        self._lat = np.zeros(self._window, np.float64)
-        self._lat_n = 0  # total latencies ever recorded
-        self._counters: Dict[str, int] = {k: 0 for k in _COUNTERS}
+        self.registry = MetricsRegistry()
+        for key in _COUNTERS:  # pre-register so /metrics shows zeros
+            self.registry.inc(_prom_name(key), 0)
         self._fill_rows = 0      # real rows dispatched
         self._fill_bucket = 0    # padded launch rows they rode in
         self._queue_depth = 0
@@ -100,22 +122,36 @@ class ServingStats:
 
     # -- events --------------------------------------------------------
     def count(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[key] = self._counters.get(key, 0) + n
+        self.registry.inc(_prom_name(key), n)
 
     def record_latency(self, seconds: float) -> None:
-        with self._lock:
-            self._lat[self._lat_n % self._window] = seconds
-            self._lat_n += 1
+        self.registry.observe(_LAT, seconds, buckets=LATENCY_BUCKETS_S,
+                              help="end-to-end request latency "
+                                   "(submit -> result)")
+
+    def record_queue_wait(self, seconds: float) -> None:
+        """Submit -> dispatch-start wall of one request."""
+        self.registry.observe(_QWAIT, seconds, buckets=LATENCY_BUCKETS_S,
+                              help="batcher queue wait "
+                                   "(submit -> dispatch start)")
+
+    def record_dispatch(self, seconds: float) -> None:
+        """One coalesced batch's runner wall (the device-side cost)."""
+        self.registry.observe(_DISPATCH, seconds,
+                              buckets=LATENCY_BUCKETS_S,
+                              help="coalesced-batch dispatch wall")
 
     def note_batch(self, rows: int, bucket: int, launches: int = 1) -> None:
         """One dispatched batch: `rows` real rows across `launches`
         device launches totalling `bucket` padded rows (fill ratio =
         rows / padded rows aggregated over batches)."""
+        self.count("batches_total", max(int(launches), 1))
         with self._lock:
-            self._counters["batches_total"] += max(int(launches), 1)
             self._fill_rows += int(rows)
             self._fill_bucket += max(int(bucket), 1)
+        self.registry.inc("lgbm_serving_batch_rows_total", int(rows))
+        self.registry.inc("lgbm_serving_batch_padded_rows_total",
+                          max(int(bucket), 1))
 
     def note_shape(self, key: Hashable, warmup: bool = False) -> bool:
         """Record one jit launch shape; returns True when it is new.
@@ -125,34 +161,46 @@ class ServingStats:
         zero-cold-compile acceptance test asserts on)."""
         with self._lock:
             if key in self._shapes:
-                self._counters["compile_cache_hits"] += 1
-                return False
-            self._shapes.add(key)
-            self._counters["compiles_warmup" if warmup
-                           else "compile_cache_misses"] += 1
-            return True
+                new = False
+            else:
+                self._shapes.add(key)
+                new = True
+        if not new:
+            self.count("compile_cache_hits")
+            return False
+        self.count("compiles_warmup" if warmup else "compile_cache_misses")
+        return True
 
     def set_queue_depth(self, rows: int) -> None:
         with self._lock:
             self._queue_depth = int(rows)
+        self.registry.set_gauge("lgbm_serving_queue_depth_rows", int(rows),
+                                help="rows currently queued in the "
+                                     "micro-batcher")
 
     # -- reading -------------------------------------------------------
     def snapshot(self) -> Dict:
+        out = {key: int(self.registry.value(_prom_name(key)))
+               for key in _COUNTERS}
         with self._lock:
-            out = dict(self._counters)
-            n = min(self._lat_n, self._window)
-            lat = self._lat[:n].copy()
             out["queue_depth_rows"] = self._queue_depth
             out["batch_fill_ratio"] = (
                 round(self._fill_rows / self._fill_bucket, 4)
                 if self._fill_bucket else 0.0)
-            out["latency_window"] = int(n)
-        if n:
-            p50, p95, p99 = np.percentile(lat, [50.0, 95.0, 99.0])
-            out["latency_p50_ms"] = round(float(p50) * 1e3, 3)
-            out["latency_p95_ms"] = round(float(p95) * 1e3, 3)
-            out["latency_p99_ms"] = round(float(p99) * 1e3, 3)
-        else:
-            out["latency_p50_ms"] = out["latency_p95_ms"] = \
-                out["latency_p99_ms"] = 0.0
+        n, _ = self.registry.histogram_stats(_LAT)
+        out["latency_window"] = int(n)
+        for tag, q in (("latency_p50_ms", 0.50), ("latency_p95_ms", 0.95),
+                       ("latency_p99_ms", 0.99)):
+            out[tag] = round(
+                self.registry.histogram_quantile(_LAT, q) * 1e3, 3)
+        qn, qs = self.registry.histogram_stats(_QWAIT)
+        out["queue_wait_mean_ms"] = round(qs / qn * 1e3, 3) if qn else 0.0
+        dn, dsum = self.registry.histogram_stats(_DISPATCH)
+        out["dispatch_mean_ms"] = round(dsum / dn * 1e3, 3) if dn else 0.0
         return out
+
+    def to_prometheus_text(self) -> str:
+        """This session's serving metrics as Prometheus exposition text
+        (the `GET /metrics` endpoint appends it to the process-global
+        registry's)."""
+        return self.registry.to_prometheus_text()

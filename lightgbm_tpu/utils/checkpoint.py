@@ -56,6 +56,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import time
 import zlib
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -176,9 +177,12 @@ class CheckpointManager:
         Re-saving an iteration that already has a VALID checkpoint is a
         no-op (the flush-on-exit path may race a just-written interval
         checkpoint)."""
+        from .. import obs
+
         final = os.path.join(self.directory, self._name(iteration))
         if os.path.isdir(final) and self.validate(final):
             return final
+        t0 = time.perf_counter()
         tmp = os.path.join(self.directory,
                            f"{_TMP_PREFIX}{int(iteration):08d}-{os.getpid()}")
         if os.path.isdir(tmp):
@@ -187,35 +191,43 @@ class CheckpointManager:
         try:
             import io as _io
 
-            payloads: Dict[str, bytes] = {
-                "model.txt": model_text.encode("utf-8"),
-                "state.json": json.dumps(state, sort_keys=True).encode(),
-            }
-            buf = _io.BytesIO()
-            np.savez(buf, **arrays)
-            payloads["arrays.npz"] = buf.getvalue()
-            manifest = {"format": FORMAT_VERSION, "iteration": int(iteration),
-                        "files": {}}
-            for name, data in payloads.items():
-                # the manifest records the INTENDED bytes: an injected
-                # (or real) torn write then fails CRC validation exactly
-                # like a crash mid-write would
-                manifest["files"][name] = {"crc32": zlib.crc32(data),
-                                           "bytes": len(data)}
-                _write_file(os.path.join(tmp, name), data)
-            with open(os.path.join(tmp, MANIFEST), "w") as f:
-                json.dump(manifest, f)
-                f.flush()
-                os.fsync(f.fileno())
-            _fsync_dir(tmp)
-            if os.path.isdir(final):  # stale invalid leftover
-                shutil.rmtree(final, ignore_errors=True)
-            os.replace(tmp, final)
-            _fsync_dir(self.directory)
+            with obs.span("checkpoint/save", iteration=int(iteration)):
+                payloads: Dict[str, bytes] = {
+                    "model.txt": model_text.encode("utf-8"),
+                    "state.json": json.dumps(state, sort_keys=True).encode(),
+                }
+                buf = _io.BytesIO()
+                np.savez(buf, **arrays)
+                payloads["arrays.npz"] = buf.getvalue()
+                manifest = {"format": FORMAT_VERSION,
+                            "iteration": int(iteration), "files": {}}
+                for name, data in payloads.items():
+                    # the manifest records the INTENDED bytes: an
+                    # injected (or real) torn write then fails CRC
+                    # validation exactly like a crash mid-write would
+                    manifest["files"][name] = {"crc32": zlib.crc32(data),
+                                               "bytes": len(data)}
+                    _write_file(os.path.join(tmp, name), data)
+                with open(os.path.join(tmp, MANIFEST), "w") as f:
+                    json.dump(manifest, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                _fsync_dir(tmp)
+                if os.path.isdir(final):  # stale invalid leftover
+                    shutil.rmtree(final, ignore_errors=True)
+                os.replace(tmp, final)
+                _fsync_dir(self.directory)
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
         self._retain()
+        # rare, durable, worth counting unconditionally: write wall +
+        # bundle count beside the train metrics
+        obs.REGISTRY.inc("lgbm_checkpoint_writes_total",
+                         help="atomic checkpoint bundles committed")
+        obs.REGISTRY.observe("lgbm_checkpoint_seconds",
+                             time.perf_counter() - t0, op="save")
+        obs.event("checkpoint_saved", iteration=int(iteration))
         return final
 
     def _retain(self) -> None:
@@ -358,6 +370,12 @@ class CheckpointManager:
         os.replace(tmp, os.path.join(self.root, name))
         _fsync_dir(self.root)
         self._retain_global()
+        from .. import obs
+
+        obs.REGISTRY.inc("lgbm_checkpoint_commits_total",
+                         help="group manifests committed (rank 0)")
+        obs.event("checkpoint_group_committed", iteration=int(iteration),
+                  host_count=int(self.host_count))
         return os.path.join(self.root, name)
 
     def group_manifests(self) -> List[Tuple[int, str]]:
@@ -836,6 +854,7 @@ def restore_checkpoint(booster, manager: CheckpointManager,
     A MATERIAL params mismatch names the differing keys: a warning by
     default, an error under `tpu_resume_strict`."""
     allow_elastic, strict = _resume_flags(booster)
+    t_restore = time.perf_counter()
     found = _load_for_topology(booster, manager, allow_elastic)
     if found is None:
         return None
@@ -880,6 +899,13 @@ def restore_checkpoint(booster, manager: CheckpointManager,
             restore(saved)
     Log.info(f"resumed training from checkpoint {path} "
              f"(iteration {state['iteration']})")
+    from .. import obs
+
+    obs.REGISTRY.inc("lgbm_checkpoint_restores_total",
+                     help="successful checkpoint restores")
+    obs.REGISTRY.observe("lgbm_checkpoint_seconds",
+                         time.perf_counter() - t_restore, op="restore")
+    obs.event("checkpoint_restored", iteration=int(state["iteration"]))
     return state
 
 

@@ -213,6 +213,13 @@ def fire(point: str, **info) -> Optional[str]:
             del _armed[point]
     if matched is None:
         return None
+    # a FIRED fault is rare and always worth counting; lazy import keeps
+    # the harness importable before the package (and cycle-free)
+    from ..obs.metrics import REGISTRY
+
+    REGISTRY.inc("lgbm_fault_injections_total",
+                 help="armed faultline specs that actually fired",
+                 point=point, action=matched.action)
     if matched.action == "raise":
         exc = matched.exc
         if isinstance(exc, type):

@@ -9,6 +9,12 @@ verbosity<0 -> Fatal, 0 -> Warning, 1 -> Info, >1 -> Debug).
 
 A redirect callback supports the binding use-case (reference
 Log::ResetCallBack used by the R/Python packages).
+
+Multihost attribution: once `jax.process_count() > 1` every line gets a
+``[host k]`` prefix so interleaved pod logs stay attributable, and
+every `Log.warning` counts into the telemetry registry
+(``lgbm_log_warnings_total``) so a fleet's warning rate is scrapeable
+even when nobody is tailing stdout.
 """
 
 from __future__ import annotations
@@ -24,6 +30,32 @@ LOG_DEBUG = 2
 
 _state = threading.local()
 _callback: Optional[Callable[[str], None]] = None
+_host_tag_cache: Optional[str] = None
+
+
+def _host_tag() -> str:
+    """``"[host k] "`` on a >1-process group, else "".  Resolved lazily
+    and only from an ALREADY-initialized jax backend (logging must never
+    force backend init); a positive resolution is cached — process
+    count cannot change after distributed init."""
+    global _host_tag_cache
+    if _host_tag_cache is not None:
+        return _host_tag_cache
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return ""
+    try:
+        from jax._src import xla_bridge
+
+        if not xla_bridge.backends_are_initialized():
+            return ""
+        if int(jax_mod.process_count()) > 1:
+            _host_tag_cache = f"[host {int(jax_mod.process_index())}] "
+        else:
+            _host_tag_cache = ""
+    except Exception:  # pragma: no cover - backend mid-teardown
+        return ""
+    return _host_tag_cache
 
 
 class LightGBMError(RuntimeError):
@@ -59,7 +91,7 @@ class Log:
     def _write(level: int, tag: str, msg: str) -> None:
         if level > Log.get_level():
             return
-        line = f"[LightGBM] [{tag}] {msg}\n"
+        line = f"{_host_tag()}[LightGBM] [{tag}] {msg}\n"
         if _callback is not None:
             _callback(line)
         else:
@@ -76,6 +108,12 @@ class Log:
 
     @staticmethod
     def warning(msg: str) -> None:
+        # count BEFORE the verbosity filter: a silenced fleet's warning
+        # rate stays observable through the registry
+        from ..obs.metrics import REGISTRY
+
+        REGISTRY.inc("lgbm_log_warnings_total",
+                     help="Log.warning calls (pre-verbosity-filter)")
         Log._write(LOG_WARNING, "Warning", msg)
 
     @staticmethod

@@ -1,17 +1,24 @@
-"""Phase timers — the TIMETAG subsystem analog.
+"""Phase timers — the TIMETAG subsystem analog, backed by the registry.
 
 The reference accumulates per-phase wall time behind a compile-time flag
 (reference src/treelearner/serial_tree_learner.cpp:21-48 init/hist/
 find-split/split buckets, gpu_tree_learner.cpp:352-532 transfer timing,
-linkers.h:169 network_time_).  Here timing is always compiled in and
-gated by an env var at runtime, and device phases can additionally be
-captured with jax.profiler traces:
+linkers.h:169 network_time_).  Here every `PHASE` block feeds the
+unified telemetry layer (`lightgbm_tpu.obs`):
 
-* `PHASE("binning")` context blocks accumulate wall time per named phase;
+* phase walls accumulate into the process-global registry as
+  ``lgbm_phase_seconds_total{phase=...}`` / ``lgbm_phase_runs_total``
+  whenever telemetry (`tpu_telemetry=metrics|trace`) OR the legacy
+  LIGHTGBM_TPU_TIMETAG switch is on — `summary()` reads the registry,
+  so bench and the Prometheus export see the SAME numbers;
+* under ``tpu_telemetry=trace`` each block is additionally a structured
+  span (Chrome-trace/Perfetto export + xprof mirror via obs.span);
 * `print_summary()` (atexit when LIGHTGBM_TPU_TIMETAG=1) prints the
   table, like the reference's Log::Info TIMETAG dumps;
 * `trace(dir)` wraps a block in jax.profiler.trace for xprof/tensorboard
   inspection of the on-device schedule.
+
+When everything is off a PHASE block costs one flag check.
 """
 
 from __future__ import annotations
@@ -20,18 +27,20 @@ import atexit
 import contextlib
 import os
 import time
-from collections import defaultdict
 from typing import Dict, Iterator
 
+from ..obs import REGISTRY, span
+from ..obs import metrics_on as _obs_metrics_on
 from .log import Log
 
-_acc: Dict[str, float] = defaultdict(float)
-_cnt: Dict[str, int] = defaultdict(int)
 _enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
+
+_SECONDS = "lgbm_phase_seconds_total"
+_RUNS = "lgbm_phase_runs_total"
 
 
 def enabled() -> bool:
-    return _enabled
+    return _enabled or _obs_metrics_on()
 
 
 def enable(on: bool = True) -> None:
@@ -39,42 +48,61 @@ def enable(on: bool = True) -> None:
     _enabled = on
 
 
+def _record(name: str, seconds: float) -> None:
+    REGISTRY.inc(_SECONDS, seconds,
+                 help="accumulated wall seconds per lifecycle phase",
+                 phase=name)
+    REGISTRY.inc(_RUNS, 1, phase=name)
+
+
 @contextlib.contextmanager
 def PHASE(name: str) -> Iterator[None]:
-    """Accumulate wall time under `name` (no-op unless enabled)."""
-    if not _enabled:
+    """Accumulate wall time under `name` (no-op unless enabled); a span
+    under tpu_telemetry=trace."""
+    if not (_enabled or _obs_metrics_on()):
         yield
         return
+    sp = span(name)
     t0 = time.perf_counter()
     try:
-        yield
+        with sp:
+            yield
     finally:
-        _acc[name] += time.perf_counter() - t0
-        _cnt[name] += 1
+        _record(name, time.perf_counter() - t0)
 
 
 def add(name: str, seconds: float) -> None:
-    if _enabled:
-        _acc[name] += seconds
-        _cnt[name] += 1
+    if _enabled or _obs_metrics_on():
+        _record(name, seconds)
 
 
 def summary() -> Dict[str, float]:
-    return dict(_acc)
+    return {p: REGISTRY.value(_SECONDS, phase=p)
+            for p in REGISTRY.label_values(_SECONDS, "phase")}
+
+
+def counts() -> Dict[str, int]:
+    return {p: int(REGISTRY.value(_RUNS, phase=p))
+            for p in REGISTRY.label_values(_RUNS, "phase")}
 
 
 def reset() -> None:
-    _acc.clear()
-    _cnt.clear()
+    """Zero the phase accumulation (bench reuses the process).  The
+    registry holds phases beside unrelated metric families, so only the
+    phase families reset."""
+    REGISTRY.clear_family(_SECONDS)
+    REGISTRY.clear_family(_RUNS)
 
 
 def print_summary() -> None:
-    if not _acc:
+    acc = summary()
+    if not acc:
         return
-    width = max(len(k) for k in _acc)
+    cnt = counts()
+    width = max(len(k) for k in acc)
     Log.info("phase timings:")
-    for name, secs in sorted(_acc.items(), key=lambda kv: -kv[1]):
-        Log.info(f"  {name:<{width}}  {secs:9.3f}s  x{_cnt[name]}")
+    for name, secs in sorted(acc.items(), key=lambda kv: -kv[1]):
+        Log.info(f"  {name:<{width}}  {secs:9.3f}s  x{cnt.get(name, 0)}")
 
 
 if _enabled:

@@ -411,3 +411,51 @@ class TestSparseScatter:
             assert m_sc == m_ser
         finally:
             jax.config.update("jax_enable_x64", False)
+
+
+@pytest.mark.slow
+class TestCrossShardInt16OpenItem6:
+    """ROADMAP open item 6 (found during PR 8 verification): a config
+    family VIOLATES the PR-5 cross-shard bitwise claim — binary
+    objective, 2000x8 normal data, num_leaves=15, max_bin=63,
+    min_data_in_leaf=5, bagging 0.8/1, int16,
+    tpu_quant_refit_leaves=false diverges serial vs 4-shard by round 6.
+    Suspects: a near-tie comparison on dequantized f32 instead of raw
+    int32 sums, or per-shard row-pad interaction with min_data
+    counting.  strict xfail = the gate for the eventual fix: the day
+    the models agree, this XPASSes loudly and the xfail must come off
+    (and PR 8's elastic-resume matrix inherits the widened contract)."""
+
+    @pytest.mark.xfail(
+        strict=True,
+        reason="ROADMAP open item 6: int16 serial vs 4-shard model "
+               "files diverge by round 6 under deep-tree bagging "
+               "(pre-existing at pre-PR-8 HEAD)")
+    def test_serial_vs_4shard_round6_bitwise(self):
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(2000, 8))
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+        q = dict(tpu_hist_precision="int16", tpu_quant_refit_leaves=False,
+                 bagging_fraction=0.8, bagging_freq=1)
+        m_serial, _ = _train_model_text(X, y, rounds=6, **q)
+        m_shard, bst = _train_model_text(
+            X, y, rounds=6, tree_learner="data", num_machines=4, **q)
+        assert bst._driver.learner.hist_agg == "scatter"
+        assert m_serial == m_shard
+
+    def test_same_data_without_bagging_still_holds(self):
+        """Bracketing control: the SAME data/precision WITHOUT bagging
+        holds at 3 rounds — pins the violation's trigger surface (the
+        bagged deep-tree family; probing during this PR found bagging
+        0.8/1 also breaks int8 here, and num_leaves=7 int16 breaks by
+        round 3, so the family is wider than the original ROADMAP
+        note).  If THIS ever fails, the regression has spread into the
+        committed PR-5 contract itself."""
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(2000, 8))
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+        q = dict(tpu_hist_precision="int16", tpu_quant_refit_leaves=False)
+        m_serial, _ = _train_model_text(X, y, rounds=3, **q)
+        m_shard, _ = _train_model_text(
+            X, y, rounds=3, tree_learner="data", num_machines=4, **q)
+        assert m_serial == m_shard

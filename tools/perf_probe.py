@@ -9,6 +9,7 @@ Reports ms/tree and train AUC for each configuration at the bench shape
 from batching show up next to the throughput numbers.
 """
 
+import json
 import os
 import sys
 import time
@@ -422,6 +423,100 @@ def run_retrace(n=20000, f=10, leaves=31, bins=63, iters=3):
     return dict(phases), LEDGER.n_programs()
 
 
+def run_trace(n=100_000, iters=3, leaves=255, bins=255):
+    """Unified profiling entry point (ISSUE 10; absorbs the old
+    tools/profile_step.py): train a few boosting iterations under
+    tpu_telemetry=trace, write the Chrome-trace JSON (open in Perfetto
+    or chrome://tracing) + the JSONL event stream under TRACE_DIR, and
+    print the span summary table (count / total / mean per name).
+    XPROF=1 additionally wraps the timed iterations in
+    jax.profiler.start_trace and prints the xprof op tables — the
+    device-side complement (the telemetry span names appear inside it
+    via TraceAnnotation/named_scope mirroring).
+
+        N=1000000 ITERS=3 [XPROF=1] python tools/perf_probe.py trace
+    """
+    import glob
+
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.utils.backend import host_sync
+
+    import shutil
+
+    trace_dir = os.environ.get("TRACE_DIR", "/tmp/lgbm_trace")
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    obs.configure(mode="trace", trace_dir=trace_dir)
+    X, y = make_data(n)
+
+    ds = lgb.Dataset(X, label=y, params={"max_bin": bins})
+    bst = lgb.Booster(params={
+        "objective": "binary", "num_leaves": leaves, "learning_rate": 0.1,
+        "min_data_in_leaf": 20, "max_bin": bins,
+        # match the BENCH program exactly (bench.py pins buckets off):
+        # the point is attributing ITS ms/tree, not the bucketed
+        # variant's
+        "tpu_shape_buckets": 0,
+        **json.loads(os.environ.get("EXTRA", "{}"))}, train_set=ds)
+    for _ in range(2):  # compile + warm
+        bst.update()
+    host_sync(bst._driver.train_scores.scores)
+    obs.reset_events()  # profile the WARM loop, not the compile tail
+
+    xprof = os.environ.get("XPROF", "") not in ("", "0")
+    if xprof:
+        jax.profiler.start_trace(trace_dir)
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    host_sync(bst._driver.train_scores.scores)
+    wall = time.time() - t0
+    if xprof:
+        jax.profiler.stop_trace()
+    print(f"{iters} iters in {wall:.2f}s = {iters / wall:.3f} it/s")
+
+    path = obs.write_chrome_trace()
+    obs.flush()
+    print(f"chrome trace: {path} (load in Perfetto)")
+
+    # span summary: where the host-side wall actually went
+    agg = {}
+    for ev in obs.events():
+        if ev["kind"] != "span":
+            continue
+        cnt, tot = agg.get(ev["name"], (0, 0.0))
+        agg[ev["name"]] = (cnt + 1, tot + ev["dur"])
+    print(f"\n{'span':<28s} {'count':>6s} {'total ms':>10s} {'mean ms':>9s}")
+    for name, (cnt, tot) in sorted(agg.items(), key=lambda kv: -kv[1][1]):
+        print(f"{name:<28s} {cnt:>6d} {tot / 1e3:>10.1f} "
+              f"{tot / cnt / 1e3:>9.2f}", flush=True)
+
+    if not xprof:
+        return
+    # device-side op breakdown via xprof (the old profile_step tail)
+    xplanes = glob.glob(f"{trace_dir}/**/*.xplane.pb", recursive=True)
+    print("xplane files:", xplanes)
+    if not xplanes:
+        return
+    try:
+        from xprof.convert import raw_to_tool_data as r
+    except ImportError as exc:
+        # the raw trace is still on disk for manual tensorboard use
+        print(f"xprof unavailable ({exc}); raw trace kept at {trace_dir}")
+        return
+    for tool in ("framework_op_stats", "hlo_op_profile", "op_profile"):
+        try:
+            data, _ = r.xspace_to_tool_data(xplanes, tool, {})
+            out = f"{trace_dir}/{tool}.out"
+            mode = "wb" if isinstance(data, bytes) else "w"
+            with open(out, mode) as f:
+                f.write(data)
+            print(f"wrote {out} ({len(data)} bytes)")
+        except Exception as exc:
+            print(f"{tool}: {type(exc).__name__}: {str(exc)[:120]}")
+
+
 def run_faults(n=4000, f=6, iters=5):
     """Chaos sweep (ISSUE 7): arm every fault-injection point against
     every relevant handling mode and print one outcome line each — the
@@ -656,6 +751,12 @@ def main():
                     leaves=int(os.environ.get("LEAVES", 31)),
                     bins=int(os.environ.get("BINS", 63)),
                     iters=int(os.environ.get("ITERS", 3)))
+        return
+    if arg == "trace":
+        run_trace(n=int(os.environ.get("N", 100_000)),
+                  iters=int(os.environ.get("ITERS", 3)),
+                  leaves=int(os.environ.get("LEAVES", 255)),
+                  bins=int(os.environ.get("BINS", 255)))
         return
     if arg == "comm":
         # no dataset needed.  Default: a virtual CPU mesh sized to the

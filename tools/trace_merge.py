@@ -1,0 +1,91 @@
+"""Merge a multihost run's per-host telemetry streams into ONE
+Perfetto-loadable Chrome trace.
+
+Every host of a `tpu_telemetry=trace` run streams its spans/events as
+``events-host<k>.jsonl`` under the shared ``tpu_trace_dir`` (the
+incremental JSONL survives a host dying mid-run — exactly the runs
+worth reading).  Rank 0 (or any machine that can see the shared
+directory) merges them:
+
+    python tools/trace_merge.py <tpu_trace_dir> [-o merged.json]
+
+Each host becomes one Perfetto process row (pid = host index, named
+``lightgbm_tpu host k``); span nesting/threads are preserved per host.
+Host clocks are independent monotonic origins, so rows are aligned per
+host, not globally — good enough to see which host stalled in which
+collective, which is the question multihost traces exist to answer.
+Malformed trailing lines (a host died mid-write) are skipped with a
+count, never an error.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def merge(trace_dir: str):
+    """-> (chrome_trace_dict, per_host_line_counts, skipped_lines)."""
+    paths = sorted(glob.glob(os.path.join(trace_dir, "events-host*.jsonl")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no events-host*.jsonl under {trace_dir!r} — was the run "
+            "launched with tpu_telemetry=trace and tpu_trace_dir set?")
+    events = []
+    counts = {}
+    skipped = 0
+    for path in paths:
+        m = re.search(r"events-host(\d+)\.jsonl$", path)
+        host = int(m.group(1)) if m else 0
+        events.append({"name": "process_name", "ph": "M", "pid": host,
+                       "tid": 0, "args": {"name": f"lightgbm_tpu host {host}"}})
+        n = 0
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    skipped += 1  # torn tail of a dying host
+                    continue
+                rec = {"name": ev.get("name", "?"),
+                       "ph": "X" if ev.get("kind") == "span" else "i",
+                       "ts": float(ev.get("ts_us", 0.0)),
+                       "pid": int(ev.get("host", host)),
+                       "tid": int(ev.get("tid", 0)),
+                       "args": dict(ev.get("tags") or {})}
+                if rec["ph"] == "X":
+                    rec["dur"] = float(ev.get("dur_us", 0.0))
+                else:
+                    rec["s"] = "t"
+                events.append(rec)
+                n += 1
+        counts[host] = n
+    return ({"traceEvents": events, "displayTimeUnit": "ms"},
+            counts, skipped)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace_dir", help="the run's tpu_trace_dir")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default: <trace_dir>/merged.json)")
+    args = ap.parse_args(argv)
+    out = args.out or os.path.join(args.trace_dir, "merged.json")
+    trace, counts, skipped = merge(args.trace_dir)
+    with open(out, "w") as f:
+        json.dump(trace, f)
+    hosts = ", ".join(f"host{k}: {n}" for k, n in sorted(counts.items()))
+    print(f"merged {sum(counts.values())} events ({hosts}) -> {out}")
+    if skipped:
+        print(f"skipped {skipped} malformed line(s) (torn host tails)",
+              file=sys.stderr)
+    return out
+
+
+if __name__ == "__main__":
+    main()
