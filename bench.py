@@ -291,6 +291,36 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     serve_p99_ms = sess.stats()["latency_p99_ms"]
     sess.close()
 
+    # overload-ramp goodput (ISSUE 11): paced open-loop load at ~4x the
+    # closed-loop rate above, smaller requests so admission/batching do
+    # real work — serve_goodput_rows_per_sec is the accepted-rows
+    # throughput UNDER overload (sheds absorbing the excess), and
+    # serve_shed_pct the fraction refused with 429/503/504 instead of
+    # queueing into timeout collapse
+    import importlib.util as _ilu
+
+    _sb_spec = _ilu.spec_from_file_location(
+        "_serve_bench", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "tools", "serve_bench.py"))
+    _sb = _ilu.module_from_spec(_sb_spec)
+    _sb_spec.loader.exec_module(_sb)
+    ramp_rows = min(256, serve_rows)
+    sess2 = ServingSession(params={
+        "serving_max_batch_rows": serve_rows, "verbosity": -1})
+    sess2.load("bench", booster=bst)
+    ramp_qps = 4.0 * serve_rows_per_sec / max(ramp_rows, 1)
+    r_ok, r_shed, r_err, r_dt = _sb.run_paced_counted(
+        sess2, "bench", X_eval[:ramp_rows], ramp_rows, serve_threads,
+        ramp_qps, 2.0 if degraded else 4.0,
+        deadline_ms=4.0 * float(sess2.config.serving_slo_ms))
+    if r_err:
+        raise RuntimeError(f"serve ramp surfaced {r_err} errors to "
+                           "accepted requests")
+    offered = max(r_ok + r_shed + r_err, 1)
+    serve_goodput_rows_per_sec = r_ok * ramp_rows / max(r_dt, 1e-9)
+    serve_shed_pct = 100.0 * r_shed / offered
+    sess2.close()
+
     # per-iteration valid-eval overhead the training loop pays when early
     # stopping is on: LIVE update+eval iterations (per-tree valid scoring
     # + materialize + metric fetch) minus the plain training it/s above —
@@ -450,6 +480,8 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "serve_rows_per_sec": round(serve_rows_per_sec, 0),
         "serve_rows_per_sec_min": round(serve_rows_per_sec_min, 0),
         "serve_p99_ms": round(serve_p99_ms, 1),
+        "serve_goodput_rows_per_sec": round(serve_goodput_rows_per_sec, 0),
+        "serve_shed_pct": round(serve_shed_pct, 1),
         "eval_ms_per_iter": round(eval_ms_per_iter, 1),
         "checkpoint_overhead_pct": round(checkpoint_overhead_pct, 2),
         "resume_s": round(resume_s, 2),

@@ -71,7 +71,8 @@ class Application:
                            params=dict(self.raw_params))
         print(f"[lightgbm_tpu] serving {key} on "
               f"http://{cfg.serving_host}:{int(cfg.serving_port)} "
-              "(POST /predict, POST /load, GET /stats, GET /models)")
+              "(POST /predict, POST /load, POST /drain, GET /stats, "
+              "GET /models; SIGTERM drains)")
         serve_forever(session, str(cfg.serving_host), int(cfg.serving_port))
 
     # ------------------------------------------------------------------

@@ -158,6 +158,34 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # how long an OPEN breaker waits before letting ONE half-open probe
     # try the device path again (success closes it, failure re-opens)
     "serving_breaker_cooldown_ms": ("float", 2000.0, ()),
+    # --- serving: adaptive admission / deadlines / drain (ISSUE 11) ---
+    # latency SLO target: the admission controller AIMDs its admitted-
+    # rows level so the projected request latency (recent queue-wait
+    # p99 + dispatch p95, from the PR-10 histograms) stays inside it,
+    # and the batcher's coalescing window narrows as load approaches it
+    "serving_slo_ms": ("float", 50.0, ()),
+    # adaptive admission on/off; off keeps only the hard
+    # serving_queue_rows wall (the pre-ISSUE-11 behavior)
+    "serving_admission": ("bool", True, ()),
+    # how often the controller re-reads the histograms and moves the
+    # level/window (lazy, on the admit path; no timer thread)
+    "serving_aimd_interval_ms": ("float", 100.0, ()),
+    # additive increase per interval while latency is comfortable
+    "serving_aimd_step_rows": ("int", 512, ()),
+    # multiplicative decrease when the projection exceeds the SLO
+    "serving_aimd_backoff": ("float", 0.5, ()),
+    # floor of the ADAPTIVE batch window (serving_max_wait_ms is its
+    # ceiling): under SLO pressure batches dispatch after at most this
+    "serving_min_wait_ms": ("float", 0.0, ()),
+    # Retry-After carried by 429/503 shed responses
+    "serving_retry_after_ms": ("float", 1000.0, ()),
+    # dispatch watchdog: a device runner that neither returns nor
+    # raises within this wall is abandoned, the batch fails over to the
+    # native walker, and the entry's breaker records the failure
+    # (0 = off: a wedged device hangs the dispatch worker, pre-ISSUE-11)
+    "serving_dispatch_timeout_ms": ("float", 30000.0, ()),
+    # default flush budget of the drain lifecycle (POST /drain, SIGTERM)
+    "serving_drain_timeout_ms": ("float", 10000.0, ()),
     # --- fault tolerance (utils/checkpoint.py + numeric guardrails) ---
     # atomic training checkpoints: bundle directory (empty = off).  Each
     # checkpoint holds the model string (with its bin-mapper trailer),

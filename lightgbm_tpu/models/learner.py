@@ -22,6 +22,7 @@ from ..io.bin_mapper import MissingType
 from ..io.dataset import TrainingData
 from ..ops.grower import (GrowerParams, canonical_params, mode_flags_np,
                           pad_rows, pool_dtype, resolve_split_batch)
+from ..ops.histogram import hashed_uniform, key_words
 from ..parallel.mesh import make_mesh, put_global, put_local
 from ..parallel.strategies import (bins_sharding, make_strategy_grower,
                                    pool_partition_spec,
@@ -1044,6 +1045,27 @@ class TPUTreeLearner:
             key, kf = jax.random.split(key)
             bag_key = jnp.where(jnp.asarray(refresh_bag),
                                 jax.random.split(bag_key)[0], bag_key)
+
+            def bag_uniform(k, salt):
+                # per-row uniforms keyed on the GLOBAL row index (PCG
+                # hash, like the quantization rounding) — NOT
+                # jax.random.uniform(k, (n_pad,)), whose threefry
+                # counters pair across array halves so every value
+                # changes with the total padded length.  n_pad differs
+                # between serial and sharded layouts (per-shard padding),
+                # which made bagging masks topology-dependent and broke
+                # the cross-shard bitwise contract (ROADMAP item 7).
+                # Precondition: iota == global row index, which holds
+                # because this fused step only exists single-process
+                # (_maybe_make_train_step gates on not _multiproc) and
+                # the single-process layout is compact-at-front (rows
+                # [0, n) contiguous, padding only at the tail) — the
+                # partitioned multihost layout with interior per-host
+                # padding rides the sync path's host-global numpy mask
+                sa, sb = key_words(k)
+                return hashed_uniform(
+                    jax.lax.iota(jnp.uint32, n_pad), sa, sb, salt)
+
             mask = ones_mask
             if goss_on:
                 # GOSS on device (reference goss.hpp:91-139 BaggingHelper):
@@ -1060,7 +1082,7 @@ class TPUTreeLearner:
                 thr = jnp.sort(gh)[n_pad - goss_top_k]
                 keep_top = gh >= thr
                 bag_key = jax.random.split(bag_key)[0]
-                r = jax.random.uniform(bag_key, (n_pad,))
+                r = bag_uniform(bag_key, 0x60553)
                 p_other = goss_other_k / max(n - goss_top_k, 1)
                 keep_other = (~keep_top) & (r < p_other)
                 multiply = (n - goss_top_k) / goss_other_k
@@ -1069,11 +1091,11 @@ class TPUTreeLearner:
                 h = h * scale
                 mask = mask * (keep_top | keep_other).astype(jnp.float32)
             elif is_pos is not None:
-                r = jax.random.uniform(bag_key, (n_pad,))
+                r = bag_uniform(bag_key, 0xBA66)
                 keep = jnp.where(is_pos, r < pos_frac, r < neg_frac)
                 mask = mask * keep.astype(jnp.float32)
             elif frac < 1.0:
-                r = jax.random.uniform(bag_key, (n_pad,))
+                r = bag_uniform(bag_key, 0xBA66)
                 mask = mask * (r < frac).astype(jnp.float32)
             fmask = jnp.zeros(f_pad, jnp.float32).at[:F].set(1.0)
             if feature_frac < 1.0:
@@ -1087,9 +1109,19 @@ class TPUTreeLearner:
         def _post(scores, records, leaf_ids, leaf_output, class_id):
             with jax.named_scope("score_update"):
                 any_split = records[0, 14] > 0.5  # REC_DID_SPLIT
-                delta = leaf_output[leaf_ids] * learning_rate
-                delta = jnp.where(any_split, delta, 0.0)
-                new_scores = scores.at[class_id, :].add(delta[:n])
+                # scale the [L] leaf vector FIRST, then gather: the
+                # per-row path is gather + ONE correctly-rounded add.
+                # The per-row `leaf_output[ids] * lr + scores` form left
+                # a mul+add chain that XLA/LLVM may (or may not)
+                # contract into an FMA depending on the surrounding
+                # program — serial and shard_map programs contracted
+                # differently, drifting scores one ulp apart at the
+                # SAME trees and breaking the cross-topology bitwise
+                # contract (ROADMAP item 7's second root cause)
+                scaled = jnp.where(any_split,
+                                   leaf_output * learning_rate, 0.0)
+                new_scores = scores.at[class_id, :].add(
+                    scaled[leaf_ids[:n]])
             return new_scores, leaf_ids[:n]
 
         external_pool = self._external_pool

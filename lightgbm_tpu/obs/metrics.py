@@ -38,8 +38,11 @@ DEFAULT_SECONDS_BUCKETS: Tuple[float, ...] = (
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0)
 
 # raw samples kept per histogram child (newest-first readback for bench
-# segment medians); bounded so long runs cannot grow memory
-_SAMPLE_RING = 64
+# segment medians AND the serving admission controller's recent-window
+# SLO projection, which reads the last ServingStats._RECENT = 256 —
+# keep this ring at least that deep); bounded so long runs cannot grow
+# memory
+_SAMPLE_RING = 256
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:

@@ -458,14 +458,17 @@ def _build_grower(params, num_features, data_axis, feature_axis,
     # path is statically disabled (numerical-only data)
     CB = B if params.has_cat else 1
 
-    def pf_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c):
+    def pf_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c,
+                  acc_scale=None):
         return per_feature_best_split(
             hist, sg, sh, cnt,
             meta["num_bin"], meta["missing_type"], meta["default_bin"],
             meta["monotone"], meta["penalty"], fmask,
-            min_constraint=min_c, max_constraint=max_c, **kw)
+            min_constraint=min_c, max_constraint=max_c,
+            acc_scale=acc_scale, **kw)
 
-    def combined_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c):
+    def combined_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c,
+                        acc_scale=None):
         """Per-feature bests merging numerical and categorical searches.
 
         Returns (gain_vec [F'], finalize(best_idx) -> SplitResult) so the
@@ -473,7 +476,8 @@ def _build_grower(params, num_features, data_axis, feature_axis,
         can each apply their own winner selection.
         """
         if not params.has_cat:
-            pf = pf_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c)
+            pf = pf_search(hist, sg, sh, cnt, meta, fmask, kw, min_c, max_c,
+                           acc_scale=acc_scale)
 
             def fin_plain(bi):
                 res = finalize_split(pf, bi, sg, sh,
@@ -798,8 +802,17 @@ def _build_grower(params, num_features, data_axis, feature_axis,
 
             # the leaf-cost boundary: integer histograms rescale to f32
             # stats HERE, once per leaf — everything upstream (psum or
-            # psum_scatter, pool, sibling subtraction) was exact int32
-            hist = dequant(hist)
+            # psum_scatter, pool, sibling subtraction) was exact int32.
+            # On the plain numerical path the int32 tensor travels one
+            # stage further: per_feature_best_split runs its bin cumsums
+            # in int32 (exact, reassociation-proof) and dequantizes at
+            # the scan boundary — bundle/sparse/categorical expansion
+            # needs f32 up front, so those paths rescale here as before
+            int_scan = (quantized and not params.has_bundles
+                        and not params.has_sparse and not params.has_cat)
+            acc = qscale if int_scan else None
+            if not int_scan:
+                hist = dequant(hist)
             if pool_scatter:
                 # scattered slice: this shard holds only the aggregated
                 # histogram columns [dax*SG, (dax+1)*SG) — search the
@@ -841,7 +854,7 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                                                sp_tot)
                 gain_vec, fin = combined_search(hist, sg, sh, cnt, meta_s,
                                                 fmask_s, split_kw,
-                                                min_c, max_c)
+                                                min_c, max_c, acc_scale=acc)
                 if params.has_cegb:
                     gain_vec = apply_delta(gain_vec, delta_s)
                 # per-shard best: slice entries ascend in feature id, so
@@ -859,7 +872,8 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                 hist = expand_sparse(hist)
                 gain_vec, fin = combined_search(hist, sg, sh, cnt,
                                                 meta_local, fmask_local,
-                                                split_kw, min_c, max_c)
+                                                split_kw, min_c, max_c,
+                                                acc_scale=acc)
                 if params.has_cegb:
                     gain_vec = apply_delta(gain_vec, delta_local)
                 bf = jnp.argmax(gain_vec).astype(jnp.int32)

@@ -121,12 +121,22 @@ def per_feature_best_split(
         *, l1: float, l2: float, max_delta_step: float,
         min_data_in_leaf: float, min_sum_hessian: float,
         min_gain_to_split: float,
-        min_constraint=-1e30, max_constraint=1e30) -> PerFeatureBest:
+        min_constraint=-1e30, max_constraint=1e30,
+        acc_scale=None) -> PerFeatureBest:
     """Best candidate per feature (the voting-parallel building block,
     reference voting_parallel_tree_learner.cpp:327-337 local candidates).
 
     min/max_constraint are the leaf's monotone value bounds, propagated down
-    the tree by the grower (reference serial_tree_learner.cpp:840-851)."""
+    the tree by the grower (reference serial_tree_learner.cpp:840-851).
+
+    acc_scale (quantized precisions): hist arrives in its int32
+    accumulation dtype and the bin cumsums run in int32 — exact and
+    reassociation-proof — before the [3] dequantization scales apply.
+    Running the scan on pre-dequantized f32 instead would let XLA's
+    per-program scan decomposition reassociate the adds, and a last-ulp
+    difference in a left sum amplifies through the gain cancellation
+    into a visible cross-topology model diff (ROADMAP item 7's residue
+    after the bagging-RNG fix)."""
     F, B, _ = hist.shape
     bin_iota = jnp.arange(B, dtype=jnp.int32)[None, :]          # [1, B]
     nb = num_bin[:, None]                                        # [F, 1]
@@ -139,13 +149,19 @@ def per_feature_best_split(
     na_bin = is_nan_missing & (bin_iota == nb - 1)
     acc_mask = (~skip_bin) & (~na_bin) & (bin_iota < nb)
 
-    ag = jnp.where(acc_mask, hg, 0.0)
-    ah = jnp.where(acc_mask, hh, 0.0)
-    ac = jnp.where(acc_mask, hc, 0.0)
+    zero = jnp.zeros((), hist.dtype)
+    ag = jnp.where(acc_mask, hg, zero)
+    ah = jnp.where(acc_mask, hh, zero)
+    ac = jnp.where(acc_mask, hc, zero)
 
     cg = jnp.cumsum(ag, axis=1)                                  # [F, B]
     ch = jnp.cumsum(ah, axis=1)
     cc = jnp.cumsum(ac, axis=1)
+    if acc_scale is not None:
+        # int32 prefix sums are exact; dequantize at the scan boundary
+        cg = cg.astype(jnp.float32) * acc_scale[0]
+        ch = ch.astype(jnp.float32) * acc_scale[1]
+        cc = cc.astype(jnp.float32) * acc_scale[2]
 
     gain_shift = leaf_split_gain(sum_g, sum_h + 2 * K_EPSILON,
                                  l1, l2, max_delta_step)

@@ -4,15 +4,19 @@ Turns trained models into a long-lived inference service on top of the
 device-resident forest predictor (`lightgbm_tpu/ops/predict.py`):
 
 * `registry`  — load-once `name@version` model registry with LRU
-  eviction, atomic hot-swap, and per-model warmup that pre-compiles
-  every row-bucket launch shape,
+  eviction, atomic hot-swap, per-entry health/breaker state, and
+  per-model warmup that pre-compiles every row-bucket launch shape,
 * `batcher`   — micro-batching queue coalescing concurrent requests up
-  to `serving_max_batch_rows` / `serving_max_wait_ms`, with bounded-
-  queue admission control,
+  to `serving_max_batch_rows` under the ADAPTIVE coalescing window,
+  with bounded-queue admission control, in-queue deadline expiry, a
+  dispatch watchdog, and device failover onto the native walker,
+* `admission` — AIMD admission controller against `serving_slo_ms`
+  (priority-class sheds, 429/503 + Retry-After, drain gate),
 * `server`    — the thread-safe `ServingSession` front end and an
-  optional stdlib HTTP/JSON endpoint (`python -m lightgbm_tpu serve`),
+  optional stdlib HTTP/JSON endpoint (`python -m lightgbm_tpu serve`)
+  with `POST /drain` + SIGTERM drain lifecycle,
 * `stats`     — rolling p50/p95/p99 latency, queue depth, batch fill,
-  compile-cache hit/miss and shed counters.
+  compile-cache hit/miss, shed/expiry/failover counters.
 
 Quick start::
 
@@ -24,16 +28,23 @@ Quick start::
     session.stats()                                 # p99, fill, ...
 """
 
-from .batcher import MicroBatcher, ServingQueueFull, ServingTimeout
+from .admission import (AdmissionController, ServingDraining,
+                        ServingOverloaded)
+from .batcher import (MicroBatcher, ServingExpired, ServingQueueFull,
+                      ServingTimeout)
 from .registry import ModelEntry, ModelRegistry
 from .server import ServingSession, serve_forever, serve_http
 from .stats import CircuitBreaker, ServingStats
 
 __all__ = [
+    "AdmissionController",
     "CircuitBreaker",
     "MicroBatcher",
     "ModelEntry",
     "ModelRegistry",
+    "ServingDraining",
+    "ServingExpired",
+    "ServingOverloaded",
     "ServingQueueFull",
     "ServingSession",
     "ServingStats",

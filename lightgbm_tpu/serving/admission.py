@@ -1,0 +1,211 @@
+"""Adaptive admission control: AIMD against the serving SLO.
+
+The static `serving_queue_rows` bound sheds only when the backlog is
+already catastrophic — by then every queued request is doomed to miss
+its latency budget anyway.  The admission controller closes the loop
+the PR-10 metrics registry opened: it projects the latency a NEW
+request would see (recent queue-wait p99 + dispatch p95, read from the
+same histograms `GET /metrics` exports) and runs AIMD on an
+*admitted-rows level* against the `serving_slo_ms` target:
+
+* **multiplicative decrease** — the projection exceeding the SLO cuts
+  the level by `serving_aimd_backoff` (x0.5 by default): offered load
+  beyond what the device clears inside the SLO is refused at the door
+  with 429 + `Retry-After`, instead of queueing into guaranteed
+  timeouts.  Goodput stays near the saturation plateau.
+* **additive increase** — a comfortable projection (< 70% of the SLO)
+  grows the level by `serving_aimd_step_rows` up to the hard
+  `serving_queue_rows` ceiling, re-probing for capacity after load
+  drops or a device recovers.
+
+**Priority classes** shed asymmetrically: each class admits only while
+the queue sits under its fraction of the level (low 60%, normal 85%,
+high 100%), so under pressure `low` traffic sheds first and `high`
+keeps flowing until the controller itself is saturated.
+
+**Batch-window coupling**: the same projection drives the batcher's
+coalescing window — slack latency widens the window toward
+`serving_max_wait_ms` (better fill, fewer launches), pressure narrows
+it toward `serving_min_wait_ms` (lowest queueing delay) — replacing
+the single static window.
+
+**Drain** rides the same gate: `begin_drain()` flips every subsequent
+admit into `ServingDraining` (503 + `Retry-After`) while in-flight
+work flushes.
+
+The controller is O(1) per admit (a monotonic-clock interval gate in
+front of the histogram read) and entirely host-side: no jit programs,
+no device work — the compile-stability retrace gate pins that.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from .stats import ServingStats
+
+# class -> fraction of the admitted-rows level it may fill before
+# shedding; admission is priority-ordered by construction
+PRIORITY_FACTORS: Dict[str, float] = {"high": 1.0, "normal": 0.85,
+                                      "low": 0.6}
+DEFAULT_PRIORITY = "normal"
+
+
+class ServingOverloaded(RuntimeError):
+    """Adaptive admission shed: the SLO projection refuses this class.
+
+    Maps to HTTP 429 (the caller should back off `retry_after_s` and
+    retry) — distinct from `ServingQueueFull`'s 503, which is the hard
+    `serving_queue_rows` capacity wall."""
+
+    http_status = 429
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+class ServingDraining(RuntimeError):
+    """The session is draining: admission is closed while in-flight
+    batches flush.  Maps to HTTP 503 + `Retry-After` (another replica
+    should take the traffic)."""
+
+    http_status = 503
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
+def resolve_priority(value) -> str:
+    """'high' | 'normal' | 'low' from a request field/header; unknown
+    spellings raise (a typo silently mapped to 'normal' would strip the
+    caller's intended protection)."""
+    if value is None:
+        return DEFAULT_PRIORITY
+    s = str(value).strip().lower()
+    if s == "":
+        return DEFAULT_PRIORITY
+    if s not in PRIORITY_FACTORS:
+        raise ValueError(
+            f"unknown priority {value!r}; known: "
+            f"{sorted(PRIORITY_FACTORS)}")
+    return s
+
+
+class AdmissionController:
+    """AIMD admitted-rows level + adaptive batch window + drain gate."""
+
+    def __init__(self, stats: ServingStats, slo_ms: float,
+                 queue_rows: int, max_batch_rows: int,
+                 interval_ms: float = 100.0, step_rows: int = 512,
+                 backoff: float = 0.5, min_wait_ms: float = 0.0,
+                 max_wait_ms: float = 2.0, retry_after_ms: float = 1000.0,
+                 enabled: bool = True):
+        self.stats = stats
+        self.slo_s = max(float(slo_ms), 1e-3) / 1e3
+        self.queue_rows = max(int(queue_rows), 1)
+        # the floor: one full batch always stays admissible, so a level
+        # crushed by a long outage still serves probes that re-grow it
+        self.min_level = min(max(int(max_batch_rows), 1), self.queue_rows)
+        self.interval_s = max(float(interval_ms), 1.0) / 1e3
+        self.step_rows = max(int(step_rows), 1)
+        self.backoff = min(max(float(backoff), 0.05), 0.95)
+        self.min_wait_s = max(float(min_wait_ms), 0.0) / 1e3
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
+        self.retry_after_s = max(float(retry_after_ms), 0.0) / 1e3
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._level = float(self.queue_rows)   # start fully open
+        self._window_s = self.max_wait_s
+        self._projection_s = 0.0
+        self._next_update = time.monotonic()
+        self._draining = False
+        self._publish()
+
+    # ------------------------------------------------------------------
+    def admit(self, rows: int, priority: str, queue_depth_rows: int,
+              ) -> None:
+        """Gate one request of `rows` at `priority` against the current
+        level; raises ServingDraining / ServingOverloaded to shed.  The
+        hard `serving_queue_rows` wall stays in the batcher
+        (`ServingQueueFull`) — this gate only ever sheds EARLIER."""
+        if self._draining:
+            self.stats.count("requests_drain_rejected")
+            raise ServingDraining(
+                "serving session is draining; admission closed",
+                self.retry_after_s)
+        if not self.enabled:
+            return
+        self._maybe_update()
+        factor = PRIORITY_FACTORS.get(priority, PRIORITY_FACTORS["normal"])
+        allowed = max(self._level * factor, float(self.min_level) * factor)
+        if queue_depth_rows + rows > allowed:
+            self.stats.count("requests_overload")
+            raise ServingOverloaded(
+                f"admission shed ({priority}): {queue_depth_rows} rows "
+                f"queued + {rows} exceeds the adaptive level "
+                f"{allowed:.0f} (SLO projection "
+                f"{self._projection_s * 1e3:.1f} ms vs serving_slo_ms="
+                f"{self.slo_s * 1e3:.0f})", self.retry_after_s)
+
+    # ------------------------------------------------------------------
+    def _maybe_update(self) -> None:
+        now = time.monotonic()
+        if now < self._next_update:
+            return
+        with self._lock:
+            if now < self._next_update:  # lost the race: already updated
+                return
+            self._next_update = now + self.interval_s
+            qwait, dispatch, n = self.stats.recent_wait_profile()
+            proj = qwait + dispatch
+            self._projection_s = proj
+            if n >= 8:
+                if proj > self.slo_s:
+                    self._level = max(self._level * self.backoff,
+                                      float(self.min_level))
+                elif proj < 0.7 * self.slo_s:
+                    self._level = min(self._level + self.step_rows,
+                                      float(self.queue_rows))
+            else:
+                # too few recent dispatches to judge: re-open additively
+                # (an idle service must not stay clamped forever)
+                self._level = min(self._level + self.step_rows,
+                                  float(self.queue_rows))
+            # batch window rides the same projection: slack -> wide
+            # (batch fill), pressure -> narrow (queueing delay)
+            slack = min(max(1.0 - proj / self.slo_s, 0.0), 1.0)
+            self._window_s = (self.min_wait_s
+                              + (self.max_wait_s - self.min_wait_s) * slack)
+            self._publish()
+
+    def _publish(self) -> None:
+        self.stats.set_admission(self._level, self._window_s,
+                                 self._projection_s)
+
+    # ------------------------------------------------------------------
+    def batch_window_s(self) -> float:
+        """Current adaptive coalescing window for the batcher."""
+        if not self.enabled:
+            return self.max_wait_s
+        return self._window_s
+
+    # ------------------------------------------------------------------
+    def begin_drain(self) -> None:
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def snapshot(self) -> Dict:
+        return {
+            "admission_enabled": self.enabled,
+            "admission_level_rows": round(self._level, 1),
+            "batch_window_ms": round(self._window_s * 1e3, 3),
+            "slo_projection_ms": round(self._projection_s * 1e3, 3),
+            "draining": self._draining,
+        }

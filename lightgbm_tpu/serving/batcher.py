@@ -8,14 +8,38 @@ compile) each.  The batcher:
 
 * queues requests per **batch key** — (model, predict options) — so only
   result-compatible requests ever share a launch,
-* holds an under-filled batch open up to `max_wait_ms`, dispatching
-  early once `max_batch_rows` rows have coalesced,
+* holds an under-filled batch open up to the ADAPTIVE coalescing window
+  (`window_fn`, the admission controller's SLO-coupled value between
+  `serving_min_wait_ms` and `serving_max_wait_ms`; static
+  `serving_max_wait_ms` without a controller), dispatching early once
+  `max_batch_rows` rows have coalesced,
 * runs batches on ONE worker thread (device access is serialized; jit
   caches and packed-forest tables never see concurrent mutation),
 * scatters each request's row slice back and wakes its caller,
 * sheds load at admission time: past `queue_rows` queued rows new
   requests fail immediately with `ServingQueueFull` instead of growing
-  an unbounded backlog.
+  an unbounded backlog,
+* **cancels expired requests in queue**: a request whose propagated
+  deadline (`X-Deadline-Ms`) passes while it waits is answered with
+  `ServingExpired` at pop time and never reaches the device — under
+  overload, device seconds go to requests that can still make their
+  budget (counted `requests_expired`, separate from the
+  `requests_timeout` dispatch-wait expiries),
+* **fails over a dying dispatch**: a runner that raises — or hangs past
+  `dispatch_timeout_s` — reports to `on_error` (the registry's health
+  hook feeding the per-entry CircuitBreaker) and the batch re-runs on
+  the `fallback` runner (the native host walker) instead of failing
+  every rider.  (The registry's own runner already absorbs plain
+  raises internally — `ModelEntry.predict` serves the batch via the
+  walker and feeds the breaker itself — so for that runner this layer
+  is the HANG backstop plus a second line for anything that escapes;
+  for raw runners it is the only one.)  An abandoned dispatch keeps
+  running on the serial helper thread, which refuses new device work
+  until it finishes — device calls never overlap,
+* **drains**: `drain()` closes admission (`RuntimeError` on submit;
+  the session maps it to 503 + Retry-After upstream), flushes every
+  queued batch, and `close()` joins the worker — zero requests lost,
+  none answered twice (each `_Request.done` fires exactly once).
 
 Row-bucket padding itself happens in the ops layer
 (`ops.predict.row_bucket` via `gbdt._chunked_device_scores`) — the
@@ -47,11 +71,21 @@ class ServingTimeout(TimeoutError):
     http_status = 504
 
 
+class ServingExpired(ServingTimeout):
+    """The request's propagated deadline passed while it sat in queue;
+    it was cancelled before burning device time.  Subclasses
+    ServingTimeout (same 504 surface) but counts separately
+    (`requests_expired` vs `requests_timeout`)."""
+
+    http_status = 504
+
+
 class _Request:
     __slots__ = ("X", "n", "done", "result", "error", "t_submit",
-                 "abandoned")
+                 "abandoned", "deadline", "group")
 
-    def __init__(self, X: np.ndarray):
+    def __init__(self, X: np.ndarray, deadline: Optional[float] = None,
+                 group: Optional[dict] = None):
         self.X = X
         self.n = int(X.shape[0])
         self.done = threading.Event()
@@ -59,6 +93,76 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.t_submit = time.monotonic()
         self.abandoned = False  # caller timed out; skip, don't compute
+        self.deadline = deadline  # absolute monotonic expiry (or None)
+        # shared across the slices of one LOGICAL request, so per-
+        # request counters (requests_expired) count once however many
+        # slices carry the deadline
+        self.group = group if group is not None else {}
+
+
+class _KeyState:
+    """Per-batch-key dispatch plumbing: the runner plus its failover."""
+
+    __slots__ = ("runner", "fallback", "on_error")
+
+    def __init__(self, runner, fallback=None, on_error=None):
+        self.runner = runner
+        self.fallback = fallback
+        self.on_error = on_error
+
+
+class _SerialDispatcher:
+    """ONE long-lived helper thread that runs device dispatches for the
+    watchdog path.  Serialization is the point: a dispatch the watchdog
+    abandoned (slow or wedged) keeps running here, and `try_submit`
+    refuses new device work until it finishes — so two device calls can
+    never overlap (the jit caches / packed tables single-writer
+    invariant survives abandonment), and the refused batches fail over
+    to the host walker instead.  A long-lived thread also keeps
+    thread-spawn churn off the per-batch hot path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._work = None
+        self._have = threading.Event()
+        self._busy = False
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while True:
+            self._have.wait()
+            with self._lock:
+                work, self._work = self._work, None
+                self._have.clear()
+            if work is None:
+                continue
+            runner, X, box, done = work
+            try:
+                box["out"] = runner(X)
+            except BaseException as exc:  # delivered to the waiter
+                box["exc"] = exc
+            finally:
+                done.set()
+                with self._lock:
+                    self._busy = False
+
+    def try_submit(self, runner, X):
+        """(done_event, box), or None while the previous (abandoned)
+        dispatch is still running — the caller fails over."""
+        with self._lock:
+            if self._busy:
+                return None
+            self._busy = True
+            box: dict = {}
+            done = threading.Event()
+            self._work = (runner, X, box, done)
+            self._have.set()
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="lgbm-serving-dispatch",
+                    daemon=True)
+                self._thread.start()
+        return done, box
 
 
 class MicroBatcher:
@@ -66,16 +170,24 @@ class MicroBatcher:
 
     def __init__(self, max_batch_rows: int = 4096, max_wait_ms: float = 2.0,
                  queue_rows: int = 65536,
-                 stats: Optional[ServingStats] = None):
+                 stats: Optional[ServingStats] = None,
+                 window_fn: Optional[Callable[[], float]] = None,
+                 dispatch_timeout_ms: float = 0.0):
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self.queue_rows = max(int(queue_rows), 1)
         self.stats = stats if stats is not None else ServingStats()
+        # adaptive coalescing window: consulted per batch; None = static
+        self.window_fn = window_fn
+        self.dispatch_timeout_s = max(float(dispatch_timeout_ms), 0.0) / 1e3
         self._cv = threading.Condition()
+        self._dispatcher = _SerialDispatcher()
         self._queues: "OrderedDict[Hashable, deque]" = OrderedDict()
-        self._runners: dict = {}
+        self._runners: "dict[Hashable, _KeyState]" = {}
         self._pending_rows = 0
         self._stop = False
+        self._draining = False
+        self._drained = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------
@@ -83,44 +195,77 @@ class MicroBatcher:
         with self._cv:
             if self._thread is None or not self._thread.is_alive():
                 self._stop = False
+                self._draining = False
+                self._drained.clear()
                 self._thread = threading.Thread(
                     target=self._loop, name="lgbm-serving-batcher",
                     daemon=True)
                 self._thread.start()
         return self
 
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Close admission and flush: new submits raise, the worker
+        dispatches every queued batch, then parks.  Returns True when
+        the flush completed inside `timeout_s` (False = still flushing;
+        nothing is lost either way, the worker keeps going).  Safe to
+        call twice; `close()` implies it."""
+        with self._cv:
+            self._draining = True
+            if not self._queues and (self._thread is None
+                                     or not self._thread.is_alive()):
+                self._drained.set()
+            self._cv.notify_all()
+        if self._thread is None or not self._thread.is_alive():
+            # no worker: queued requests can never flush; report state
+            with self._cv:
+                return not self._queues
+        return self._drained.wait(timeout_s)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def close(self) -> None:
         with self._cv:
             self._stop = True
+            self._draining = True
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
 
     # ------------------------------------------------------------------
     def submit(self, key: Hashable, runner: Callable[[np.ndarray], np.ndarray],
-               X: np.ndarray) -> _Request:
+               X: np.ndarray, **kw) -> _Request:
         """Enqueue one request; returns a handle for `wait`.
 
         `runner(X_batch)` must be row-independent: request i's rows in a
         coalesced batch produce the same values they would alone (the
         bin-space traversal is, per construction)."""
-        return self.submit_many(key, runner, [X])[0]
+        return self.submit_many(key, runner, [X], **kw)[0]
 
     def submit_many(self, key: Hashable,
                     runner: Callable[[np.ndarray], np.ndarray],
-                    slices) -> list:
+                    slices, deadline: Optional[float] = None,
+                    fallback: Optional[Callable] = None,
+                    on_error: Optional[Callable] = None) -> list:
         """Enqueue the slices of ONE logical request atomically:
         admission is all-or-nothing (a mid-request shed would leave
         already-queued slices burning device time for a caller that
-        already got ServingQueueFull), and the counters see one request."""
-        reqs = [_Request(X) for X in slices]
+        already got ServingQueueFull), and the counters see one request.
+
+        deadline: absolute monotonic expiry propagated from the caller
+        (X-Deadline-Ms); slices still queued past it are cancelled at
+        pop time instead of dispatched.  fallback/on_error: the
+        device-failover hooks (see module docstring)."""
+        group: dict = {}
+        reqs = [_Request(X, deadline, group) for X in slices]
         if not reqs:
             # an empty deque would crash the dispatch worker's oldest-
             # head selection and brick the whole session
             raise ValueError("submit_many needs at least one slice")
         total = sum(r.n for r in reqs)
         with self._cv:
-            if self._stop:
+            if self._stop or self._draining:
                 raise RuntimeError("batcher is closed")
             if self._pending_rows + total > self.queue_rows:
                 self.stats.count("requests_shed")
@@ -133,7 +278,7 @@ class MicroBatcher:
             if key not in self._queues:
                 self._queues[key] = deque()
             self._queues[key].extend(reqs)
-            self._runners[key] = runner
+            self._runners[key] = _KeyState(runner, fallback, on_error)
             self._pending_rows += total
             self.stats.set_queue_depth(self._pending_rows)
             self._cv.notify_all()
@@ -158,22 +303,37 @@ class MicroBatcher:
         return req.result
 
     # ------------------------------------------------------------------
+    def _window_s(self) -> float:
+        if self._draining:
+            return 0.0  # flush immediately: nothing new is coming
+        if self.window_fn is not None:
+            try:
+                return max(float(self.window_fn()), 0.0)
+            except Exception:  # pragma: no cover - defensive
+                return self.max_wait_s
+        return self.max_wait_s
+
     def _loop(self) -> None:
         while True:
             with self._cv:
                 while not self._stop and not self._queues:
+                    if self._draining:
+                        # flushed: report drain completion, then park
+                        # (close() wakes us to exit)
+                        self._drained.set()
                     self._cv.wait()
                 if self._stop and not self._queues:
+                    self._drained.set()
                     return
                 # serve the key whose head request has waited longest
                 key = min(self._queues,
                           key=lambda k: self._queues[k][0].t_submit)
                 dq = self._queues[key]
                 rows = sum(r.n for r in dq)
-                deadline = dq[0].t_submit + self.max_wait_s
+                deadline = dq[0].t_submit + self._window_s()
                 now = time.monotonic()
                 if rows < self.max_batch_rows and now < deadline \
-                        and not self._stop:
+                        and not self._stop and not self._draining:
                     # hold the batch open for more coalescing
                     self._cv.wait(deadline - now)
                     continue
@@ -188,13 +348,29 @@ class MicroBatcher:
                         dropped += r.n
                         r.done.set()
                         continue
+                    if r.deadline is not None and t_pop > r.deadline:
+                        # expired IN QUEUE: cancel before device time —
+                        # counted apart from dispatch-wait timeouts,
+                        # and ONCE per logical request however many
+                        # slices it was split into (requests_total is
+                        # per-request too; the ratio must stay sane)
+                        dropped += r.n
+                        if not r.group.get("expired"):
+                            r.group["expired"] = True
+                            self.stats.count("requests_expired")
+                        r.error = ServingExpired(
+                            f"request of {r.n} rows expired in queue "
+                            f"({(t_pop - r.t_submit) * 1e3:.0f} ms past "
+                            "submit, deadline exceeded)")
+                        r.done.set()
+                        continue
                     # queue wait = submit -> dispatch start: the number
                     # that separates "the device is slow" from "the
                     # queue is deep" when p99 climbs
                     self.stats.record_queue_wait(t_pop - r.t_submit)
                     batch.append(r)
                     take += r.n
-                runner = self._runners[key]
+                ks = self._runners[key]
                 if not dq:
                     # drop the drained queue AND its runner: a stale
                     # runner closure would pin its ModelEntry (packed
@@ -204,17 +380,72 @@ class MicroBatcher:
                 self._pending_rows -= take + dropped
                 self.stats.set_queue_depth(self._pending_rows)
             if batch:
-                self._run(runner, batch)
+                self._run(ks, batch)
 
-    def _run(self, runner, batch) -> None:
+    # ------------------------------------------------------------------
+    def _dispatch(self, runner, X):
+        """One runner call, bounded by dispatch_timeout_s when armed.
+
+        A hang is indistinguishable from slow device work from inside
+        this thread, so the bounded form runs the runner on the serial
+        helper thread and abandons the WAIT on expiry (the helper keeps
+        running; try_submit refuses new device work until it finishes,
+        so an abandoned dispatch never overlaps a fresh one — refused
+        batches fail over to the walker and the breaker keeps later
+        requests off the device path).  Returns (ok, value_or_exc)."""
+        if self.dispatch_timeout_s <= 0:
+            try:
+                return True, runner(X)
+            except BaseException as exc:
+                return False, exc
+        sub = self._dispatcher.try_submit(runner, X)
+        if sub is None:
+            # a previously-abandoned dispatch still owns the device:
+            # NOT a new timeout (dispatch_timeouts counts real expiries)
+            return False, ServingTimeout(
+                f"dispatch of {X.shape[0]} rows refused: a prior "
+                "dispatch is still running past its watchdog deadline")
+        done, box = sub
+        if not done.wait(self.dispatch_timeout_s):
+            self.stats.count("dispatch_timeouts")
+            return False, ServingTimeout(
+                f"dispatch of {X.shape[0]} rows hung past "
+                f"{self.dispatch_timeout_s * 1e3:.0f} ms "
+                "(serving_dispatch_timeout_ms)")
+        if "exc" in box:
+            return False, box["exc"]
+        return True, box["out"]
+
+    def _run(self, ks: _KeyState, batch) -> None:
         from .. import obs
 
         X = batch[0].X if len(batch) == 1 else \
             np.concatenate([r.X for r in batch], axis=0)
         t0 = time.monotonic()
+        out = None
         try:
             with obs.span("serve/dispatch", rows=int(X.shape[0])):
-                out = runner(X)
+                ok, val = self._dispatch(ks.runner, X)
+            if not ok:
+                # device-path failure (raise OR hang): report to the
+                # registry health hook, then fail the BATCH over to the
+                # fallback runner (native walker) so riders still get
+                # answers.  on_error may veto (False = caller error,
+                # e.g. malformed rows raise identically on both paths
+                # and must not mask as a device fallback)
+                failover = ks.fallback is not None
+                if ks.on_error is not None:
+                    try:
+                        failover = bool(ks.on_error(val)) and failover
+                    except Exception:  # pragma: no cover - defensive
+                        pass
+                if not failover:
+                    raise val
+                self.stats.count("dispatch_failovers")
+                with obs.span("serve/failover", rows=int(X.shape[0])):
+                    out = ks.fallback(X)
+            else:
+                out = val
         except BaseException as exc:  # delivered to every waiter
             for r in batch:
                 r.error = exc

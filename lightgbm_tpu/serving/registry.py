@@ -148,9 +148,22 @@ class ModelEntry:
             launches = -(-n // self.chunk) if n > self.chunk else 1
             self.stats.note_batch(n, launches * bucket, launches=launches)
         self.stats.note_shape((self.key, ni, bucket), warmup=warmup)
+        # generation snapshot: if the dispatch watchdog abandons this
+        # call and records a failure while it runs, the success below
+        # becomes stale and must not reset/close the breaker
+        gen = self.breaker.generation
         try:
             if not warmup:
-                faultline.fire("serve_dispatch", model=self.key)
+                action = faultline.fire("serve_dispatch", model=self.key)
+                if action == "hang":
+                    # simulate a wedged device stream: never return.
+                    # The batcher's dispatch watchdog
+                    # (serving_dispatch_timeout_ms) abandons this
+                    # thread, fails the batch over to the native
+                    # walker, and feeds the breaker
+                    import time as _time
+
+                    _time.sleep(3600.0)
             out = self.booster.predict(X, raw_score=raw_score,
                                        num_iteration=ni, device="tpu",
                                        tpu_predict_device="true")
@@ -164,7 +177,7 @@ class ModelEntry:
                 self.breaker.record_failure()
             return out
         if not warmup:
-            self.breaker.record_success()
+            self.breaker.record_success(gen)
         return out
 
     def _native_predict(self, X: np.ndarray, raw_score: bool,
@@ -172,12 +185,48 @@ class ModelEntry:
         return self.booster.predict(X, raw_score=raw_score,
                                     num_iteration=ni, device="cpu")
 
+    # -- failover hooks (the batcher's on_error / fallback pair) -------
+    @property
+    def healthy(self) -> bool:
+        """False while the device-path breaker is OPEN (requests are
+        short-circuiting to the native walker)."""
+        return self.breaker.state != "open"
+
+    def native_runner(self, raw_score: bool, ni: int):
+        """The failover target: a pure host-walker runner for this
+        entry — the 'healthy replica' of last resort.  The batcher
+        re-runs a batch on it when the device dispatch raises or hangs,
+        so riders get answers instead of the failure.  (Mesh replicas
+        slot into this hook: a multi-device registry returns another
+        device's runner here before degrading to the walker.)"""
+        def run(Xb: np.ndarray) -> np.ndarray:
+            return self._native_predict(Xb, raw_score, ni)
+        return run
+
+    def record_dispatch_error(self, exc: BaseException) -> bool:
+        """Classify a dispatch failure for the batcher: True = device-
+        path failure (feed the breaker, fail the batch over to the
+        native runner); False = caller error (malformed rows raise
+        identically on both paths — failing over would mask a 400 as a
+        fallback and poison the breaker signal)."""
+        from ..utils.log import LightGBMError
+
+        if isinstance(exc, (LightGBMError, ValueError, KeyError,
+                            TypeError)):
+            return False
+        # device/XLA error or a hang promoted to ServingTimeout by the
+        # dispatch watchdog: the breaker keeps later requests off the
+        # device path until a half-open probe finds it healthy
+        self.breaker.record_failure()
+        return True
+
     def describe(self) -> Dict:
         return {"key": self.key, "name": self.name, "version": self.version,
                 "num_feature": self.num_feature,
                 "num_trees": self.booster.num_trees(),
                 "device": bool(self.device_on),
-                "breaker": self.breaker.state}
+                "breaker": self.breaker.state,
+                "healthy": self.healthy}
 
 
 class ModelRegistry:

@@ -1,22 +1,47 @@
 """Serving front end: thread-safe `ServingSession` + HTTP/JSON endpoint.
 
 `ServingSession` is the process-local API: it owns one registry, one
-micro-batcher and one stats sink, and `session.predict(name, X)` is safe
-to call from any number of threads — requests coalesce in the batcher
-and run serialized on its worker.  The HTTP layer is a thin stdlib
-(`http.server`) translation of the same calls for non-Python clients;
-`python -m lightgbm_tpu serve` binds it.  `GET /metrics` exposes the
-process-global telemetry registry plus this session's serving metrics
-as Prometheus text — its latency histogram and the `/stats`
-percentiles derive from the same buckets.
+micro-batcher, one admission controller and one stats sink, and
+`session.predict(name, X)` is safe to call from any number of threads —
+requests coalesce in the batcher and run serialized on its worker.  The
+HTTP layer is a thin stdlib (`http.server`) translation of the same
+calls for non-Python clients; `python -m lightgbm_tpu serve` binds it.
+`GET /metrics` exposes the process-global telemetry registry plus this
+session's serving metrics as Prometheus text — its latency histogram
+and the `/stats` percentiles derive from the same buckets.
 
-Error contract (mirrored into HTTP statuses):
-* unknown model                -> KeyError            -> 404
-* malformed request            -> ValueError          -> 400
-* queue at capacity (shed)     -> ServingQueueFull    -> 503
-* per-request timeout          -> ServingTimeout      -> 504
-* device failure               -> served via the native-walker fallback
-                                  (counted in stats, never an error)
+Request metadata propagates from HTTP into the batcher:
+
+* `X-Deadline-Ms` header (or `deadline_ms` body field) — the caller's
+  end-to-end budget; requests still queued past it are cancelled before
+  burning device time (`ServingExpired`, counted `requests_expired`).
+* `X-Priority` header (or `priority` body field) — `high` | `normal` |
+  `low`; under pressure the admission controller sheds low first.
+
+Error contract (mirrored into HTTP statuses; every shed/timeout body is
+structured JSON `{"error", "code", "retry_after_ms"?}` and 429/503
+responses carry a `Retry-After` header):
+
+| condition                                | exception          | HTTP |
+|------------------------------------------|--------------------|------|
+| unknown model                            | KeyError           | 404  |
+| malformed request                        | ValueError         | 400  |
+| data error (feature count, dtype...)     | LightGBMError      | 400  |
+| adaptive admission shed (priority class) | ServingOverloaded  | 429  |
+| hard queue capacity (serving_queue_rows) | ServingQueueFull   | 503  |
+| session draining                         | ServingDraining    | 503  |
+| caller wait budget exhausted             | ServingTimeout     | 504  |
+| expired in queue (X-Deadline-Ms)         | ServingExpired     | 504  |
+| device failure                           | served via failover/breaker (counted, never an error) | — |
+
+Drain lifecycle: `POST /drain` (or SIGTERM under `python -m
+lightgbm_tpu serve`) stops admission — new requests get 503 +
+`Retry-After` — flushes every in-flight batch, and reports
+`{"drained": true}` when the queue is empty.  Zero requests are lost
+or double-answered: every admitted request resolves exactly once, by
+result or by structured error.  Hot-swap (`POST /load` on a live name)
+needs no drain: in-flight requests finish against their resolved entry
+while new ones see the new version.
 """
 
 from __future__ import annotations
@@ -30,7 +55,10 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..config import Config
-from .batcher import MicroBatcher, ServingQueueFull, ServingTimeout
+from .admission import (AdmissionController, ServingDraining,
+                        ServingOverloaded, resolve_priority)
+from .batcher import (MicroBatcher, ServingExpired, ServingQueueFull,
+                      ServingTimeout)
 from .registry import ModelRegistry
 from .stats import ServingStats
 
@@ -46,11 +74,26 @@ class ServingSession:
         obs.configure_from_config(cfg)  # tpu_telemetry / tpu_trace_dir
         self._stats = ServingStats(window=int(cfg.serving_stats_window))
         self.registry = ModelRegistry(cfg, self._stats)
+        self.admission = AdmissionController(
+            self._stats, slo_ms=float(cfg.serving_slo_ms),
+            queue_rows=int(cfg.serving_queue_rows),
+            max_batch_rows=int(cfg.serving_max_batch_rows),
+            interval_ms=float(cfg.serving_aimd_interval_ms),
+            step_rows=int(cfg.serving_aimd_step_rows),
+            backoff=float(cfg.serving_aimd_backoff),
+            min_wait_ms=float(cfg.serving_min_wait_ms),
+            max_wait_ms=float(cfg.serving_max_wait_ms),
+            retry_after_ms=float(cfg.serving_retry_after_ms),
+            enabled=bool(cfg.serving_admission))
         self.batcher = MicroBatcher(
             max_batch_rows=int(cfg.serving_max_batch_rows),
             max_wait_ms=float(cfg.serving_max_wait_ms),
             queue_rows=int(cfg.serving_queue_rows),
-            stats=self._stats)
+            stats=self._stats,
+            window_fn=self.admission.batch_window_s,
+            dispatch_timeout_ms=float(cfg.serving_dispatch_timeout_ms))
+        self._drain_lock = threading.Lock()
+        self._drained = False
         if start:
             self.batcher.start()
 
@@ -67,7 +110,9 @@ class ServingSession:
         return self.registry.models()
 
     def stats(self) -> Dict:
-        return self._stats.snapshot()
+        out = self._stats.snapshot()
+        out.update(self.admission.snapshot())
+        return out
 
     def metrics_text(self) -> str:
         """Prometheus exposition text: the process-global registry
@@ -81,11 +126,18 @@ class ServingSession:
     # ------------------------------------------------------------------
     def predict(self, name: str, X, raw_score: bool = False,
                 num_iteration: Optional[int] = None,
-                timeout_ms: Optional[float] = None) -> np.ndarray:
+                timeout_ms: Optional[float] = None,
+                deadline_ms: Optional[float] = None,
+                priority=None) -> np.ndarray:
         """Micro-batched predict: blocks until this request's rows come
-        back (or sheds/times out).  Results are exactly what
+        back (or sheds/expires/times out).  Results are exactly what
         `entry.booster.predict` returns for the same rows — coalescing
-        never changes a row's value (the traversal is row-independent)."""
+        never changes a row's value (the traversal is row-independent).
+
+        deadline_ms: the caller's END-TO-END budget (X-Deadline-Ms);
+        it caps the wait AND cancels still-queued slices at expiry.
+        priority: 'high' | 'normal' | 'low' admission class."""
+        prio = resolve_priority(priority)
         entry = self.registry.resolve(name)
         from ..basic import _to_2d_array
 
@@ -98,6 +150,11 @@ class ServingSession:
                 f"request of {Xm.shape[0]} rows exceeds serving_queue_rows="
                 f"{self.batcher.queue_rows}; raise the limit or split the "
                 "request")
+        # adaptive admission gate (429/503 shed) BEFORE any queue state
+        # mutates: an overloaded shed costs one histogram read, zero
+        # device work and zero queue churn
+        self.admission.admit(int(Xm.shape[0]), prio,
+                             self.batcher.stats.snapshot_queue_depth())
         # None matches Booster.predict's default (best_iteration when
         # set) — the same value warmup pre-compiled
         ni = (entry.default_num_iteration() if num_iteration is None
@@ -109,14 +166,31 @@ class ServingSession:
                                           num_iteration=ni)
         timeout_s = (float(self.config.serving_timeout_ms)
                      if timeout_ms is None else float(timeout_ms)) / 1e3
+        if deadline_ms is not None:
+            # the deadline caps the whole wait: a 10 s default timeout
+            # must not outlive a 50 ms caller budget
+            timeout_s = min(timeout_s, max(float(deadline_ms), 0.0) / 1e3)
+        abs_deadline = (time.monotonic() + max(float(deadline_ms), 0.0) / 1e3
+                        if deadline_ms is not None else None)
         # oversize requests split into max_batch_rows slices so every
         # launch stays inside the warmed row buckets (an unsplit 10k-row
         # batch would hit a cold 16k-bucket compile); admission is
         # all-or-nothing and ONE timeout budget covers all slices
         max_rows = self.batcher.max_batch_rows
-        reqs = self.batcher.submit_many(
-            key, runner, [Xm[lo:lo + max_rows]
-                          for lo in range(0, max(Xm.shape[0], 1), max_rows)])
+        try:
+            reqs = self.batcher.submit_many(
+                key, runner,
+                [Xm[lo:lo + max_rows]
+                 for lo in range(0, max(Xm.shape[0], 1), max_rows)],
+                deadline=abs_deadline,
+                fallback=entry.native_runner(bool(raw_score), ni),
+                on_error=entry.record_dispatch_error)
+        except RuntimeError as exc:
+            if self.batcher.draining:
+                raise ServingDraining(
+                    "serving session is draining; admission closed",
+                    self.admission.retry_after_s) from exc
+            raise
         deadline = time.monotonic() + timeout_s
         try:
             outs = [self.batcher.wait(r,
@@ -130,7 +204,31 @@ class ServingSession:
             raise
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 
+    # ------------------------------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None) -> Dict:
+        """Drain lifecycle: stop admission, flush in-flight batches,
+        hand off cleanly.  Idempotent; returns the outcome dict the
+        `POST /drain` route serializes.  Zero admitted requests are
+        lost or double-answered: each resolves exactly once before the
+        flush reports complete."""
+        from .. import obs
+
+        if timeout_s is None:
+            timeout_s = float(self.config.serving_drain_timeout_ms) / 1e3
+        with self._drain_lock:
+            first = not self._drained
+            self.admission.begin_drain()   # new requests -> 503
+            with obs.span("serve/drain"):
+                flushed = self.batcher.drain(timeout_s)
+            if first and flushed:
+                self._stats.count("drains")
+                self._drained = True
+        return {"drained": bool(flushed),
+                "queued_rows": self._stats.snapshot()["queue_depth_rows"]}
+
     def close(self) -> None:
+        """Shutdown rides the drain path: flush, then stop the worker."""
+        self.admission.begin_drain()
         self.batcher.close()
 
 
@@ -150,13 +248,27 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *args):  # no stderr chatter per request
         pass
 
-    def _json(self, code: int, obj) -> None:
+    def _json(self, code: int, obj, retry_after_s: float = None) -> None:
         body = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if retry_after_s is not None:
+            # whole seconds per RFC 9110 (minimum 1: a 0 invites an
+            # immediate hammer-retry)
+            self.send_header("Retry-After",
+                             str(max(int(round(retry_after_s)), 1)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _error(self, code: int, exc: BaseException, error_code: str,
+               retry_after_s: float = None) -> None:
+        """Structured JSON error body; sheds carry machine-readable
+        `code` + `retry_after_ms` so clients can back off correctly."""
+        obj = {"error": str(exc), "code": error_code}
+        if retry_after_s is not None:
+            obj["retry_after_ms"] = int(retry_after_s * 1e3)
+        self._json(code, obj, retry_after_s=retry_after_s)
 
     def _body(self) -> Dict:
         length = int(self.headers.get("Content-Length", 0) or 0)
@@ -190,7 +302,12 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/models":
             self._json(200, {"models": session.models()})
         elif self.path == "/healthz":
-            self._json(200, {"ok": True})
+            if session.admission.draining:
+                # draining replicas must fall out of load-balancer
+                # rotation before the flush finishes
+                self._json(503, {"ok": False, "draining": True})
+            else:
+                self._json(200, {"ok": True})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -204,10 +321,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if not name or rows is None:
                     raise ValueError("need 'model' and 'rows'")
                 X = np.asarray(rows, np.float64)
+                deadline_ms = self.headers.get("X-Deadline-Ms",
+                                               body.get("deadline_ms"))
+                priority = self.headers.get("X-Priority",
+                                            body.get("priority"))
                 out = session.predict(
                     str(name), X, raw_score=bool(body.get("raw_score")),
                     num_iteration=body.get("num_iteration"),
-                    timeout_ms=body.get("timeout_ms"))
+                    timeout_ms=body.get("timeout_ms"),
+                    deadline_ms=(float(deadline_ms)
+                                 if deadline_ms is not None else None),
+                    priority=priority)
                 self._json(200, {"model": str(name),
                                  "predictions": np.asarray(out).tolist()})
             elif self.path == "/load":
@@ -220,12 +344,34 @@ class _Handler(BaseHTTPRequestHandler):
                     params=body.get("params"),
                     version=body.get("version"))
                 self._json(200, {"loaded": key})
+            elif self.path == "/drain":
+                timeout_s = body.get("timeout_s")
+                if timeout_s is not None:
+                    # validate BEFORE any side effect: begin_drain() is
+                    # irreversible, so a malformed body must 400 here,
+                    # not TypeError mid-drain with admission closed
+                    try:
+                        timeout_s = float(timeout_s)
+                    except (TypeError, ValueError):
+                        raise ValueError(
+                            f"timeout_s must be a number, got "
+                            f"{timeout_s!r}") from None
+                self._json(200, session.drain(timeout_s=timeout_s))
             else:
                 self._json(404, {"error": f"no route {self.path}"})
+        except ServingOverloaded as exc:
+            self._error(429, exc, "overload",
+                        retry_after_s=exc.retry_after_s)
+        except ServingDraining as exc:
+            self._error(503, exc, "draining",
+                        retry_after_s=exc.retry_after_s)
         except ServingQueueFull as exc:
-            self._json(503, {"error": str(exc)})
+            self._error(503, exc, "capacity",
+                        retry_after_s=session.admission.retry_after_s)
+        except ServingExpired as exc:
+            self._error(504, exc, "deadline")
         except ServingTimeout as exc:
-            self._json(504, {"error": str(exc)})
+            self._error(504, exc, "timeout")
         except KeyError as exc:
             self._json(404, {"error": str(exc.args[0]) if exc.args
                              else str(exc)})
@@ -257,13 +403,33 @@ def serve_http(session: ServingSession, host: str = "127.0.0.1",
 
 def serve_forever(session: ServingSession, host: str = "127.0.0.1",
                   port: int = 18080) -> None:
-    """Blocking variant for the CLI `serve` task."""
+    """Blocking variant for the CLI `serve` task.  SIGTERM rides the
+    drain lifecycle: admission stops, in-flight batches flush, the
+    socket closes — zero accepted requests lost."""
+    import signal
+
     server = _ServingHTTPServer((host, int(port)), _Handler)
     server.session = session
+
+    def _term(signum, frame):  # pragma: no cover - signal timing
+        # drain THEN stop accepting: requests admitted before the
+        # signal flush to completion; shutdown() must come from another
+        # thread (serve_forever blocks this one)
+        threading.Thread(target=lambda: (session.drain(),
+                                         server.shutdown()),
+                         daemon=True).start()
+
+    try:
+        prior = signal.signal(signal.SIGTERM, _term)
+    except ValueError:  # pragma: no cover - non-main thread
+        prior = None
     try:
         server.serve_forever()
     except KeyboardInterrupt:  # clean ^C exit for the CLI
         pass
     finally:
+        if prior is not None:
+            signal.signal(signal.SIGTERM, prior)
         server.server_close()
+        session.drain()
         session.close()

@@ -27,6 +27,19 @@ _COUNTERS = (
     "requests_timeout", "device_fallbacks", "compile_cache_hits",
     "compile_cache_misses", "compiles_warmup", "models_loaded",
     "models_evicted", "breaker_open", "breaker_halfopen_probes",
+    # adaptive admission / deadline / drain / failover (ISSUE 11):
+    # requests_overload       = AIMD priority-class sheds (HTTP 429)
+    # requests_expired        = cancelled in queue past their deadline
+    #                           (separate from requests_timeout, the
+    #                           dispatch-WAIT expiries)
+    # requests_drain_rejected = refused because the session is draining
+    # dispatch_timeouts       = runner hangs past
+    #                           serving_dispatch_timeout_ms
+    # dispatch_failovers      = batches re-run on the fallback runner
+    #                           after a device-path raise/hang
+    # drains                  = drain lifecycles completed
+    "requests_overload", "requests_expired", "requests_drain_rejected",
+    "dispatch_timeouts", "dispatch_failovers", "drains",
 )
 
 # serving latency buckets: sub-ms device hits through multi-second
@@ -67,6 +80,18 @@ class CircuitBreaker:
         self.state = "closed"
         self._failures = 0
         self._entered_at = 0.0  # when the current open/half_open began
+        # failure generation: bumps on every record_failure so a
+        # STRAGGLER success — a dispatch the watchdog already abandoned
+        # (and recorded as failed) completing minutes later — cannot
+        # wipe the failures recorded since it began (see generation /
+        # record_success(gen))
+        self._gen = 0
+
+    @property
+    def generation(self) -> int:
+        """Snapshot before a device attempt; pass back to
+        record_success so stale completions can be ignored."""
+        return self._gen
 
     def allow(self) -> bool:
         """May this request try the device path?"""
@@ -86,13 +111,24 @@ class CircuitBreaker:
                 return True
             return False
 
-    def record_success(self) -> None:
+    def record_success(self, gen: int = None) -> None:
         with self._lock:
+            if gen is not None and gen != self._gen:
+                # the attempt predates failures recorded while it ran
+                # (watchdog-abandoned straggler): its success is stale
+                # evidence and must not close/reset the breaker
+                return
+            if gen is None and self.state == "open":
+                # an OPEN breaker closes only through an allowed
+                # half-open probe (which carries a fresh generation),
+                # never through an unattributed late success
+                return
             self.state = "closed"
             self._failures = 0
 
     def record_failure(self) -> None:
         with self._lock:
+            self._gen += 1
             self._failures += 1
             if self.state == "half_open" or self._failures >= self.threshold:
                 if self.state != "open" and self.stats is not None:
@@ -177,6 +213,55 @@ class ServingStats:
         self.registry.set_gauge("lgbm_serving_queue_depth_rows", int(rows),
                                 help="rows currently queued in the "
                                      "micro-batcher")
+
+    def set_admission(self, level_rows: float, window_s: float,
+                      projection_s: float) -> None:
+        """Admission-controller state published as gauges (scraped via
+        /metrics beside the histograms that drive it).  The controller
+        itself stays the single source of truth — `ServingSession.
+        stats()` merges `AdmissionController.snapshot()`; nothing is
+        mirrored here."""
+        self.registry.set_gauge("lgbm_serving_admission_level_rows",
+                                float(level_rows),
+                                help="AIMD admitted-rows level")
+        self.registry.set_gauge("lgbm_serving_batch_window_ms",
+                                float(window_s) * 1e3,
+                                help="adaptive batcher coalescing window")
+        self.registry.set_gauge("lgbm_serving_slo_projection_ms",
+                                float(projection_s) * 1e3,
+                                help="projected new-request latency "
+                                     "(queue-wait p99 + dispatch p95)")
+
+    def snapshot_queue_depth(self) -> int:
+        """Cheap queue-depth read for the per-request admission gate
+        (the full snapshot() walks every counter)."""
+        with self._lock:
+            return self._queue_depth
+
+    # -- admission feedback --------------------------------------------
+    # samples the AIMD projection reads from each ring; must not exceed
+    # obs.metrics._SAMPLE_RING or the window silently shrinks
+    _RECENT = 256
+
+    def recent_wait_profile(self):
+        """(queue_wait_p99_s, dispatch_p95_s, n) over the most recent
+        raw samples in the PR-10 histogram rings — the closed-loop
+        signal the admission controller AIMDs against.  Uses the raw
+        rings rather than the cumulative buckets so a long-gone
+        overload episode cannot keep the projection pinned high."""
+        qs = self.registry.histogram_samples(_QWAIT)[-self._RECENT:]
+        ds = self.registry.histogram_samples(_DISPATCH)[-self._RECENT:]
+        n = len(qs)
+        if not qs:
+            return 0.0, 0.0, 0
+        qs = sorted(qs)
+        q99 = qs[min(int(0.99 * (len(qs) - 1) + 0.5), len(qs) - 1)]
+        if ds:
+            ds = sorted(ds)
+            d95 = ds[min(int(0.95 * (len(ds) - 1) + 0.5), len(ds) - 1)]
+        else:
+            d95 = 0.0
+        return float(q99), float(d95), n
 
     # -- reading -------------------------------------------------------
     def snapshot(self) -> Dict:

@@ -198,7 +198,41 @@ class TestLifecycleProgramCounts:
         np.testing.assert_array_equal(
             got, bst.predict(X[:37], raw_score=True, device="tpu",
                              tpu_predict_device="true"))
+        serve_programs = ledger.n_programs()
+
+        # ISSUE 11 gate: the whole overload/robustness layer is host-
+        # side control flow — admission sheds, a priority predict, a
+        # deadline-capped predict, a device failover onto the native
+        # walker, and the drain lifecycle must compile ZERO new
+        # programs on top of the warmed serve lifecycle
+        from lightgbm_tpu.serving import ServingOverloaded
+        from lightgbm_tpu.utils import faultline
+
+        sess.predict("m", X[:23], priority="high", deadline_ms=30000)
+        import time as _time
+
+        sess.admission._level = 1.0  # force an admission shed
+        sess.admission.min_level = 1  # bypass the one-batch floor
+        # pin the lazy AIMD update past the test so it cannot re-open
+        # the level before the shed lands
+        sess.admission._next_update = _time.monotonic() + 60.0
+        try:
+            with pytest.raises(ServingOverloaded):
+                sess.predict("m", X[:23], priority="low")
+        finally:
+            sess.admission._level = float(sess.admission.queue_rows)
+            sess.admission.min_level = 4096
+        faultline.reset()
+        faultline.arm("serve_dispatch", action="raise", times=1)
+        try:
+            sess.predict("m", X[:23])  # served via walker failover
+        finally:
+            faultline.reset()
+        assert sess.drain()["drained"] is True
         sess.close()
+        assert ledger.n_programs() == serve_programs, (
+            "admission/drain/failover compiled new programs:\n"
+            + ledger.format_report())
 
         sites = {a["site"]: a["programs"] for a in ledger.report()}
         assert sites == {"grower.grow": 1, "predict.class_scores": 1}, \

@@ -415,45 +415,72 @@ class TestSparseScatter:
 
 @pytest.mark.slow
 class TestCrossShardInt16OpenItem6:
-    """ROADMAP open item 6 (found during PR 8 verification): a config
-    family VIOLATES the PR-5 cross-shard bitwise claim — binary
-    objective, 2000x8 normal data, num_leaves=15, max_bin=63,
-    min_data_in_leaf=5, bagging 0.8/1, int16,
-    tpu_quant_refit_leaves=false diverges serial vs 4-shard by round 6.
-    Suspects: a near-tie comparison on dequantized f32 instead of raw
-    int32 sums, or per-shard row-pad interaction with min_data
-    counting.  strict xfail = the gate for the eventual fix: the day
-    the models agree, this XPASSes loudly and the xfail must come off
-    (and PR 8's elastic-resume matrix inherits the widened contract)."""
+    """ROADMAP open item 7 (née 6), FIXED (ISSUE 11): the bagged family
+    violated the PR-5 cross-shard bitwise claim.  Three stacked root
+    causes, none of them the suspected min_data counting:
 
-    @pytest.mark.xfail(
-        strict=True,
-        reason="ROADMAP open item 6: int16 serial vs 4-shard model "
-               "files diverge by round 6 under deep-tree bagging "
-               "(pre-existing at pre-PR-8 HEAD)")
-    def test_serial_vs_4shard_round6_bitwise(self):
+    1. bagging/GOSS masks were drawn with shape-keyed
+       `jax.random.uniform(key, (n_pad,))` — threefry counters pair
+       across array halves, so every row's draw changes with the TOTAL
+       padded length, and n_pad is topology-dependent (serial pads 2000
+       rows to a 2048 block multiple; 4 x 500-row shards need none).
+       Masks now come from the PCG hash over GLOBAL row indices, like
+       the PR-4 quantization rounding.
+    2. the fused step's score update `leaf_output[ids] * lr + scores`
+       was a mul+add chain XLA/LLVM could contract into an FMA — and
+       contracted DIFFERENTLY in the serial vs shard_map programs,
+       drifting scores one ulp apart under identical trees.  The update
+       now pre-scales the [L] leaf vector so the per-row path is
+       gather + one correctly-rounded add.
+    3. the split-search bin cumsums ran over pre-dequantized f32
+       histograms; for quantized precisions they now run in exact int32
+       and dequantize at the scan boundary (reassociation-proof).
+
+    These tests are the former strict-xfail repro flipped to the
+    passing gate, widened to the whole family probing found broken
+    (int8 under bagging, int16 at num_leaves=7, pos/neg bagging) at 2
+    AND 4 shards.  PR 8's elastic-resume matrix inherits the widened
+    contract."""
+
+    @staticmethod
+    def _family_data():
         rng = np.random.default_rng(7)
         X = rng.normal(size=(2000, 8))
         y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+        return X, y
+
+    @pytest.mark.parametrize("shards", [2, 4])
+    def test_serial_vs_sharded_bagged_round6_bitwise(self, shards):
+        X, y = self._family_data()
         q = dict(tpu_hist_precision="int16", tpu_quant_refit_leaves=False,
                  bagging_fraction=0.8, bagging_freq=1)
         m_serial, _ = _train_model_text(X, y, rounds=6, **q)
         m_shard, bst = _train_model_text(
-            X, y, rounds=6, tree_learner="data", num_machines=4, **q)
+            X, y, rounds=6, tree_learner="data", num_machines=shards, **q)
         assert bst._driver.learner.hist_agg == "scatter"
         assert m_serial == m_shard
 
+    @pytest.mark.parametrize("q", [
+        dict(tpu_hist_precision="int8", bagging_fraction=0.8,
+             bagging_freq=1),
+        dict(tpu_hist_precision="int16", num_leaves=7,
+             bagging_fraction=0.8, bagging_freq=1),
+        dict(tpu_hist_precision="int16", pos_bagging_fraction=0.7,
+             neg_bagging_fraction=0.9, bagging_freq=1),
+    ], ids=["int8-bagged", "int16-leaves7", "int16-posneg"])
+    def test_widened_family_bitwise(self, q):
+        X, y = self._family_data()
+        q = dict(tpu_quant_refit_leaves=False, **q)
+        m_serial, _ = _train_model_text(X, y, rounds=4, **q)
+        m_shard, _ = _train_model_text(
+            X, y, rounds=4, tree_learner="data", num_machines=4, **q)
+        assert m_serial == m_shard
+
     def test_same_data_without_bagging_still_holds(self):
-        """Bracketing control: the SAME data/precision WITHOUT bagging
-        holds at 3 rounds — pins the violation's trigger surface (the
-        bagged deep-tree family; probing during this PR found bagging
-        0.8/1 also breaks int8 here, and num_leaves=7 int16 breaks by
-        round 3, so the family is wider than the original ROADMAP
-        note).  If THIS ever fails, the regression has spread into the
-        committed PR-5 contract itself."""
-        rng = np.random.default_rng(7)
-        X = rng.normal(size=(2000, 8))
-        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+        """Bracketing control from the xfail era: the SAME
+        data/precision WITHOUT bagging — the committed PR-5 contract
+        itself."""
+        X, y = self._family_data()
         q = dict(tpu_hist_precision="int16", tpu_quant_refit_leaves=False)
         m_serial, _ = _train_model_text(X, y, rounds=3, **q)
         m_shard, _ = _train_model_text(

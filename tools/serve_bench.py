@@ -11,6 +11,15 @@ Modes:
 * target QPS (`--qps N`): workers pace their requests to an aggregate
   open-loop arrival rate, reporting achieved QPS and shed counts — the
   overload-behavior probe.
+* overload ramp (`--ramp`): measure the closed-loop saturation rate,
+  then step offered load from 0.5x to `--ramp-max`x (default 5x) of
+  it, one line per step with goodput, shed %, accepted p99, and the
+  admission controller's level/window — the adaptive-admission
+  acceptance probe (ISSUE 11).  `--chaos` arms a faultline
+  `serve_dispatch` raise mid-ramp to prove accepted requests never
+  see a device failure.  The summary line carries
+  `serve_goodput_rows_per_sec` (best goodput across steps) and
+  `serve_shed_pct` (top step) — the two numbers bench.py tracks.
 
 The model comes from `--model model.txt`, or a synthetic binary model is
 trained in-process (same shape family as bench.py, much smaller).
@@ -18,6 +27,7 @@ trained in-process (same shape family as bench.py, much smaller).
 Usage:
     python tools/serve_bench.py                      # sweep 1..4096
     python tools/serve_bench.py --qps 500 --rows 64  # paced load
+    python tools/serve_bench.py --ramp --chaos       # overload ramp
     python tools/serve_bench.py --model model.txt --threads 16
 """
 
@@ -73,14 +83,27 @@ def run_closed_loop(sess, name, X, rows, threads, duration_s):
 
 
 def run_paced(sess, name, X, rows, threads, qps, duration_s):
-    """Open-loop: aggregate arrivals paced to `qps` across workers."""
-    period = threads / float(qps)  # each worker fires every `period` s
+    """Open-loop: aggregate arrivals paced to `qps` across workers.
+    Thin wrapper over run_paced_counted (ONE pacing implementation)."""
+    n_ok, n_shed, _n_err, dt = run_paced_counted(
+        sess, name, X, rows, threads, qps, duration_s)
+    return n_ok, n_shed, dt
+
+
+def run_paced_counted(sess, name, X, rows, threads, qps, duration_s,
+                      deadline_ms=None, chaos_at_s=None):
+    """Open-loop paced load distinguishing accepted vs shed vs error;
+    optionally arms a serve_dispatch fault `chaos_at_s` into the run."""
+    period = threads / float(qps)
     stop = time.monotonic() + duration_s
-    counts = [0] * threads
+    ok = [0] * threads
     shed = [0] * threads
+    errors = [0] * threads
 
     def worker(i):
-        from lightgbm_tpu.serving import ServingQueueFull, ServingTimeout
+        from lightgbm_tpu.serving import (ServingOverloaded,
+                                          ServingQueueFull,
+                                          ServingTimeout)
 
         Xi = X[:rows]
         next_t = time.monotonic() + (i / threads) * period
@@ -93,19 +116,88 @@ def run_paced(sess, name, X, rows, threads, qps, duration_s):
                 continue
             next_t += period
             try:
-                sess.predict(name, Xi, raw_score=True)
-                counts[i] += 1
-            except (ServingQueueFull, ServingTimeout):
+                sess.predict(name, Xi, raw_score=True,
+                             deadline_ms=deadline_ms)
+                ok[i] += 1
+            except (ServingOverloaded, ServingQueueFull, ServingTimeout):
                 shed[i] += 1
+            except Exception:
+                errors[i] += 1
 
     ts = [threading.Thread(target=worker, args=(i,)) for i in range(threads)]
     t0 = time.monotonic()
     for t in ts:
         t.start()
+    if chaos_at_s is not None:
+        from lightgbm_tpu.utils import faultline
+
+        time.sleep(min(chaos_at_s, duration_s / 2))
+        faultline.arm("serve_dispatch", action="raise", times=3)
     for t in ts:
         t.join()
     dt = time.monotonic() - t0
-    return sum(counts), sum(shed), dt
+    return sum(ok), sum(shed), sum(errors), dt
+
+
+def run_ramp(new_session, name, X, rows, threads, duration_s,
+             ramp_max=5.0, steps=5, chaos=False, print_fn=print):
+    """Overload ramp: saturation probe, then paced steps to
+    ramp_max x saturation.  Returns the summary dict."""
+    from lightgbm_tpu.utils import faultline
+
+    sess = new_session()
+    n_ok, _, dt = run_closed_loop(sess, name, X, rows, max(threads, 4),
+                                  duration_s)
+    sat_qps = max(n_ok / dt, 1.0)
+    sess.close()
+    print_fn(json.dumps({"mode": "ramp_saturation",
+                         "sat_qps": round(sat_qps, 1),
+                         "sat_rows_per_sec": round(sat_qps * rows, 0)}))
+    best_goodput = 0.0
+    top = None
+    slo_ms = None
+    for k in range(steps):
+        mult = 0.5 + (ramp_max - 0.5) * k / max(steps - 1, 1)
+        qps = sat_qps * mult
+        sess = new_session()
+        slo_ms = float(sess.config.serving_slo_ms)
+        chaos_at = duration_s * 0.4 if (chaos and k == steps - 1) else None
+        n_ok, n_shed, n_err, dt = run_paced_counted(
+            sess, name, X, rows, threads, qps, duration_s,
+            deadline_ms=slo_ms * 4, chaos_at_s=chaos_at)
+        faultline.reset()
+        st = sess.stats()
+        offered = n_ok + n_shed + n_err
+        goodput = n_ok * rows / dt
+        best_goodput = max(best_goodput, goodput)
+        top = {
+            "mode": "ramp_step", "offered_x_saturation": round(mult, 2),
+            "offered_qps": round(qps, 1),
+            "goodput_rows_per_sec": round(goodput, 0),
+            "shed_pct": round(100.0 * n_shed / offered, 1) if offered
+            else 0.0,
+            "errors": n_err,
+            "p99_ms": st["latency_p99_ms"],
+            "expired": st["requests_expired"],
+            "overload_429": st["requests_overload"],
+            "queue_full_503": st["requests_shed"],
+            "admission_level_rows": st["admission_level_rows"],
+            "batch_window_ms": st["batch_window_ms"],
+            "chaos": bool(chaos_at is not None),
+            "device_fallbacks": st["device_fallbacks"],
+        }
+        print_fn(json.dumps(top))
+        sess.close()
+    summary = {
+        "mode": "ramp_summary",
+        "serve_goodput_rows_per_sec": round(best_goodput, 0),
+        "serve_shed_pct": top["shed_pct"] if top else 0.0,
+        "serve_slo_ms": slo_ms,
+        "top_step_p99_ms": top["p99_ms"] if top else 0.0,
+        "top_step_errors": top["errors"] if top else 0,
+    }
+    print_fn(json.dumps(summary))
+    return summary
 
 
 def main():
@@ -121,6 +213,17 @@ def main():
                     help="rows per request in --qps mode")
     ap.add_argument("--qps", type=float, default=0.0,
                     help="target aggregate QPS (0 = closed-loop sweep)")
+    ap.add_argument("--ramp", action="store_true",
+                    help="overload ramp mode (saturation probe + paced "
+                         "steps to --ramp-max x saturation)")
+    ap.add_argument("--ramp-max", type=float, default=5.0,
+                    help="top ramp step as a multiple of saturation")
+    ap.add_argument("--ramp-steps", type=int, default=5)
+    ap.add_argument("--chaos", action="store_true",
+                    help="arm a serve_dispatch device fault mid-ramp "
+                         "(top step)")
+    ap.add_argument("--slo-ms", type=float, default=0.0,
+                    help="serving_slo_ms override (0 = config default)")
     ap.add_argument("--max-batch-rows", type=int, default=4096)
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     args = ap.parse_args()
@@ -131,10 +234,13 @@ def main():
         """Fresh session (and stats) per configuration: cumulative
         counters/latency windows would misattribute earlier configs'
         numbers to later sweep lines."""
-        s = ServingSession(params={
+        params = {
             "serving_max_batch_rows": args.max_batch_rows,
             "serving_max_wait_ms": args.max_wait_ms,
-            "verbosity": -1})
+            "verbosity": -1}
+        if args.slo_ms > 0:
+            params["serving_slo_ms"] = args.slo_ms
+        s = ServingSession(params=params)
         if args.model:
             s.load("bench", model_file=args.model,
                    params={"tpu_predict_device": "true"})
@@ -152,6 +258,11 @@ def main():
         bst = None
     else:
         bst, X = make_model()
+    if args.ramp:
+        run_ramp(new_session, "bench", X, args.rows, args.threads,
+                 args.duration, ramp_max=args.ramp_max,
+                 steps=args.ramp_steps, chaos=args.chaos)
+        return
     sess = new_session()
 
     if args.qps > 0:
