@@ -201,7 +201,14 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     from lightgbm_tpu.utils.compile_ledger import LEDGER
 
     LEDGER.enable()
+    # ISSUE 12: capture each program's re-lowerable specs so the round
+    # carries a per-program cost table (flops / bytes accessed; HBM
+    # byte fields where a backend reports them) beside n_programs
+    LEDGER.enable_capture()
     LEDGER.reset()
+    from lightgbm_tpu.obs import resources
+
+    resources.reset_phase_peaks()
     bst = Booster(params=params, train_set=ds)
     # snapshot ingest phases NOW: later valid-set constructs would
     # double-count sketch/binning
@@ -209,9 +216,17 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     from lightgbm_tpu.utils.backend import host_sync
 
     def _segments(tag, k=3):
-        """The last k registry-recorded walls for one bench segment."""
-        return obs.REGISTRY.histogram_samples(
-            "lgbm_timed_seconds", name=tag)[-k:]
+        """The last k registry-recorded walls for one bench segment.
+        The readback REFUSES a truncated ring shorter than the request:
+        a silently under-counted repeat series would publish a median
+        over the wrong repeats (ISSUE 12 satellite)."""
+        samples, truncated = obs.REGISTRY.histogram_samples(
+            "lgbm_timed_seconds", with_truncated=True, name=tag)
+        if truncated and len(samples) < k:
+            raise RuntimeError(
+                f"bench segment {tag!r}: sample ring truncated below "
+                f"the {k} requested repeats — raise tpu_obs_ring_samples")
+        return samples[-k:]
 
     with obs.timed("bench/compile"):
         for _ in range(WARMUP_ITERS):
@@ -234,6 +249,10 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     train_s = sum(seg_walls)
     bench_iters = 3 * seg_iters
     iters_per_sec, iters_per_sec_min = spread(seg_rates)
+    # snapshot the TRAIN peak NOW: peak_bytes_in_use is a process-
+    # lifetime high-water mark with no reset, so reading it after the
+    # predict/serve sections would attribute their peaks to training
+    train_peak_hbm_bytes = resources.peak_hbm_bytes()
 
     # prediction throughput: full-forest raw predict rows/s on the path
     # the configuration would actually use (device bin-space traversal on
@@ -289,6 +308,9 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
                            / serve_s)
     serve_rows_per_sec, serve_rows_per_sec_min = spread(serve_rates)
     serve_p99_ms = sess.stats()["latency_p99_ms"]
+    # ISSUE 12: what this model costs resident in the registry — the
+    # packed device-table bytes the serve_model_hbm_bytes gauge tracks
+    serve_model_hbm_bytes = int(sess.registry.resolve("bench").hbm_bytes)
     sess.close()
 
     # overload-ramp goodput (ISSUE 11): paced open-loop load at ~4x the
@@ -427,6 +449,14 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     n_programs = LEDGER.n_programs()
     ledger_sites = {a["site"]: a["programs"] for a in LEDGER.report()}
 
+    # ISSUE 12: resource accounting — peak device bytes (None on CPU:
+    # the backend reports no memory_stats, and a null beats a fiction),
+    # phase watermarks, and the per-program static cost table (flops /
+    # bytes-accessed everywhere; HBM byte fields where the backend
+    # reports, i.e. auto-skipped on CPU)
+    res = resources.bench_resource_metrics(
+        LEDGER, train_peak=train_peak_hbm_bytes)
+
     # regression note: compile_s / n_programs against the newest
     # committed BENCH_r*.json (same-shape comparisons only make sense
     # between degraded rounds or between TPU rounds; the note carries the
@@ -506,6 +536,18 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "n_programs_train": n_programs_train,
         "n_programs": n_programs,
         "ledger_sites": ledger_sites,
+        # ISSUE 12: device memory/cost accounting.  Fields derived from
+        # device memory_stats (train/phase peaks, program memory bytes)
+        # are explicitly null on CPU — "not measurable here", not
+        # "missing"; serve_model_hbm_bytes (packed-table bytes on
+        # whatever backend holds them — host RAM on CPU) and the cost
+        # table's flops/bytes_accessed are real numbers everywhere.
+        # Train peak snapshotted right after the train segments, before
+        # predict/serve could raise the process high-water mark
+        "train_peak_hbm_bytes": res["train_peak_hbm_bytes"],
+        "phase_peak_hbm_bytes": res["phase_peak_hbm_bytes"],
+        "serve_model_hbm_bytes": serve_model_hbm_bytes,
+        "program_costs": res["program_costs"],
         "platform": jax.devices()[0].platform,
         # ISSUE 10 satellite: the backend/degraded marker lives IN the
         # record (it used to go only to stderr, so rounds 3-5's silent

@@ -253,6 +253,23 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # merge a multihost run's streams with tools/trace_merge.py.
     # "" = unset (in-memory span buffer only)
     "tpu_trace_dir": ("str", "", ()),
+    # raw samples kept per metrics-registry histogram child (the bench's
+    # repeat readback and the serving admission controller's
+    # recent-window SLO projection both read this ring).  Readers that
+    # must not silently under-count ask
+    # histogram_samples(with_truncated=True).  0 = leave the process
+    # default (256) untouched
+    "tpu_obs_ring_samples": ("int", 0, ()),
+    # flight-recorder depth: the last N spans / events / watchdog-guard-
+    # breaker transitions kept in the ALWAYS-ON process-global ring
+    # (obs/flightrecorder.py) and dumped to blackbox-host<k>.json on
+    # unhandled exception, CollectiveTimeout, SIGTERM, or a guard raise.
+    # 0 = leave the process default (512) untouched
+    "tpu_obs_blackbox_events": ("int", 0, ()),
+    # where blackbox-host<k>.json dumps land.  "" = unset: the
+    # LIGHTGBM_TPU_BLACKBOX_DIR env var, then tpu_trace_dir, then the
+    # working directory
+    "tpu_obs_blackbox_dir": ("str", "", ()),
     # --- objective ---
     "num_class": ("int", 1, ("num_classes",)),
     "is_unbalance": ("bool", False, ("unbalance", "unbalanced_sets")),

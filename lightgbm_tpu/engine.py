@@ -192,6 +192,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
             # account for >= 95% of the train-loop wall (asserted by
             # tests/test_telemetry.py); obs.span is a shared null
             # context manager when tracing is off
+            # one always-on flight-recorder entry per round, recorded
+            # at round START in every mode: the blackbox of a dying
+            # run names the round it died IN (the trace span mirror
+            # only lands at span exit, which a mid-round death never
+            # reaches)
+            obs.flightrecorder.note("round", "train/round", iteration=i)
             with obs.span("train/round", iteration=i):
                 for cb in cb_before:
                     cb(CallbackEnv(model=booster, params=params, iteration=i,
@@ -241,6 +247,15 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     "checkpoint; restart the group and resume=True to "
                     "rejoin (elastic: any shard/host count)")
             flush_checkpoint(booster, ckpt_manager, callbacks=callbacks)
+        # blackbox AFTER the checkpoint flush: the dump's metric
+        # snapshot then carries the flush's own counters, proving to
+        # the postmortem reader that the checkpoint landed before the
+        # process died (SIGTERM rides this path as KeyboardInterrupt)
+        obs.flightrecorder.note("crash", "train_interrupted",
+                                type=type(exc).__name__,
+                                iteration=booster.current_iteration())
+        obs.flightrecorder.dump(f"train_interrupt:{type(exc).__name__}",
+                                exc=exc)
         _dump_trace()
         raise
     finally:

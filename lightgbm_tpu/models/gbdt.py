@@ -555,7 +555,14 @@ class GBDT:
         """Fast path: one fused async device dispatch per class and NO
         host<->device sync; host Tree objects materialize lazily at
         eval/predict/save time (`_materialize`)."""
-        with timer.PHASE("train_dispatch"):
+        # the fused step is one async dispatch holding the histogram
+        # pool + score buffers; its watermark is tagged hist_build (the
+        # grow program owns the [L, G/P, B, 3] pool, the dominant HBM
+        # consumer).  Async means the bracket reads allocation, not
+        # execution — an under-estimate on accelerators, never an
+        # over-estimate
+        with timer.PHASE("train_dispatch"), \
+                obs.resources.phase_peak("hist_build"):
             bag = self._bag_cfg
             extra = {}
             if self._goss_cfg is not None:
@@ -694,16 +701,21 @@ class GBDT:
                          help="non-finite-score iterations caught by "
                               "tpu_guard_numerics")
         obs.event("guard_poisoned", iteration=it, mode=self._guard)
+        obs.flightrecorder.note("guard", "guard_poisoned",
+                                iteration=it, mode=self._guard)
         if self._guard == "warn":
             Log.warning(f"non-finite training scores after iteration {it} "
                         "(tpu_guard_numerics=warn): continuing")
             return False
         if self._guard == "raise":
             self._iter_restore(snap)  # leave the booster usable
-            raise LightGBMError(
+            exc = LightGBMError(
                 f"non-finite training scores after iteration {it} "
                 "(tpu_guard_numerics=raise); the poisoned iteration was "
                 "rolled back")
+            # the blackbox is the postmortem for exactly this death
+            obs.flightrecorder.dump("guard_raise", exc=exc)
+            raise exc
         # skip: drop the iteration but KEEP the advanced PRNG streams so
         # the retry re-bags instead of replaying the same poison.  With
         # no stochastic lever at all the retry would be a bit-identical
@@ -787,12 +799,14 @@ class GBDT:
                     or self.objective.class_need_train(k))
             tree = None
             if need:
-                with obs.span("grow", class_id=k):
+                with obs.span("grow", class_id=k), \
+                        obs.resources.phase_peak("hist_build"):
                     tree, leaf_ids, out = self.learner.train(
                         grad[k], hess[k], mask)
             if tree is not None and tree.num_leaves > 1:
                 should_continue = True
-                with obs.span("score_update", class_id=k):
+                with obs.span("score_update", class_id=k), \
+                        obs.resources.phase_peak("score_update"):
                     self._renew_and_update(tree, leaf_ids, k, mask)
                 if abs(init_scores[k]) > K_EPSILON:
                     tree.add_bias(init_scores[k])
@@ -1479,28 +1493,29 @@ class GBDT:
         `get_bins(lo, hi)` supplies host bins per chunk."""
         chunk = self.predict_chunk_rows()
         out = np.zeros((k, n), np.float64)
-        for lo in range(0, max(n, 1), chunk):
-            hi = min(lo + chunk, n)
-            rows = hi - lo
-            faultline.fire("h2d_copy", rows=rows)
-            bins = get_bins(lo, hi)
-            # pad every launch to a bucketed row count (row_bucket: full
-            # chunks for multi-chunk predicts, the policy's geometric
-            # ladder below that) so repeated predicts of varying batch
-            # sizes reuse a handful of compiled programs instead of one
-            # per distinct n
-            policy = self.bucket_policy()
-            target = (chunk if n > chunk
-                      else row_bucket(rows, chunk, policy=policy))
-            if rows < target:
-                bins = np.concatenate(
-                    [bins, np.zeros((target - rows, bins.shape[1]),
-                                    np.int32)])
-            scores = forest_class_scores(tables, jnp.asarray(bins),
-                                         meta_dev, k, depth,
-                                         policy=policy)
-            out[:, lo:hi] = np.asarray(
-                jax.device_get(scores), np.float64)[:, :rows]
+        with obs.resources.phase_peak("predict"):
+            for lo in range(0, max(n, 1), chunk):
+                hi = min(lo + chunk, n)
+                rows = hi - lo
+                faultline.fire("h2d_copy", rows=rows)
+                bins = get_bins(lo, hi)
+                # pad every launch to a bucketed row count (row_bucket:
+                # full chunks for multi-chunk predicts, the policy's
+                # geometric ladder below that) so repeated predicts of
+                # varying batch sizes reuse a handful of compiled
+                # programs instead of one per distinct n
+                policy = self.bucket_policy()
+                target = (chunk if n > chunk
+                          else row_bucket(rows, chunk, policy=policy))
+                if rows < target:
+                    bins = np.concatenate(
+                        [bins, np.zeros((target - rows, bins.shape[1]),
+                                        np.int32)])
+                scores = forest_class_scores(tables, jnp.asarray(bins),
+                                             meta_dev, k, depth,
+                                             policy=policy)
+                out[:, lo:hi] = np.asarray(
+                    jax.device_get(scores), np.float64)[:, :rows]
         return out
 
     def predict_binned_device(self, data: TrainingData,

@@ -1,8 +1,10 @@
-"""Unified telemetry: the metrics registry + structured span tracer.
+"""Unified telemetry: metrics registry + span tracer + resource
+accounting + flight recorder.
 
 One import surface for every instrumented layer::
 
     from ..obs import REGISTRY, span, timed, metrics_on, tracing_on
+    from ..obs import flightrecorder, resources
 
 * `REGISTRY` — process-global `MetricsRegistry` (counters, gauges,
   fixed-bucket histograms; Prometheus text export).
@@ -11,11 +13,20 @@ One import surface for every instrumented layer::
   ``tpu_telemetry`` != trace.
 * `timed(name)` — registry-backed stopwatch (the bench's segment timer).
 * `configure` / `configure_from_config` — process-global policy from
-  ``tpu_telemetry`` (off | metrics | trace) and ``tpu_trace_dir``.
+  ``tpu_telemetry`` (off | metrics | trace), ``tpu_trace_dir`` and the
+  ``tpu_obs_*`` params (histogram sample ring, flight-recorder depth
+  and blackbox dump dir).
+* `resources` — device HBM gauges, phase-tagged peak watermarks,
+  process runtime stats (ISSUE 12).
+* `flightrecorder` — the ALWAYS-ON bounded ring of recent spans/
+  transitions dumped to ``blackbox-host<k>.json`` on crash/hang/
+  SIGTERM (ISSUE 12).
 
-See `obs.metrics` and `obs.trace` for the full contracts.
+See `obs.metrics`, `obs.trace`, `obs.resources` and
+`obs.flightrecorder` for the full contracts.
 """
 
+from . import flightrecorder, resources  # noqa: F401
 from .metrics import (DEFAULT_SECONDS_BUCKETS, MetricsRegistry,  # noqa: F401
                       REGISTRY, histogram_quantile)
 from .trace import (chrome_trace, configure, configure_from_config,  # noqa: F401

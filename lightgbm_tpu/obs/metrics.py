@@ -41,8 +41,24 @@ DEFAULT_SECONDS_BUCKETS: Tuple[float, ...] = (
 # segment medians AND the serving admission controller's recent-window
 # SLO projection, which reads the last ServingStats._RECENT = 256 —
 # keep this ring at least that deep); bounded so long runs cannot grow
-# memory
-_SAMPLE_RING = 256
+# memory.  Configurable via tpu_obs_ring_samples (set_sample_ring);
+# readers that care whether the ring dropped samples ask
+# histogram_samples(..., with_truncated=True).
+DEFAULT_SAMPLE_RING = 256
+_sample_ring = DEFAULT_SAMPLE_RING
+
+
+def set_sample_ring(n: int) -> None:
+    """Resize the per-histogram raw-sample ring (process-global; wired
+    from ``tpu_obs_ring_samples``).  Existing rings shrink lazily on
+    their next observe; floor 1 so readback always sees the newest
+    sample."""
+    global _sample_ring
+    _sample_ring = max(int(n), 1)
+
+
+def sample_ring() -> int:
+    return _sample_ring
 
 
 def _label_key(labels: Dict[str, str]) -> Tuple[Tuple[str, str], ...]:
@@ -75,7 +91,8 @@ class _Gauge:
 
 
 class _Histogram:
-    __slots__ = ("bounds", "counts", "sum", "count", "samples")
+    __slots__ = ("bounds", "counts", "sum", "count", "samples",
+                 "samples_truncated")
 
     def __init__(self, bounds: Tuple[float, ...]) -> None:
         self.bounds = bounds                     # finite upper bounds
@@ -83,14 +100,16 @@ class _Histogram:
         self.sum = 0.0
         self.count = 0
         self.samples: List[float] = []           # bounded ring
+        self.samples_truncated = False           # ring ever dropped one
 
     def observe(self, v: float) -> None:
         self.counts[bisect.bisect_left(self.bounds, v)] += 1
         self.sum += v
         self.count += 1
         self.samples.append(v)
-        if len(self.samples) > _SAMPLE_RING:
-            del self.samples[:len(self.samples) - _SAMPLE_RING]
+        if len(self.samples) > _sample_ring:
+            del self.samples[:len(self.samples) - _sample_ring]
+            self.samples_truncated = True
 
     def quantile(self, q: float) -> float:
         """Prometheus histogram_quantile: linear interpolation inside
@@ -219,17 +238,25 @@ class MetricsRegistry:
         h = fam.children.get(_label_key(labels))
         return 0.0 if h is None else h.quantile(q)
 
-    def histogram_samples(self, _name: str, **labels: str) -> List[float]:
+    def histogram_samples(self, _name: str, with_truncated: bool = False,
+                          **labels: str):
         """The bounded raw-sample ring (newest last) — per-repeat walls
-        for callers like bench that need medians, not just buckets."""
+        for callers like bench that need medians, not just buckets.
+
+        ``with_truncated=True`` returns ``(samples, truncated)`` where
+        `truncated` reports whether the ring EVER dropped a sample for
+        this child — so a repeat-readback loop can tell "all my repeats
+        are here" from "the ring silently under-counts"."""
         fam = self._families.get(_name)
         if fam is None:
-            return []
+            return ([], False) if with_truncated else []
         h = fam.children.get(_label_key(labels))
         if h is None:
-            return []
+            return ([], False) if with_truncated else []
         with fam.lock:
-            return list(h.samples)
+            samples = list(h.samples)
+            truncated = bool(h.samples_truncated)
+        return (samples, truncated) if with_truncated else samples
 
     def histogram_stats(self, _name: str, **labels: str
                         ) -> Tuple[int, float]:
@@ -280,6 +307,16 @@ class MetricsRegistry:
         if fam is not None:
             with fam.lock:
                 fam.children.clear()
+
+    def remove(self, _name: str, **labels: str) -> None:
+        """Drop ONE labeled child — per-entity gauges (a serving
+        model's HBM bytes) must disappear with the entity, or a
+        long-lived hot-swapping server grows one dead series per
+        version ever loaded."""
+        fam = self._families.get(_name)
+        if fam is not None:
+            with fam.lock:
+                fam.children.pop(_label_key(labels), None)
 
     # -- Prometheus text exposition (version 0.0.4) --------------------
     def to_prometheus_text(self) -> str:

@@ -1,0 +1,306 @@
+"""Resource observability: device HBM accounting + process runtime stats.
+
+ROADMAP items 1 (out-of-core streaming) and 2c (quantized serving
+tables) are both HBM-budget problems, yet until ISSUE 12 nothing in the
+codebase could say what the [L, G/P, B, 3] histogram pool or a packed
+forest actually costs on device — block-size and models-per-HBM
+decisions (the *Out-of-Core GPU Gradient Boosting* trade, PAPERS.md
+arXiv 2005.09148) live or die on exactly that number.  This module is
+the one place that reads it:
+
+* **device gauges** — `device_memory_stats()` wraps
+  ``device.memory_stats()`` (``bytes_in_use`` / ``peak_bytes_in_use``
+  on TPU/GPU; the CPU backend returns None and every caller here
+  degrades gracefully to None instead of inventing a number).
+* **phase watermarks** — `phase_peak(phase)` brackets one lifecycle
+  phase (ingest / hist_build / score_update / predict, the PR-10 span
+  boundaries) and records the peak HBM the phase owned.  XLA exposes no
+  per-phase peak reset, so the bracket emulates reset-and-read: if the
+  process-wide ``peak_bytes_in_use`` grew inside the bracket the phase
+  owns the new peak; otherwise the phase is bounded by the live
+  ``bytes_in_use`` it saw.  Gated on `obs.metrics_on()` — the off-mode
+  train loop pays one flag check.
+* **process runtime stats** — `process_runtime_stats()` (RSS, uptime,
+  threads, open fds, GC collections): flat /proc + stdlib reads, no new
+  deps, published as gauges on the serving ``GET /metrics`` / ``/stats``
+  endpoints.
+* **bench metrics** — `bench_resource_metrics()` packages the above
+  plus the CompileLedger's per-program cost capture into the
+  ``train_peak_hbm_bytes`` / ``program_costs`` bench fields (explicitly
+  None on CPU rather than silently absent).
+
+Nothing here ever forces a backend init: jax is consulted only when the
+caller already imported it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import gc
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .metrics import REGISTRY
+from .trace import metrics_on
+
+#: the phase vocabulary the watermark gauges use (PR-10 span boundaries)
+PHASES = ("ingest", "hist_build", "score_update", "predict")
+
+_PEAK_GAUGE = "lgbm_device_phase_peak_bytes"
+
+# process-start anchor for uptime (obs imports at package import, so
+# this is within milliseconds of interpreter start for any real run)
+_T_START = time.time()
+
+_lock = threading.Lock()
+_phase_peaks: Dict[str, int] = {}
+
+
+def _devices():
+    """Already-initialized jax devices, or [] — resource accounting must
+    never be the thing that forces (or hangs) backend init."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return []
+    try:
+        return list(jax_mod.devices())
+    except Exception:  # pragma: no cover - backend init failure
+        return []
+
+
+def device_memory_stats(device=None) -> Optional[Dict[str, int]]:
+    """One device's ``memory_stats()`` dict, or None when the backend
+    does not report (CPU) or jax is not imported yet."""
+    if device is None:
+        devs = _devices()
+        if not devs:
+            return None
+        device = devs[0]
+    try:
+        stats = device.memory_stats()
+    except Exception:  # pragma: no cover - exotic plugin
+        return None
+    if not stats:
+        return None
+    return {str(k): int(v) for k, v in stats.items()}
+
+
+def all_device_memory_stats() -> List[Optional[Dict[str, int]]]:
+    """Per-device memory_stats (None entries for non-reporting devices)."""
+    return [device_memory_stats(d) for d in _devices()]
+
+
+def hbm_bytes_in_use() -> Optional[int]:
+    """Max ``bytes_in_use`` across reporting devices; None on CPU."""
+    vals = [s.get("bytes_in_use") for s in all_device_memory_stats()
+            if s is not None and s.get("bytes_in_use") is not None]
+    return max(vals) if vals else None
+
+
+def peak_hbm_bytes() -> Optional[int]:
+    """Max ``peak_bytes_in_use`` across reporting devices; None on CPU
+    (the value the ``train_peak_hbm_bytes`` bench metric records)."""
+    vals = []
+    for s in all_device_memory_stats():
+        if s is None:
+            continue
+        v = s.get("peak_bytes_in_use", s.get("bytes_in_use"))
+        if v is not None:
+            vals.append(v)
+    return max(vals) if vals else None
+
+
+# ---------------------------------------------------------------------------
+# phase-tagged peak watermarks
+# ---------------------------------------------------------------------------
+def _note_phase_peak(phase: str, peak: int) -> None:
+    with _lock:
+        prev = _phase_peaks.get(phase, 0)
+        if peak <= prev:
+            return
+        _phase_peaks[phase] = int(peak)
+        # gauge write INSIDE the lock: a racing smaller peak must not
+        # overwrite a larger one on the exported surface
+        REGISTRY.set_gauge(_PEAK_GAUGE, int(peak),
+                           help="peak device bytes owned by one "
+                                "lifecycle phase (reset-and-read "
+                                "watermark)",
+                           phase=phase)
+
+
+#: shared no-op CM handed back when metrics are off — the per-iteration
+#: hot path pays one flag check + one allocation-free return, the same
+#: discipline obs.span uses
+_NULL = contextlib.nullcontext()
+
+
+def _fleet_watermark() -> Optional[tuple]:
+    """(max peak, max bytes_in_use) across ALL reporting devices, or
+    None — the phase table must aggregate the same way
+    `peak_hbm_bytes()` does, or a sharded phase peaking on a non-zero
+    device could not explain the train peak it sits next to."""
+    peaks, in_use = [], []
+    for s in all_device_memory_stats():
+        if s is None:
+            continue
+        peaks.append(s.get("peak_bytes_in_use", 0))
+        in_use.append(s.get("bytes_in_use", 0))
+    if not peaks:
+        return None
+    return max(peaks), max(in_use)
+
+
+class _PhasePeak:
+    __slots__ = ("phase", "_p0", "_b0")
+
+    def __init__(self, phase: str):
+        self.phase = phase
+
+    def __enter__(self) -> "_PhasePeak":
+        before = _fleet_watermark()
+        if before is None:      # CPU / no backend: graceful None
+            self._p0 = None
+        else:
+            self._p0, self._b0 = before
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._p0 is None:
+            return
+        after = _fleet_watermark()
+        if after is None:  # pragma: no cover - backend vanished
+            return
+        p1, b1 = after
+        # process-wide peak grew inside the bracket -> this phase owns
+        # the new watermark; else bound by the live bytes seen
+        _note_phase_peak(self.phase,
+                         p1 if p1 > self._p0 else max(self._b0, b1))
+
+
+def phase_peak(phase: str):
+    """Bracket one lifecycle phase and record its peak HBM watermark.
+
+    The shared null CM (no allocation) when telemetry metrics are off;
+    on CPU the bracket runs but records nothing (memory_stats is
+    None)."""
+    if not metrics_on():
+        return _NULL
+    return _PhasePeak(phase)
+
+
+def phase_peaks() -> Dict[str, int]:
+    """Phase -> peak device bytes recorded so far ({} on CPU)."""
+    with _lock:
+        return dict(_phase_peaks)
+
+
+def reset_phase_peaks() -> None:
+    with _lock:
+        _phase_peaks.clear()
+    REGISTRY.clear_family(_PEAK_GAUGE)
+
+
+# ---------------------------------------------------------------------------
+# process runtime stats (satellite: /metrics + /stats gauges)
+# ---------------------------------------------------------------------------
+def _rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        try:  # pragma: no cover - non-procfs host
+            import resource
+
+            peak = int(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+            # linux reports KiB, darwin bytes; either way this is the
+            # PEAK rss — the best a non-procfs host can offer
+            return peak if sys.platform == "darwin" else peak * 1024
+        except Exception:  # pragma: no cover
+            return None
+
+
+def _open_fds() -> Optional[int]:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:  # pragma: no cover - non-procfs host
+        return None
+
+
+def process_runtime_stats() -> Dict[str, Optional[float]]:
+    """Flat process-runtime reads: RSS, uptime, threads, open fds, GC
+    collections.  Every value is cheap (one /proc read or stdlib call);
+    an unavailable source reports an explicit None — never a fictional
+    0 an fd-leak alert would read as a measurement."""
+    rss = _rss_bytes()
+    fds = _open_fds()
+    return {
+        "process_rss_bytes": int(rss) if rss is not None else None,
+        "process_uptime_s": round(time.time() - _T_START, 3),
+        "process_threads": threading.active_count(),
+        "process_open_fds": int(fds) if fds is not None else None,
+        "process_gc_collections": sum(
+            s.get("collections", 0) for s in gc.get_stats()),
+    }
+
+
+def publish_process_gauges(registry=None) -> Dict[str, float]:
+    """Refresh the process-runtime gauges in `registry` (default: the
+    process-global one) — called per /metrics scrape so the exported
+    values are scrape-time reads, not stale snapshots."""
+    reg = REGISTRY if registry is None else registry
+    stats = process_runtime_stats()
+    names = {
+        "process_rss_bytes": ("lgbm_process_resident_memory_bytes",
+                              "resident set size"),
+        "process_uptime_s": ("lgbm_process_uptime_seconds",
+                             "seconds since process start"),
+        "process_threads": ("lgbm_process_threads",
+                            "live python threads"),
+        "process_open_fds": ("lgbm_process_open_fds",
+                             "open file descriptors"),
+        "process_gc_collections": ("lgbm_process_gc_collections",
+                                   "cumulative gc collections across "
+                                   "generations"),
+    }
+    for key, (metric, help_text) in names.items():
+        if stats[key] is None:
+            continue   # unmeasurable here: no series beats a fiction
+        reg.set_gauge(metric, float(stats[key]), help=help_text)
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# bench packaging
+# ---------------------------------------------------------------------------
+def bench_resource_metrics(ledger=None, memory: Optional[bool] = None,
+                           train_peak: Optional[int] = None) -> Dict:
+    """The resource fields a bench/smoke record carries:
+
+    * ``train_peak_hbm_bytes`` — peak device bytes (None on CPU).
+      Pass ``train_peak`` snapshotted right after the train segments
+      (bench does): ``peak_bytes_in_use`` is a process-lifetime
+      high-water mark, so a call-time read after predict/serve would
+      attribute THEIR peaks to training.  Without a snapshot the field
+      is the process peak so far.
+    * ``phase_peak_hbm_bytes`` — phase -> watermark dict (None on CPU),
+    * ``program_costs`` — the CompileLedger's per-site cost rollup
+      (flops / bytes accessed everywhere; temp/arg/output bytes only
+      where a compiled memory_analysis exists — None per field on CPU
+      unless ``memory=True`` forces the recompile-based capture).
+
+    Explicit None beats silent absence: a reader of the JSON can tell
+    "not measurable on this backend" from "forgot to measure".
+    """
+    if ledger is None:
+        from ..utils.compile_ledger import LEDGER as ledger
+    peaks = phase_peaks()
+    return {
+        "train_peak_hbm_bytes": (peak_hbm_bytes() if train_peak is None
+                                 else train_peak),
+        "phase_peak_hbm_bytes": peaks if peaks else None,
+        "program_costs": ledger.cost_table(memory=memory) or None,
+    }

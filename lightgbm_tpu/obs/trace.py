@@ -122,12 +122,22 @@ def configure(mode: Optional[str] = None,
 
 
 def configure_from_config(config) -> None:
-    """Apply ``tpu_telemetry`` / ``tpu_trace_dir`` from a Config.  The
-    registry default "" means UNSET (leave the process policy); an
-    explicit value — including "off" — really applies."""
+    """Apply the ``tpu_telemetry`` / ``tpu_trace_dir`` / ``tpu_obs_*``
+    params from a Config.  The registry defaults ("" / 0) mean UNSET
+    (leave the process policy); an explicit value — including "off" —
+    really applies."""
     mode = str(config.tpu_telemetry).strip()
     tdir = str(config.tpu_trace_dir).strip()
     configure(mode=mode or None, trace_dir=tdir or None)
+    from . import flightrecorder, metrics
+
+    ring = int(config.tpu_obs_ring_samples)
+    if ring > 0:
+        metrics.set_sample_ring(ring)
+    bb_events = int(config.tpu_obs_blackbox_events)
+    bb_dir = str(config.tpu_obs_blackbox_dir).strip()
+    flightrecorder.configure(events=bb_events if bb_events > 0 else None,
+                             dump_dir=bb_dir or None)
 
 
 def _env_init() -> None:
@@ -262,6 +272,12 @@ def timed(name: str, metric: str = "lgbm_timed_seconds"):
 # ---------------------------------------------------------------------------
 def _record(ev: Dict) -> None:
     global _dropped
+    # mirror into the always-on flight recorder FIRST: the blackbox
+    # ring is independently bounded, so a full trace buffer (the
+    # _EVENT_CAP drop path below) must not silence it
+    from . import flightrecorder
+
+    flightrecorder.note(ev["kind"], ev["name"], **(ev["tags"] or {}))
     with _events_lock:
         if len(_events) >= _EVENT_CAP:
             _dropped += 1

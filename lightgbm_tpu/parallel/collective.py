@@ -179,6 +179,11 @@ def guarded_collective(fn: Callable, *args,
     attempt = 0
     while True:
         attempt += 1
+        # flight recorder (always-on): the begin entry is what names
+        # the hung site in a blackbox dump — a collective that never
+        # returns leaves a span_begin with no span_end
+        obs.flightrecorder.note("span_begin", f"collective/{name}",
+                                attempt=attempt, host=me)
         try:
             try:
                 drop = faultline.fire("host_drop", name=name, host=me)
@@ -219,6 +224,8 @@ def guarded_collective(fn: Callable, *args,
                     result = _run_with_deadline(fn, args, kwargs, name,
                                                 timeout_s, attempt)
             _note_wait(name, time.perf_counter() - t_wait)
+            obs.flightrecorder.note("span_end", f"collective/{name}",
+                                    attempt=attempt, host=me)
             if attempt > 1:
                 # a retried collective that finally succeeded is a
                 # RECOVERY — the event PR 8's watchdogs had no way to
@@ -236,12 +243,25 @@ def guarded_collective(fn: Callable, *args,
                     help="watchdog deadline expiries", name=name)
                 obs.event("collective_timeout", name=name,
                           timeout_s=timeout_s, attempt=attempt)
+                # the evidence of WHAT hung must outlive the process:
+                # ring the transition and flush the blackbox before the
+                # structured error starts unwinding the train loop
+                obs.flightrecorder.note("watchdog", "collective_timeout",
+                                        name=name, timeout_s=timeout_s,
+                                        attempt=attempt, host=me)
+                obs.flightrecorder.dump("collective_timeout", exc=exc)
             elif isinstance(exc, HostDropped):
                 obs.REGISTRY.inc("lgbm_collective_host_drops_total",
                                  name=name)
                 obs.event("host_dropped", name=name, host=me)
+                obs.flightrecorder.note("watchdog", "host_dropped",
+                                        name=name, host=me)
+                obs.flightrecorder.dump("host_dropped", exc=exc)
             raise
         except Exception as exc:  # noqa: BLE001 - transient transport error
+            obs.flightrecorder.note("watchdog", "collective_error",
+                                    name=name, attempt=attempt,
+                                    error=type(exc).__name__)
             if attempt > retries:
                 raise
             obs.REGISTRY.inc("lgbm_collective_retries_total",

@@ -30,6 +30,7 @@ import numpy as np
 from ..config import Config, parse_tristate
 from ..ops.predict import _depth_bucket, predict_row_buckets, row_bucket
 from ..utils import faultline
+from ..utils.log import Log
 from .stats import CircuitBreaker, ServingStats
 
 
@@ -63,8 +64,20 @@ class ModelEntry:
         self.device_on = (mode == "true"
                           and drv._pred_context() is not None
                           and booster.num_trees() > 0)
+        self.hbm_bytes = 0
         if self.device_on:
             drv._packed_forest()  # pack + upload the forest tables once
+            # what this model actually costs on device: the packed
+            # table bytes at the num_iteration a default request slices
+            # to — the capacity unit LRU eviction reports in (bytes,
+            # not model count; ROADMAP 2c's quantized tables shrink it)
+            total, _ = drv._model_subset(self.default_num_iteration())
+            self.hbm_bytes = sum(
+                int(v.nbytes)
+                for v in drv._packed_forest().device(total).values())
+        # the gauge is set by ModelRegistry.load's registration block,
+        # not here: a load that fails after construction (warmup error)
+        # must not leave a phantom per-model series
         # circuit breaker on the device path: threshold failures open it
         # (requests short-circuit to the native walker), a timed
         # half-open probe retries the device path
@@ -225,6 +238,7 @@ class ModelEntry:
                 "num_feature": self.num_feature,
                 "num_trees": self.booster.num_trees(),
                 "device": bool(self.device_on),
+                "hbm_bytes": int(self.hbm_bytes),
                 "breaker": self.breaker.state,
                 "healthy": self.healthy}
 
@@ -302,6 +316,7 @@ class ModelRegistry:
         with self._lock:
             self._entries[entry.key] = entry
             self._entries.move_to_end(entry.key)
+            self.stats.set_model_hbm(entry.key, entry.hbm_bytes)
             # atomic flip (hot-swap) — but never BACKWARDS: concurrent
             # loads finish warmup in arbitrary order, and last-finisher-
             # wins would let a stale version steal the alias
@@ -335,8 +350,15 @@ class ModelRegistry:
                 victim = next(iter(self._entries))
                 self._latest = {n: k for n, k in self._latest.items()
                                 if k != victim}
+            freed = int(self._entries[victim].hbm_bytes)
             del self._entries[victim]
             self.stats.count("models_evicted")
+            self.stats.clear_model_hbm(victim)
+            Log.info(f"serving registry evicted {victim}: freed {freed} "
+                     "device bytes "
+                     f"({len(self._entries)}/{cap} models resident)")
+        self.stats.set_total_hbm(sum(e.hbm_bytes
+                                     for e in self._entries.values()))
 
     # ------------------------------------------------------------------
     def resolve(self, name: str) -> ModelEntry:
@@ -364,6 +386,13 @@ class ModelRegistry:
                            if e.name == name]
             removed = [self._entries.pop(k) for k in victims
                        if k in self._entries]
+            for e in removed:
+                self.stats.clear_model_hbm(e.key)
+                if e.hbm_bytes:
+                    Log.info(f"serving registry unloaded {e.key}: freed "
+                             f"{int(e.hbm_bytes)} device bytes")
+            self.stats.set_total_hbm(sum(
+                s.hbm_bytes for s in self._entries.values()))
             gone = set(victims)
             self._latest = {n: k for n, k in self._latest.items()
                             if k not in gone and n != name}

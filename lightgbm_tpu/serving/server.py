@@ -112,15 +112,34 @@ class ServingSession:
     def stats(self) -> Dict:
         out = self._stats.snapshot()
         out.update(self.admission.snapshot())
+        # process-runtime gauges (ISSUE 12): RSS / uptime / threads /
+        # fds / GC — scrape-time reads, same values /metrics exports
+        from ..obs import resources
+
+        out.update(resources.process_runtime_stats())
         return out
+
+    def blackbox(self) -> Dict:
+        """The live flight-recorder ring (GET /debug/blackbox): what
+        this process was recently doing, without waiting for it to die
+        and dump."""
+        from ..obs import flightrecorder
+        from ..utils import faultline
+
+        return {"host": faultline.host_index(),
+                "ring_depth": flightrecorder.depth(),
+                "last_dump": flightrecorder.last_dump(),
+                "entries": flightrecorder.entries()}
 
     def metrics_text(self) -> str:
         """Prometheus exposition text: the process-global registry
         (train/collective/checkpoint/phase metrics) plus this session's
         serving metrics.  The serving latency histogram here and the
-        `/stats` percentiles derive from the SAME buckets."""
-        from ..obs import REGISTRY
+        `/stats` percentiles derive from the SAME buckets; the
+        process-runtime gauges are refreshed per scrape."""
+        from ..obs import REGISTRY, resources
 
+        resources.publish_process_gauges(REGISTRY)
         return REGISTRY.to_prometheus_text() + self._stats.to_prometheus_text()
 
     # ------------------------------------------------------------------
@@ -301,6 +320,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._text(200, session.metrics_text())
         elif self.path == "/models":
             self._json(200, {"models": session.models()})
+        elif self.path == "/debug/blackbox":
+            # the live flight-recorder ring: the postmortem view
+            # WITHOUT the mortem
+            self._json(200, session.blackbox())
         elif self.path == "/healthz":
             if session.admission.draining:
                 # draining replicas must fall out of load-balancer

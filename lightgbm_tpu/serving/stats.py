@@ -108,6 +108,9 @@ class CircuitBreaker:
                 self._entered_at = time.monotonic()
                 if self.stats is not None:
                     self.stats.count("breaker_halfopen_probes")
+                from ..obs import flightrecorder
+
+                flightrecorder.note("breaker", "half_open")
                 return True
             return False
 
@@ -123,6 +126,10 @@ class CircuitBreaker:
                 # half-open probe (which carries a fresh generation),
                 # never through an unattributed late success
                 return
+            if self.state != "closed":
+                from ..obs import flightrecorder
+
+                flightrecorder.note("breaker", "closed")
             self.state = "closed"
             self._failures = 0
 
@@ -131,8 +138,13 @@ class CircuitBreaker:
             self._gen += 1
             self._failures += 1
             if self.state == "half_open" or self._failures >= self.threshold:
-                if self.state != "open" and self.stats is not None:
-                    self.stats.count("breaker_open")
+                if self.state != "open":
+                    if self.stats is not None:
+                        self.stats.count("breaker_open")
+                    from ..obs import flightrecorder
+
+                    flightrecorder.note("breaker", "open",
+                                        failures=self.threshold)
                 self.state = "open"
                 self._entered_at = time.monotonic()
                 self._failures = 0
@@ -232,6 +244,31 @@ class ServingStats:
                                 help="projected new-request latency "
                                      "(queue-wait p99 + dispatch p95)")
 
+    def set_model_hbm(self, key: str, nbytes: int) -> None:
+        """Per-model device-table bytes gauge (load / hot-swap sets it,
+        unload / LRU eviction zeroes it): the unit `serving_max_models`
+        should have counted in — quantized tables (ROADMAP 2c) make
+        "models" the wrong capacity unit, bytes the right one."""
+        self.registry.set_gauge("lgbm_serving_model_hbm_bytes",
+                                int(nbytes),
+                                help="packed device-table bytes of one "
+                                     "resident model",
+                                model=str(key))
+
+    def clear_model_hbm(self, key: str) -> None:
+        """Remove a departed model's gauge series entirely (unload /
+        LRU eviction): a zeroed-but-resident series per version ever
+        loaded would grow /metrics without bound on a hot-swapping
+        server."""
+        self.registry.remove("lgbm_serving_model_hbm_bytes",
+                             model=str(key))
+
+    def set_total_hbm(self, nbytes: int) -> None:
+        self.registry.set_gauge("lgbm_serving_models_hbm_bytes",
+                                int(nbytes),
+                                help="packed device-table bytes across "
+                                     "all resident models")
+
     def snapshot_queue_depth(self) -> int:
         """Cheap queue-depth read for the per-request admission gate
         (the full snapshot() walks every counter)."""
@@ -239,8 +276,9 @@ class ServingStats:
             return self._queue_depth
 
     # -- admission feedback --------------------------------------------
-    # samples the AIMD projection reads from each ring; must not exceed
-    # obs.metrics._SAMPLE_RING or the window silently shrinks
+    # samples the AIMD projection reads from each ring; capped by the
+    # configured obs.metrics sample ring (tpu_obs_ring_samples) — a
+    # smaller ring legitimately narrows the projection window
     _RECENT = 256
 
     def recent_wait_profile(self):

@@ -56,6 +56,38 @@ def _describe_leaf(x: Any) -> str:
     return type(x).__name__
 
 
+def _spec_leaf(x: Any) -> Any:
+    """Array leaf -> ShapeDtypeStruct (re-lowerable after the original
+    buffers are donated/freed); everything else passes through."""
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return jax.ShapeDtypeStruct(tuple(shape), dtype,
+                                    weak_type=bool(getattr(x, "weak_type",
+                                                           False)))
+    return x
+
+
+def call_specs(args: tuple, kwargs: dict, static_argnums=(),
+               static_argnames=()) -> tuple:
+    """(args, kwargs) with every NON-STATIC array replaced by its
+    ShapeDtypeStruct — the re-lowerable coordinates of one compiled
+    program, captured BEFORE the call so donation cannot invalidate
+    them.  Static args stay as their hashable values (a struct there
+    would trace a different program)."""
+    import jax.tree_util as jtu
+
+    static_argnums = set(static_argnums or ())
+    static_argnames = set(static_argnames or ())
+    spec_args = tuple(
+        a if i in static_argnums else jtu.tree_map(_spec_leaf, a)
+        for i, a in enumerate(args))
+    spec_kwargs = {
+        k: (v if k in static_argnames else jtu.tree_map(_spec_leaf, v))
+        for k, v in kwargs.items()}
+    return spec_args, spec_kwargs
+
+
 def call_signature(args: tuple, kwargs: dict) -> str:
     """One-line signature of a jit call: static values + array avals.
 
@@ -82,6 +114,7 @@ class CompileLedger:
     def __init__(self):
         self._lock = threading.Lock()
         self._enabled = False
+        self._capture = False
         self._programs: List[Dict] = []
 
     # -- control -------------------------------------------------------
@@ -92,16 +125,29 @@ class CompileLedger:
     def enable(self, on: bool = True) -> None:
         self._enabled = bool(on)
 
+    @property
+    def capture_costs(self) -> bool:
+        return self._capture
+
+    def enable_capture(self, on: bool = True) -> None:
+        """Additionally capture each new program's re-lowerable call
+        specs so `analyze()` can attach its static cost/memory analysis
+        (ISSUE 12).  Off by default: spec capture is cheap but not
+        free, and only resource-accounting callers (bench,
+        perf_probe mem) read it."""
+        self._capture = bool(on)
+
     def reset(self) -> None:
         with self._lock:
             self._programs = []
 
     # -- recording (called by LedgeredJit) ------------------------------
-    def record(self, site: str, signature: str, wall_s: float) -> None:
+    def record(self, site: str, signature: str, wall_s: float,
+               aot=None) -> None:
         with self._lock:
             self._programs.append({"site": site, "signature": signature,
                                    "first_call_s": wall_s,
-                                   "t": time.time()})
+                                   "t": time.time(), "_aot": aot})
 
     # -- reading --------------------------------------------------------
     def n_programs(self, site: Optional[str] = None) -> int:
@@ -113,7 +159,97 @@ class CompileLedger:
 
     def programs(self) -> List[Dict]:
         with self._lock:
-            return [dict(p) for p in self._programs]
+            return [{k: v for k, v in p.items() if k != "_aot"}
+                    for p in self._programs]
+
+    # -- static cost/memory analysis (ISSUE 12) -------------------------
+    @staticmethod
+    def _memory_default() -> bool:
+        """memory_analysis needs a fresh AOT compile per program (jax
+        gives no handle on the jit cache's own executable), so the
+        auto policy pays it only where HBM numbers exist to read back;
+        on CPU the table carries flops/bytes from the (compile-free)
+        lowered analysis and None for the memory fields."""
+        try:
+            return jax.devices()[0].platform != "cpu"
+        except Exception:  # pragma: no cover - backend init failure
+            return False
+
+    def analyze(self, memory: Optional[bool] = None) -> List[Dict]:
+        """Attach each captured program's `cost_analysis()` (flops,
+        bytes accessed — from the lowering, no compile) and, when
+        `memory` (default: auto — True off-CPU), its compiled
+        `memory_analysis()` (argument / output / temp / generated-code
+        bytes).  Idempotent; failures record None per field rather than
+        raising — a program that cannot re-lower (mesh-sharded specs,
+        exotic statics) still keeps its ledger entry."""
+        if memory is None:
+            memory = self._memory_default()
+        with self._lock:
+            # re-analyze when memory is requested but a prior pass
+            # (auto: memory=False on CPU) SKIPPED it — "mem" absent
+            # means not yet attempted; "mem": None means a real attempt
+            # FAILED and must not be re-paid (a failing re-lower would
+            # otherwise re-run its AOT attempt on every call)
+            todo = [p for p in self._programs
+                    if p.get("_aot") is not None
+                    and ("cost" not in p or (memory and "mem" not in p))]
+        for p in todo:
+            fn, spec_args, spec_kwargs = p["_aot"]
+            cost = None
+            lowered = None
+            try:
+                lowered = fn.lower(*spec_args, **spec_kwargs)
+                ca = lowered.cost_analysis() or {}
+                cost = {"flops": float(ca.get("flops", 0.0)),
+                        "bytes_accessed": float(
+                            ca.get("bytes accessed", 0.0))}
+            except Exception:
+                cost = None
+            updates = {"cost": cost}
+            if lowered is None:
+                updates["mem"] = None          # can never re-lower
+            elif memory:
+                try:
+                    ms = lowered.compile().memory_analysis()
+                    updates["mem"] = {
+                        "argument_bytes": int(ms.argument_size_in_bytes),
+                        "output_bytes": int(ms.output_size_in_bytes),
+                        "temp_bytes": int(ms.temp_size_in_bytes),
+                        "alias_bytes": int(ms.alias_size_in_bytes),
+                        "generated_code_bytes": int(
+                            ms.generated_code_size_in_bytes),
+                    }
+                except Exception:
+                    updates["mem"] = None      # attempted and failed
+            with self._lock:
+                p.update(updates)
+        return self.programs()
+
+    def cost_table(self, memory: Optional[bool] = None) -> List[Dict]:
+        """Per-program cost rows for the bench JSON / perf_probe mem
+        table: site, flops, bytes accessed, and the memory-analysis
+        byte fields (None where unavailable — explicitly null on CPU
+        rather than silently absent)."""
+        rows = []
+        for p in self.analyze(memory=memory):
+            cost, mem = p.get("cost"), p.get("mem")
+            rows.append({
+                "site": p["site"],
+                "signature": p["signature"][:160],
+                "first_call_s": round(p["first_call_s"], 3),
+                "flops": None if cost is None else cost["flops"],
+                "bytes_accessed": (None if cost is None
+                                   else cost["bytes_accessed"]),
+                "argument_bytes": None if mem is None
+                else mem["argument_bytes"],
+                "output_bytes": None if mem is None
+                else mem["output_bytes"],
+                "temp_bytes": None if mem is None else mem["temp_bytes"],
+                "generated_code_bytes": (None if mem is None
+                                         else mem["generated_code_bytes"]),
+            })
+        return rows
 
     def report(self) -> List[Dict]:
         """Per-site rollup sorted by total first-call wall, descending."""
@@ -156,6 +292,14 @@ class LedgeredJit:
     def __init__(self, fn, site: Optional[str] = None, **jit_kwargs):
         self._fn = jax.jit(fn, **jit_kwargs)
         self.site = site or getattr(fn, "__name__", "<fn>")
+        def _as_tuple(v):
+            if v is None:
+                return ()
+            return (v,) if isinstance(v, (int, str)) else tuple(v)
+
+        self._static_argnums = _as_tuple(jit_kwargs.get("static_argnums"))
+        self._static_argnames = _as_tuple(
+            jit_kwargs.get("static_argnames"))
         self._seen_sigs = set()
         # serializes the (cache-size, call, cache-size) window while the
         # ledger is ENABLED: without it, a thread's cache-hit call that
@@ -170,6 +314,21 @@ class LedgeredJit:
         except Exception:
             return None
 
+    def _capture_specs(self, args, kwargs):
+        """Re-lowerable specs of one call, built only on the RARE
+        new-program branch (never on cache hits — a per-call pytree
+        walk under the lock would tax every timed loop the bench
+        gates).  Safe AFTER the call: shape/dtype metadata stays
+        readable on donated-and-deleted arrays."""
+        if not LEDGER.capture_costs:
+            return None
+        try:
+            specs = call_specs(args, kwargs, self._static_argnums,
+                               self._static_argnames)
+        except Exception:  # pragma: no cover - exotic pytree
+            return None
+        return (self._fn, *specs)
+
     def __call__(self, *args, **kwargs):
         if not LEDGER.enabled:
             return self._fn(*args, **kwargs)
@@ -183,10 +342,12 @@ class LedgeredJit:
                 if sig not in self._seen_sigs:
                     self._seen_sigs.add(sig)
                     LEDGER.record(self.site, sig,
-                                  time.perf_counter() - t0)
+                                  time.perf_counter() - t0,
+                                  aot=self._capture_specs(args, kwargs))
             elif after is not None and after > before:
                 LEDGER.record(self.site, call_signature(args, kwargs),
-                              time.perf_counter() - t0)
+                              time.perf_counter() - t0,
+                              aot=self._capture_specs(args, kwargs))
         return out
 
     def __getattr__(self, name):

@@ -215,11 +215,14 @@ def fire(point: str, **info) -> Optional[str]:
         return None
     # a FIRED fault is rare and always worth counting; lazy import keeps
     # the harness importable before the package (and cycle-free)
+    from ..obs import flightrecorder
     from ..obs.metrics import REGISTRY
 
     REGISTRY.inc("lgbm_fault_injections_total",
                  help="armed faultline specs that actually fired",
                  point=point, action=matched.action)
+    # the blackbox of a chaos run must show the injection that killed it
+    flightrecorder.note("fault", point, action=matched.action, **info)
     if matched.action == "raise":
         exc = matched.exc
         if isinstance(exc, type):

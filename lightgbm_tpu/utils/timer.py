@@ -31,6 +31,7 @@ from typing import Dict, Iterator
 
 from ..obs import REGISTRY, span
 from ..obs import metrics_on as _obs_metrics_on
+from ..obs import resources as _resources
 from .log import Log
 
 _enabled = os.environ.get("LIGHTGBM_TPU_TIMETAG", "") not in ("", "0")
@@ -55,17 +56,28 @@ def _record(name: str, seconds: float) -> None:
     REGISTRY.inc(_RUNS, 1, phase=name)
 
 
+# phases whose wall bracket doubles as a device-memory watermark
+# bracket (obs/resources.py phase_peak): the binning phase IS the
+# ingest HBM phase — the chunked device matrix and key planes live
+# inside it
+_MEM_PHASE = {"binning": "ingest"}
+
+
 @contextlib.contextmanager
 def PHASE(name: str) -> Iterator[None]:
     """Accumulate wall time under `name` (no-op unless enabled); a span
-    under tpu_telemetry=trace."""
+    under tpu_telemetry=trace; a device-memory watermark bracket for
+    the phases in `_MEM_PHASE`."""
     if not (_enabled or _obs_metrics_on()):
         yield
         return
     sp = span(name)
+    mem_phase = _MEM_PHASE.get(name)
+    mem = (_resources.phase_peak(mem_phase) if mem_phase
+           else contextlib.nullcontext())
     t0 = time.perf_counter()
     try:
-        with sp:
+        with mem, sp:
             yield
     finally:
         _record(name, time.perf_counter() - t0)

@@ -1,0 +1,303 @@
+"""Flight recorder (ISSUE 12): the always-on bounded blackbox ring.
+
+Covers ring bounds under a 16-thread hammer, blackbox dumps on an
+injected collective hang (the dump's newest entries must NAME the hung
+collective site), dump-on-SIGTERM ordering against the PR-7 checkpoint
+flush (the dump's metric snapshot proves the checkpoint landed first),
+the guard-raise dump, breaker/fault transitions ringing, the
+tpu_obs_* configuration wiring, and the off-mode overhead of a ring
+note staying negligible beside a training iteration.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import flightrecorder as fr
+from lightgbm_tpu.parallel.collective import CollectiveTimeout
+from lightgbm_tpu.parallel.metric_sync import sync_sums
+from lightgbm_tpu.utils import faultline
+
+_P = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+      "learning_rate": 0.1, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _problem(n=800, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder(tmp_path, monkeypatch):
+    """Every test gets a fresh ring and a sandboxed dump dir."""
+    monkeypatch.setenv("LIGHTGBM_TPU_BLACKBOX_DIR", str(tmp_path))
+    fr.reset()
+    faultline.reset()
+    yield
+    faultline.reset()
+    fr.reset()
+    fr.configure(events=fr.DEFAULT_EVENTS, dump_dir="")
+
+
+def _read_dump(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# the ring
+# ---------------------------------------------------------------------------
+class TestRing:
+    def test_ring_is_bounded_and_keeps_newest(self):
+        fr.configure(events=64)
+        for i in range(500):
+            fr.note("k", f"e{i}", i=i)
+        ents = fr.entries()
+        assert len(ents) == 64
+        assert ents[-1]["name"] == "e499"
+        assert ents[0]["name"] == "e436"
+
+    def test_sixteen_thread_hammer_never_exceeds_bound(self):
+        """16 threads x 2000 notes: the ring stays exactly bounded,
+        every surviving entry is well-formed, and no note is lost from
+        the newest window (GIL-atomic deque appends, no lock)."""
+        fr.configure(events=256)
+        threads, per = 16, 2000
+        barrier = threading.Barrier(threads)
+
+        def hammer(t):
+            barrier.wait()
+            for i in range(per):
+                fr.note("hammer", f"t{t}", i=i)
+
+        ws = [threading.Thread(target=hammer, args=(t,))
+              for t in range(threads)]
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join()
+        ents = fr.entries()
+        assert len(ents) == 256
+        for e in ents:
+            assert e["kind"] == "hammer"
+            assert e["name"].startswith("t")
+            assert isinstance(e["fields"]["i"], int)
+        # the newest entry overall must be some thread's LAST note
+        assert ents[-1]["fields"]["i"] == per - 1
+
+    def test_resize_keeps_newest_entries(self):
+        fr.configure(events=128)
+        for i in range(128):
+            fr.note("k", f"e{i}")
+        fr.configure(events=32)
+        ents = fr.entries()
+        assert len(ents) == 32 and ents[-1]["name"] == "e127"
+
+    def test_config_wiring_from_params(self):
+        """tpu_obs_blackbox_events / tpu_obs_blackbox_dir ride
+        obs.configure_from_config; 0/"" leave the policy untouched."""
+        from lightgbm_tpu.config import Config
+
+        fr.configure(events=100, dump_dir="")
+        obs.configure_from_config(Config({}))  # defaults: no clobber
+        assert fr.depth() == 100
+        obs.configure_from_config(Config({
+            "tpu_obs_blackbox_events": 48,
+            "tpu_obs_blackbox_dir": "/tmp/some-bb"}))
+        assert fr.depth() == 48
+        assert fr.blackbox_dir() == "/tmp/some-bb"
+        fr.configure(dump_dir="")
+
+
+# ---------------------------------------------------------------------------
+# blackbox dumps
+# ---------------------------------------------------------------------------
+class TestDump:
+    def test_dump_is_atomic_json_with_metrics_snapshot(self, tmp_path):
+        fr.note("k", "breadcrumb", detail="x")
+        obs.REGISTRY.inc("lgbm_test_dump_counter_total", 3)
+        path = fr.dump("unit_test")
+        assert path == str(tmp_path / "blackbox-host0.json")
+        rec = _read_dump(path)
+        assert rec["reason"] == "unit_test"
+        assert rec["entries"][-1]["name"] == "breadcrumb"
+        assert rec["metrics"]["lgbm_test_dump_counter_total"] == 3
+        assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
+
+    def test_dump_on_injected_collective_hang_names_the_site(self,
+                                                             tmp_path):
+        """The acceptance scenario: a faultline collective_sync hang
+        kills the collective; the blackbox left behind must show the
+        IN-FLIGHT collective span (a span_begin with no span_end) in
+        its newest entries."""
+        faultline.arm("collective_sync", action="hang")
+        with pytest.raises(CollectiveTimeout):
+            sync_sums([1.0])
+        path = str(tmp_path / "blackbox-host0.json")
+        assert os.path.exists(path)
+        rec = _read_dump(path)
+        assert rec["reason"] == "collective_timeout"
+        tail = rec["entries"][-4:]
+        begins = [e for e in tail if e["kind"] == "span_begin"
+                  and e["name"].startswith("collective/")]
+        assert begins, f"no in-flight collective in dump tail: {tail}"
+        hung = begins[-1]["name"]
+        ends = [e for e in tail if e["kind"] == "span_end"
+                and e["name"] == hung]
+        assert not ends, "the hung collective must have no span_end"
+        # and the structured transition rode the ring too
+        assert any(e["name"] == "collective_timeout" for e in tail)
+
+    def test_dump_on_hang_mid_train_via_engine(self, tmp_path):
+        """The full path: an armed hang inside a training run's metric
+        sync surfaces CollectiveTimeout through lgb.train, and the
+        blackbox names the collective plus the round it died in."""
+        X, y = _problem()
+        ds = lgb.Dataset(X, label=y, params=_P)
+        dv = lgb.Dataset(X[:200], label=y[:200], reference=ds, params=_P)
+        faultline.arm("collective_sync", action="hang", at=3,
+                      absolute=True)
+        with pytest.raises(CollectiveTimeout):
+            lgb.train(dict(_P), ds, num_boost_round=6, valid_sets=[dv],
+                      verbose_eval=False, keep_training_booster=True)
+        rec = _read_dump(str(tmp_path / "blackbox-host0.json"))
+        names = [e["name"] for e in rec["entries"]]
+        assert any(n.startswith("collective/") for n in names)
+        assert "train/round" in names  # the always-on per-round entry
+        assert any(e["kind"] == "fault" for e in rec["entries"])
+
+    def test_dump_on_sigterm_orders_after_checkpoint_flush(self,
+                                                           tmp_path):
+        """SIGTERM mid-train (the engine maps it to KeyboardInterrupt)
+        must flush the PR-7 checkpoint FIRST, then dump the blackbox —
+        proven by the dump's own metric snapshot carrying the flush's
+        write counter."""
+        import signal
+
+        from lightgbm_tpu.utils.checkpoint import CheckpointManager
+
+        ck = tmp_path / "ck"
+        writes_before = obs.REGISTRY.value("lgbm_checkpoint_writes_total")
+
+        def bomb(env):
+            if env.iteration == 2:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        X, y = _problem()
+        p = dict(_P, tpu_checkpoint_dir=str(ck))
+        ds = lgb.Dataset(X, label=y, params=p)
+        with pytest.raises(KeyboardInterrupt):
+            lgb.train(p, ds, num_boost_round=8, callbacks=[bomb],
+                      verbose_eval=False, keep_training_booster=True)
+        found = CheckpointManager(str(ck)).load_latest()
+        assert found is not None and found[0] >= 1
+        rec = _read_dump(str(tmp_path / "blackbox-host0.json"))
+        assert rec["reason"].startswith("train_interrupt")
+        assert rec["exception"]["type"] == "KeyboardInterrupt"
+        writes_in_dump = rec["metrics"].get(
+            "lgbm_checkpoint_writes_total", 0)
+        assert writes_in_dump > writes_before, (
+            "the blackbox snapshot must include the final checkpoint "
+            "flush — dump ran before the flush?")
+
+    def test_dump_on_guard_raise(self, tmp_path):
+        from lightgbm_tpu.booster import Booster
+        from lightgbm_tpu.utils.log import LightGBMError
+
+        X, y = _problem()
+        p = dict(_P, tpu_guard_numerics="raise")
+        bst = Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+        faultline.arm("grow_step", action="poison", at=2)
+        with pytest.raises(LightGBMError):
+            for _ in range(4):
+                bst.update()
+        rec = _read_dump(str(tmp_path / "blackbox-host0.json"))
+        assert rec["reason"] == "guard_raise"
+        assert any(e["name"] == "guard_poisoned"
+                   for e in rec["entries"])
+
+    def test_dump_on_unhandled_thread_exception(self, tmp_path):
+        """sys.excepthook never fires for worker threads; the chained
+        threading.excepthook must dump for the multithreaded serving
+        runtime's deaths too."""
+        def die():
+            raise RuntimeError("worker died")
+
+        t = threading.Thread(target=die, name="doomed")
+        t.start()
+        t.join()
+        path = str(tmp_path / "blackbox-host0.json")
+        assert os.path.exists(path)
+        rec = _read_dump(path)
+        assert rec["reason"] == "unhandled_thread_exception"
+        crash = rec["entries"][-1]
+        assert crash["fields"]["thread"] == "doomed"
+        assert "worker died" in crash["fields"]["message"]
+
+    def test_repeated_dumps_overwrite_in_place(self, tmp_path):
+        fr.note("k", "first")
+        fr.dump("one")
+        fr.note("k", "second")
+        path = fr.dump("two")
+        rec = _read_dump(path)
+        assert rec["reason"] == "two"
+        assert [p for p in os.listdir(tmp_path)
+                if p.startswith("blackbox-")] == ["blackbox-host0.json"]
+
+
+# ---------------------------------------------------------------------------
+# transition sources
+# ---------------------------------------------------------------------------
+class TestTransitions:
+    def test_breaker_transitions_ring(self):
+        from lightgbm_tpu.serving.stats import CircuitBreaker, ServingStats
+
+        br = CircuitBreaker(threshold=2, cooldown_s=0.01,
+                            stats=ServingStats())
+        br.record_failure()
+        br.record_failure()          # -> open
+        time.sleep(0.02)
+        assert br.allow()            # -> half_open
+        br.record_success(br.generation)  # -> closed
+        names = [e["name"] for e in fr.entries()
+                 if e["kind"] == "breaker"]
+        assert names == ["open", "half_open", "closed"]
+
+    def test_trace_mode_mirrors_spans_into_ring(self):
+        prev = obs.mode()
+        obs.configure(mode="trace")
+        try:
+            with obs.span("train/iteration", iteration=7):
+                pass
+        finally:
+            obs.configure(mode=prev or "off")
+        spans = [e for e in fr.entries() if e["kind"] == "span"]
+        assert any(e["name"] == "train/iteration" for e in spans)
+
+
+# ---------------------------------------------------------------------------
+# overhead: a ring note beside the existing <1% telemetry gate
+# ---------------------------------------------------------------------------
+class TestNoteOverhead:
+    def test_note_cost_is_microseconds(self):
+        """The always-on note must stay ring-cheap: recorded once per
+        ROUND / collective / transition, so even a conservative 10us
+        bound keeps it orders of magnitude under the 1% off-mode gate
+        (training rounds are milliseconds at minimum)."""
+        reps = 20000
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            for i in range(reps):
+                fr.note("bench", "train/round", iteration=i)
+            best = min(best, (time.perf_counter() - t0) / reps)
+        assert best < 10e-6, f"flight-recorder note costs {best * 1e6:.2f}us"

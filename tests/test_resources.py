@@ -1,0 +1,379 @@
+"""Resource observability (ISSUE 12): device memory/cost accounting.
+
+Covers the CPU memory_stats-None graceful fallback, phase watermarks,
+process-runtime gauges on /stats and /metrics, the CompileLedger's
+per-program cost capture (flops populated everywhere, memory fields
+explicitly None on CPU unless forced), the configurable histogram
+sample ring + truncation reporting, the serving registry's
+serve_model_hbm_bytes gauge with bytes-freed eviction, and the tier-1
+smoke that the bench record's resource fields exist (populated or
+explicitly null on CPU).
+"""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.obs import metrics as obs_metrics
+from lightgbm_tpu.obs import resources
+from lightgbm_tpu.utils.compile_ledger import LEDGER, ledger_jit
+
+_P = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+      "learning_rate": 0.1, "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def _problem(n=600, f=5, seed=1):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] - 0.3 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(autouse=True)
+def _restore():
+    prev_ring = obs_metrics.sample_ring()
+    yield
+    obs_metrics.set_sample_ring(prev_ring)
+    resources.reset_phase_peaks()
+    LEDGER.enable_capture(False)
+    LEDGER.enable(False)
+    LEDGER.reset()
+
+
+# ---------------------------------------------------------------------------
+# device memory: the CPU None contract
+# ---------------------------------------------------------------------------
+class TestDeviceMemory:
+    def test_cpu_memory_stats_is_none(self):
+        import jax
+
+        if jax.devices()[0].platform != "cpu":
+            pytest.skip("CPU-backend fallback contract")
+        assert resources.device_memory_stats() is None
+        assert resources.peak_hbm_bytes() is None
+        assert resources.hbm_bytes_in_use() is None
+        assert all(s is None
+                   for s in resources.all_device_memory_stats())
+
+    def test_phase_peak_graceful_on_cpu(self):
+        """The bracket must run the body exactly once and record
+        nothing when the backend reports no memory stats."""
+        prev = obs.mode()
+        obs.configure(mode="metrics")
+        try:
+            ran = []
+            with resources.phase_peak("hist_build"):
+                ran.append(1)
+            assert ran == [1]
+            assert resources.phase_peaks() == {}
+        finally:
+            obs.configure(mode=prev or "off")
+
+    def test_phase_peak_noop_when_telemetry_off(self):
+        assert obs.mode() == "off"
+        with resources.phase_peak("predict"):
+            pass
+        assert resources.phase_peaks() == {}
+
+    def test_watermark_bookkeeping(self):
+        """The max-wins phase table + gauge, independent of backend."""
+        resources._note_phase_peak("hist_build", 100)
+        resources._note_phase_peak("hist_build", 50)   # not a new peak
+        resources._note_phase_peak("ingest", 70)
+        assert resources.phase_peaks() == {"hist_build": 100,
+                                           "ingest": 70}
+        assert obs.REGISTRY.value("lgbm_device_phase_peak_bytes",
+                                  phase="hist_build") == 100
+        resources.reset_phase_peaks()
+        assert resources.phase_peaks() == {}
+
+
+# ---------------------------------------------------------------------------
+# process runtime stats
+# ---------------------------------------------------------------------------
+class TestProcessStats:
+    def test_values_are_sane(self):
+        st = resources.process_runtime_stats()
+        assert st["process_rss_bytes"] > 1 << 20      # > 1 MiB
+        assert st["process_uptime_s"] > 0
+        assert st["process_threads"] >= 1
+        assert st["process_open_fds"] >= 3            # stdio at least
+        assert st["process_gc_collections"] >= 0
+
+    def test_publish_gauges_exports_prometheus_text(self):
+        reg = obs_metrics.MetricsRegistry()
+        resources.publish_process_gauges(reg)
+        text = reg.to_prometheus_text()
+        for name in ("lgbm_process_resident_memory_bytes",
+                     "lgbm_process_uptime_seconds",
+                     "lgbm_process_threads",
+                     "lgbm_process_open_fds",
+                     "lgbm_process_gc_collections"):
+            assert name in text
+
+
+# ---------------------------------------------------------------------------
+# compile-ledger cost capture
+# ---------------------------------------------------------------------------
+class TestLedgerCosts:
+    def test_capture_and_analyze(self):
+        import jax.numpy as jnp
+
+        LEDGER.enable()
+        LEDGER.enable_capture()
+        LEDGER.reset()
+        f = ledger_jit(lambda x: (x * 2.0) @ x.T, site="probe")
+        f(jnp.ones((32, 8), jnp.float32))
+        rows = LEDGER.cost_table(memory=True)
+        assert len(rows) == 1
+        r = rows[0]
+        assert r["site"] == "probe"
+        assert r["flops"] and r["flops"] > 0
+        assert r["bytes_accessed"] > 0
+        # forced memory analysis works even on CPU (AOT recompile)
+        assert r["argument_bytes"] == 32 * 8 * 4
+        assert r["output_bytes"] == 32 * 32 * 4
+        assert r["temp_bytes"] is not None
+        json.dumps(rows)  # bench embeds the table: must be JSON-safe
+
+    def test_memory_fields_null_on_cpu_by_default(self):
+        import jax
+        import jax.numpy as jnp
+
+        if jax.devices()[0].platform != "cpu":
+            pytest.skip("CPU-backend auto policy")
+        LEDGER.enable()
+        LEDGER.enable_capture()
+        LEDGER.reset()
+        f = ledger_jit(lambda x: x + 1, site="cheap")
+        f(jnp.ones((4,)))
+        rows = LEDGER.cost_table()        # memory=None -> auto: off
+        assert rows[0]["flops"] is not None
+        assert rows[0]["temp_bytes"] is None
+        assert rows[0]["argument_bytes"] is None
+
+    def test_capture_survives_donated_buffers(self):
+        import jax.numpy as jnp
+
+        LEDGER.enable()
+        LEDGER.enable_capture()
+        LEDGER.reset()
+        g = ledger_jit(lambda x: x * 3, site="donated",
+                       donate_argnums=(0,))
+        g(jnp.zeros((16,)))               # donation deletes the arg
+        rows = LEDGER.cost_table(memory=True)
+        assert rows[0]["flops"] is not None
+        assert rows[0]["argument_bytes"] is not None
+
+    def test_statics_stay_static_in_specs(self):
+        import jax.numpy as jnp
+
+        LEDGER.enable()
+        LEDGER.enable_capture()
+        LEDGER.reset()
+        f = ledger_jit(lambda x, n: x * n, site="static",
+                       static_argnames=("n",))
+        f(jnp.ones((8,)), n=3)
+        rows = LEDGER.cost_table(memory=True)
+        assert rows[0]["flops"] is not None
+
+    def test_forced_memory_after_auto_pass_fills_the_fields(self):
+        """An auto (memory-off) analyze must not make a later explicit
+        memory=True vacuous — the perf_probe 'forceable on CPU' path."""
+        import jax.numpy as jnp
+
+        LEDGER.enable()
+        LEDGER.enable_capture()
+        LEDGER.reset()
+        f = ledger_jit(lambda x: x * 2, site="refill")
+        f(jnp.ones((8,)))
+        first = LEDGER.cost_table(memory=False)
+        assert first[0]["temp_bytes"] is None
+        forced = LEDGER.cost_table(memory=True)
+        assert forced[0]["argument_bytes"] is not None
+
+    def test_analyze_idempotent_and_no_capture_means_empty(self):
+        import jax.numpy as jnp
+
+        LEDGER.enable()
+        LEDGER.enable_capture(False)
+        LEDGER.reset()
+        f = ledger_jit(lambda x: x - 1, site="plain")
+        f(jnp.ones((8,)))
+        rows = LEDGER.cost_table(memory=True)
+        assert rows[0]["flops"] is None   # nothing captured to analyze
+        assert LEDGER.cost_table(memory=True) == rows
+
+
+# ---------------------------------------------------------------------------
+# histogram sample ring (satellite)
+# ---------------------------------------------------------------------------
+class TestSampleRing:
+    def test_configurable_ring_and_truncation_flag(self):
+        reg = obs_metrics.MetricsRegistry()
+        obs_metrics.set_sample_ring(4)
+        for i in range(3):
+            reg.observe("h", float(i), name="a")
+        samples, trunc = reg.histogram_samples("h", with_truncated=True,
+                                               name="a")
+        assert samples == [0.0, 1.0, 2.0] and trunc is False
+        for i in range(3, 10):
+            reg.observe("h", float(i), name="a")
+        samples, trunc = reg.histogram_samples("h", with_truncated=True,
+                                               name="a")
+        assert samples == [6.0, 7.0, 8.0, 9.0] and trunc is True
+        # legacy single-value call keeps returning the bare list
+        assert reg.histogram_samples("h", name="a") == samples
+
+    def test_wired_from_config(self):
+        from lightgbm_tpu.config import Config
+
+        obs_metrics.set_sample_ring(obs_metrics.DEFAULT_SAMPLE_RING)
+        obs.configure_from_config(Config({}))  # 0 = no clobber
+        assert obs_metrics.sample_ring() == \
+            obs_metrics.DEFAULT_SAMPLE_RING
+        obs.configure_from_config(Config({"tpu_obs_ring_samples": 32}))
+        assert obs_metrics.sample_ring() == 32
+
+
+# ---------------------------------------------------------------------------
+# serving: model HBM gauge + process gauges + blackbox route
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="class")
+def served():
+    from lightgbm_tpu.serving import ServingSession
+    from lightgbm_tpu.serving.server import serve_http
+
+    X, y = _problem()
+    ds = lgb.Dataset(X, label=y, params=_P)
+    bst = Booster(params=dict(_P), train_set=ds)
+    for _ in range(3):
+        bst.update()
+    sess = ServingSession(params={"serving_max_batch_rows": 256,
+                                  "serving_max_models": 2,
+                                  "verbosity": -1})
+    server = serve_http(sess, port=0)
+    base = f"http://127.0.0.1:{server.server_address[1]}"
+    yield sess, bst, base, X
+    server.shutdown()
+    sess.close()
+
+
+class TestServingResources:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.status, r.read().decode()
+
+    def test_model_hbm_gauge_set_on_load(self, served):
+        sess, bst, base, X = served
+        key = sess.load("m", booster=bst)
+        entry = sess.registry.resolve("m")
+        assert entry.hbm_bytes > 0   # packed tables exist (device path)
+        gauge = sess._stats.registry.value(
+            "lgbm_serving_model_hbm_bytes", model=key)
+        assert gauge == entry.hbm_bytes
+        models = {m["key"]: m for m in sess.models()}
+        assert models[key]["hbm_bytes"] == entry.hbm_bytes
+        total = sess._stats.registry.value(
+            "lgbm_serving_models_hbm_bytes")
+        assert total >= entry.hbm_bytes
+
+    def test_eviction_zeroes_gauge_and_logs_bytes_freed(self, served):
+        from lightgbm_tpu.utils.log import LOG_INFO, Log
+
+        sess, bst, base, X = served
+        k1 = sess.load("ev1", booster=bst)
+        lines = []
+        prev_level = Log.get_level()
+        Log.reset_level(LOG_INFO)
+        Log.reset_callback(lines.append)
+        try:
+            sess.load("ev2", booster=bst)
+            sess.load("ev3", booster=bst)   # cap 2: evicts the LRU
+        finally:
+            Log.reset_callback(None)
+            Log.reset_level(prev_level)
+        resident = {m["key"] for m in sess.models()}
+        evicted = {k1, "ev2@1", "ev3@1"} - resident
+        assert evicted, "cap-2 registry must have evicted something"
+        victim = next(iter(evicted))
+        assert sess._stats.registry.value(
+            "lgbm_serving_model_hbm_bytes", model=victim) == 0
+        assert any("freed" in ln and "device bytes" in ln
+                   for ln in lines)
+
+    def test_stats_and_metrics_carry_process_gauges(self, served):
+        sess, bst, base, X = served
+        st = json.loads(self._get(base + "/stats")[1])
+        assert st["process_rss_bytes"] > 0
+        assert st["process_threads"] >= 1
+        assert st["process_open_fds"] > 0
+        assert "process_uptime_s" in st and "process_gc_collections" in st
+        text = self._get(base + "/metrics")[1]
+        assert "lgbm_process_resident_memory_bytes" in text
+        assert "lgbm_process_open_fds" in text
+        assert "lgbm_serving_model_hbm_bytes" in text
+
+    def test_debug_blackbox_route(self, served):
+        from lightgbm_tpu.obs import flightrecorder as fr
+
+        sess, bst, base, X = served
+        fr.note("test", "served_breadcrumb")
+        status, body = self._get(base + "/debug/blackbox")
+        assert status == 200
+        rec = json.loads(body)
+        assert rec["ring_depth"] >= 16
+        assert any(e["name"] == "served_breadcrumb"
+                   for e in rec["entries"])
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: the bench record's resource fields (satellite)
+# ---------------------------------------------------------------------------
+class TestBenchResourceSmoke:
+    def test_bench_resource_metrics_populated_or_null_on_cpu(self):
+        """A tiny train with capture armed must yield exactly the bench
+        contract: program_costs populated with real flops,
+        train_peak_hbm_bytes an explicit None on CPU (a number where a
+        backend reports memory_stats)."""
+        import jax
+
+        LEDGER.enable()
+        LEDGER.enable_capture()
+        LEDGER.reset()
+        resources.reset_phase_peaks()
+        # a shape no other test in this process compiles: the ledger
+        # records only NEW programs, and a cache-hot shape records none
+        X, y = _problem(n=673, f=7, seed=9)
+        bst = Booster(params=dict(_P),
+                      train_set=lgb.Dataset(X, label=y, params=_P))
+        for _ in range(2):
+            bst.update()
+        res = resources.bench_resource_metrics(LEDGER)
+        assert set(res) == {"train_peak_hbm_bytes",
+                            "phase_peak_hbm_bytes", "program_costs"}
+        on_cpu = jax.devices()[0].platform == "cpu"
+        if on_cpu:
+            assert res["train_peak_hbm_bytes"] is None
+            assert res["phase_peak_hbm_bytes"] is None
+        else:
+            assert res["train_peak_hbm_bytes"] > 0
+        costs = res["program_costs"]
+        assert costs and any(r["flops"] for r in costs)
+        json.dumps(res)  # the bench embeds this verbatim
+
+    def test_bench_emits_the_resource_fields(self):
+        """The bench script itself wires the fields into its JSON
+        record (the full run is exercised by the bench rounds; tier-1
+        asserts the wiring exists)."""
+        src = open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py")).read()
+        for key in ('"train_peak_hbm_bytes"', '"phase_peak_hbm_bytes"',
+                    '"serve_model_hbm_bytes"', '"program_costs"'):
+            assert key in src, f"bench.py no longer records {key}"

@@ -1,0 +1,249 @@
+"""Perf-regression sentinel: compare two bench records metric-by-metric.
+
+The bench trajectory was untrustworthy for three rounds (every round
+since r02 ran on degraded CPU fallback) and nothing refused the
+apples-to-oranges comparisons — the r04→r05 "regression" cost a
+postmortem to diagnose as container variance.  This tool is the gate
+that replaces the ad-hoc ``compile_vs_prior`` note:
+
+    python tools/bench_diff.py                      # newest two committed
+    python tools/bench_diff.py A.json B.json        # explicit old vs new
+    python tools/bench_diff.py --head NEW.json      # newest committed vs NEW
+    python tools/bench_diff.py --gate [...]         # exit nonzero on fail
+
+Semantics:
+
+* every known metric carries a DIRECTION (higher-better throughput vs
+  lower-better walls/overheads) and a relative TOLERANCE — a metric
+  outside tolerance in the bad direction is a regression;
+* comparisons are REFUSED (exit 2, loud message) when the two records
+  ran on different backends, when either side is a degraded run, or
+  when either side is a crash record — a TPU-vs-degraded-CPU ratio is
+  fiction and the tool says so instead of printing it;
+* ``--allow-degraded`` permits same-backend degraded-vs-degraded
+  comparisons (informational runs on the CPU container);
+* exit codes: 0 = comparable + no regression, 1 = regression,
+  2 = refused, 3 = usage/IO error.  ``--gate`` is an alias that makes
+  the intent explicit where the dryrun tail wires it in.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXIT_OK = 0
+EXIT_REGRESSION = 1
+EXIT_REFUSED = 2
+EXIT_ERROR = 3
+
+# direction: +1 = higher is better, -1 = lower is better.
+# tolerance: relative slack before a bad-direction move counts as a
+# regression (generous where cross-round container variance is known).
+METRICS = {
+    "value": (+1, 0.15),                      # headline iters/s
+    "predict_rows_per_sec": (+1, 0.15),
+    "serve_rows_per_sec": (+1, 0.20),
+    "serve_goodput_rows_per_sec": (+1, 0.20),
+    "ingest_rows_per_sec": (+1, 0.20),
+    "hist_int8_rows_per_sec": (+1, 0.20),
+    "hist_hilo_rows_per_sec": (+1, 0.20),
+    "train_auc": (+1, 0.01),
+    "serve_p99_ms": (-1, 0.30),
+    "serve_shed_pct": (-1, 0.50),
+    "eval_ms_per_iter": (-1, 0.30),
+    "checkpoint_overhead_pct": (-1, 0.50),
+    "resume_s": (-1, 0.30),
+    "resume_elastic_s": (-1, 0.30),
+    "collective_timeout_recovery_s": (-1, 0.30),
+    "compile_s": (-1, 0.20),
+    "n_programs": (-1, 0.0),                  # program zoo: exact gate
+    "n_programs_train": (-1, 0.0),
+    "train_peak_hbm_bytes": (-1, 0.10),       # HBM budget (ISSUE 12)
+    "serve_model_hbm_bytes": (-1, 0.10),
+}
+
+
+class RecordError(ValueError):
+    """Unreadable/malformed bench record — maps to EXIT_ERROR, never to
+    the regression code (CI must distinguish 'bench got slower' from
+    'your path is wrong')."""
+
+
+def load_record(path):
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError) as exc:
+        raise RecordError(f"bench_diff: cannot read {path!r}: {exc}")
+    parsed = rec.get("parsed", rec)
+    if not isinstance(parsed, dict):
+        if "parsed" in rec:
+            # a committed crash wrapper ({'rc': 1, 'parsed': null},
+            # e.g. BENCH_r01): keep it as a record so refusal() fires
+            # LOUDLY on it — silently dropping the newest round and
+            # diffing two older ones would report 'no regressions'
+            # right after a round crashed
+            return {"error": f"crashed round (rc={rec.get('rc')}, "
+                             "parsed=null)"}
+        raise RecordError(f"bench_diff: {path!r} holds no record dict")
+    return parsed
+
+
+def committed_records():
+    """Newest-first [(name, parsed record)] of the committed BENCH_r*."""
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")),
+                   key=lambda p: [int(s) for s in re.findall(r"\d+", p)])
+    out = []
+    for path in reversed(files):
+        try:
+            out.append((os.path.basename(path), load_record(path)))
+        except RecordError:
+            continue
+    return out
+
+
+def _backend(rec):
+    return str(rec.get("backend", rec.get("platform", "unknown")))
+
+
+def refusal(old, new, allow_degraded=False):
+    """Reason this comparison must not be scored, or None."""
+    for tag, rec in (("old", old), ("new", new)):
+        if rec.get("error"):
+            return (f"{tag} record is a CRASH record "
+                    f"({rec['error']!r}) — nothing to compare")
+    b_old, b_new = _backend(old), _backend(new)
+    if b_old != b_new:
+        return (f"cross-backend comparison refused: old ran on "
+                f"{b_old!r}, new on {b_new!r} — a "
+                "TPU-vs-degraded-CPU ratio is fiction, not a regression "
+                "signal")
+    degraded = bool(old.get("degraded")) or bool(new.get("degraded"))
+    if degraded and not allow_degraded:
+        which = " and ".join(tag for tag, r in (("old", old), ("new", new))
+                             if r.get("degraded"))
+        return (f"degraded comparison refused: {which} ran on the "
+                "degraded fallback path (reduced problem, throwaway "
+                "container) — pass --allow-degraded for an "
+                "informational same-backend diff")
+    return None
+
+
+def diff(old, new, tolerance_scale=1.0):
+    """[(metric, old, new, ratio, verdict)] for every shared metric."""
+    rows = []
+    for metric, (direction, tol) in METRICS.items():
+        a, b = old.get(metric), new.get(metric)
+        if a is None or b is None or not isinstance(a, (int, float)) \
+                or not isinstance(b, (int, float)):
+            continue
+        if a == 0:
+            # zero baseline: the relative tolerance has no scale, so
+            # never score it as a regression — a 0.0 -> 0.01 shed_pct
+            # move is noise, not a gate failure; surface it as
+            # new-nonzero for the human reader instead
+            rows.append((metric, a, b, float("inf") if b else 1.0,
+                         "ok" if b == 0 else "new-nonzero"))
+            continue
+        ratio = b / a
+        tol = tol * tolerance_scale
+        if direction > 0:            # higher better: b < a*(1-tol) bad
+            bad = b < a * (1.0 - tol)
+            improved = b > a * (1.0 + tol)
+        else:                        # lower better: b > a*(1+tol) bad
+            bad = b > a * (1.0 + tol)
+            improved = b < a * (1.0 - tol)
+        verdict = "REGRESSION" if bad else ("improved" if improved else "ok")
+        rows.append((metric, a, b, ratio, verdict))
+    return rows
+
+
+def format_table(rows, old_name, new_name):
+    lines = [f"{'metric':<32s} {'old':>14s} {'new':>14s} {'ratio':>7s}  "
+             f"verdict   ({old_name} -> {new_name})"]
+    for metric, a, b, ratio, verdict in rows:
+        lines.append(f"{metric:<32s} {a:>14.4g} {b:>14.4g} "
+                     f"{ratio:>7.3f}  {verdict}")
+    return "\n".join(lines)
+
+
+def run(old_path=None, new_path=None, head=None, allow_degraded=False,
+        tolerance_scale=1.0):
+    """-> (exit_code, text).  The CLI and the dryrun tail both call
+    this; the dryrun treats EXIT_REFUSED as a loud skip, never a
+    pass."""
+    try:
+        if head is not None:
+            committed = committed_records()
+            if not committed:
+                return EXIT_ERROR, "bench_diff: no committed BENCH_r*.json"
+            old_name, old = committed[0]
+            new_name, new = os.path.basename(head), load_record(head)
+        elif old_path is not None and new_path is not None:
+            old_name, old = os.path.basename(old_path), \
+                load_record(old_path)
+            new_name, new = os.path.basename(new_path), \
+                load_record(new_path)
+        else:
+            committed = committed_records()
+            if len(committed) < 2:
+                return EXIT_ERROR, ("bench_diff: need two committed "
+                                    "BENCH_r*.json (or explicit paths)")
+            new_name, new = committed[0]
+            old_name, old = committed[1]
+    except RecordError as exc:
+        return EXIT_ERROR, str(exc)
+    reason = refusal(old, new, allow_degraded=allow_degraded)
+    if reason is not None:
+        return EXIT_REFUSED, (f"bench_diff REFUSED ({old_name} -> "
+                              f"{new_name}): {reason}")
+    rows = diff(old, new, tolerance_scale=tolerance_scale)
+    if not rows:
+        return EXIT_ERROR, ("bench_diff: the records share no known "
+                            "numeric metrics")
+    text = format_table(rows, old_name, new_name)
+    regressions = [r for r in rows if r[4] == "REGRESSION"]
+    if regressions:
+        names = ", ".join(r[0] for r in regressions)
+        return EXIT_REGRESSION, (
+            text + f"\nbench_diff: {len(regressions)} REGRESSION(s): "
+            f"{names}")
+    return EXIT_OK, text + "\nbench_diff: no regressions"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="OLD.json NEW.json (default: the two newest "
+                         "committed BENCH_r*.json)")
+    ap.add_argument("--head", default=None, metavar="NEW.json",
+                    help="compare the newest committed record against "
+                         "this fresh (HEAD) record")
+    ap.add_argument("--gate", action="store_true",
+                    help="CI intent marker: identical behavior, spelled "
+                         "out where a nonzero exit must fail the run")
+    ap.add_argument("--allow-degraded", action="store_true",
+                    help="permit same-backend degraded-vs-degraded "
+                         "comparisons (informational)")
+    ap.add_argument("--tolerance-scale", type=float, default=1.0,
+                    help="scale every per-metric tolerance (2.0 = twice "
+                         "as lenient)")
+    args = ap.parse_args(argv)
+    if args.paths and len(args.paths) != 2:
+        ap.error("pass exactly two record paths (OLD NEW), or none")
+    old_path, new_path = (args.paths if args.paths else (None, None))
+    code, text = run(old_path=old_path, new_path=new_path, head=args.head,
+                     allow_degraded=args.allow_degraded,
+                     tolerance_scale=args.tolerance_scale)
+    print(text, file=sys.stderr if code in (EXIT_REFUSED, EXIT_ERROR)
+          else sys.stdout)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -517,6 +517,113 @@ def run_trace(n=100_000, iters=3, leaves=255, bins=255):
             print(f"{tool}: {type(exc).__name__}: {str(exc)[:120]}")
 
 
+def run_mem(n=20000, f=10, leaves=31, bins=63, iters=3):
+    """Device memory/cost accounting (ISSUE 12): run a canonical
+    train + predict + serve lifecycle with the CompileLedger's cost
+    capture armed and print, per compiled program, its static
+    memory_analysis (argument/output/temp/generated-code bytes) and
+    cost_analysis (FLOPs, bytes accessed) — plus live device
+    memory_stats, the phase-tagged peak watermarks, and the big named
+    buffers (histogram pool, packed forest) called out by name.
+
+    Works on ANY backend: on CPU the device gauges read "n/a" but the
+    per-program table still carries real FLOPs/bytes (and the memory
+    fields via a forced AOT recompile of each small probe program).
+
+        N=20000 python tools/perf_probe.py mem
+    """
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.booster import Booster
+    from lightgbm_tpu.obs import resources
+    from lightgbm_tpu.serving import ServingSession
+    from lightgbm_tpu.utils.backend import host_sync
+    from lightgbm_tpu.utils.compile_ledger import LEDGER
+
+    obs.configure(mode="metrics")        # arm the phase watermarks
+    LEDGER.enable()
+    LEDGER.enable_capture()
+    LEDGER.reset()
+    resources.reset_phase_peaks()
+
+    X, y = make_data(n, f=f)
+    p = {"objective": "binary", "num_leaves": leaves, "max_bin": bins,
+         "learning_rate": 0.1, "min_data_in_leaf": 20, "verbosity": -1}
+    ds = lgb.Dataset(X, label=y, params=p)
+    bst = Booster(params=p, train_set=ds)
+    for _ in range(iters):
+        bst.update()
+    host_sync(bst._driver.train_scores.scores)
+    bst.predict(X[:4096], raw_score=True, device="tpu",
+                tpu_predict_device="true")
+    sess = ServingSession(params={"serving_max_batch_rows": 1024,
+                                  "verbosity": -1})
+    sess.load("m", booster=bst)
+    sess.predict("m", X[:64])
+    serve_hbm = sess.registry.resolve("m").hbm_bytes
+    sess.close()
+
+    mb = 1.0 / (1024 * 1024)
+    # ---- live device gauges ----
+    print("device memory (memory_stats):")
+    devs = jax.devices()
+    any_stats = False
+    for d, st in zip(devs, resources.all_device_memory_stats()):
+        if st is None:
+            print(f"  {d}: n/a ({d.platform} backend reports no "
+                  "memory_stats)")
+        else:
+            any_stats = True
+            print(f"  {d}: in_use {st.get('bytes_in_use', 0) * mb:.1f}M"
+                  f"  peak {st.get('peak_bytes_in_use', 0) * mb:.1f}M")
+    # ---- phase watermarks ----
+    peaks = resources.phase_peaks()
+    if peaks:
+        print("phase peak watermarks:")
+        for phase, b in sorted(peaks.items(), key=lambda kv: -kv[1]):
+            print(f"  {phase:<14s} {b * mb:10.1f}M")
+    elif not any_stats:
+        print("phase peak watermarks: n/a (no device memory_stats)")
+
+    # ---- named buffers ----
+    learner = bst._driver.learner
+    pool = getattr(learner, "_pool", None)
+    donated = bool(getattr(learner, "_donate", False))
+    if pool is not None:
+        print(f"histogram pool [L, G/P, B, 3]: shape {tuple(pool.shape)} "
+              f"{pool.dtype} = {pool.nbytes * mb:.1f}M"
+              f"{' (donated, rewritten in place)' if donated else ''}")
+    total, _ = bst._driver._model_subset(-1)
+    tables = bst._driver._packed_forest().device(total)
+    pf_bytes = sum(int(v.nbytes) for v in tables.values())
+    print(f"packed forest ({total} trees): {pf_bytes * mb:.2f}M across "
+          f"{len(tables)} tables; serving entry gauge "
+          f"{serve_hbm * mb:.2f}M")
+    scores = bst._driver.train_scores.scores
+    print(f"score buffer: shape {tuple(scores.shape)} {scores.dtype} = "
+          f"{scores.nbytes * mb:.2f}M"
+          f"{' (donated at the step boundary)' if donated else ''}")
+
+    # ---- per-program static cost table ----
+    rows = LEDGER.cost_table(memory=True)  # force AOT analysis on CPU too
+    print(f"\nper-program cost table ({len(rows)} programs):")
+    print(f"{'site':<26s} {'MFLOPs':>9s} {'acc MB':>8s} {'arg MB':>8s} "
+          f"{'out MB':>8s} {'tmp MB':>8s} {'code KB':>8s}")
+
+    def fmt(v, scale, width=8, prec=2):
+        return (f"{'n/a':>{width}s}" if v is None
+                else f"{v * scale:>{width}.{prec}f}")
+
+    for r in sorted(rows, key=lambda r: -(r["temp_bytes"] or 0)):
+        print(f"{r['site']:<26s} "
+              f"{fmt(r['flops'], 1e-6, 9)} {fmt(r['bytes_accessed'], mb)} "
+              f"{fmt(r['argument_bytes'], mb)} {fmt(r['output_bytes'], mb)} "
+              f"{fmt(r['temp_bytes'], mb)} "
+              f"{fmt(r['generated_code_bytes'], 1 / 1024)}", flush=True)
+    return rows
+
+
 def run_faults(n=4000, f=6, iters=5):
     """Chaos sweep (ISSUE 7): arm every fault-injection point against
     every relevant handling mode and print one outcome line each — the
@@ -745,6 +852,12 @@ def main():
             return
         run_faults(n=int(os.environ.get("N", 4000)),
                    iters=int(os.environ.get("ITERS", 5)))
+        return
+    if arg == "mem":
+        run_mem(n=int(os.environ.get("N", 20000)),
+                leaves=int(os.environ.get("LEAVES", 31)),
+                bins=int(os.environ.get("BINS", 63)),
+                iters=int(os.environ.get("ITERS", 3)))
         return
     if arg == "retrace":
         run_retrace(n=int(os.environ.get("N", 20000)),
