@@ -1170,8 +1170,8 @@ class TPUTreeLearner:
             # arrays captured) that re-trace per Booster in milliseconds
             # — the ledger tracks the programs that dominate compile wall
             # (grower, fused step, predict/binning/histogram kernels).
-            pre_j = jax.jit(_pre, static_argnames=("goss_on",))
-            post_j = jax.jit(_post,
+            pre_j = jax.jit(_pre, static_argnames=("goss_on",))  # graftlint: disable=J201 per-objective closure, deliberately off-ledger (see comment above)
+            post_j = jax.jit(_post,  # graftlint: disable=J201 per-objective closure, deliberately off-ledger (see comment above)
                              donate_argnums=((0,) if donate else ()))
             return make_step(pre_j, post_j)
         # exact-shape mode (tpu_shape_buckets=0): ONE fused program —

@@ -34,7 +34,12 @@ answer "who hung first".
 
 The dump directory resolves: `configure(dump_dir=...)` (the
 ``tpu_obs_blackbox_dir`` param) > ``LIGHTGBM_TPU_BLACKBOX_DIR`` env >
-the live ``tpu_trace_dir`` > the working directory.
+the live ``tpu_trace_dir`` > the working directory.  Wherever it
+lands, the FILENAME is always the canonical ``blackbox-host<k>.json``
+— the exact pattern the repo's .gitignore carries — so a dump written
+into a source checkout (the working-directory fallback) never turns
+into an accidentally-committed artifact; callers that pass `path=` a
+directory get the canonical name joined under it.
 """
 
 from __future__ import annotations
@@ -47,6 +52,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..utils import lockcheck
+
 DEFAULT_EVENTS = 512
 
 # the ring: GIL-atomic appends (deque with maxlen), no lock on the
@@ -55,7 +62,7 @@ DEFAULT_EVENTS = 512
 # at dump/read time.
 _ring: deque = deque(maxlen=DEFAULT_EVENTS)
 
-_dump_lock = threading.Lock()
+_dump_lock = lockcheck.make_lock("obs.flightrecorder.dump")
 _dump_dir = ""
 _last_dump: Optional[str] = None
 _dumps = 0
@@ -115,8 +122,13 @@ def reset() -> None:
     """Clear the ring (tests / fresh windows); configuration persists."""
     global _last_dump, _dumps
     _ring.clear()
-    _last_dump = None
-    _dumps = 0
+    # under the dump lock like dump() itself: a reset racing a crash
+    # dump must not interleave with its _last_dump/_dumps writes
+    # (found by graftlint C301 — the ring clear above stays lock-free
+    # by design, deque ops are GIL-atomic)
+    with _dump_lock:
+        _last_dump = None
+        _dumps = 0
 
 
 def last_dump() -> Optional[str]:
@@ -152,6 +164,10 @@ def dump(reason: str, path: Optional[str] = None,
             d = blackbox_dir()
             os.makedirs(d, exist_ok=True)
             path = os.path.join(d, f"blackbox-host{host}.json")
+        elif os.path.isdir(path):
+            # a directory keeps the canonical (gitignored) filename —
+            # only an explicit FILE path may rename the dump
+            path = os.path.join(path, f"blackbox-host{host}.json")
         record = {
             "reason": str(reason),
             "host": host,

@@ -28,8 +28,9 @@ the per-iteration hot-path instrumentation sites.
 from __future__ import annotations
 
 import bisect
-import threading
 from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..utils import lockcheck
 
 # default seconds buckets: wide enough for ingest phases (minutes) and
 # fine enough for serving latencies (sub-ms)
@@ -150,7 +151,7 @@ class _Family:
         self.kind = kind                        # counter | gauge | histogram
         self.help = help_text
         self.buckets = buckets
-        self.lock = threading.Lock()
+        self.lock = lockcheck.make_lock(f"obs.metrics.family:{name}")
         self.children: Dict[Tuple[Tuple[str, str], ...], object] = {}
 
     def child(self, labels: Dict[str, str]):
@@ -177,7 +178,7 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("obs.metrics.registry")
         self._families: Dict[str, _Family] = {}
 
     # -- family creation/lookup ----------------------------------------

@@ -40,10 +40,10 @@ no device work — the compile-stability retrace gate pins that.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Optional
 
+from ..utils import lockcheck
 from .stats import ServingStats
 
 # class -> fraction of the admitted-rows level it may fill before
@@ -117,7 +117,7 @@ class AdmissionController:
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self.retry_after_s = max(float(retry_after_ms), 0.0) / 1e3
         self.enabled = bool(enabled)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("serving.admission")
         self._level = float(self.queue_rows)   # start fully open
         self._window_s = self.max_wait_s
         self._projection_s = 0.0
@@ -195,7 +195,13 @@ class AdmissionController:
 
     # ------------------------------------------------------------------
     def begin_drain(self) -> None:
-        self._draining = True
+        # under the controller lock like every other state flip: the
+        # bool write is GIL-atomic, but lock discipline is the declared
+        # invariant (graftlint C301 enforces the ownership map), and an
+        # undeclared exception here would rot into a real race the next
+        # time drain grows a second field
+        with self._lock:
+            self._draining = True
 
     @property
     def draining(self) -> bool:

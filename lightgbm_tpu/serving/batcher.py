@@ -56,6 +56,7 @@ from typing import Callable, Hashable, Optional
 
 import numpy as np
 
+from ..utils import lockcheck
 from .stats import ServingStats
 
 
@@ -122,7 +123,7 @@ class _SerialDispatcher:
     thread-spawn churn off the per-batch hot path."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("serving.dispatcher")
         self._work = None
         self._have = threading.Event()
         self._busy = False
@@ -393,6 +394,7 @@ class MicroBatcher:
         so an abandoned dispatch never overlaps a fresh one — refused
         batches fail over to the walker and the breaker keeps later
         requests off the device path).  Returns (ok, value_or_exc)."""
+        lockcheck.check_dispatch("batcher.dispatch")
         if self.dispatch_timeout_s <= 0:
             try:
                 return True, runner(X)

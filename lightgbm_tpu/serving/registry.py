@@ -21,7 +21,6 @@ the runtime discipline around that:
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
@@ -29,7 +28,7 @@ import numpy as np
 
 from ..config import Config, parse_tristate
 from ..ops.predict import _depth_bucket, predict_row_buckets, row_bucket
-from ..utils import faultline
+from ..utils import faultline, lockcheck
 from ..utils.log import Log
 from .stats import CircuitBreaker, ServingStats
 
@@ -165,6 +164,10 @@ class ModelEntry:
         # call and records a failure while it runs, the success below
         # becomes stale and must not reset/close the breaker
         gen = self.breaker.generation
+        # device walls are unbounded from the host's view: entering one
+        # holding any serving/obs lock would stall every thread queued
+        # on it (lockcheck flags it under tests)
+        lockcheck.check_dispatch("registry.predict")
         try:
             if not warmup:
                 action = faultline.fire("serve_dispatch", model=self.key)
@@ -250,7 +253,7 @@ class ModelRegistry:
                  stats: Optional[ServingStats] = None):
         self.config = config if config is not None else Config({})
         self.stats = stats if stats is not None else ServingStats()
-        self._lock = threading.RLock()
+        self._lock = lockcheck.make_rlock("serving.registry")
         self._entries: "OrderedDict[str, ModelEntry]" = OrderedDict()
         self._latest: Dict[str, str] = {}   # name -> current key
         self._counts: Dict[str, int] = {}   # name -> loads so far

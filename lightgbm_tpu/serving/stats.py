@@ -16,11 +16,11 @@ request sizes within `serving_max_batch_rows`.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Dict, Hashable, Tuple
 
 from ..obs.metrics import MetricsRegistry
+from ..utils import lockcheck
 
 _COUNTERS = (
     "requests_total", "rows_total", "batches_total", "requests_shed",
@@ -73,7 +73,7 @@ class CircuitBreaker:
 
     def __init__(self, threshold: int = 3, cooldown_s: float = 2.0,
                  stats: "ServingStats" = None):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("serving.breaker")
         self.threshold = max(int(threshold), 1)
         self.cooldown_s = max(float(cooldown_s), 0.0)
         self.stats = stats
@@ -159,7 +159,7 @@ class ServingStats:
     by construction."""
 
     def __init__(self, window: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("serving.stats")
         self.registry = MetricsRegistry()
         for key in _COUNTERS:  # pre-register so /metrics shows zeros
             self.registry.inc(_prom_name(key), 0)

@@ -132,6 +132,20 @@ class TestDump:
         assert rec["metrics"]["lgbm_test_dump_counter_total"] == 3
         assert not [p for p in os.listdir(tmp_path) if ".tmp." in p]
 
+    def test_explicit_dir_path_keeps_canonical_gitignored_name(
+            self, tmp_path):
+        """dump(path=<directory>) joins the canonical
+        blackbox-host<k>.json name — the exact .gitignore pattern — so
+        no caller can strand a differently-named (trackable) dump in a
+        source checkout (ISSUE 13: a stale dump was sitting at the
+        repo root)."""
+        d = tmp_path / "dumps"
+        d.mkdir()
+        fr.note("k", "crumb")
+        path = fr.dump("unit_test", path=str(d))
+        assert path == str(d / "blackbox-host0.json")
+        assert _read_dump(path)["reason"] == "unit_test"
+
     def test_dump_on_injected_collective_hang_names_the_site(self,
                                                              tmp_path):
         """The acceptance scenario: a faultline collective_sync hang

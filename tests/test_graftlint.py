@@ -1,0 +1,601 @@
+"""graftlint: the static-analysis suite gates every PR (ISSUE 13).
+
+Three layers of proof:
+
+1. **HEAD is clean** — the full suite over `lightgbm_tpu/` yields zero
+   findings beyond the committed (empty) baseline.  This is the tier-1
+   gate itself: a PR that re-introduces a PR-11 bug class fails here.
+2. **Every rule fires** — fixture trees seed one violation per rule and
+   the rule must flag it, including regression fixtures reproducing
+   ALL THREE PR-11 root-cause patterns (shape-keyed RNG, fused
+   mul+add on a score path, f32 reduction over dequantized values).
+3. **The machinery works** — suppression comments, the baseline
+   workflow, JSON/text reporters, --explain, and exit codes.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.graftlint import run_gate  # noqa: E402
+from tools.graftlint.core import (RULES, apply_baseline, explain,  # noqa: E402
+                                  load_baseline, run, to_json, to_text)
+
+pytestmark = pytest.mark.graftlint
+
+
+def _tree(tmp_path, files):
+    """Materialize {relpath: source} under tmp_path as a mini repo."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        # package markers so the layout mirrors the real tree
+        d = p.parent
+        while d != tmp_path:
+            init = d / "__init__.py"
+            if not init.exists():
+                init.write_text("")
+            d = d.parent
+    return str(tmp_path)
+
+
+def _rules(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# 1. the gate: HEAD lints clean beyond the committed baseline
+# ---------------------------------------------------------------------------
+class TestHeadGate:
+    def test_head_zero_findings_over_baseline(self):
+        new, _all = run_gate(REPO)
+        assert new == [], (
+            "graftlint found NEW violations on HEAD:\n"
+            + to_text(new)
+            + "\nfix them or (exceptionally) add a justified baseline "
+              "entry / inline suppression")
+
+    def test_committed_baseline_is_empty_or_justified(self):
+        entries = load_baseline(
+            os.path.join(REPO, "tools", "graftlint", "baseline.json"))
+        for e in entries:
+            just = e.get("justification", "").strip()
+            assert just and not just.startswith("TODO"), (
+                f"baseline entry {e.get('rule')}@{e.get('path')} lacks a "
+                "real justification")
+
+    def test_cli_exits_zero_on_head(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "lightgbm_tpu",
+             "--format", "json"],
+            cwd=REPO, capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, out.stdout + out.stderr
+        payload = json.loads(out.stdout)
+        assert payload["new_findings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 2. determinism family: the three PR-11 root causes, as fixtures
+# ---------------------------------------------------------------------------
+class TestDeterminismRules:
+    def test_pr11_root_cause_1_shape_keyed_rng(self, tmp_path):
+        """Root cause #1: bagging masks drawn from shape-keyed threefry
+        over the PADDED row axis."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/bagging.py": """
+            import jax
+            def draw_mask(key, bins, n_pad):
+                k = jax.random.fold_in(key, bins.shape[0])
+                r = jax.random.PRNGKey(n_pad)
+                return k, r
+        """})
+        fs = run(["lightgbm_tpu"], root)
+        d101 = [f for f in fs if f.rule == "D101"]
+        assert len(d101) == 2
+        assert "topology-dependent" in d101[0].message
+
+    def test_pr11_root_cause_2_fused_mul_add_score(self, tmp_path):
+        """Root cause #2: gather*lr+scores contracted into an FMA
+        differently between serial and shard_map programs."""
+        root = _tree(tmp_path, {"lightgbm_tpu/models/learner.py": """
+            def update(scores, leaf_output, leaf_ids, lr):
+                scores = leaf_output[leaf_ids] * lr + scores
+                return scores
+            def update_aug(scores, leaf_output, ids, lr):
+                scores += leaf_output[ids] * lr
+                return scores
+        """})
+        fs = run(["lightgbm_tpu"], root)
+        assert len([f for f in fs if f.rule == "D103"]) == 2
+
+    def test_pr11_root_cause_3_f32_reduction(self, tmp_path):
+        """Root cause #3: split-search cumsums on pre-dequantized f32
+        where the exact int32 scan exists."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/split.py": """
+            import jax.numpy as jnp
+            def left_sums(hist_i32, scale):
+                return jnp.cumsum(hist_i32.astype(jnp.float32) * scale)
+            def left_sums_kwarg(hist_i32):
+                # the dtype= spelling of the same dequantizing reduction
+                return jnp.cumsum(hist_i32, dtype=jnp.float32)
+        """})
+        fs = run(["lightgbm_tpu"], root)
+        assert _rules(fs) == ["D102"] and len(fs) == 2
+
+    def test_pr11_fixed_idioms_stay_clean(self, tmp_path):
+        """The PR-11 FIXES must not trip the rules that encode them."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/fixed.py": """
+            import jax
+            import jax.numpy as jnp
+            def good(key, n_pad, hist_i32, scores, leaf_output, ids, lr,
+                     any_split):
+                # global-row-index hashing: iota LENGTH is n_pad but the
+                # VALUES are global ids — not keying on the shape
+                rows = jax.lax.iota(jnp.uint32, n_pad)
+                # exact int32 scan, dequantize at the boundary
+                left = jnp.cumsum(hist_i32)
+                # pre-scaled leaf vector, gather + ONE rounded add
+                scaled = jnp.where(any_split, leaf_output * lr, 0.0)
+                new_scores = scores.at[0, :].add(scaled[ids])
+                return rows, left, new_scores
+        """})
+        fs = run(["lightgbm_tpu"], root)
+        assert fs == []
+
+    def test_out_of_scope_module_not_flagged(self, tmp_path):
+        """Determinism rules only bind the bitwise-critical modules."""
+        root = _tree(tmp_path, {"lightgbm_tpu/plotting.py": """
+            import jax
+            def jitter(key, data):
+                return jax.random.fold_in(key, data.shape[0])
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "D101"] == []
+
+
+# ---------------------------------------------------------------------------
+# 2b. jit-discipline family
+# ---------------------------------------------------------------------------
+class TestJitRules:
+    def test_unledgered_jit_and_decorator(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/kernels.py": """
+            import jax
+            def f(x):
+                return x + 1
+            jf = jax.jit(f)
+            @jax.jit
+            def g(x):
+                return x * 2
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "J201"]
+        assert len(fs) == 2
+
+    def test_jit_alias_spellings_caught(self, tmp_path):
+        """`from jax import jit`, `j = jax.jit` aliases, and
+        partial(jax.jit, ...) must not evade the ledger gate."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/alias.py": """
+            from functools import partial
+            import jax
+            from jax import jit
+            my_jit = jax.jit
+            def f(x):
+                return x
+            a = jit(f)
+            b = my_jit(f)
+            c = partial(jax.jit, static_argnames=("k",))(f)
+            @jit
+            def g(x):
+                return x
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "J201"]
+        # four SITES: jit(f), my_jit(f), partial(jax.jit,...)(f), @jit
+        # (the `my_jit = jax.jit` alias assignment is not itself a site)
+        assert len(fs) == 4, [(f.line, f.snippet) for f in fs]
+        assert {f.snippet for f in fs} == {
+            "a = jit(f)", "b = my_jit(f)",
+            'c = partial(jax.jit, static_argnames=("k",))(f)', "@jit"}
+
+    def test_jit_via_module_alias_caught(self, tmp_path):
+        """`import jax as jx; jx.jit(f)` must not evade J201."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/modalias.py": """
+            import jax as jx
+            def f(x):
+                return x
+            jf = jx.jit(f)
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "J201"]
+        assert len(fs) == 1 and fs[0].snippet == "jf = jx.jit(f)"
+
+    def test_ledgered_jit_clean(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/kernels.py": """
+            from ..utils.compile_ledger import ledger_jit
+            @ledger_jit(site="k.f")
+            def f(x):
+                return x + 1
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "J201"] == []
+
+    def test_unledgered_shard_map(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/parallel/bad.py": """
+            from jax.experimental.shard_map import shard_map
+            def build(grow, mesh):
+                fn = shard_map(grow, mesh=mesh)
+                return fn
+        """})
+        fs = run(["lightgbm_tpu"], root)
+        assert "J202" in _rules(fs)
+
+    def test_shard_map_through_wrapper_clean(self, tmp_path):
+        """The strategies.py pattern: shard_map result flows into a
+        local wrapper that returns ledger_jit(...)."""
+        root = _tree(tmp_path, {"lightgbm_tpu/parallel/good.py": """
+            from jax.experimental.shard_map import shard_map
+            from ..utils.compile_ledger import ledger_jit
+            def _strategy_jit(fn, strategy):
+                return ledger_jit(fn, site=strategy)
+            def build(grow, mesh):
+                fn = shard_map(grow, mesh=mesh)
+                return _strategy_jit(fn, "data")
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "J202"] == []
+
+    def test_host_calls_in_jitted_body(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/traced.py": """
+            import time
+            import jax
+            import numpy as np
+            def body(x):
+                t = time.time()
+                r = np.random.uniform()
+                v = x.item()
+                h = jax.device_get(x)
+                return x * t * r * v + h.sum()
+            jf = jax.jit(body)  # graftlint: disable=J201 fixture
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "J203"]
+        assert len(fs) == 4
+
+    def test_host_calls_outside_jit_clean(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/host.py": """
+            import time
+            def wall():
+                return time.time()
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "J203"] == []
+
+    def test_static_argname_of_folded_mode_param(self, tmp_path):
+        root = _tree(tmp_path, {
+            "lightgbm_tpu/ops/grower.py": """
+                _FOLDED_FIELDS = dict(quant_round="stochastic",
+                                      quant_refit=False)
+                def canonical_params(p):
+                    return p._replace(**_FOLDED_FIELDS)
+            """,
+            "lightgbm_tpu/ops/bad_site.py": """
+                from ..utils.compile_ledger import ledger_jit
+                def f(x, quant_round="stochastic"):
+                    return x
+                jf = ledger_jit(f, site="bad",
+                                static_argnames=("quant_round",))
+            """})
+        fs = run(["lightgbm_tpu"], root)
+        assert "J204" in _rules(fs)
+        # structural statics (shapes/dtypes/depth) stay allowed
+        assert all("quant_round" in f.message for f in fs
+                   if f.rule == "J204")
+
+
+# ---------------------------------------------------------------------------
+# 2c. concurrency family
+# ---------------------------------------------------------------------------
+class TestConcurrencyRules:
+    def test_mutation_outside_owning_lock(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/serving/registry.py": """
+            import threading
+            class ModelRegistry:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._entries = {}
+                def racy(self, k, e):
+                    self._entries[k] = e
+                def fine(self, k, e):
+                    with self._lock:
+                        self._entries[k] = e
+                def _evict_locked(self):
+                    self._entries.clear()
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "C301"]
+        assert len(fs) == 1 and "racy" not in fs[0].message
+        assert fs[0].snippet == "self._entries[k] = e"
+
+    def test_dispatch_under_lock(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/serving/registry.py": """
+            import threading
+            class ModelRegistry:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                def stall(self, entry, X):
+                    with self._lock:
+                        return entry.predict(X)
+                def ok(self, entry, X):
+                    return entry.predict(X)
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "C302"]
+        assert len(fs) == 1
+
+    def test_init_exempt(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/serving/batcher.py": """
+            import threading
+            class MicroBatcher:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._queues = {}
+                    self._pending_rows = 0
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "C301"] == []
+
+
+# ---------------------------------------------------------------------------
+# 2d. config/docs drift family
+# ---------------------------------------------------------------------------
+class TestDriftRules:
+    def _mini(self, tmp_path, doc):
+        return _tree(tmp_path, {
+            "lightgbm_tpu/config.py": """
+                _P = {
+                    "tpu_dead_knob": ("int", 0, ()),
+                    "serving_live_knob": ("int", 1, ()),
+                    "tpu_undocumented": ("int", 2, ()),
+                    "max_bin": ("int", 255, ()),
+                }
+            """,
+            "lightgbm_tpu/user.py": """
+                def use(c):
+                    return c.serving_live_knob + c.tpu_undocumented
+            """,
+            "docs/Parameters.md": doc})
+
+    def test_dead_undocumented_and_phantom(self, tmp_path):
+        root = self._mini(
+            tmp_path,
+            "`tpu_dead_knob` `serving_live_knob` `tpu_phantom_knob`\n")
+        fs = run(["lightgbm_tpu"], root)
+        by = {f.rule: f for f in fs}
+        assert set(by) == {"P401", "P402", "P403"}
+        assert "tpu_dead_knob" in by["P401"].message
+        assert "tpu_undocumented" in by["P402"].message
+        assert by["P403"].snippet == "tpu_phantom_knob"
+
+    def test_param_read_only_by_tools_script_not_dead(self, tmp_path):
+        """A param consumed only by tools/ or bench.py (serve_bench
+        reads serving config) is NOT dead — the usage scan must cover
+        the consumer scripts its message names."""
+        root = self._mini(
+            tmp_path,
+            "`tpu_dead_knob` `serving_live_knob` `tpu_undocumented`\n")
+        (tmp_path / "tools").mkdir()
+        (tmp_path / "tools" / "serve_bench.py").write_text(
+            'P = {"tpu_dead_knob": 7}\n')
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "P401"] == []
+
+    def test_clean_when_in_sync(self, tmp_path):
+        root = self._mini(
+            tmp_path,
+            "`tpu_dead_knob` `serving_live_knob` `tpu_undocumented`\n")
+        # make the dead knob live
+        (tmp_path / "lightgbm_tpu" / "user2.py").write_text(
+            "def f(c):\n    return c.tpu_dead_knob\n")
+        assert run(["lightgbm_tpu"], root) == []
+
+
+# ---------------------------------------------------------------------------
+# 3. machinery: suppressions, baseline, reporters, explain, CLI
+# ---------------------------------------------------------------------------
+class TestMachinery:
+    BAD = {"lightgbm_tpu/ops/bad.py": """
+        import jax
+        def f(x):
+            return x
+        jf = jax.jit(f)
+    """}
+
+    def test_inline_suppression(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/bad.py": """
+            import jax
+            def f(x):
+                return x
+            jf = jax.jit(f)  # graftlint: disable=J201 fixture says so
+        """})
+        assert run(["lightgbm_tpu"], root) == []
+
+    def test_file_suppression_and_next_line(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/bad.py": """
+            # graftlint: disable-file=J201 whole file is a fixture
+            import jax
+            def f(x):
+                return x
+            jf = jax.jit(f)
+            # graftlint: disable-next-line=J203
+            # (no-op directive: nothing on the next line)
+        """})
+        assert run(["lightgbm_tpu"], root) == []
+
+    def test_directive_in_docstring_is_not_a_suppression(self, tmp_path):
+        """Documentation QUOTING the suppression syntax inside a
+        string/docstring must not create real (file-wide!)
+        suppressions — only comment tokens count."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/bad.py": '''
+            """Suppress findings like this:
+
+                # graftlint: disable-file=J201 <why>
+            """
+            import jax
+            def f(x):
+                return x
+            jf = jax.jit(f)
+        '''})
+        assert _rules(run(["lightgbm_tpu"], root)) == ["J201"]
+
+    def test_suppression_comma_list(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/bad.py": """
+            import jax, time
+            def f(x):
+                return x + time.time()  # graftlint: disable=J203 fixture
+            jf = jax.jit(f)  # graftlint: disable=J201, J204 list form with a why
+        """})
+        assert run(["lightgbm_tpu"], root) == []
+
+    def test_suppression_is_per_rule(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/bad.py": """
+            import jax
+            def f(x):
+                return x
+            jf = jax.jit(f)  # graftlint: disable=D101 wrong id
+        """})
+        assert _rules(run(["lightgbm_tpu"], root)) == ["J201"]
+
+    def test_baseline_absorbs_then_pins(self, tmp_path):
+        root = _tree(tmp_path, self.BAD)
+        fs = run(["lightgbm_tpu"], root)
+        assert len(fs) == 1
+        entries = [{"rule": fs[0].rule, "path": fs[0].path,
+                    "snippet": fs[0].snippet, "justification": "legacy"}]
+        assert apply_baseline(fs, entries) == []
+        assert fs[0].baselined
+        # a SECOND, new violation is still caught
+        (tmp_path / "lightgbm_tpu" / "ops" / "bad2.py").write_text(
+            "import jax\njg = jax.jit(lambda x: x)\n")
+        fs2 = run(["lightgbm_tpu"], root)
+        new = apply_baseline(fs2, entries)
+        assert len(new) == 1 and new[0].path.endswith("bad2.py")
+
+    def test_baseline_keys_on_snippet_not_lineno(self, tmp_path):
+        """Line drift above a baselined finding must not un-baseline
+        it — the key is (rule, path, source line text)."""
+        root = _tree(tmp_path, self.BAD)
+        fs = run(["lightgbm_tpu"], root)
+        entries = [{"rule": fs[0].rule, "path": fs[0].path,
+                    "snippet": fs[0].snippet, "justification": "legacy"}]
+        p = tmp_path / "lightgbm_tpu" / "ops" / "bad.py"
+        p.write_text("# a new comment shifts every line\n"
+                     + p.read_text())
+        fs2 = run(["lightgbm_tpu"], root)
+        assert fs2[0].line != fs[0].line
+        assert apply_baseline(fs2, entries) == []
+
+    def test_reporters(self, tmp_path):
+        root = _tree(tmp_path, self.BAD)
+        fs = run(["lightgbm_tpu"], root)
+        text = to_text(fs)
+        assert "J201" in text and "bad.py" in text
+        payload = json.loads(to_json(fs, fs))
+        assert payload["new_findings"] == 1
+        assert payload["per_rule"] == {"J201": 1}
+        assert payload["findings"][0]["snippet"] == "jf = jax.jit(f)"
+
+    def test_explain_every_rule_points_home(self):
+        for rid, rule in sorted(RULES.items()):
+            text = explain(rid)
+            assert text and rid in text and rule.summary in text
+        # determinism explains cite the PR-11 postmortem (ROADMAP 7)
+        for rid in ("D101", "D102", "D103"):
+            assert "ROADMAP" in explain(rid) and "PR-11" in explain(rid)
+
+    def test_cli_explain_and_exit_codes(self, tmp_path):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--explain", "D101"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0 and "PR-11" in out.stdout
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--explain", "NOPE"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 2
+        root = _tree(tmp_path, self.BAD)
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "lightgbm_tpu",
+             "--root", root, "--no-baseline", "--format", "json"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 1
+        assert json.loads(out.stdout)["new_findings"] == 1
+
+    def test_syntax_error_reported_not_crash(self, tmp_path):
+        root = _tree(tmp_path,
+                     {"lightgbm_tpu/ops/broken.py": "def f(:\n"})
+        fs = run(["lightgbm_tpu"], root)
+        assert len(fs) == 1 and fs[0].rule == "E000"
+
+    def test_rules_filter_selects_each_drift_rule(self, tmp_path):
+        """--rules P402 must RUN the P402 check, and --rules P401 must
+        not leak P402/P403 findings (the shared-walk regression)."""
+        root = _tree(tmp_path, {
+            "lightgbm_tpu/config.py": """
+                _P = {"tpu_undoc": ("int", 0, ())}
+            """,
+            "lightgbm_tpu/user.py": "def f(c):\n    return c.tpu_undoc\n",
+            "docs/Parameters.md": "`tpu_phantom`\n"})
+        assert _rules(run(["lightgbm_tpu"], root, rules=["P402"])) \
+            == ["P402"]
+        assert _rules(run(["lightgbm_tpu"], root, rules=["P403"])) \
+            == ["P403"]
+        assert _rules(run(["lightgbm_tpu"], root, rules=["P401"])) == []
+
+    def test_no_matching_files_is_an_error_not_a_pass(self, tmp_path):
+        """A typo'd path must not silently disable the gate."""
+        with pytest.raises(OSError, match="no .py files matched"):
+            run(["nonexistent_dir"], str(tmp_path))
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "nonexistent_dir",
+             "--root", str(tmp_path)],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 2
+        assert "no .py files matched" in out.stderr
+
+    def test_write_baseline_refuses_subset_runs(self, tmp_path):
+        """A --rules or path-subset --write-baseline would silently
+        drop every other entry from the shared baseline file."""
+        root = _tree(tmp_path, self.BAD)
+        for extra in (["--rules", "J201"], ["lightgbm_tpu"]):
+            out = subprocess.run(
+                [sys.executable, "-m", "tools.graftlint", "--root", root,
+                 "--baseline", str(tmp_path / "b.json"),
+                 "--write-baseline"] + extra,
+                cwd=REPO, capture_output=True, text=True, timeout=60)
+            assert out.returncode == 2, (extra, out.stdout, out.stderr)
+            assert "subset" in out.stderr
+        # the full default run writes (and E000 entries are excluded)
+        (tmp_path / "lightgbm_tpu" / "ops" / "broken.py").write_text(
+            "def f(:\n")
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--root", root,
+             "--baseline", str(tmp_path / "b.json"), "--write-baseline"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        entries = json.loads((tmp_path / "b.json").read_text())["entries"]
+        assert [e["rule"] for e in entries] == ["J201"]
+
+    def test_corrupt_baseline_is_a_usage_error(self, tmp_path):
+        root = _tree(tmp_path, self.BAD)
+        bad_baseline = tmp_path / "baseline.json"
+        bad_baseline.write_text("{not json<<<<")
+        with pytest.raises(ValueError, match="not valid JSON"):
+            load_baseline(str(bad_baseline))
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "lightgbm_tpu",
+             "--root", root, "--baseline", str(bad_baseline)],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        assert out.returncode == 2
+        assert "not valid JSON" in out.stderr
+        # absent baseline stays a valid (empty) state
+        assert load_baseline(str(tmp_path / "missing.json")) == []

@@ -1,0 +1,42 @@
+"""graftlint: determinism / jit-discipline / concurrency / drift
+static analysis for the lightgbm_tpu codebase.
+
+Four rule families, each born from a postmortem this repo already
+paid for (see `--explain <rule-id>` and ROADMAP item 7):
+
+* **D1xx determinism** — the PR-11 bitwise root causes as lint:
+  shape-keyed RNG (D101), f32 reductions over dequantized values
+  (D102), fused mul+add on score paths (D103).
+* **J2xx jit discipline** — every program on the CompileLedger (J201
+  jax.jit, J202 shard_map), no host calls in traced bodies (J203),
+  static_argnames in sync with canonical_params (J204).
+* **C3xx concurrency** — the serving/obs lock-ownership map (C301),
+  no dispatch under a lock (C302); runtime twin in
+  lightgbm_tpu/utils/lockcheck.py.
+* **P4xx config/docs drift** — every tpu_*/serving_* param read
+  somewhere (P401), documented (P402), and nothing documented that
+  does not exist (P403).
+
+Run: ``python -m tools.graftlint lightgbm_tpu/`` (text) or
+``--format json`` (machine-readable, the multichip-dryrun gate).
+Suppress inline: ``# graftlint: disable=J201 <why>``.  Accepted legacy
+findings live in tools/graftlint/baseline.json (committed, justified).
+"""
+
+from .core import (Finding, Project, RULES, apply_baseline, explain,  # noqa: F401
+                   load_baseline, run, to_json, to_text)
+
+DEFAULT_BASELINE = "tools/graftlint/baseline.json"
+
+
+def run_gate(root: str, paths=("lightgbm_tpu",)):
+    """The programmatic gate (multichip dryrun tail, tests): lint
+    `paths` under `root` against the committed baseline.  Returns
+    (new_findings, all_findings) — nonzero new findings fail the
+    caller."""
+    import os
+
+    findings = run(list(paths), root)
+    entries = load_baseline(os.path.join(root, DEFAULT_BASELINE))
+    new = apply_baseline(findings, entries)
+    return new, findings

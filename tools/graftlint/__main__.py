@@ -1,0 +1,147 @@
+"""CLI: python -m tools.graftlint [paths...] [options].
+
+Exit codes: 0 = clean beyond the baseline, 1 = new findings,
+2 = usage/configuration error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import DEFAULT_BASELINE
+from .core import (RULES, apply_baseline, baseline_payload, explain,
+                   load_baseline, run, to_json, to_text)
+
+
+def _find_root(start: str) -> str:
+    """Walk up until the directory containing the lightgbm_tpu package
+    (the repo root) — so the CLI works from subdirectories too."""
+    d = os.path.abspath(start)
+    while True:
+        if os.path.isdir(os.path.join(d, "lightgbm_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.path.abspath(start)
+        d = parent
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.graftlint",
+        description="determinism / jit / concurrency / drift lint")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: lightgbm_tpu/)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: auto-detected)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default: {DEFAULT_BASELINE} "
+                         "under the root, when present)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report everything, ignoring any baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings to the baseline file "
+                         "(then hand-edit the justifications)")
+    ap.add_argument("--explain", metavar="RULE_ID",
+                    help="print one rule's rationale and exit")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule ids to run (default all)")
+    args = ap.parse_args(argv)
+
+    if args.explain:
+        # load the registry
+        from . import concurrency, determinism, drift, jitrules  # noqa: F401
+
+        text = explain(args.explain)
+        if text is None:
+            print(f"unknown rule {args.explain!r}; known: "
+                  f"{', '.join(sorted(RULES))}", file=sys.stderr)
+            return 2
+        print(text)
+        return 0
+
+    if args.list_rules:
+        from . import concurrency, determinism, drift, jitrules  # noqa: F401
+
+        for rid in sorted(RULES):
+            r = RULES[rid]
+            print(f"{rid}  [{r.family}]  {r.name}: {r.summary}")
+        return 0
+
+    root = args.root or _find_root(os.getcwd())
+    paths = args.paths or ["lightgbm_tpu"]
+    rules = ([s.strip() for s in args.rules.split(",") if s.strip()]
+             if args.rules else None)
+    if rules:
+        from . import concurrency, determinism, drift, jitrules  # noqa: F401
+
+        unknown = [r for r in rules if r not in RULES]
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+    try:
+        findings = run(paths, root, rules=rules)
+    except (OSError, ValueError) as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    if args.write_baseline:
+        # the baseline is shared across ALL rules and paths: writing it
+        # from a subset run would silently drop every entry the subset
+        # didn't produce, and the next full gate run fails on them
+        if rules:
+            print("--write-baseline needs a full-rule run (drop "
+                  "--rules): a subset write would discard the other "
+                  "rules' baseline entries", file=sys.stderr)
+            return 2
+        if args.paths:
+            print("--write-baseline needs the default full path set "
+                  "(drop the path arguments): a subset write would "
+                  "discard other files' baseline entries",
+                  file=sys.stderr)
+            return 2
+        # parse failures are findings to FIX, never to baseline
+        writable = [f for f in findings if f.rule != "E000"]
+        payload = baseline_payload(writable)
+        bdir = os.path.dirname(baseline_path)
+        if bdir:
+            os.makedirs(bdir, exist_ok=True)
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2)
+            f.write("\n")
+        skipped = len(findings) - len(writable)
+        print(f"wrote {len(writable)} entr"
+              f"{'y' if len(writable) == 1 else 'ies'} to "
+              f"{baseline_path}; fill in the justifications."
+              + (f"  ({skipped} parse-failure finding(s) NOT baselined "
+                 "— fix the files)" if skipped else ""))
+        return 0
+
+    try:
+        entries = [] if args.no_baseline else load_baseline(baseline_path)
+    except ValueError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+    new = apply_baseline(findings, entries)
+    if args.format == "json":
+        print(to_json(new, findings))
+    else:
+        print(to_text(new, baselined_count=len(findings) - len(new)))
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:     # | head closed the pipe: not an error
+        import os
+
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -1,0 +1,253 @@
+"""Concurrency rules (C3xx): the declared lock-ownership map, enforced.
+
+Scope: `lightgbm_tpu/serving/` and `lightgbm_tpu/obs/` — the
+multithreaded layers (batcher worker, dispatch helper, HTTP handlers,
+admission gate, metrics writers).  The OWNERSHIP table below IS the
+contract: each guarded attribute may only be mutated inside a `with`
+block on its owning lock.  State deliberately left lock-free (the
+flight recorder's GIL-atomic deque ring, `obs.metrics._sample_ring`)
+is simply not in the table — adding new shared state means adding a
+row here (or documenting why it is lock-free).
+
+The runtime half lives in `lightgbm_tpu/utils/lockcheck.py`: the same
+locks, created through `lockcheck.make_lock`, detect lock-ORDER
+inversions and hold-while-dispatching dynamically under tests — things
+no static map can see.
+
+Conventions the checker honors:
+* `__init__`/`__new__` are exempt (the object is not yet shared);
+* methods named `*_locked` are exempt (the documented caller-holds-it
+  convention, e.g. ModelRegistry._evict_locked);
+* a mutation counts as guarded when ANY enclosing `with` manages an
+  expression whose terminal attribute/name equals the owning lock's.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, Optional, Tuple
+
+from .core import FileContext, Rule, dotted_name, enclosing_withs, \
+    parents, register
+
+_SCOPE = re.compile(r"(^|/)lightgbm_tpu/(serving|obs)/")
+
+
+def concurrent_scope(rel: str) -> bool:
+    return bool(_SCOPE.search(rel))
+
+
+# (file suffix, class name or None for module level) ->
+#     {guarded attribute: owning lock attribute}
+OWNERSHIP: Dict[Tuple[str, Optional[str]], Dict[str, str]] = {
+    ("serving/registry.py", "ModelRegistry"): {
+        "_entries": "_lock", "_latest": "_lock", "_counts": "_lock",
+        "_warmed": "_lock"},
+    ("serving/batcher.py", "MicroBatcher"): {
+        "_queues": "_cv", "_runners": "_cv", "_pending_rows": "_cv",
+        "_stop": "_cv", "_draining": "_cv"},
+    ("serving/batcher.py", "_SerialDispatcher"): {
+        "_work": "_lock", "_busy": "_lock"},
+    ("serving/stats.py", "ServingStats"): {
+        "_fill_rows": "_lock", "_fill_bucket": "_lock",
+        "_queue_depth": "_lock", "_shapes": "_lock"},
+    ("serving/stats.py", "CircuitBreaker"): {
+        "state": "_lock", "_failures": "_lock", "_entered_at": "_lock",
+        "_gen": "_lock"},
+    ("serving/admission.py", "AdmissionController"): {
+        "_level": "_lock", "_window_s": "_lock", "_projection_s": "_lock",
+        "_next_update": "_lock", "_draining": "_lock"},
+    ("obs/metrics.py", "MetricsRegistry"): {
+        "_families": "_lock"},
+    ("obs/metrics.py", "_Family"): {
+        "children": "lock"},
+    ("obs/flightrecorder.py", None): {
+        "_last_dump": "_dump_lock", "_dumps": "_dump_lock"},
+}
+
+_MUTATORS = {"append", "extend", "add", "remove", "discard", "pop",
+             "popleft", "popitem", "clear", "update", "setdefault",
+             "insert", "move_to_end", "appendleft"}
+
+
+def _enclosing_class(node: ast.AST) -> Optional[str]:
+    for p in parents(node):
+        if isinstance(p, ast.ClassDef):
+            return p.name
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # keep walking: methods live inside the class
+            continue
+    return None
+
+
+def _exempt_function(node: ast.AST) -> bool:
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if p.name in ("__init__", "__new__") or \
+                    p.name.endswith("_locked"):
+                return True
+            # only the INNERMOST def decides; a nested closure inside
+            # __init__ is still exempt via the outer hit above
+    return False
+
+
+def _with_locks(node: ast.AST) -> Iterable[str]:
+    """Terminal names of every context-manager expression in enclosing
+    with blocks: `with self._lock:` -> '_lock', `with fam.lock:` ->
+    'lock', `with _dump_lock:` -> '_dump_lock'."""
+    for w in enclosing_withs(node):
+        for item in w.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):   # e.g. MonkeyPatch.context()
+                expr = expr.func
+            if isinstance(expr, ast.Attribute):
+                yield expr.attr
+            elif isinstance(expr, ast.Name):
+                yield expr.id
+
+
+def _attr_of_interest(node: ast.AST, guarded: Dict[str, str]
+                      ) -> Optional[str]:
+    """If `node` is (or drills into) self.X / obj.X / module-global X
+    with X guarded, return X."""
+    # unwrap subscripts: self._entries[k] -> self._entries
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and node.attr in guarded:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in guarded:
+        return node.id
+    return None
+
+
+def _module_has_global(fn: ast.AST, name: str) -> bool:
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Global) and name in n.names:
+            return True
+    return False
+
+
+def _check_unlocked_mutation(fc: FileContext):
+    maps = {cls: m for (suffix, cls), m in OWNERSHIP.items()
+            if fc.rel.endswith(suffix)}
+    if not maps:
+        return
+    for node in ast.walk(fc.tree):
+        guarded_attr = owner = None
+        anchor = node
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                cls = _enclosing_class(t)
+                # maps.get(None) IS the module-level ownership map
+                m = maps.get(cls)
+                if m is None:
+                    continue
+                attr = _attr_of_interest(t, m)
+                if attr is not None:
+                    # module-level map only applies to real globals
+                    if cls is None and isinstance(t, ast.Name):
+                        fn = next((p for p in parents(t) if isinstance(
+                            p, (ast.FunctionDef, ast.AsyncFunctionDef))),
+                            None)
+                        if fn is None or not _module_has_global(fn, attr):
+                            continue
+                    guarded_attr, owner = attr, m[attr]
+                    break
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                cls = _enclosing_class(t)
+                m = maps.get(cls)
+                if m is None:
+                    continue
+                attr = _attr_of_interest(t, m)
+                if attr is not None:
+                    guarded_attr, owner = attr, m[attr]
+                    break
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            cls = _enclosing_class(node)
+            m = maps.get(cls)
+            if m is not None:
+                attr = _attr_of_interest(node.func.value, m)
+                if attr is not None:
+                    guarded_attr, owner = attr, m[attr]
+        if guarded_attr is None:
+            continue
+        if _exempt_function(anchor):
+            continue
+        if owner in set(_with_locks(anchor)):
+            continue
+        yield fc.finding(
+            "C301", anchor,
+            f"{guarded_attr!r} mutated outside `with {owner}`: the "
+            "lock-ownership map (tools/graftlint/concurrency.py "
+            "OWNERSHIP) declares it guarded.  Take the owning lock, "
+            "move the mutation into a *_locked helper, or amend the "
+            "map with a comment if the state became lock-free by "
+            "design.")
+
+
+_DISPATCH_CALLEES = {"predict", "warmup", "runner", "fallback",
+                     "_native_predict", "block_until_ready",
+                     "device_get", "device_put"}
+_LOCK_NAME = re.compile(r"(^|_)(lock|cv)$|_lock$|_cv$")
+
+
+def _check_dispatch_under_lock(fc: FileContext):
+    for node in ast.walk(fc.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        leaf = dotted_name(node.func).rsplit(".", 1)[-1]
+        if leaf not in _DISPATCH_CALLEES:
+            continue
+        held = [w for w in _with_locks(node) if _LOCK_NAME.search(w)]
+        if held:
+            yield fc.finding(
+                "C302", node,
+                f"device-dispatch call {leaf!r} inside `with "
+                f"{held[0]}`: a device wall is unbounded from the "
+                "host's view, so every thread queued on that lock "
+                "stalls behind the launch (the registry runs warmup "
+                "OUTSIDE its lock for exactly this reason).  Snapshot "
+                "state under the lock, release it, then dispatch.  "
+                "The runtime twin is lockcheck.check_dispatch.")
+
+
+register(Rule(
+    id="C301", name="mutation-outside-owning-lock", family="concurrency",
+    summary=("Shared mutable state declared in the lock-ownership map "
+             "may only be mutated under its owning lock."),
+    rationale=(
+        "The serving/obs layers are mutated from HTTP handler threads, "
+        "the batcher worker, the dispatch helper, and the admission "
+        "gate concurrently.  Each shared structure has exactly one "
+        "owning lock, declared in the OWNERSHIP table; an undeclared "
+        "mutation path is a data race waiting for a scheduler to find "
+        "it.  Deliberately lock-free state (the flight recorder's "
+        "GIL-atomic ring) is excluded from the table, with the "
+        "reasoning documented at the definition.  The runtime half — "
+        "lock-order inversions, mutation-without-lock under a thread "
+        "hammer — is utils/lockcheck.py, enabled under tests."),
+    scope=concurrent_scope,
+    check=lambda fc: _check_unlocked_mutation(fc)))
+
+register(Rule(
+    id="C302", name="dispatch-while-holding-lock", family="concurrency",
+    summary=("No device dispatch (predict/warmup/runner/fallback/"
+             "block_until_ready) inside a with-lock block."),
+    rationale=(
+        "A jit launch or device sync can take seconds (cold compile) "
+        "or forever (wedged device — the PR-11 watchdog exists because "
+        "it happened).  Holding a serving lock across one turns a "
+        "single slow launch into a full-service stall: every HTTP "
+        "thread piles up on the lock behind it.  The registry "
+        "deliberately runs load/warmup outside its lock and the "
+        "batcher dispatches outside its condition variable; this rule "
+        "keeps it that way.  lockcheck.check_dispatch() is the runtime "
+        "twin at the dispatch sites themselves."),
+    scope=concurrent_scope,
+    check=lambda fc: _check_dispatch_under_lock(fc)))

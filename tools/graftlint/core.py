@@ -1,0 +1,422 @@
+"""graftlint core: AST walker, rule registry, suppressions, baseline.
+
+The engine is deliberately small: parse every file once into a
+`FileContext` (source lines + AST with parent links + suppression
+directives), run each registered rule's per-file `check` over the
+contexts in its scope, then run project-wide rules (`project_check`)
+that need the whole file set (config/docs drift, the canonical_params
+folded-field set).  Findings are plain records keyed for baselining by
+(rule, path, stripped source line) — line NUMBERS drift with every
+edit, line TEXT only changes when the flagged code does, so a committed
+baseline survives unrelated churn.
+
+Suppression directives (scanned per raw source line):
+
+    x = jax.jit(f)          # graftlint: disable=J201 <why>
+    # graftlint: disable-next-line=D103 <why>
+    # graftlint: disable-file=J203 <why>
+
+Multiple ids separate with commas.  Every suppression should carry a
+justification in the trailing text — `--format json` surfaces the
+directive line so reviews can audit them.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# findings
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str              # repo-relative, forward slashes
+    line: int
+    message: str
+    snippet: str = ""      # stripped source line (the baseline key)
+    baselined: bool = False
+
+    def key(self):
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> Dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "snippet": self.snippet,
+                "baselined": self.baselined}
+
+
+# ---------------------------------------------------------------------------
+# rule registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Rule:
+    id: str
+    name: str
+    family: str            # determinism | jit | concurrency | drift
+    summary: str
+    rationale: str         # --explain body
+    scope: Optional[Callable[[str], bool]] = None   # relpath predicate
+    check: Optional[Callable[["FileContext"], Iterable[Finding]]] = None
+    project_check: Optional[Callable[["Project"], Iterable[Finding]]] = None
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    if rule.id in RULES:
+        raise ValueError(f"duplicate rule id {rule.id}")
+    RULES[rule.id] = rule
+    return rule
+
+
+# ---------------------------------------------------------------------------
+# suppression directives
+# ---------------------------------------------------------------------------
+
+_DIRECTIVE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-next-line|-file)?)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)")
+
+
+def _comment_lines(source: str, lines: Sequence[str]):
+    """(lineno, comment_text) for every REAL comment token.  Tokenizing
+    (rather than regex over raw lines) keeps directive-shaped text
+    inside strings/docstrings — e.g. documentation QUOTING the
+    suppression syntax — from silently creating real (even file-wide)
+    suppressions.  Token errors fall back to raw-line scanning: a file
+    the tokenizer rejects usually fails ast.parse too (reported as
+    E000), and over-suppressing an unparseable file is moot."""
+    import io
+    import tokenize
+
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                yield tok.start[0], tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        for i, raw in enumerate(lines, start=1):
+            yield i, raw
+
+
+def _parse_suppressions(source: str, lines: Sequence[str]):
+    """-> (per-line {lineno: set(ids)}, file-wide set(ids))."""
+    per_line: Dict[int, set] = {}
+    file_wide: set = set()
+    for i, comment in _comment_lines(source, lines):
+        m = _DIRECTIVE.search(comment)
+        if not m:
+            continue
+        kind = m.group(1)
+        ids = {s.strip() for s in m.group(2).split(",") if s.strip()}
+        if kind == "disable-file":
+            file_wide |= ids
+        elif kind == "disable-next-line":
+            per_line.setdefault(i + 1, set()).update(ids)
+        else:
+            per_line.setdefault(i, set()).update(ids)
+    return per_line, file_wide
+
+
+# ---------------------------------------------------------------------------
+# file context
+# ---------------------------------------------------------------------------
+
+
+class FileContext:
+    """One parsed file: source, AST with parent links, suppressions."""
+
+    def __init__(self, abspath: str, rel: str, source: str):
+        self.abspath = abspath
+        self.rel = rel.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=abspath)
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._gl_parent = node  # type: ignore[attr-defined]
+        self._suppress_line, self._suppress_file = _parse_suppressions(
+            source, self.lines)
+
+    # -- helpers rules use ---------------------------------------------
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule_id: str, lineno: int) -> bool:
+        if rule_id in self._suppress_file:
+            return True
+        ids = self._suppress_line.get(lineno)
+        return bool(ids) and rule_id in ids
+
+    def finding(self, rule_id: str, node_or_line, message: str
+                ) -> Optional[Finding]:
+        """Build a Finding unless suppressed; rules yield the result if
+        not None."""
+        lineno = (node_or_line if isinstance(node_or_line, int)
+                  else getattr(node_or_line, "lineno", 0))
+        if self.suppressed(rule_id, lineno):
+            return None
+        return Finding(rule=rule_id, path=self.rel, line=lineno,
+                       message=message, snippet=self.line_text(lineno))
+
+
+# -- AST utilities shared by the rule modules --------------------------------
+
+
+def parents(node: ast.AST):
+    p = getattr(node, "_gl_parent", None)
+    while p is not None:
+        yield p
+        p = getattr(p, "_gl_parent", None)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.random.fold_in' for the func of a Call (best effort)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    elif isinstance(node, ast.Call):
+        # partial(jax.jit, ...)(f) and friends: descend into the callee
+        parts.append(dotted_name(node.func))
+    return ".".join(reversed(parts))
+
+
+def subtree_names(node: ast.AST) -> List[str]:
+    """Every Name id and Attribute attr below `node` (inclusive)."""
+    out: List[str] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.append(n.attr)
+    return out
+
+
+def subtree_strings(node: ast.AST) -> List[str]:
+    return [n.value for n in ast.walk(node)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)]
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for p in parents(node):
+        if isinstance(p, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return p
+    return None
+
+
+def enclosing_withs(node: ast.AST) -> List[ast.With]:
+    return [p for p in parents(node) if isinstance(p, ast.With)]
+
+
+# ---------------------------------------------------------------------------
+# project: the linted file set + cross-file facts
+# ---------------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", ".pytest_cache",
+              ".hypothesis", ".refbuild", ".jax_cache", "node_modules"}
+
+
+def iter_py_files(paths: Sequence[str], root: str) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in _SKIP_DIRS)
+            for f in sorted(filenames):
+                if f.endswith(".py"):
+                    out.append(os.path.join(dirpath, f))
+    return out
+
+
+class Project:
+    def __init__(self, root: str, files: List[FileContext]):
+        self.root = root
+        self.files = files
+        self.errors: List[Finding] = []   # parse failures, reported
+
+    @classmethod
+    def load(cls, paths: Sequence[str], root: str) -> "Project":
+        files: List[FileContext] = []
+        errors: List[Finding] = []
+        for abspath in iter_py_files(paths, root):
+            rel = os.path.relpath(abspath, root).replace(os.sep, "/")
+            try:
+                with open(abspath, encoding="utf-8") as f:
+                    src = f.read()
+                files.append(FileContext(abspath, rel, src))
+            except (SyntaxError, UnicodeDecodeError) as exc:
+                errors.append(Finding(
+                    rule="E000", path=rel,
+                    line=getattr(exc, "lineno", 0) or 0,
+                    message=f"could not parse: {exc}"))
+        proj = cls(root, files)
+        proj.errors = errors
+        return proj
+
+    def file(self, rel_suffix: str) -> Optional[FileContext]:
+        for fc in self.files:
+            if fc.rel.endswith(rel_suffix):
+                return fc
+        return None
+
+    def read_text(self, *relparts: str) -> Optional[str]:
+        """A non-linted project file (docs/Parameters.md); None when
+        absent — project rules skip rather than crash on partial
+        checkouts / fixture trees."""
+        p = os.path.join(self.root, *relparts)
+        try:
+            with open(p, encoding="utf-8") as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+
+def run(paths: Sequence[str], root: str,
+        rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    # rule modules self-register on import
+    from . import concurrency, determinism, drift, jitrules  # noqa: F401
+
+    project = Project.load(paths, root)
+    if not project.files and not project.errors:
+        # a typo'd path must not silently disable the gate (the same
+        # contract the dryrun tail holds bench_diff to): zero matched
+        # files is a usage error, never a clean pass
+        raise OSError(
+            f"no .py files matched {list(paths)!r} under {root!r}")
+    selected = [RULES[r] for r in rules] if rules else list(RULES.values())
+    findings: List[Finding] = list(project.errors)
+    for rule in selected:
+        if rule.check is not None:
+            for fc in project.files:
+                if rule.scope is not None and not rule.scope(fc.rel):
+                    continue
+                findings.extend(f for f in rule.check(fc) if f is not None)
+        if rule.project_check is not None:
+            findings.extend(f for f in rule.project_check(project)
+                            if f is not None)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_baseline(path: str) -> List[Dict]:
+    """Entries of a baseline file; [] when the file is absent (no
+    baseline is a valid state).  A PRESENT-but-unparseable baseline
+    raises ValueError: silently ignoring it would resurface every
+    baselined finding (confusing) or — worse, had we returned the
+    parseable prefix — hide some (gate-defeating)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return []
+    except json.JSONDecodeError as exc:
+        raise ValueError(
+            f"baseline {path!r} is not valid JSON ({exc}); fix it or "
+            "regenerate with --write-baseline") from exc
+    if not isinstance(data, dict) or not isinstance(
+            data.get("entries", []), list):
+        raise ValueError(
+            f"baseline {path!r} malformed: expected an object with an "
+            "'entries' list")
+    return list(data.get("entries", []))
+
+
+def apply_baseline(findings: List[Finding],
+                   entries: List[Dict]) -> List[Finding]:
+    """Mark findings matching a baseline entry (rule+path+snippet).
+    Returns the NEW (un-baselined) findings; the input list keeps the
+    `baselined` flags for full reports."""
+    pool: Dict[tuple, int] = {}
+    for e in entries:
+        k = (e.get("rule", ""), e.get("path", ""), e.get("snippet", ""))
+        pool[k] = pool.get(k, 0) + 1
+    new: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if pool.get(k, 0) > 0:
+            pool[k] -= 1
+            f.baselined = True
+        else:
+            new.append(f)
+    return new
+
+
+def baseline_payload(findings: List[Finding]) -> Dict:
+    return {"_comment": (
+        "graftlint baseline: findings accepted as-is.  Every entry "
+        "MUST carry a justification; prefer fixing or an inline "
+        "suppression comment next to the code.  Regenerate with "
+        "python -m tools.graftlint --write-baseline."),
+        "entries": [
+            {"rule": f.rule, "path": f.path, "snippet": f.snippet,
+             "justification": "TODO: justify or fix"}
+            for f in findings]}
+
+
+# ---------------------------------------------------------------------------
+# reporters
+# ---------------------------------------------------------------------------
+
+
+def to_text(findings: List[Finding], baselined_count: int = 0) -> str:
+    lines = []
+    for f in findings:
+        lines.append(f"{f.path}:{f.line}: {f.rule} {f.message}")
+        if f.snippet:
+            lines.append(f"    {f.snippet}")
+    lines.append(f"graftlint: {len(findings)} finding(s)"
+                 + (f" ({baselined_count} baselined, not shown)"
+                    if baselined_count else ""))
+    return "\n".join(lines)
+
+
+def to_json(findings: List[Finding], all_findings: List[Finding]) -> str:
+    per_rule: Dict[str, int] = {}
+    for f in findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    return json.dumps({
+        "tool": "graftlint",
+        "new_findings": len(findings),
+        "baselined": sum(1 for f in all_findings if f.baselined),
+        "per_rule": per_rule,
+        "findings": [f.to_dict() for f in findings],
+    }, indent=2)
+
+
+def explain(rule_id: str) -> Optional[str]:
+    from . import concurrency, determinism, drift, jitrules  # noqa: F401
+
+    rule = RULES.get(rule_id)
+    if rule is None:
+        return None
+    return (f"{rule.id} ({rule.family}): {rule.name}\n\n"
+            f"{rule.summary}\n\n{rule.rationale.strip()}\n")

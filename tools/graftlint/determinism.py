@@ -1,0 +1,213 @@
+"""Determinism rules (D1xx): the PR-11 postmortem bug classes as lint.
+
+Scope: the bitwise-critical modules — `lightgbm_tpu/ops/`,
+`lightgbm_tpu/parallel/`, and `lightgbm_tpu/models/learner.py` — where
+the cross-shard/cross-topology bitwise contract lives (ROADMAP item 7).
+All three PR-11 root causes were syntactically recognizable; these
+rules make them machine-checked so the next jit site cannot re-ship
+them.  `--explain D101` (etc.) prints the full story.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import (FileContext, Rule, dotted_name, register,
+                   subtree_names, subtree_strings)
+
+_SCOPE = re.compile(
+    r"(^|/)lightgbm_tpu/(ops|parallel)/|(^|/)lightgbm_tpu/models/learner\.py$")
+
+
+def bitwise_critical(rel: str) -> bool:
+    return bool(_SCOPE.search(rel))
+
+
+_POSTMORTEM = (
+    "Background: ROADMAP.md open item 7 — the PR-11 postmortem of the "
+    "cross-shard int16 bitwise violation (three stacked root causes, "
+    "each one a syntactic pattern this family now rejects).")
+
+# padded-axis spellings: the length of a PADDED axis is topology-
+# dependent, so anything derived from it diverges across shard counts
+_PAD_NAME = re.compile(r"(^|_)(n_pad|f_pad|g_pad|k_pad|pad|padded|"
+                       r"pad_rows|pad_cols|padding)($|_)|_pad$|^pad_")
+
+_RNG_KEYING = ("PRNGKey", "fold_in", "key", "key_data")
+
+
+def _check_shape_keyed_rng(fc: FileContext):
+    for node in ast.walk(fc.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf not in _RNG_KEYING or "random" not in name:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            names = subtree_names(arg)
+            shapes = [n for n in names if n == "shape"]
+            pads = [n for n in names if _PAD_NAME.search(n)]
+            if shapes or pads:
+                what = "array shape" if shapes else f"padded axis {pads[0]!r}"
+                yield fc.finding(
+                    "D101", node,
+                    f"PRNG keying via {name} derived from {what}: padded/"
+                    "sharded axis lengths are topology-dependent, so the "
+                    "stream diverges across shard counts.  Key on GLOBAL "
+                    "row indices instead (the PCG hash over "
+                    "jax.lax.iota of global ids, as bagging does "
+                    "post-PR-11).")
+
+
+_REDUCERS = ("cumsum", "sum", "cumulative_sum", "nancumsum")
+_F32_TOKENS = ("float32", "float", "f32", "float64", "f64")
+
+
+def _casts_int_to_float(node: ast.AST) -> bool:
+    """True when the subtree dequantizes: .astype(float...) /
+    jnp.float32(...) over something, or names containing 'dequant'."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            dn = dotted_name(n.func)
+            leaf = dn.rsplit(".", 1)[-1]
+            if leaf == "astype":
+                toks = subtree_names(n) + subtree_strings(n)
+                if any(t in _F32_TOKENS for t in toks):
+                    return True
+            if leaf in ("float32", "float64", "bfloat16"):
+                return True
+    return any("dequant" in n.lower() for n in subtree_names(node))
+
+
+def _float_dtype_kwarg(node: ast.Call) -> bool:
+    """cumsum(x, dtype=jnp.float32) — the kwarg spelling of the same
+    dequantizing reduction (`dtype` is an Attribute, not a cast call,
+    so _casts_int_to_float alone misses it)."""
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            toks = subtree_names(kw.value) + subtree_strings(kw.value)
+            if any(t in _F32_TOKENS for t in toks):
+                return True
+    return False
+
+
+def _check_f32_reduction(fc: FileContext):
+    for node in ast.walk(fc.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf not in _REDUCERS:
+            continue
+        # jnp.cumsum(x) / x.cumsum(): scan args AND the method receiver
+        operands = list(node.args) + [kw.value for kw in node.keywords]
+        if isinstance(node.func, ast.Attribute):
+            operands.append(node.func.value)
+        if any(_casts_int_to_float(a) for a in operands) or \
+                _float_dtype_kwarg(node):
+            yield fc.finding(
+                "D102", node,
+                f"f32 {leaf} over dequantized values: float reductions "
+                "reassociate under sharding/fusion (one-ulp split-gain "
+                "drift at near-ties).  Reduce on the int32 grid and "
+                "dequantize at the BOUNDARY — exact integer scans are "
+                "associative at any shard count.")
+
+
+_SCORE_NAME = re.compile(r"(^|_)scores?($|_)")
+_LEAF_NAME = re.compile(r"leaf|output|values")
+
+
+def _is_mult(node: ast.AST) -> bool:
+    return isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult)
+
+
+def _mult_has_leaf_gather(mult: ast.AST) -> bool:
+    for n in ast.walk(mult):
+        if isinstance(n, ast.Subscript):
+            base = subtree_names(n.value)
+            if any(_LEAF_NAME.search(b) for b in base):
+                return True
+    return False
+
+
+def _check_fused_mul_add(fc: FileContext):
+    seen = set()
+    for node in ast.walk(fc.tree):
+        mult = other = None
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            if _is_mult(node.left):
+                mult, other = node.left, node.right
+            elif _is_mult(node.right):
+                mult, other = node.right, node.left
+            anchor = node
+        elif isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add) \
+                and _is_mult(node.value):
+            mult, other = node.value, node.target
+            anchor = node
+        if mult is None:
+            continue
+        if not any(_SCORE_NAME.search(n) for n in subtree_names(other)):
+            continue
+        if not _mult_has_leaf_gather(mult):
+            continue
+        if anchor.lineno in seen:
+            continue
+        seen.add(anchor.lineno)
+        yield fc.finding(
+            "D103", anchor,
+            "fused a*b+c chain on a score/leaf path: XLA/LLVM may (or "
+            "may not) contract the mul+add into an FMA depending on the "
+            "surrounding program, so serial and shard_map builds drift "
+            "one ulp apart at the SAME trees.  Pre-scale the [L] leaf "
+            "vector first, then gather + ONE rounded add "
+            "(scores.at[...].add(scaled[ids]) — the PR-11 idiom).")
+
+
+register(Rule(
+    id="D101", name="shape-keyed-rng", family="determinism",
+    summary=("PRNG keys/streams must never derive from array shapes or "
+             "padded-axis lengths in bitwise-critical modules; key on "
+             "global row indices."),
+    rationale=(
+        "PR-11 root cause #1: bagging/GOSS masks were drawn with "
+        "shape-keyed threefry over the PADDED row axis, whose length is "
+        "topology-dependent — identical seeds produced different masks "
+        "at different shard counts, silently breaking the cross-shard "
+        "bitwise contract.  The fix keys the PCG hash on GLOBAL row "
+        "indices (invariant to padding and sharding).  " + _POSTMORTEM),
+    scope=bitwise_critical,
+    check=lambda fc: _check_shape_keyed_rng(fc)))
+
+register(Rule(
+    id="D102", name="f32-reduction-on-dequantized", family="determinism",
+    summary=("No f32 cumsum/sum over dequantized (int-origin) values "
+             "where the exact int32 route exists; reduce integer, "
+             "dequantize at the boundary."),
+    rationale=(
+        "PR-11 root cause #3: split-search bin cumsums ran on "
+        "pre-dequantized f32 stats — float addition is not associative, "
+        "so psum/scatter aggregation orders produced one-ulp gain drift "
+        "and flipped near-tied splits.  Quantized precisions carry "
+        "exact int32 sums; scanning THOSE and dequantizing the final "
+        "values is bit-identical at every shard count.  " + _POSTMORTEM),
+    scope=bitwise_critical,
+    check=lambda fc: _check_f32_reduction(fc)))
+
+register(Rule(
+    id="D103", name="fused-mul-add-on-score-path", family="determinism",
+    summary=("No a*b+c mul+add chains touching score/leaf-output "
+             "buffers; pre-scale the leaf vector, then gather + one "
+             "rounded add."),
+    rationale=(
+        "PR-11 root cause #2: the fused score update's "
+        "`gather * lr + scores` chain contracted into an FMA "
+        "differently between the serial and shard_map programs — "
+        "scores drifted one ulp apart under IDENTICAL trees.  Scaling "
+        "the [L] leaf vector first leaves the per-row path as gather + "
+        "one correctly-rounded add, which every backend lowers "
+        "identically.  " + _POSTMORTEM),
+    scope=bitwise_critical,
+    check=lambda fc: _check_fused_mul_add(fc)))

@@ -1,0 +1,205 @@
+"""Config/docs drift rules (P4xx): every tpu_*/serving_* param read
+somewhere and documented, and nothing documented that does not exist.
+
+The config registry (`lightgbm_tpu/config.py` `_P`) is the single
+source of truth; docs/Parameters.md is GENERATED from it
+(tools/gen_params_doc.py, gated by tests/test_params_doc.py).  What the
+generator cannot check is the third leg: that the code actually READS
+each param.  A `tpu_*` knob nobody reads is worse than dead code — it
+is a user-facing promise ("set this and behavior changes") that
+silently does nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, Project, Rule, register
+
+_PREFIX = re.compile(r"^(tpu_|serving_)")
+_DOC_TOKEN = re.compile(r"\b((?:tpu|serving)_[a-z0-9_]+)\b")
+
+# tokens that LOOK like params in docs prose but are not registry
+# entries by design (each one justified here, not baselined):
+#   tpu_bin_mappers — the saved-model trailer section name (PR 2), a
+#       model-file format token, not a config knob
+_DOC_TOKEN_ALLOWED = {"tpu_bin_mappers"}
+
+
+def _registry_params(project: Project) -> Dict[str, int]:
+    """tpu_*/serving_* keys of config.py's _P literal -> lineno."""
+    fc = project.file("lightgbm_tpu/config.py")
+    if fc is None:
+        return {}
+    for node in ast.walk(fc.tree):
+        if isinstance(node, ast.AnnAssign) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id == "_P" and isinstance(node.value, ast.Dict):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and _PREFIX.match(k.value)}
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "_P" and \
+                isinstance(node.value, ast.Dict):
+            return {k.value: k.lineno for k in node.value.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                    and _PREFIX.match(k.value)}
+    return {}
+
+
+def _usage_tokens(project: Project) -> Set[str]:
+    """Every identifier-ish token that counts as 'reading' a param:
+    attribute access (config.tpu_x), Name, keyword arg, or a string
+    literal ("tpu_x" lookups / docstring references do NOT count —
+    only code-position strings inside calls, e.g. .get("tpu_x"))."""
+    used: Set[str] = set()
+    # the lint file set usually covers only lightgbm_tpu/, but a param
+    # legitimately consumed ONLY by tools/ or the bench/driver scripts
+    # (serve_bench reads serving config) must not be reported dead —
+    # the message says "package/tools", so the scan reads them too
+    used |= _script_tokens(project)
+    for fc in project.files:
+        if fc.rel.endswith("lightgbm_tpu/config.py"):
+            continue  # the registry defining a param is not a read
+        for node in ast.walk(fc.tree):
+            if isinstance(node, ast.Attribute):
+                used.add(node.attr)
+            elif isinstance(node, ast.Name):
+                used.add(node.id)
+            elif isinstance(node, ast.keyword) and node.arg:
+                used.add(node.arg)
+            elif isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                # string params surface as .get("tpu_x") / params
+                # dict keys in tests and tools — count them, but only
+                # exact identifier-shaped strings (not prose)
+                v = node.value.strip()
+                if _PREFIX.match(v) and re.fullmatch(r"[a-z0-9_]+", v):
+                    used.add(v)
+    return used
+
+
+def _script_tokens(project: Project) -> Set[str]:
+    """tpu_*/serving_* word tokens from the non-linted consumer
+    scripts (tools/*.py, bench.py, __graft_entry__.py): a word-level
+    scan — membership is all P401 needs, and these files may not be in
+    the linted set at all."""
+    import os
+
+    out: Set[str] = set()
+    paths = []
+    tools_dir = os.path.join(project.root, "tools")
+    if os.path.isdir(tools_dir):
+        for dirpath, dirnames, filenames in os.walk(tools_dir):
+            # graftlint itself is not a consumer: a param named in a
+            # rule comment must not count as "read"
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", "graftlint")]
+            paths += [os.path.join(dirpath, f) for f in filenames
+                      if f.endswith(".py")]
+    for extra in ("bench.py", "__graft_entry__.py"):
+        paths.append(os.path.join(project.root, extra))
+    for p in paths:
+        try:
+            with open(p, encoding="utf-8") as f:
+                out |= set(_DOC_TOKEN.findall(f.read()))
+        except OSError:
+            continue
+    return out
+
+
+def _facts(project: Project):
+    """(params, doc, doc_tokens) computed once per Project — the three
+    drift rules share the scan instead of re-parsing the registry and
+    re-reading Parameters.md per rule."""
+    cached = getattr(project, "_gl_drift_facts", None)
+    if cached is None:
+        params = _registry_params(project)
+        doc = project.read_text("docs", "Parameters.md")
+        doc_tokens = set(_DOC_TOKEN.findall(doc)) if doc else set()
+        cached = project._gl_drift_facts = (params, doc, doc_tokens)
+    return cached
+
+
+def _check_param_drift(project: Project, which: str):
+    """Shared scan; `which` selects the rule so each registered rule
+    emits exactly its own findings (--rules P402 must run the P402
+    check, and --rules P401 must NOT leak P402/P403 findings)."""
+    params, doc, doc_tokens = _facts(project)
+    if not params:
+        return
+    cfg = project.file("lightgbm_tpu/config.py")
+    if which == "P401":
+        used = _usage_tokens(project)
+        for name, lineno in sorted(params.items()):
+            if name not in used:
+                yield cfg.finding(
+                    "P401", lineno,
+                    f"config param {name!r} is never read anywhere in "
+                    "the package/tools: a knob that silently does "
+                    "nothing is a broken user-facing promise.  Wire it "
+                    "up or delete the registry entry (and regenerate "
+                    "docs/Parameters.md).")
+    elif which == "P402" and doc is not None:
+        for name, lineno in sorted(params.items()):
+            if name not in doc_tokens:
+                yield cfg.finding(
+                    "P402", lineno,
+                    f"config param {name!r} missing from "
+                    "docs/Parameters.md — run python "
+                    "tools/gen_params_doc.py.")
+    elif which == "P403" and doc is not None:
+        # aliases and non-tpu params share the doc; only flag tokens
+        # that CLAIM the tpu_/serving_ namespace without a registry row
+        for tok in sorted(doc_tokens - set(params) - _DOC_TOKEN_ALLOWED):
+            yield Finding(
+                rule="P403", path="docs/Parameters.md", line=0,
+                message=(f"{tok!r} appears in docs/Parameters.md but is "
+                         "not a config-registry param: stale doc or a "
+                         "typo'd name readers will copy into configs "
+                         "that silently no-op.  Fix the doc (or extend "
+                         "_DOC_TOKEN_ALLOWED with a justification)."),
+                snippet=tok)
+
+
+register(Rule(
+    id="P401", name="param-never-read", family="drift",
+    summary=("Every tpu_*/serving_* registry param must be read "
+             "somewhere in the package or tools."),
+    rationale=(
+        "A config knob nobody reads is a silent lie: users set it, "
+        "nothing changes, and the failure mode is indistinguishable "
+        "from 'the feature is broken'.  The registry/doc generator "
+        "keeps names and docs in sync mechanically; this closes the "
+        "third leg (code actually consumes the param)."),
+    project_check=lambda p: _check_param_drift(p, "P401")))
+
+register(Rule(
+    id="P402", name="param-undocumented", family="drift",
+    summary="Every tpu_*/serving_* registry param appears in "
+            "docs/Parameters.md.",
+    rationale=(
+        "docs/Parameters.md is generated from the registry "
+        "(tools/gen_params_doc.py) and gated by tests/test_params_doc; "
+        "this rule catches the window where a param landed without "
+        "regenerating, from the lint gate that also runs outside "
+        "pytest (multichip dryrun tail)."),
+    project_check=lambda p: _check_param_drift(p, "P402")))
+
+register(Rule(
+    id="P403", name="doc-param-phantom", family="drift",
+    summary=("No tpu_*/serving_* token in docs/Parameters.md without a "
+             "registry entry behind it."),
+    rationale=(
+        "The reverse direction of P402: a documented-but-nonexistent "
+        "param is a name readers will copy into configs where it "
+        "silently lands in Config.extra and does nothing.  Tokens that "
+        "legitimately share the namespace (the tpu_bin_mappers model "
+        "trailer) are allow-listed in the rule source with the "
+        "justification."),
+    project_check=lambda p: _check_param_drift(p, "P403")))

@@ -1,0 +1,338 @@
+"""Jit-discipline rules (J2xx): every program on the ledger, no host
+work inside traced bodies, statics in sync with canonical_params.
+
+The compile ledger (PR 6) is how this repo keeps the program zoo
+countable: `n_programs` is a gated bench metric, serving warmup
+enumerates exactly the ledgered launch shapes, and perf_probe retrace
+attributes compile wall per site.  A bare `jax.jit` is a program the
+ledger cannot see; host calls inside a traced body either burn at
+trace time (silently keyed to whatever triggered the trace) or force
+a sync; and a static_argnames entry naming a canonical_params-folded
+mode field re-keys programs the cache claims are shared.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from .core import (FileContext, Project, Rule, dotted_name,
+                   enclosing_function, parents, register, subtree_names)
+
+_LEDGER_WRAPPERS = {"ledger_jit", "LedgeredJit"}
+
+# the one module allowed to say jax.jit: the wrapper itself
+_EXEMPT = re.compile(r"(^|/)lightgbm_tpu/utils/compile_ledger\.py$")
+
+
+def in_package(rel: str) -> bool:
+    return "lightgbm_tpu/" in rel or rel.startswith("lightgbm_tpu")
+
+
+def _jit_aliases(tree: ast.AST) -> Set[str]:
+    """Local names that ARE jax.jit: `from jax import jit [as j]`,
+    `j = jax.jit` assignment aliases, and `<m>.jit` for every module
+    alias `import jax as m` — the spellings that would otherwise evade
+    the literal `jax.jit` match."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "jit":
+                    out.add(a.asname or a.name)
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "jax":
+                    out.add(f"{a.asname or a.name}.jit")
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            if dotted_name(node.value) == "jax.jit":
+                out.add(node.targets[0].id)
+    return out
+
+
+def _is_jax_jit(node: ast.AST, aliases: Set[str]) -> bool:
+    name = dotted_name(node)
+    return (name == "jax.jit" or name.endswith(".jax.jit")
+            or name in aliases)
+
+
+def _jit_calls(tree: ast.AST, aliases: Set[str]):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jax_jit(node.func, aliases):
+            yield node, dotted_name(node.func)
+        elif dotted_name(node.func).rsplit(".", 1)[-1] == "partial" and \
+                node.args and _is_jax_jit(node.args[0], aliases):
+            # partial(jax.jit, static_argnames=...)(f)
+            yield node, "partial(jax.jit, ...)"
+
+
+def _check_unledgered_jit(fc: FileContext):
+    if _EXEMPT.search(fc.rel):
+        return
+    aliases = _jit_aliases(fc.tree)
+    decorator_jits = set()
+    # decorator spelling: @jax.jit / @jit / @partial(jax.jit, ...)
+    for node in ast.walk(fc.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in node.decorator_list:
+            names = set(subtree_names(dec))
+            if names & _LEDGER_WRAPPERS:
+                continue
+            bare = isinstance(dec, ast.Name) and dec.id in aliases
+            if bare or ("jax" in names and "jit" in names) or \
+                    (names & aliases):
+                decorator_jits.add(id(dec))
+                yield fc.finding(
+                    "J201", dec,
+                    f"@jax.jit decorator on {node.name!r}: use "
+                    "@ledger_jit(site=...) so the program lands on the "
+                    "compile ledger.")
+    for node, name in _jit_calls(fc.tree, aliases):
+        if id(node) in decorator_jits:
+            continue  # already reported as a decorator
+        yield fc.finding(
+            "J201", node,
+            f"bare {name} call site: programs compiled here are "
+            "invisible to the CompileLedger (n_programs gates, retrace "
+            "attribution, serving warmup accounting).  Route through "
+            "utils.compile_ledger.ledger_jit(site=...), or suppress "
+            "with a justification if the site is deliberately "
+            "off-ledger.")
+
+
+def _local_wrapper_names(tree: ast.AST) -> Set[str]:
+    """Module functions whose body returns ledger_jit(...)/LedgeredJit
+    — 'registered wrappers' a shard_map result may legitimately flow
+    into (parallel/strategies.py's _strategy_jit)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        for ret in ast.walk(node):
+            if isinstance(ret, ast.Return) and ret.value is not None \
+                    and isinstance(ret.value, ast.Call):
+                leaf = dotted_name(ret.value.func).rsplit(".", 1)[-1]
+                if leaf in _LEDGER_WRAPPERS:
+                    out.add(node.name)
+    return out
+
+
+def _check_unledgered_shard_map(fc: FileContext):
+    wrappers = _LEDGER_WRAPPERS | _local_wrapper_names(fc.tree)
+    for node in ast.walk(fc.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if dotted_name(node.func).rsplit(".", 1)[-1] != "shard_map":
+            continue
+        # the version-compat def shard_map(f, **kw) shim itself
+        fn = enclosing_function(node)
+        if fn is not None and fn.name == "shard_map":
+            continue
+        ok = False
+        # (a) already an argument of a wrapper call
+        for p in parents(node):
+            if isinstance(p, ast.Call) and \
+                    dotted_name(p.func).rsplit(".", 1)[-1] in wrappers:
+                ok = True
+                break
+        # (b) assigned to a name that later feeds a wrapper call in the
+        # same function
+        if not ok:
+            assign = next((p for p in parents(node)
+                           if isinstance(p, ast.Assign)), None)
+            if assign is not None and fn is not None and \
+                    len(assign.targets) == 1 and \
+                    isinstance(assign.targets[0], ast.Name):
+                var = assign.targets[0].id
+                for call in ast.walk(fn):
+                    if isinstance(call, ast.Call) and \
+                            dotted_name(call.func).rsplit(".", 1)[-1] \
+                            in wrappers and \
+                            any(isinstance(a, ast.Name) and a.id == var
+                                for a in call.args):
+                        ok = True
+                        break
+        if not ok:
+            yield fc.finding(
+                "J202", node,
+                "shard_map program never reaches ledger_jit (or a "
+                "wrapper returning it): sharded programs are the most "
+                "expensive compiles in the zoo and MUST be on the "
+                "ledger.  Wrap the result in ledger_jit(site=...).")
+
+
+_BANNED_IN_JIT = {
+    "time.time": "wall-clock read burns at TRACE time (a constant "
+                 "keyed to whatever call triggered the compile)",
+    "time.monotonic": "wall-clock read burns at trace time",
+    "time.perf_counter": "wall-clock read burns at trace time",
+    "jax.device_get": "host sync inside a traced body",
+    "device_get": "host sync inside a traced body",
+}
+
+
+def _jitted_defs(tree: ast.AST) -> List[ast.FunctionDef]:
+    """Function defs traced by jax: decorated with jit/ledger_jit, or
+    whose NAME appears anywhere inside the argument subtree of a
+    jit/ledger_jit call (covers `ledger_jit(make_step(_pre, _post))`:
+    _pre/_post are traced through the returned closure)."""
+    aliases = _jit_aliases(tree)
+    jit_arg_names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            leaf = dotted_name(node.func).rsplit(".", 1)[-1]
+            if leaf in ("jit",) or leaf in _LEDGER_WRAPPERS or \
+                    leaf in aliases:
+                for a in node.args:
+                    jit_arg_names.update(
+                        n.id for n in ast.walk(a)
+                        if isinstance(n, ast.Name))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        decorated = any(
+            ("jit" in subtree_names(d)) or
+            (set(subtree_names(d)) & (_LEDGER_WRAPPERS | aliases))
+            for d in node.decorator_list)
+        if decorated or node.name in jit_arg_names:
+            out.append(node)
+    return out
+
+
+def _check_host_call_in_jit(fc: FileContext):
+    for fn in _jitted_defs(fc.tree):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            leaf = name.rsplit(".", 1)[-1]
+            why = _BANNED_IN_JIT.get(name) or _BANNED_IN_JIT.get(leaf)
+            if why is None and leaf == "item" and \
+                    isinstance(node.func, ast.Attribute):
+                why = (".item() forces a device->host sync and a "
+                       "concrete value inside a traced body")
+            if why is None and (name.startswith("np.random")
+                                or name.startswith("numpy.random")):
+                why = ("numpy RNG inside a traced body draws at TRACE "
+                       "time: the value freezes into the program, keyed "
+                       "to whatever call triggered the compile — "
+                       "topology-dependent and invisible to seeds.  Use "
+                       "jax.random with explicit keys")
+            if why is not None:
+                yield fc.finding(
+                    "J203", node,
+                    f"{name}() inside jitted function {fn.name!r}: "
+                    f"{why}.")
+
+
+def _folded_fields(project: Project) -> Set[str]:
+    """keys of ops/grower.py's _FOLDED_FIELDS — the mode params
+    canonical_params strips from the grower cache key."""
+    fc = project.file("lightgbm_tpu/ops/grower.py")
+    if fc is None:
+        return set()
+    for node in ast.walk(fc.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "_FOLDED_FIELDS":
+            v = node.value
+            if isinstance(v, ast.Call):        # dict(a=..., b=...)
+                return {kw.arg for kw in v.keywords if kw.arg}
+            if isinstance(v, ast.Dict):
+                return {k.value for k in v.keys
+                        if isinstance(k, ast.Constant)}
+    return set()
+
+
+def _check_static_argnames(project: Project):
+    folded = _folded_fields(project)
+    if not folded:
+        return
+    for fc in project.files:
+        if not in_package(fc.rel):
+            continue
+        for node in ast.walk(fc.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            leaf = dotted_name(node.func).rsplit(".", 1)[-1]
+            if leaf not in ({"jit"} | _LEDGER_WRAPPERS):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "static_argnames":
+                    continue
+                for c in ast.walk(kw.value):
+                    if isinstance(c, ast.Constant) and \
+                            isinstance(c.value, str) and c.value in folded:
+                        yield fc.finding(
+                            "J204", node,
+                            f"static_argnames names {c.value!r}, a mode "
+                            "param canonical_params STRIPS from the "
+                            "grower cache key: every distinct value "
+                            "would compile a new program while the "
+                            "params cache claims one.  Mode switches "
+                            "ride the traced meta['mode_flags'] vector "
+                            "instead (ops/grower.py).")
+
+
+register(Rule(
+    id="J201", name="unledgered-jax-jit", family="jit",
+    summary=("Every jax.jit site must go through "
+             "utils.compile_ledger.ledger_jit so the program zoo stays "
+             "counted and attributable."),
+    rationale=(
+        "PR 6 halved compile latency by making every compiled program "
+        "countable: `n_programs` is a gated bench metric and perf_probe "
+        "retrace attributes compile wall per site.  A bare jax.jit is a "
+        "program none of that sees — the zoo regrows invisibly.  "
+        "Deliberately off-ledger sites (per-objective closures that "
+        "re-trace in milliseconds) carry an inline suppression with the "
+        "justification in the comment."),
+    scope=in_package, check=lambda fc: _check_unledgered_jit(fc)))
+
+register(Rule(
+    id="J202", name="unledgered-shard-map", family="jit",
+    summary=("shard_map programs must flow into ledger_jit (directly "
+             "or via a wrapper that returns it)."),
+    rationale=(
+        "Sharded grower programs are the most expensive compiles in "
+        "the process (minutes on a cold pod).  parallel/strategies.py "
+        "routes every strategy through _strategy_jit -> ledger_jit; a "
+        "new shard_map site that skips the ledger breaks the "
+        "program-count gates the moment it re-traces."),
+    scope=in_package, check=lambda fc: _check_unledgered_shard_map(fc)))
+
+register(Rule(
+    id="J203", name="host-call-in-jitted-body", family="jit",
+    summary=("No time.time()/np.random/.item()/device_get inside "
+             "functions that get jitted: host work either freezes at "
+             "trace time or forces a sync."),
+    rationale=(
+        "A traced body runs ONCE per compile: `time.time()` bakes the "
+        "trace-time wall clock into the program; `np.random` draws a "
+        "constant keyed to whichever call happened to trigger the "
+        "compile (topology-dependent, invisible to seeds — exactly the "
+        "shape of PR-11's RNG root cause); `.item()`/`device_get` "
+        "force device->host syncs that serialize the async dispatch "
+        "pipeline the train loop depends on."),
+    scope=in_package, check=lambda fc: _check_host_call_in_jit(fc)))
+
+register(Rule(
+    id="J204", name="static-argname-of-folded-mode-param", family="jit",
+    summary=("static_argnames must not name params canonical_params "
+             "strips: folded mode fields ride the traced mode_flags "
+             "vector, never the jit cache key."),
+    rationale=(
+        "canonical_params normalizes the folded mode fields "
+        "(quant_round, quant_refit, cegb_*) so structurally identical "
+        "configurations share ONE cached grower program; the actual "
+        "values ride the traced meta['mode_flags'] vector.  Passing "
+        "such a field as a static argname bypasses the fold: each "
+        "value silently keys a fresh program while the memoized-grower "
+        "cache (and the compile-stability gates) believe one exists."),
+    project_check=lambda project: _check_static_argnames(project)))
