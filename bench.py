@@ -311,6 +311,34 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     # ISSUE 12: what this model costs resident in the registry — the
     # packed device-table bytes the serve_model_hbm_bytes gauge tracks
     serve_model_hbm_bytes = int(sess.registry.resolve("bench").hbm_bytes)
+
+    # drift-monitor overhead (ISSUE 14): the same entry-level predict
+    # loop with the sampled drift accumulator enabled vs disabled, one
+    # scrape (absorb + PSI/JS) amortized per window — the number the
+    # <1% telemetry gate bounds for the OFF configuration, published so
+    # bench_diff can watch the ON cost too.  min-of-3 windows per arm
+    # to wash container stalls
+    entry = sess.registry.resolve("bench")
+    drift_reps = 10
+    Xd = X_eval[:min(512, serve_rows)]
+
+    def _drift_wall():
+        t0 = time.time()
+        for _ in range(drift_reps):
+            entry.predict(Xd, raw_score=True)
+        if entry.drift is not None:
+            entry.drift.snapshot()
+        return time.time() - t0
+
+    entry.predict(Xd, raw_score=True)  # warm
+    monitor, entry.drift = entry.drift, None
+    off_wall = min(_drift_wall() for _ in range(3))
+    entry.drift = monitor
+    on_wall = min(_drift_wall() for _ in range(3))
+    # clamped at 0: a negative measurement is container noise, and
+    # bench_diff's relative gate needs a sane baseline sign
+    drift_overhead_pct = max(100.0 * (on_wall - off_wall)
+                             / max(off_wall, 1e-9), 0.0)
     sess.close()
 
     # overload-ramp goodput (ISSUE 11): paced open-loop load at ~4x the
@@ -512,6 +540,7 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "serve_p99_ms": round(serve_p99_ms, 1),
         "serve_goodput_rows_per_sec": round(serve_goodput_rows_per_sec, 0),
         "serve_shed_pct": round(serve_shed_pct, 1),
+        "drift_overhead_pct": round(drift_overhead_pct, 1),
         "eval_ms_per_iter": round(eval_ms_per_iter, 1),
         "checkpoint_overhead_pct": round(checkpoint_overhead_pct, 2),
         "resume_s": round(resume_s, 2),

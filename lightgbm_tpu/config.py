@@ -186,6 +186,18 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "serving_dispatch_timeout_ms": ("float", 30000.0, ()),
     # default flush budget of the drain lifecycle (POST /drain, SIGTERM)
     "serving_drain_timeout_ms": ("float", 10000.0, ()),
+    # --- serving: model & data health (ISSUE 14) ---
+    # rows per predict batch the drift monitor stride-samples into its
+    # accumulator (models carrying a tpu_feature_profile trailer only).
+    # The tap is one bounded row copy on the dispatch path; binning,
+    # PSI/JS and the score histogram run lazily at scrape time
+    # (GET /drift, GET /metrics).  0 disables drift monitoring
+    "serving_drift_sample_rows": ("int", 256, ()),
+    # per-feature PSI threshold: crossing it records a flight-recorder
+    # `psi_warn` event, a Log.warning, and the drift_warnings counter
+    # (conventional PSI reading: <0.1 stable, 0.1-0.25 moderate,
+    # >0.25 major shift)
+    "serving_drift_psi_warn": ("float", 0.25, ()),
     # --- fault tolerance (utils/checkpoint.py + numeric guardrails) ---
     # atomic training checkpoints: bundle directory (empty = off).  Each
     # checkpoint holds the model string (with its bin-mapper trailer),
@@ -270,6 +282,16 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # LIGHTGBM_TPU_BLACKBOX_DIR env var, then tpu_trace_dir, then the
     # working directory
     "tpu_obs_blackbox_dir": ("str", "", ()),
+    # capture the training reference profile (per-feature bin occupancy
+    # from BinMapper.cnt_in_bin, NaN/zero fractions, label stats, raw-
+    # score histogram) and write it as the tpu_feature_profile: model-
+    # string trailer — the reference every serving drift monitor and
+    # model_report compares against.  false = no trailer (a loaded
+    # model's existing profile still round-trips)
+    "tpu_profile_capture": ("bool", True, ()),
+    # bins of the profile's raw-score histogram (equal-width over the
+    # end-of-training score range)
+    "tpu_profile_score_bins": ("int", 32, ()),
     # --- objective ---
     "num_class": ("int", 1, ("num_classes",)),
     "is_unbalance": ("bool", False, ("unbalance", "unbalanced_sets")),

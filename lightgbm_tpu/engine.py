@@ -215,6 +215,18 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         evaluation_result_list.extend(
                             booster.eval_train(feval))
                     evaluation_result_list.extend(booster.eval_valid(feval))
+                    if obs.metrics_on():
+                        # train/valid metric TIME SERIES (ISSUE 14): the
+                        # registry's bounded sample ring keeps the
+                        # per-iteration values in order — model_report
+                        # reads its learning curves back from here
+                        for item in evaluation_result_list:
+                            obs.REGISTRY.observe(
+                                "lgbm_train_metric", float(item[2]),
+                                help="per-iteration train/valid metric "
+                                     "values (ring = learning curve)",
+                                dataset=str(item[0]),
+                                metric=str(item[1]))
                 try:
                     for cb in cb_after:
                         cb(CallbackEnv(

@@ -593,6 +593,16 @@ class BinMapper:
             cnt_in_bin[-1] += total_sample_cnt - used_cnt
         self._cnt_in_bin = cnt_in_bin
 
+    @property
+    def cnt_in_bin(self) -> List[int]:
+        """Per-bin sample occupancy recorded by `find_bin` (reference
+        ``BinMapper::cnt_in_bin``, bin.h:102) — the training reference
+        the model-health profile captures.  Serialized by
+        `to_dict`/`from_dict` so binary dataset caches and the
+        distributed bin-mapper sync keep it; empty only for mappers
+        from snapshots written before it existed."""
+        return list(getattr(self, "_cnt_in_bin", []))
+
     # ------------------------------------------------------------------
     def value_to_bin(self, value: float) -> int:
         """Map one raw value to its bin (reference bin.h:472-508)."""
@@ -657,6 +667,12 @@ class BinMapper:
             "default_bin": self.default_bin,
             "most_freq_bin": self.most_freq_bin,
             "sparse_rate": self.sparse_rate,
+            # sample occupancy travels with the mapper so the model-
+            # health profile survives binary dataset caches and the
+            # distributed bin-mapper sync (ISSUE 14); absent in files
+            # written before it existed (from_dict defaults to [])
+            "cnt_in_bin": [int(x) for x in
+                           getattr(self, "_cnt_in_bin", [])],
         }
 
     @classmethod
@@ -674,7 +690,7 @@ class BinMapper:
         m.default_bin = int(d["default_bin"])
         m.most_freq_bin = int(d["most_freq_bin"])
         m.sparse_rate = float(d.get("sparse_rate", 0.0))
-        m._cnt_in_bin = []
+        m._cnt_in_bin = [int(x) for x in d.get("cnt_in_bin", [])]
         return m
 
 

@@ -68,6 +68,15 @@ def quant_headroom_check(precision: str, total_rows: int, mode: str) -> int:
 # save_model_to_string, parsed back by from_model_string)
 _MAPPER_MARKER = "tpu_bin_mappers:"
 
+# training-quality histogram ladders (obs registry): leaf counts and
+# tree depths are small ints; powers-of-two-ish bounds keep the
+# distributions readable at any num_leaves
+_LEAF_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0,
+                 48.0, 64.0, 96.0, 128.0, 192.0, 256.0, 384.0, 512.0,
+                 768.0, 1024.0)
+_DEPTH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 10.0, 12.0, 16.0,
+                  20.0, 24.0, 32.0, 48.0, 64.0)
+
 
 def _predict_binned(tree: Tree, bins: np.ndarray,
                     meta: Dict[str, np.ndarray]) -> np.ndarray:
@@ -248,6 +257,13 @@ class GBDT:
         self._bag_cfg = None
         self._goss_cfg = None          # set by GOSS subclass
         self.average_output = False    # set by RF subclass / model load
+        # training reference profile (obs/modelhealth.py): parsed from
+        # a loaded model's tpu_feature_profile: trailer, or snapshotted
+        # by free_dataset; live training boosters rebuild it per save
+        self._profile = None
+        # sync-path trees awaiting telemetry until the numerics guard
+        # accepts the iteration (train_one_iter)
+        self._note_after_guard = None
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TrainingData) -> None:
@@ -527,6 +543,7 @@ class GBDT:
         rollback + re-bag)."""
         if self._stopped:
             return True
+        self._note_after_guard = None
         snap = self._iter_snapshot()
         try:
             with obs.span("train/iteration", iteration=self.iter_):
@@ -544,6 +561,11 @@ class GBDT:
             return self._poisoned_iteration(snap)
         self._guard_streak = 0
         self._force_bag_refresh = False  # the skip retry (if any) is done
+        # sync-path trees survived the guard: record their telemetry now
+        if self._note_after_guard:
+            for t in self._note_after_guard:
+                self._note_tree_telemetry(t)
+            self._note_after_guard = None
         return ret
 
     def _train_one_iter_impl(self, grad, hess, snap) -> bool:
@@ -828,6 +850,12 @@ class GBDT:
                 del self.models[-self.num_tree_per_iteration:]
             self._stopped = True
             return True
+        # telemetry defers to train_one_iter AFTER the numerics guard:
+        # a tpu_guard_numerics=skip rollback deletes these trees again,
+        # and noting them would break the counter <-> feature_importance
+        # bit-equality (the fused path gets this for free — rolled-back
+        # pending records never materialize)
+        self._note_after_guard = self.models[-self.num_tree_per_iteration:]
         self.iter_ += 1
         return False
 
@@ -870,6 +898,7 @@ class GBDT:
                 if abs(init) > K_EPSILON:
                     tree.add_bias(init)
                 self.models.append(tree)
+                self._note_tree_telemetry(tree)
             else:
                 # no split happened: device scores were not changed; stop
                 # training like the reference ("no more leaves that meet the
@@ -1407,6 +1436,9 @@ class GBDT:
               else self.learner.td if self.learner is not None else None)
         if td is not None:
             self._pred_ctx = _PredictContext.from_training_data(td)
+        # the health profile needs the training data too: capture it now
+        # so a freed (predict-only) booster still writes the trailer
+        self._profile = self.health_profile()
 
     def _pred_context(self) -> Optional["_PredictContext"]:
         td = (self.train_data if self.train_data is not None
@@ -1741,6 +1773,68 @@ class GBDT:
         rng.shuffle(seg)
         self.models[start:end] = seg
 
+    def _note_tree_telemetry(self, tree: Tree) -> None:
+        """Training-quality telemetry for one NEWLY-TRAINED tree (ISSUE
+        14): per-feature split/gain counters plus leaf-count and depth
+        distributions into the process-global registry.  Gated on
+        `obs.metrics_on()` (one bool check per tree when off).  The
+        per-split inc order matches `feature_importance`'s flat
+        (tree, node) walk exactly, so the f64 counter totals are
+        BIT-EQUAL to feature_importance('gain')/('split') over the same
+        trees (tests/test_modelhealth.py cross-checks both, including
+        after a model-string reload).  Counters are monotonic: a
+        rolled-back iteration's trees are not subtracted."""
+        if not obs.metrics_on():
+            return
+        names = self.feature_names
+        for j in range(tree.num_leaves - 1):
+            f = int(tree.split_feature[j])
+            fname = names[f] if f < len(names) else f"Column_{f}"
+            obs.REGISTRY.inc(
+                "lgbm_train_splits_total", 1,
+                help="splits per feature across trained trees",
+                feature=fname)
+            obs.REGISTRY.inc(
+                "lgbm_train_split_gain_total",
+                max(float(tree.split_gain[j]), 0.0),
+                help="summed split gain per feature", feature=fname)
+        obs.REGISTRY.observe(
+            "lgbm_train_leaf_count", float(tree.num_leaves),
+            buckets=_LEAF_BUCKETS,
+            help="leaves per trained tree")
+        obs.REGISTRY.observe(
+            "lgbm_train_tree_depth", float(tree.max_depth()),
+            buckets=_DEPTH_BUCKETS,
+            help="depth per trained tree")
+
+    def health_profile(self):
+        """The model-health reference profile (obs/modelhealth.py
+        FeatureProfile) this booster serializes as its
+        ``tpu_feature_profile:`` trailer.  A LIVE training booster
+        rebuilds it per call (scores move every iteration); a loaded or
+        freed booster returns the parsed/snapshotted one unchanged —
+        which is what makes the trailer byte-identical through
+        save -> load -> save.  None when capture is disabled
+        (tpu_profile_capture=false) and nothing was loaded."""
+        td = self.train_data
+        if td is not None and self.train_scores is not None:
+            if self.config is not None and \
+                    not bool(self.config.tpu_profile_capture):
+                return self._profile
+            from ..obs import modelhealth
+
+            score_bins = (int(self.config.tpu_profile_score_bins)
+                          if self.config is not None
+                          else modelhealth.DEFAULT_SCORE_BINS)
+            prof = modelhealth.FeatureProfile.from_training(
+                td, self.feature_names, self.train_scores.numpy(),
+                score_bins)
+            # nothing capturable (e.g. count-less mappers from an old
+            # snapshot): a profile loaded from the trailer must still
+            # round-trip rather than silently vanish on re-save
+            return prof if prof is not None else self._profile
+        return self._profile
+
     def feature_importance(self, importance_type: str = "split") -> np.ndarray:
         self._materialize()
         imp = np.zeros(self.max_feature_idx + 1, np.float64)
@@ -1832,6 +1926,12 @@ class GBDT:
             import json
 
             buf.write(_MAPPER_MARKER + json.dumps(ctx.to_payload()) + "\n")
+        # model-health trailer (ISSUE 14): the training reference
+        # profile, same round-trip contract as the mapper snapshot —
+        # the reference parser ignores trailing lines either way
+        prof = self.health_profile()
+        if prof is not None:
+            buf.write(prof.to_line())
         return buf.getvalue()
 
     @classmethod
@@ -1843,6 +1943,10 @@ class GBDT:
         pos = text.rfind("\npandas_categorical:")
         if pos >= 0:
             text = text[:pos]
+        from ..obs.modelhealth import split_profile_trailer
+
+        text, profile = split_profile_trailer(text)
+        self._profile = profile
         text, ctx = _split_mapper_snapshot(text)
         lines = text.split("\n")
         kv: Dict[str, str] = {}

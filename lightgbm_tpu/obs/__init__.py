@@ -21,12 +21,15 @@ One import surface for every instrumented layer::
 * `flightrecorder` — the ALWAYS-ON bounded ring of recent spans/
   transitions dumped to ``blackbox-host<k>.json`` on crash/hang/
   SIGTERM (ISSUE 12).
+* `modelhealth` — training reference profiles
+  (``tpu_feature_profile:`` trailer) + the serving drift monitor:
+  PSI / Jensen-Shannon over the binned representation (ISSUE 14).
 
 See `obs.metrics`, `obs.trace`, `obs.resources` and
 `obs.flightrecorder` for the full contracts.
 """
 
-from . import flightrecorder, resources  # noqa: F401
+from . import flightrecorder, modelhealth, resources  # noqa: F401
 from .metrics import (DEFAULT_SECONDS_BUCKETS, MetricsRegistry,  # noqa: F401
                       REGISTRY, histogram_quantile)
 from .trace import (chrome_trace, configure, configure_from_config,  # noqa: F401

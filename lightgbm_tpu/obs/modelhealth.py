@@ -1,0 +1,521 @@
+"""Model & data health: training reference profiles and serving-side
+binned drift detection (ISSUE 14).
+
+The framework's binned representation makes drift detection nearly
+free: serving already maps every raw row through the TRAINING bin
+mappers (the `tpu_bin_mappers:` snapshot), so "has the input
+distribution moved off the training data?" reduces to comparing
+per-feature bin occupancy against the occupancy captured at train time
+(reference ``BinMapper::cnt_in_bin``; the binned/quantized-matrix
+design of arXiv 1806.11248).
+
+Two halves:
+
+* `FeatureProfile` — the training reference: per-feature bin-occupancy
+  counts, NaN/zero fractions, label stats, and the raw-score histogram,
+  captured at train end and serialized as a compact
+  ``tpu_feature_profile:`` model-string trailer (exactly like
+  ``tpu_bin_mappers:`` — it round-trips byte-identically through
+  save/load, checkpoints, and the serving registry).
+* `DriftMonitor` — the serving tap: per-batch row samples
+  (`serving_drift_sample_rows`) are stashed on the dispatch path with
+  one deque append (GIL-atomic, NO lock, no device work), then binned
+  and accumulated lazily at scrape time (`/drift`, `/metrics`,
+  `snapshot()`), off the dispatch hot path.  Divergences are PSI and
+  Jensen-Shannon per feature plus a raw-score-histogram JS, all
+  computed in float64 on the host so they match a NumPy oracle exactly
+  — the sampled bin counts are exact int64, and the accumulation is
+  pure integer addition (order-independent).
+
+The accumulator is deliberately host-side numpy: the serving lifecycle
+carries an exact compiled-program-count gate
+(tests/test_compile_stability.py), and a jitted bincount would add a
+program per launch shape for a O(sample_rows * features) integer count
+that the host does in microseconds.
+
+PSI uses add-one-half count smoothing (0.5 added to every bin before
+normalizing) so empty bins cannot produce infinities; JS needs no
+smoothing (0 * log 0 terms are 0 by continuity).  Both use natural
+logarithms.  Conventional PSI reading: < 0.1 stable, 0.1-0.25 moderate
+shift, > 0.25 major shift — `serving_drift_psi_warn` defaults to 0.25.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils import lockcheck
+
+#: model-string trailer marker (same convention as ``tpu_bin_mappers:``)
+PROFILE_MARKER = "tpu_feature_profile:"
+
+#: default raw-score histogram resolution (``tpu_profile_score_bins``)
+DEFAULT_SCORE_BINS = 32
+
+#: stashed-but-unabsorbed sample batches the monitor retains; older
+#: batches drop silently (it is a SAMPLING monitor — a scrape gap must
+#: bound memory, not grow it)
+PENDING_BATCHES = 64
+
+
+# ---------------------------------------------------------------------------
+# divergences (float64 host math — the oracle IS the implementation)
+# ---------------------------------------------------------------------------
+def _proportions(counts: np.ndarray, smooth: float) -> np.ndarray:
+    c = np.asarray(counts, np.float64) + np.float64(smooth)
+    return c / c.sum()
+
+
+def psi(expected: Sequence[float], observed: Sequence[float]) -> float:
+    """Population Stability Index between two count vectors.
+
+    ``sum((o_i - e_i) * ln(o_i / e_i))`` over add-0.5-smoothed,
+    normalized proportions, in float64.  Returns 0.0 when either side
+    carries no counts (no evidence is not drift)."""
+    e = np.asarray(expected, np.float64)
+    o = np.asarray(observed, np.float64)
+    if e.size == 0 or e.sum() <= 0 or o.sum() <= 0:
+        return 0.0
+    ep = _proportions(e, 0.5)
+    op = _proportions(o, 0.5)
+    return float(np.sum((op - ep) * np.log(op / ep)))
+
+
+def js_divergence(expected: Sequence[float],
+                  observed: Sequence[float]) -> float:
+    """Jensen-Shannon divergence (natural log, so the bound is ln 2)
+    between two count vectors, float64, no smoothing — zero bins
+    contribute 0 by the 0*log(0)=0 convention."""
+    e = np.asarray(expected, np.float64)
+    o = np.asarray(observed, np.float64)
+    if e.size == 0 or e.sum() <= 0 or o.sum() <= 0:
+        return 0.0
+    p = e / e.sum()
+    q = o / o.sum()
+    m = 0.5 * (p + q)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        kl_p = np.where(p > 0, p * np.log(p / m), 0.0)
+        kl_q = np.where(q > 0, q * np.log(q / m), 0.0)
+    return float(0.5 * kl_p.sum() + 0.5 * kl_q.sum())
+
+
+def bin_occupancy(bins: np.ndarray, num_bin: int) -> np.ndarray:
+    """Exact int64 occupancy of one already-binned column."""
+    return np.bincount(np.asarray(bins, np.int64),
+                       minlength=int(num_bin)).astype(np.int64)
+
+
+def score_hist_counts(edges: Sequence[float],
+                      values: np.ndarray) -> np.ndarray:
+    """int64 histogram of `values` over fixed `edges` (len B+1); out-of-
+    range values clip into the boundary bins, non-finite values drop."""
+    e = np.asarray(edges, np.float64)
+    v = np.asarray(values, np.float64).ravel()
+    v = v[np.isfinite(v)]
+    if v.size == 0 or e.size < 2:
+        return np.zeros(max(e.size - 1, 0), np.int64)
+    idx = np.clip(np.searchsorted(e[1:-1], v, side="right"),
+                  0, e.size - 2)
+    return np.bincount(idx, minlength=e.size - 1).astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# training reference profile
+# ---------------------------------------------------------------------------
+class FeatureProfile:
+    """The training-time statistical reference a drift monitor compares
+    against.  Payload layout is deterministic (fixed key order, plain
+    int/float JSON scalars) so `to_line()` bytes survive
+    save -> load -> save unchanged."""
+
+    def __init__(self, features: Dict[int, Dict], label: Dict,
+                 score_edges: List[float], score_counts: List[List[int]]):
+        self.features = features          # real feature idx -> stats
+        self.label = label
+        self.score_edges = score_edges
+        self.score_counts = score_counts  # one count row per class
+
+    # -- capture --------------------------------------------------------
+    @classmethod
+    def from_training(cls, td, feature_names: Sequence[str],
+                      raw_scores: np.ndarray,
+                      score_bins: int = DEFAULT_SCORE_BINS
+                      ) -> Optional["FeatureProfile"]:
+        """Capture from a live TrainingData + the end-of-training raw
+        scores ([k, n] float).  Occupancy comes from each used mapper's
+        ``cnt_in_bin`` (the reference's own sample counts); mappers
+        without counts (deserialized) are skipped.  Returns None when
+        nothing is capturable."""
+        from ..io.bin_mapper import MissingType
+
+        features: Dict[int, Dict] = {}
+        used = list(getattr(td, "used_feature_idx", []))
+        for c in used:
+            m = td.mappers[c]
+            cnt = [int(x) for x in m.cnt_in_bin]
+            if m.is_trivial or not cnt:
+                continue
+            total = max(sum(cnt), 1)
+            # the last bin is a NaN bin only when one actually exists:
+            # numerical NAN mappers always reserve it, but a TRUNCATED
+            # categorical sets missing_type=NAN with the last bin being
+            # a real category plus the rare-tail remainder — counting
+            # that as NaN mass would bias every nan_delta afterwards
+            if int(m.bin_type) == 0:
+                has_nan_bin = m.missing_type == MissingType.NAN
+            else:
+                has_nan_bin = (bool(m.bin_2_categorical)
+                               and m.bin_2_categorical[-1] == -1)
+            nan_frac = cnt[-1] / total if has_nan_bin else 0.0
+            zero_frac = (cnt[m.default_bin] / total
+                         if int(m.bin_type) == 0
+                         and 0 <= m.default_bin < len(cnt) else 0.0)
+            name = (str(feature_names[c]) if c < len(feature_names)
+                    else f"Column_{c}")
+            features[int(c)] = {
+                "name": name, "bin_type": int(m.bin_type),
+                "num_bin": int(m.num_bin), "cnt": cnt,
+                "nan_frac": float(nan_frac),
+                "zero_frac": float(zero_frac)}
+        if not features:
+            return None
+        y = np.asarray(td.metadata.label, np.float64)
+        label = {"n": int(y.size),
+                 "mean": float(y.mean()) if y.size else 0.0,
+                 "std": float(y.std()) if y.size else 0.0,
+                 "min": float(y.min()) if y.size else 0.0,
+                 "max": float(y.max()) if y.size else 0.0}
+        s = np.asarray(raw_scores, np.float64)
+        if s.ndim == 1:
+            s = s[None, :]
+        fin = s[np.isfinite(s)]
+        lo = float(fin.min()) if fin.size else 0.0
+        hi = float(fin.max()) if fin.size else 1.0
+        if hi <= lo:
+            hi = lo + 1.0
+        nb = max(int(score_bins), 2)
+        edges = [float(x) for x in np.linspace(lo, hi, nb + 1)]
+        counts = [[int(x) for x in score_hist_counts(edges, row)]
+                  for row in s]
+        return cls(features, label, edges, counts)
+
+    # -- serialization --------------------------------------------------
+    def to_payload(self) -> Dict:
+        """JSON payload, deterministic key order (features sorted by
+        index) — the byte-identity contract of the trailer."""
+        return {
+            "version": 1,
+            "features": {str(c): {
+                "name": f["name"], "bin_type": int(f["bin_type"]),
+                "num_bin": int(f["num_bin"]),
+                "cnt": [int(x) for x in f["cnt"]],
+                "nan_frac": float(f["nan_frac"]),
+                "zero_frac": float(f["zero_frac"]),
+            } for c, f in sorted(self.features.items())},
+            "label": {k: (int(self.label[k]) if k == "n"
+                          else float(self.label[k]))
+                      for k in ("n", "mean", "std", "min", "max")},
+            "score": {"edges": [float(x) for x in self.score_edges],
+                      "counts": [[int(x) for x in row]
+                                 for row in self.score_counts]},
+        }
+
+    @classmethod
+    def from_payload(cls, payload: Dict) -> "FeatureProfile":
+        features = {int(c): {
+            "name": str(f["name"]), "bin_type": int(f["bin_type"]),
+            "num_bin": int(f["num_bin"]),
+            "cnt": [int(x) for x in f["cnt"]],
+            "nan_frac": float(f["nan_frac"]),
+            "zero_frac": float(f["zero_frac"]),
+        } for c, f in payload["features"].items()}
+        label = {k: (int(payload["label"][k]) if k == "n"
+                     else float(payload["label"][k]))
+                 for k in ("n", "mean", "std", "min", "max")}
+        score = payload["score"]
+        return cls(features, label,
+                   [float(x) for x in score["edges"]],
+                   [[int(x) for x in row] for row in score["counts"]])
+
+    def to_line(self) -> str:
+        """The full trailer line, newline-terminated."""
+        return PROFILE_MARKER + json.dumps(self.to_payload()) + "\n"
+
+    def summary(self) -> Dict:
+        """Compact human-facing digest (model_report)."""
+        return {
+            "features": len(self.features),
+            "label": dict(self.label),
+            "score_bins": len(self.score_edges) - 1,
+            "score_classes": len(self.score_counts),
+            "nan_frac_max": max((f["nan_frac"]
+                                 for f in self.features.values()),
+                                default=0.0),
+        }
+
+
+def split_profile_trailer(text: str):
+    """Split a model string into (model_text, FeatureProfile | None) —
+    the ``tpu_feature_profile:`` analog of `_split_mapper_snapshot`."""
+    marker = "\n" + PROFILE_MARKER
+    pos = text.rfind(marker)
+    if pos < 0:
+        return text, None
+    line_end = text.find("\n", pos + 1)
+    payload = text[pos + len(marker): len(text) if line_end < 0
+                   else line_end].strip()
+    rest = "" if line_end < 0 else text[line_end:]
+    try:
+        prof = FeatureProfile.from_payload(json.loads(payload))
+    except (json.JSONDecodeError, KeyError, ValueError, TypeError) as exc:
+        raise ValueError(
+            f"corrupt tpu_feature_profile line in model: {payload[:80]!r}"
+        ) from exc
+    return text[:pos] + rest, prof
+
+
+# ---------------------------------------------------------------------------
+# serving drift monitor
+# ---------------------------------------------------------------------------
+class DriftMonitor:
+    """Accumulates sampled serving traffic against a `FeatureProfile`.
+
+    Dispatch path (`tap`): stride-sample up to `sample_rows` rows of the
+    batch, copy, one deque append — GIL-atomic like the flight-recorder
+    ring, deliberately lock-free and device-free (C3xx: never dispatch
+    or block the batcher worker).  Scrape path (`snapshot`): drain the
+    pending deque, bin the samples through the TRAINING mappers, score
+    them with the host walker (raw scores, matching the profile's
+    histogram), and merge exact int64 counts under the monitor lock.
+    """
+
+    def __init__(self, profile: FeatureProfile, mappers: List,
+                 sample_rows: int, psi_warn: float = 0.25,
+                 model: str = "",
+                 score_fn: Optional[Callable[[np.ndarray],
+                                             np.ndarray]] = None,
+                 stats=None,
+                 num_feature: Optional[int] = None):
+        self.profile = profile
+        self.model = str(model)
+        self.sample_rows = max(int(sample_rows), 0)
+        self.psi_warn = float(psi_warn)
+        self._score_fn = score_fn
+        self._stats = stats
+        self._num_feature = (int(num_feature) if num_feature is not None
+                             else None)
+        self._lock = lockcheck.make_lock("obs.modelhealth.monitor")
+        # tracked features: profile occupancy exists AND the serving
+        # mapper list can bin the column
+        self.tracked: List[int] = sorted(
+            c for c in profile.features
+            if c < len(mappers) and not mappers[c].is_trivial
+            and (num_feature is None or c < num_feature))
+        self._mappers = mappers
+        # pending sampled batches: GIL-atomic deque appends/pops, no
+        # lock by design (bounded; oldest unscraped samples drop) —
+        # the modelhealth analog of the flight-recorder ring
+        self._pending: deque = deque(maxlen=PENDING_BATCHES)
+        # accumulators (all guarded by _lock; see graftlint OWNERSHIP)
+        self._counts: Dict[int, np.ndarray] = {
+            c: np.zeros(profile.features[c]["num_bin"], np.int64)
+            for c in self.tracked}
+        self._nan: Dict[int, int] = {c: 0 for c in self.tracked}
+        self._unseen: Dict[int, int] = {c: 0 for c in self.tracked}
+        self._rows = 0
+        self._score_counts = np.zeros(
+            (len(profile.score_counts),
+             max(len(profile.score_edges) - 1, 1)), np.int64)
+        self._warned = False
+        self._warnings = 0
+
+    # -- dispatch path --------------------------------------------------
+    def tap(self, X: np.ndarray) -> None:
+        """Stash a deterministic stride-sample of one predict batch.
+        Cost: one bounded row copy + a deque append.  Never locks,
+        never bins, never touches the device."""
+        k = self.sample_rows
+        if k <= 0 or X.shape[0] == 0:
+            return
+        if self._num_feature is not None and \
+                X.shape[1] != self._num_feature:
+            # wrong-width request: the predictor fails it alone (HTTP
+            # 400) — it must not poison the accumulator, where a mixed-
+            # width concatenate would break every later scrape
+            return
+        n = int(X.shape[0])
+        if n > k:
+            step = -(-n // k)           # ceil: deterministic stride
+            X = X[::step][:k]
+        self._pending.append(np.array(X, np.float64))
+
+    # -- scrape path ----------------------------------------------------
+    def _absorb(self) -> None:
+        """Drain pending samples into the accumulators.  All counting
+        happens OUTSIDE the lock (pure local work on the drained
+        batches); the lock only guards the final integer merges."""
+        work: List[np.ndarray] = []
+        while True:
+            try:
+                work.append(self._pending.popleft())
+            except IndexError:
+                break
+        if not work:
+            return
+        # second line of defense behind tap's width check: only
+        # same-width batches may concatenate
+        width = (self._num_feature if self._num_feature is not None
+                 else work[0].shape[1])
+        work = [w for w in work if w.shape[1] == width]
+        if not work:
+            return
+        Xs = work[0] if len(work) == 1 else np.concatenate(work, axis=0)
+        counts: Dict[int, np.ndarray] = {}
+        nan: Dict[int, int] = {}
+        unseen: Dict[int, int] = {}
+        for c in self.tracked:
+            if c >= Xs.shape[1]:
+                continue
+            m = self._mappers[c]
+            col = Xs[:, c]
+            bins = m.values_to_bins(col)
+            counts[c] = bin_occupancy(bins, self.profile
+                                      .features[c]["num_bin"])
+            nan[c] = int(np.isnan(col).sum())
+            if int(m.bin_type) == 1:            # categorical: unseen =
+                ok = np.isfinite(col)           # unmappable category
+                iv = col[ok].astype(np.int64)
+                seen = np.zeros(iv.shape, bool)
+                for cat in m.categorical_2_bin:
+                    if cat >= 0:
+                        seen |= iv == cat
+                unseen[c] = int((~seen).sum())
+            else:
+                unseen[c] = 0
+        score_counts = None
+        if self._score_fn is not None:
+            s = np.asarray(self._score_fn(Xs), np.float64)
+            if s.ndim == 1:
+                s = s[None, :]
+            score_counts = np.stack([
+                score_hist_counts(self.profile.score_edges, row)
+                for row in s[:self._score_counts.shape[0]]])
+        with self._lock:
+            self._rows += int(Xs.shape[0])
+            for c, v in counts.items():
+                self._counts[c] += v
+                self._nan[c] += nan[c]
+                self._unseen[c] += unseen[c]
+            if score_counts is not None:
+                self._score_counts[:score_counts.shape[0]] += score_counts
+
+    def snapshot(self) -> Dict:
+        """Absorb pending samples, compute every divergence (float64),
+        publish the gauges, and fire the warn-threshold transition.
+        The shape of this dict IS the ``GET /drift`` per-model schema."""
+        self._absorb()
+        with self._lock:
+            rows = self._rows
+            counts = {c: self._counts[c].copy() for c in self.tracked}
+            nan = dict(self._nan)
+            unseen = dict(self._unseen)
+            score_counts = self._score_counts.copy()
+        features: Dict[str, Dict] = {}
+        psi_max = 0.0
+        psi_argmax = ""
+        for c in self.tracked:
+            ref = self.profile.features[c]
+            obs_cnt = counts[c]
+            total = int(obs_cnt.sum())
+            f_psi = psi(ref["cnt"], obs_cnt)
+            f_js = js_divergence(ref["cnt"], obs_cnt)
+            nan_rate = nan[c] / total if total else 0.0
+            out = {
+                "psi": f_psi, "js": f_js,
+                "rows": total,
+                "nan_rate": nan_rate,
+                "nan_delta": nan_rate - ref["nan_frac"],
+                "unseen_rate": (unseen[c] / total if total else 0.0),
+            }
+            features[ref["name"]] = out
+            if f_psi > psi_max:
+                psi_max = f_psi
+                psi_argmax = ref["name"]
+        score_js = [js_divergence(ref_row, obs_row)
+                    for ref_row, obs_row in zip(self.profile.score_counts,
+                                                score_counts)]
+        score_js_max = max(score_js) if score_js else 0.0
+        warn = psi_max >= self.psi_warn
+        self._note_transition(warn, psi_max, psi_argmax)
+        snap = {
+            "model": self.model,
+            "rows_sampled": int(rows),
+            "sample_rows": self.sample_rows,
+            "psi_warn": self.psi_warn,
+            "psi_max": psi_max,
+            "psi_max_feature": psi_argmax,
+            "score_js": score_js,
+            "score_js_max": score_js_max,
+            "warn": bool(warn),
+            "features": features,
+        }
+        self._publish(snap)
+        return snap
+
+    # -- side channels --------------------------------------------------
+    def _note_transition(self, warn: bool, psi_max: float,
+                         feature: str) -> None:
+        """Flight-recorder + log + counter, once per below->above
+        crossing (re-arms when PSI falls back under the threshold)."""
+        fire = False
+        with self._lock:
+            if warn and not self._warned:
+                self._warned = True
+                self._warnings += 1
+                fire = True
+            elif not warn:
+                self._warned = False
+        if not fire:
+            return
+        from ..utils.log import Log
+        from . import flightrecorder
+
+        flightrecorder.note("drift", "psi_warn", model=self.model,
+                            feature=feature, psi=round(psi_max, 6))
+        Log.warning(
+            f"serving drift: model {self.model!r} feature {feature!r} "
+            f"PSI {psi_max:.4f} >= serving_drift_psi_warn "
+            f"{self.psi_warn:g} — input distribution has moved off the "
+            "training bins")
+        if self._stats is not None:
+            self._stats.count("drift_warnings")
+
+    def _publish(self, snap: Dict) -> None:
+        if self._stats is None:
+            return
+        for name, f in snap["features"].items():
+            self._stats.set_drift_psi(self.model, name, f["psi"])
+        self._stats.set_drift_score_js(self.model, snap["score_js_max"])
+        self._stats.set_drift_rows(self.model, snap["rows_sampled"])
+
+    def warnings(self) -> int:
+        with self._lock:
+            return int(self._warnings)
+
+
+# ---------------------------------------------------------------------------
+# offline comparison (model_report --compare-data)
+# ---------------------------------------------------------------------------
+def compare_dataset(profile: FeatureProfile, mappers: List,
+                    X: np.ndarray,
+                    score_fn: Optional[Callable] = None) -> Dict:
+    """One-shot drift table of a raw matrix against a profile — the
+    batch analog of a DriftMonitor scrape (same math, no sampling)."""
+    mon = DriftMonitor(profile, mappers, sample_rows=max(X.shape[0], 1),
+                       model="offline", score_fn=score_fn)
+    mon.tap(np.asarray(X, np.float64))
+    return mon.snapshot()

@@ -18,6 +18,14 @@ device-resident forest predictor (`lightgbm_tpu/ops/predict.py`):
 * `stats`     — rolling p50/p95/p99 latency, queue depth, batch fill,
   compile-cache hit/miss, shed/expiry/failover counters.
 
+Models that carry a ``tpu_feature_profile:`` trailer additionally get a
+per-model drift monitor (`obs/modelhealth.py`): sampled serving traffic
+is binned through the TRAINING mappers and compared against the
+captured profile (per-feature PSI/JS, NaN/unseen-category rates, raw-
+score-histogram divergence), exposed as ``GET /drift`` JSON and
+``lgbm_drift_*`` gauges on ``GET /metrics``, with a flight-recorder
+event past ``serving_drift_psi_warn``.
+
 Quick start::
 
     from lightgbm_tpu.serving import ServingSession

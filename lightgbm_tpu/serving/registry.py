@@ -77,6 +77,29 @@ class ModelEntry:
         # the gauge is set by ModelRegistry.load's registration block,
         # not here: a load that fails after construction (warmup error)
         # must not leave a phantom per-model series
+        # drift monitor (ISSUE 14): models carrying a
+        # tpu_feature_profile: trailer get sampled input/score drift
+        # tracking against their training profile.  The tap is one
+        # bounded row copy per predict; binning + PSI/JS run at scrape
+        # time (GET /drift, GET /metrics) — zero device programs, zero
+        # work when the profile is absent or sampling is off
+        self.drift = None
+        sample_rows = int(config.serving_drift_sample_rows)
+        profile = drv.health_profile()
+        if profile is not None and sample_rows > 0 \
+                and drv._pred_context() is not None:
+            from ..obs.modelhealth import DriftMonitor
+
+            ctx = drv._pred_context()
+            self.drift = DriftMonitor(
+                profile, ctx.mappers, sample_rows=sample_rows,
+                psi_warn=float(config.serving_drift_psi_warn),
+                model=self.key, stats=stats,
+                num_feature=self.num_feature,
+                # raw scores via the host walker: matches the profile's
+                # raw-score histogram on every objective, and the
+                # scrape path may not steal device time from dispatch
+                score_fn=lambda Xs: drv.predict_raw(Xs, -1))
         # circuit breaker on the device path: threshold failures open it
         # (requests short-circuit to the native walker), a timed
         # half-open probe retries the device path
@@ -142,6 +165,12 @@ class ModelEntry:
         walker (zero device attempts) until a timed half-open probe
         finds the device path healthy again."""
         ni = -1 if num_iteration is None else int(num_iteration)
+        if not warmup and self.drift is not None:
+            # drift tap BEFORE any path split: input drift is a property
+            # of the request, not of which predictor served it.  One
+            # stride-sampled row copy + a GIL-atomic deque append — the
+            # accumulation itself runs at scrape time, off this worker
+            self.drift.tap(X)
         if not self.device_on:
             if not warmup:
                 self.stats.note_batch(X.shape[0], X.shape[0])
@@ -243,7 +272,8 @@ class ModelEntry:
                 "device": bool(self.device_on),
                 "hbm_bytes": int(self.hbm_bytes),
                 "breaker": self.breaker.state,
-                "healthy": self.healthy}
+                "healthy": self.healthy,
+                "drift_monitor": self.drift is not None}
 
 
 class ModelRegistry:
@@ -320,6 +350,10 @@ class ModelRegistry:
             self._entries[entry.key] = entry
             self._entries.move_to_end(entry.key)
             self.stats.set_model_hbm(entry.key, entry.hbm_bytes)
+            # a reloaded key re-arms drift publishing (clear_drift
+            # tombstones it on unload/eviction so an in-flight scrape
+            # cannot resurrect a departed model's gauges)
+            self.stats.reopen_drift(entry.key)
             # atomic flip (hot-swap) — but never BACKWARDS: concurrent
             # loads finish warmup in arbitrary order, and last-finisher-
             # wins would let a stale version steal the alias
@@ -357,6 +391,7 @@ class ModelRegistry:
             del self._entries[victim]
             self.stats.count("models_evicted")
             self.stats.clear_model_hbm(victim)
+            self.stats.clear_drift(victim)
             Log.info(f"serving registry evicted {victim}: freed {freed} "
                      "device bytes "
                      f"({len(self._entries)}/{cap} models resident)")
@@ -391,6 +426,7 @@ class ModelRegistry:
                        if k in self._entries]
             for e in removed:
                 self.stats.clear_model_hbm(e.key)
+                self.stats.clear_drift(e.key)
                 if e.hbm_bytes:
                     Log.info(f"serving registry unloaded {e.key}: freed "
                              f"{int(e.hbm_bytes)} device bytes")
@@ -421,3 +457,9 @@ class ModelRegistry:
             current = {k: n for n, k in self._latest.items()}
             return [{**e.describe(), "current": e.key in current}
                     for e in self._entries.values()]
+
+    def entries(self) -> List[ModelEntry]:
+        """Resident entries, snapshot under the lock (no LRU touch) —
+        the drift scrape iterates this without blocking loads."""
+        with self._lock:
+            return list(self._entries.values())

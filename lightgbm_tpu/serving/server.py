@@ -131,15 +131,33 @@ class ServingSession:
                 "last_dump": flightrecorder.last_dump(),
                 "entries": flightrecorder.entries()}
 
+    def drift(self) -> Dict:
+        """Model/data drift snapshot (ISSUE 14): per resident model with
+        a drift monitor, the per-feature PSI/JS table, NaN and unseen-
+        category rates, and the raw-score-histogram divergence against
+        its training profile.  The scrape ABSORBS pending samples (the
+        dispatch path only stashes them), so this is also what
+        refreshes the `lgbm_drift_*` gauges — `GET /drift` and
+        `GET /metrics` derive from the same accumulators and cannot
+        disagree."""
+        models = {}
+        for entry in self.registry.entries():
+            if entry.drift is not None:
+                models[entry.key] = entry.drift.snapshot()
+        return {"models": models,
+                "psi_warn": float(self.config.serving_drift_psi_warn),
+                "sample_rows": int(self.config.serving_drift_sample_rows)}
+
     def metrics_text(self) -> str:
         """Prometheus exposition text: the process-global registry
         (train/collective/checkpoint/phase metrics) plus this session's
         serving metrics.  The serving latency histogram here and the
         `/stats` percentiles derive from the SAME buckets; the
-        process-runtime gauges are refreshed per scrape."""
+        process-runtime and drift gauges are refreshed per scrape."""
         from ..obs import REGISTRY, resources
 
         resources.publish_process_gauges(REGISTRY)
+        self.drift()  # refresh lgbm_drift_* gauges from the accumulators
         return REGISTRY.to_prometheus_text() + self._stats.to_prometheus_text()
 
     # ------------------------------------------------------------------
@@ -320,6 +338,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._text(200, session.metrics_text())
         elif self.path == "/models":
             self._json(200, {"models": session.models()})
+        elif self.path == "/drift":
+            # model/data health: PSI/JS drift vs the training profiles
+            self._json(200, session.drift())
         elif self.path == "/debug/blackbox":
             # the live flight-recorder ring: the postmortem view
             # WITHOUT the mortem

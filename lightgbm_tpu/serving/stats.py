@@ -40,6 +40,9 @@ _COUNTERS = (
     # drains                  = drain lifecycles completed
     "requests_overload", "requests_expired", "requests_drain_rejected",
     "dispatch_timeouts", "dispatch_failovers", "drains",
+    # model/data health (ISSUE 14): drift_warnings = PSI warn-threshold
+    # crossings recorded by the per-model DriftMonitor
+    "drift_warnings",
 )
 
 # serving latency buckets: sub-ms device hits through multi-second
@@ -167,6 +170,14 @@ class ServingStats:
         self._fill_bucket = 0    # padded launch rows they rode in
         self._queue_depth = 0
         self._shapes: set = set()
+        # drift gauge series published per model (label tuples), so an
+        # unloaded/evicted model's series can be removed exactly.
+        # _drift_closed holds keys whose series were cleared: an
+        # in-flight scrape that snapshotted the entry BEFORE its unload
+        # must not re-publish (phantom-series race); reload of the same
+        # key re-opens it.  Bounded by version churn (small strings)
+        self._drift_series: set = set()
+        self._drift_closed: set = set()
 
     # -- events --------------------------------------------------------
     def count(self, key: str, n: int = 1) -> None:
@@ -262,6 +273,64 @@ class ServingStats:
         server."""
         self.registry.remove("lgbm_serving_model_hbm_bytes",
                              model=str(key))
+
+    # -- model/data health (ISSUE 14) ----------------------------------
+    # The set_gauge runs INSIDE the stats lock on purpose: a scrape
+    # that snapshotted an entry just before its unload would otherwise
+    # re-create the gauge after clear_drift removed it, leaving a
+    # phantom per-model series forever (the lock + _drift_closed check
+    # serialize publish against clear).  Lock order stats._lock ->
+    # registry family lock is one-way; nothing takes them reversed.
+    def _set_drift_gauge(self, series, value: float, help: str,
+                         **labels) -> None:
+        name, model, _feat = series
+        with self._lock:
+            if model in self._drift_closed:
+                return  # unloaded while the scrape was in flight
+            self._drift_series.add(series)
+            self.registry.set_gauge(name, value, help=help, **labels)
+
+    def set_drift_psi(self, model: str, feature: str, value: float) -> None:
+        """Per-(model, feature) PSI gauge — refreshed by every drift
+        snapshot (GET /drift, GET /metrics scrapes)."""
+        self._set_drift_gauge(
+            ("lgbm_drift_psi", str(model), str(feature)), float(value),
+            help="per-feature PSI of sampled serving traffic vs the "
+                 "training profile",
+            model=str(model), feature=str(feature))
+
+    def set_drift_score_js(self, model: str, value: float) -> None:
+        self._set_drift_gauge(
+            ("lgbm_drift_score_js", str(model), None), float(value),
+            help="Jensen-Shannon divergence of the served raw-score "
+                 "histogram vs the training profile (max over classes)",
+            model=str(model))
+
+    def set_drift_rows(self, model: str, rows: int) -> None:
+        self._set_drift_gauge(
+            ("lgbm_drift_sampled_rows", str(model), None), float(rows),
+            help="rows absorbed by the drift monitor since model load",
+            model=str(model))
+
+    def reopen_drift(self, model: str) -> None:
+        """Re-arm drift publishing for a (re)loaded model key — undoes
+        a prior clear_drift tombstone."""
+        with self._lock:
+            self._drift_closed.discard(str(model))
+
+    def clear_drift(self, model: str) -> None:
+        """Drop a departed model's drift series (unload / LRU eviction)
+        — same no-dead-series contract as clear_model_hbm.  Also
+        tombstones the key so an in-flight scrape cannot re-publish."""
+        with self._lock:
+            gone = {s for s in self._drift_series if s[1] == str(model)}
+            self._drift_series -= gone
+            self._drift_closed.add(str(model))
+        for name, mdl, feat in gone:
+            if feat is None:
+                self.registry.remove(name, model=mdl)
+            else:
+                self.registry.remove(name, model=mdl, feature=feat)
 
     def set_total_hbm(self, nbytes: int) -> None:
         self.registry.set_gauge("lgbm_serving_models_hbm_bytes",

@@ -400,6 +400,60 @@ class TestDriftRules:
         assert run(["lightgbm_tpu"], root) == []
 
 
+class TestMetricDrift:
+    """P405 (ISSUE 14): lgbm_* metric names <-> USAGE.md tables."""
+
+    def _tree_with(self, tmp_path, code, usage):
+        return _tree(tmp_path, {
+            "lightgbm_tpu/m.py": code,
+            "docs/USAGE.md": usage})
+
+    def test_undocumented_and_phantom(self, tmp_path):
+        root = self._tree_with(
+            tmp_path,
+            """
+            def f(r):
+                r.inc("lgbm_hidden_total")
+                r.observe("lgbm_known_seconds", 1.0)
+            """,
+            "| `lgbm_known_seconds` | histogram |\n"
+            "| `lgbm_ghost_total` | counter |\n")
+        fs = run(["lightgbm_tpu"], root, rules=["P405"])
+        msgs = {f.snippet if f.path.endswith("USAGE.md")
+                else "code": f for f in fs}
+        assert any("lgbm_hidden_total" in f.message for f in fs), fs
+        assert "lgbm_ghost_total" in msgs
+        assert len(fs) == 2
+
+    def test_wildcard_and_histogram_suffixes_cover(self, tmp_path):
+        root = self._tree_with(
+            tmp_path,
+            """
+            def f(r, c):
+                r.inc(f"lgbm_serving_{c}")            # dynamic family
+                r.observe("lgbm_lat_seconds", 1.0)
+                r.inc("lgbm_serving_batches_total")   # wildcard-covered
+            """,
+            "| `lgbm_serving_*_total` | counter |\n"
+            "| `lgbm_lat_seconds_bucket` | histogram |\n")
+        assert run(["lightgbm_tpu"], root, rules=["P405"]) == []
+
+    def test_fstring_head_is_not_a_code_name(self, tmp_path):
+        # f"lgbm_serving_{x}" must register a dyn PREFIX, not a literal
+        # metric called 'lgbm_serving_' that the doc then has to carry
+        root = self._tree_with(
+            tmp_path,
+            'def f(r, x):\n    r.inc(f"lgbm_serving_{x}")\n',
+            "`lgbm_serving_*_total` counters\n")
+        assert run(["lightgbm_tpu"], root, rules=["P405"]) == []
+
+    def test_skips_without_usage_doc(self, tmp_path):
+        root = _tree(tmp_path, {
+            "lightgbm_tpu/m.py":
+                'def f(r):\n    r.inc("lgbm_orphan_total")\n'})
+        assert run(["lightgbm_tpu"], root, rules=["P405"]) == []
+
+
 # ---------------------------------------------------------------------------
 # 3. machinery: suppressions, baseline, reporters, explain, CLI
 # ---------------------------------------------------------------------------

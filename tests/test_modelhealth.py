@@ -1,0 +1,565 @@
+"""Model & data health (ISSUE 14): PSI/JS float64 oracle equality, the
+tpu_feature_profile: trailer byte-identity round trip (save -> load ->
+registry load -> checkpoint resume), the drift-injected warn -> shadow
+-> refuse promotion flow, and the training-telemetry <->
+feature_importance cross-check."""
+
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import modelhealth
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+# max_bin 63 (not the 31 most suites share): padded launch shapes stay
+# distinct from tests that assert on NEWLY-compiled programs later in
+# the alphabet (test_resources' ledger-capture smoke trains the shared
+# shape and must still see a fresh compile)
+_P = {"objective": "binary", "num_leaves": 7, "max_bin": 63,
+      "min_data_in_leaf": 5, "verbosity": -1}
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_isolation():
+    yield
+    obs.configure(mode="off", trace_dir="")
+    obs.flush()
+    obs.reset_events()
+
+
+def _problem(n=600, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, params=None, rounds=5, **kw):
+    p = dict(_P, **(params or {}))
+    ds = lgb.Dataset(X, label=y, params=p)
+    return lgb.train(p, ds, num_boost_round=rounds, verbose_eval=False,
+                     **kw)
+
+
+def _trailer(model_str):
+    lines = [ln for ln in model_str.splitlines()
+             if ln.startswith("tpu_feature_profile:")]
+    return lines[0] if lines else None
+
+
+# ---------------------------------------------------------------------------
+# divergences: independent float64 oracles
+# ---------------------------------------------------------------------------
+def _oracle_psi(e, o):
+    e = np.asarray(e, np.float64) + 0.5
+    o = np.asarray(o, np.float64) + 0.5
+    ep = e / e.sum()
+    op = o / o.sum()
+    return float(np.sum((op - ep) * np.log(op / ep)))
+
+
+def _oracle_js(e, o):
+    p = np.asarray(e, np.float64)
+    q = np.asarray(o, np.float64)
+    p = p / p.sum()
+    q = q / q.sum()
+    m = 0.5 * (p + q)
+    acc = 0.0
+    for pi, qi, mi in zip(p, q, m):
+        if pi > 0:
+            acc += 0.5 * pi * np.log(pi / mi)
+        if qi > 0:
+            acc += 0.5 * qi * np.log(qi / mi)
+    return float(acc)
+
+
+class TestDivergences:
+    def test_psi_js_match_oracle(self):
+        rng = np.random.default_rng(1)
+        for _ in range(20):
+            b = rng.integers(2, 40)
+            e = rng.integers(0, 1000, size=b)
+            o = rng.integers(0, 1000, size=b)
+            if e.sum() == 0 or o.sum() == 0:
+                continue
+            assert abs(modelhealth.psi(e, o) - _oracle_psi(e, o)) < 1e-12
+            assert abs(modelhealth.js_divergence(e, o)
+                       - _oracle_js(e, o)) < 1e-12
+
+    def test_identity_and_bounds(self):
+        c = np.array([5, 10, 0, 85])
+        assert modelhealth.psi(c, c) == 0.0
+        assert modelhealth.js_divergence(c, c) == 0.0
+        # disjoint distributions approach the JS bound ln 2
+        a, b = np.array([100, 0]), np.array([0, 100])
+        assert abs(modelhealth.js_divergence(a, b) - np.log(2)) < 1e-12
+        assert modelhealth.psi(a, b) > 1.0
+        # no evidence is not drift
+        assert modelhealth.psi([], []) == 0.0
+        assert modelhealth.js_divergence([1, 2], [0, 0]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# profile trailer round trips
+# ---------------------------------------------------------------------------
+class TestProfileTrailer:
+    def test_save_load_save_byte_identical(self):
+        X, y = _problem()
+        bst = _train(X, y)
+        s1 = bst.model_to_string()
+        t1 = _trailer(s1)
+        assert t1 is not None, "trained model carries no profile trailer"
+        b2 = lgb.Booster(model_str=s1)
+        t2 = _trailer(b2.model_to_string())
+        assert t1 == t2
+
+    def test_registry_load_keeps_trailer(self, tmp_path):
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _problem()
+        bst = _train(X, y)
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        t1 = _trailer(open(path).read())
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", model_file=path)
+            entry = sess.registry.resolve("m")
+            assert entry.drift is not None
+            t2 = _trailer(entry.booster.model_to_string())
+            assert t1 == t2
+        finally:
+            sess.close()
+
+    def test_checkpoint_resume_keeps_trailer(self, tmp_path):
+        X, y = _problem()
+        p = dict(_P)
+        ds = lgb.Dataset(X, label=y, params=p)
+        bst = lgb.Booster(params=p, train_set=ds)
+        for _ in range(4):
+            bst.update()
+        t1 = _trailer(bst.model_to_string())
+        bst.save_checkpoint(str(tmp_path))
+        ds2 = lgb.Dataset(X, label=y, params=p)
+        b2 = lgb.Booster(params=p, train_set=ds2)
+        assert b2.resume_from_checkpoint(str(tmp_path)) == 4
+        t2 = _trailer(b2.model_to_string())
+        assert t1 == t2
+
+    def test_binary_cache_keeps_profile(self, tmp_path):
+        """cnt_in_bin rides the mapper snapshot: a model trained from a
+        binary dataset cache (mappers rebuilt via from_dict) must still
+        write a full profile trailer."""
+        from lightgbm_tpu.io.dataset import TrainingData
+
+        X, y = _problem(n=500)
+        ds = lgb.Dataset(X, label=y, params=_P)
+        ds.construct()
+        ref = {c: ds._inner.mappers[c].cnt_in_bin
+               for c in ds._inner.used_feature_idx}
+        path = str(tmp_path / "cache.bin")
+        ds.save_binary(path)
+        td = TrainingData.from_binary(path)
+        for c, cnt in ref.items():
+            assert td.mappers[c].cnt_in_bin == cnt
+        prof = modelhealth.FeatureProfile.from_training(
+            td, [], np.zeros((1, td.num_data)), 8)
+        assert prof is not None
+        assert set(prof.features) == {c for c, cnt in ref.items() if cnt}
+
+    def test_capture_off_suppresses_trailer(self):
+        X, y = _problem()
+        bst = _train(X, y, params={"tpu_profile_capture": False})
+        assert _trailer(bst.model_to_string()) is None
+
+    def test_payload_contents(self):
+        X, y = _problem(n=500)
+        bst = _train(X, y)
+        prof = bst._driver.health_profile()
+        assert prof is not None
+        pay = prof.to_payload()
+        assert pay["label"]["n"] == 500
+        assert abs(pay["label"]["mean"] - float(y.mean())) < 1e-12
+        # occupancy sums to the sample count per feature
+        for f in pay["features"].values():
+            assert sum(f["cnt"]) == 500
+            assert len(f["cnt"]) == f["num_bin"]
+        # score histogram covers every training row per class
+        for row in pay["score"]["counts"]:
+            assert sum(row) == 500
+
+
+# ---------------------------------------------------------------------------
+# drift monitor vs the float64 oracle
+# ---------------------------------------------------------------------------
+class TestDriftOracle:
+    def test_monitor_matches_numpy_oracle(self):
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _problem(n=800)
+        bst = _train(X, y)
+        sample_rows = 100
+        sess = ServingSession(params={
+            "serving_drift_sample_rows": sample_rows,
+            "serving_max_batch_rows": 4096, "verbosity": -1},
+            start=False)
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            batches = [X[:300] + 1.5, X[300:550], X[550:]]
+            for Xb in batches:
+                entry.predict(Xb)
+            snap = entry.drift.snapshot()
+        finally:
+            sess.close()
+
+        # oracle: replicate the stride sampling, bin through the SAME
+        # mappers, accumulate int64, and apply the independent PSI/JS
+        # oracles — equality to 1e-12 is the acceptance bar
+        prof = json.loads(_trailer(bst.model_to_string())
+                          .split(":", 1)[1])
+        ctx = bst._driver._pred_context()
+        sampled = []
+        for Xb in batches:
+            n = Xb.shape[0]
+            if n > sample_rows:
+                step = -(-n // sample_rows)
+                Xb = Xb[::step][:sample_rows]
+            sampled.append(np.asarray(Xb, np.float64))
+        Xs = np.concatenate(sampled, axis=0)
+        assert snap["rows_sampled"] == Xs.shape[0]
+        for key, ref in prof["features"].items():
+            c = int(key)
+            mapper = ctx.mappers[c]
+            bins = mapper.values_to_bins(Xs[:, c])
+            ocnt = np.bincount(bins, minlength=ref["num_bin"])
+            got = snap["features"][ref["name"]]
+            assert abs(got["psi"] - _oracle_psi(ref["cnt"], ocnt)) < 1e-12
+            assert abs(got["js"] - _oracle_js(ref["cnt"], ocnt)) < 1e-12
+            assert got["rows"] == Xs.shape[0]
+        # raw-score histogram divergence, same bar
+        raw = np.asarray(bst.predict(Xs, raw_score=True), np.float64)
+        edges = np.asarray(prof["score"]["edges"], np.float64)
+        idx = np.clip(np.searchsorted(edges[1:-1], raw, side="right"),
+                      0, len(edges) - 2)
+        ocnt = np.bincount(idx, minlength=len(edges) - 1)
+        assert abs(snap["score_js"][0]
+                   - _oracle_js(prof["score"]["counts"][0], ocnt)) < 1e-12
+
+    def test_nan_and_unseen_rates(self):
+        from lightgbm_tpu.serving import ServingSession
+
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(500, 3))
+        X[:100, 1] = np.nan                      # train-time NaNs too
+        y = (X[:, 0] > 0).astype(np.float64)
+        bst = _train(X, y)
+        sess = ServingSession(params={
+            "serving_drift_sample_rows": 4096, "verbosity": -1},
+            start=False)
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            Xq = X[:200].copy()
+            Xq[:100, 1] = np.nan                 # 50% NaN vs 20% trained
+            entry.predict(Xq)
+            snap = entry.drift.snapshot()
+        finally:
+            sess.close()
+        names = bst.feature_name()
+        f = snap["features"][names[1]]
+        assert abs(f["nan_rate"] - 0.5) < 1e-12
+        assert abs(f["nan_delta"] - (0.5 - 0.2)) < 1e-12
+
+    def test_sampling_disabled_means_no_monitor(self):
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _problem()
+        bst = _train(X, y)
+        sess = ServingSession(params={
+            "serving_drift_sample_rows": 0, "verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            assert sess.registry.resolve("m").drift is None
+            assert sess.drift()["models"] == {}
+        finally:
+            sess.close()
+
+    def test_no_profile_means_no_monitor(self):
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _problem()
+        bst = _train(X, y, params={"tpu_profile_capture": False})
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            assert sess.registry.resolve("m").drift is None
+        finally:
+            sess.close()
+
+    def test_wrong_width_request_does_not_poison_monitor(self):
+        """A 400-class request (wrong feature count) fails alone — it
+        must not land in the drift accumulator, where a mixed-width
+        concatenate would break every later scrape."""
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _problem(n=500)
+        bst = _train(X, y)
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            entry.predict(X[:40])
+            with pytest.raises(Exception):
+                entry.predict(X[:10, :3])       # wrong width: 3 vs 5
+            snap = entry.drift.snapshot()       # scrape must survive
+            assert snap["rows_sampled"] == 40   # bad batch not counted
+            entry.predict(X[40:80])
+            assert entry.drift.snapshot()["rows_sampled"] == 80
+        finally:
+            sess.close()
+
+    def test_truncated_categorical_has_no_phantom_nan_frac(self):
+        """A truncated high-cardinality categorical sets
+        missing_type=NAN without a dedicated NaN bin; its rare-tail
+        mass must not be recorded as NaN fraction."""
+        rng = np.random.default_rng(8)
+        X = rng.normal(size=(600, 2))
+        # 40 categories over a max_bin=31 budget: guaranteed truncation
+        X[:, 1] = rng.integers(0, 40, size=600)
+        y = (X[:, 0] > 0).astype(np.float64)
+        p = dict(_P, max_bin=31)
+        ds = lgb.Dataset(X, label=y, params=p, categorical_feature=[1])
+        bst = lgb.train(p, ds, num_boost_round=3, verbose_eval=False)
+        prof = bst._driver.health_profile()
+        f = prof.features.get(1)
+        if f is not None:                        # categorical profiled
+            assert f["bin_type"] == 1
+            assert f["nan_frac"] == 0.0
+
+    def test_unload_during_scrape_cannot_resurrect_gauges(self):
+        """The clear_drift tombstone: a publish that snapshotted the
+        entry before its unload must not re-create the per-model
+        series (the phantom-series race)."""
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _problem(n=400)
+        bst = _train(X, y)
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            entry.predict(X[:50])
+            monitor = entry.drift
+            sess.unload("m")                     # clears + tombstones
+            monitor.snapshot()                   # in-flight publish
+            assert "lgbm_drift_" not in sess._stats.to_prometheus_text()
+            # reloading the same key re-arms publishing
+            sess.load("m", booster=bst, version="1")
+            e2 = sess.registry.resolve("m")
+            e2.predict(X[:50])
+            e2.drift.snapshot()
+            assert "lgbm_drift_psi{" in sess._stats.to_prometheus_text()
+        finally:
+            sess.close()
+
+    def test_unload_clears_drift_gauges(self):
+        from lightgbm_tpu.serving import ServingSession
+
+        X, y = _problem()
+        bst = _train(X, y)
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            entry.predict(X[:50])
+            entry.drift.snapshot()
+            assert "lgbm_drift_psi{" in sess._stats.to_prometheus_text()
+            sess.unload("m")
+            assert "lgbm_drift_psi{" not in sess._stats.to_prometheus_text()
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance flow: drift warn -> shadow compare -> refuse
+# ---------------------------------------------------------------------------
+class TestPromotionFlow:
+    def test_drift_warn_and_shadow_refuse_end_to_end(self, tmp_path):
+        from lightgbm_tpu.obs import flightrecorder
+        from lightgbm_tpu.serving import ServingSession
+        from lightgbm_tpu.serving.server import serve_http
+
+        sys.path.insert(0, TOOLS)
+        try:
+            import model_report
+        finally:
+            sys.path.remove(TOOLS)
+
+        X, y = _problem(n=800, seed=11)
+        live = _train(X, y, rounds=8)
+        live_path = str(tmp_path / "live.txt")
+        live.save_model(live_path)
+        # worse candidate: trained on permuted labels
+        rng = np.random.default_rng(5)
+        yb = y.copy()
+        rng.shuffle(yb)
+        cand = _train(X, yb, rounds=8)
+        cand_path = str(tmp_path / "cand.txt")
+        cand.save_model(cand_path)
+
+        flightrecorder.reset()
+        sess = ServingSession(params={
+            "serving_max_batch_rows": 512,
+            "serving_drift_sample_rows": 256,
+            "serving_drift_psi_warn": 0.25, "verbosity": -1})
+        server = serve_http(sess, port=0)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        try:
+            sess.load("live", model_file=live_path)
+            for lo in range(0, 600, 200):          # shifted traffic
+                sess.predict("live", X[lo:lo + 200] + 2.5)
+            with urllib.request.urlopen(base + "/drift") as resp:
+                payload = json.loads(resp.read().decode())
+            snap = payload["models"]["live@1"]
+            assert snap["warn"] is True
+            assert snap["psi_max"] >= 0.25
+            # gauges on /metrics agree with the payload
+            with urllib.request.urlopen(base + "/metrics") as resp:
+                text = resp.read().decode()
+            assert "lgbm_drift_psi{" in text
+            # flight recorder carries the psi_warn transition
+            kinds = [(e["kind"], e["name"])
+                     for e in flightrecorder.entries()]
+            assert ("drift", "psi_warn") in kinds
+            assert sess.stats()["drift_warnings"] >= 1
+        finally:
+            server.shutdown()
+            sess.close()
+
+        # the promotion gate refuses the worse candidate on the same
+        # (labeled) sample, and promotes the live model vs itself
+        np.savez(tmp_path / "sample.npz", X=X[:400], y=y[:400])
+        rc = model_report.main([
+            "--shadow", "--live", live_path, "--candidate", cand_path,
+            "--data", str(tmp_path / "sample.npz")])
+        assert rc == model_report.EXIT_REFUSED
+        rc = model_report.main([
+            "--shadow", "--live", live_path, "--candidate", live_path,
+            "--data", str(tmp_path / "sample.npz")])
+        assert rc == model_report.EXIT_OK
+
+
+# ---------------------------------------------------------------------------
+# training telemetry <-> feature_importance cross-check
+# ---------------------------------------------------------------------------
+class TestTrainingTelemetry:
+    def test_importance_counters_cross_check(self):
+        obs.configure(mode="metrics")
+        for fam in ("lgbm_train_splits_total",
+                    "lgbm_train_split_gain_total"):
+            obs.REGISTRY.clear_family(fam)
+        X, y = _problem(n=700, seed=2)
+        bst = _train(X, y, rounds=6, keep_training_booster=True)
+        names = bst.feature_name()
+        split = bst.feature_importance("split")
+        gain = bst.feature_importance("gain")
+        for i, nm in enumerate(names):
+            assert obs.REGISTRY.value("lgbm_train_splits_total",
+                                      feature=nm) == split[i]
+            # the per-split f64 inc order matches feature_importance's
+            # flat walk, so equality is EXACT, not approximate
+            assert obs.REGISTRY.value("lgbm_train_split_gain_total",
+                                      feature=nm) == gain[i]
+        # ... and a model reloaded from string reports the SAME
+        # importances the live counters recorded
+        b2 = lgb.Booster(model_str=bst.model_to_string())
+        s2 = b2.feature_importance("split")
+        g2 = b2.feature_importance("gain")
+        for i, nm in enumerate(b2.feature_name()):
+            assert obs.REGISTRY.value("lgbm_train_splits_total",
+                                      feature=nm) == s2[i]
+            assert obs.REGISTRY.value("lgbm_train_split_gain_total",
+                                      feature=nm) == pytest.approx(
+                                          g2[i], rel=1e-6, abs=1e-12)
+
+    def test_leaf_depth_distributions_and_metric_series(self):
+        obs.configure(mode="metrics")
+        for fam in ("lgbm_train_leaf_count", "lgbm_train_tree_depth",
+                    "lgbm_train_metric"):
+            obs.REGISTRY.clear_family(fam)
+        X, y = _problem(n=700, seed=4)
+        p = dict(_P, metric=["binary_logloss"])
+        ds = lgb.Dataset(X, label=y, params=p)
+        vd = lgb.Dataset(X[:150], label=y[:150], reference=ds, params=p)
+        bst = lgb.train(p, ds, num_boost_round=6, valid_sets=[vd],
+                        verbose_eval=False, keep_training_booster=True)
+        n_leaf, _ = obs.REGISTRY.histogram_stats("lgbm_train_leaf_count")
+        assert n_leaf == 6
+        samples = obs.REGISTRY.histogram_samples(
+            "lgbm_train_leaf_count")
+        drv = bst._driver
+        assert samples == [float(t.num_leaves) for t in drv.models]
+        # metric time series: one sample per iteration, in order
+        series = obs.REGISTRY.histogram_samples(
+            "lgbm_train_metric", dataset="valid_0",
+            metric="binary_logloss")
+        assert len(series) == 6
+        assert all(isinstance(v, float) for v in series)
+
+    def test_guard_skip_rollback_not_counted_on_sync_path(self):
+        """A tpu_guard_numerics=skip iteration's trees are rolled back
+        — the sync path must not have counted them (telemetry defers
+        until the guard accepts the iteration), keeping the counter <->
+        feature_importance bit-equality."""
+        from lightgbm_tpu.utils import faultline
+
+        obs.configure(mode="metrics")
+        for fam in ("lgbm_train_splits_total",
+                    "lgbm_train_split_gain_total"):
+            obs.REGISTRY.clear_family(fam)
+        X, y = _problem(n=500, seed=12)
+
+        def fobj(preds, ds):
+            p = 1.0 / (1.0 + np.exp(-np.asarray(preds)))
+            return (p - y).astype(np.float32), \
+                (p * (1 - p)).astype(np.float32)
+
+        # bagging gives skip-mode the stochastic lever its re-bag needs
+        p = dict(_P, objective="none", tpu_guard_numerics="skip",
+                 bagging_fraction=0.8, bagging_freq=1)
+        ds = lgb.Dataset(X, label=y, params=p)
+        bst = lgb.Booster(params=p, train_set=ds)
+        faultline.reset()
+        faultline.arm("grow_step", action="poison", at=1)
+        try:
+            for _ in range(4):
+                bst.update(fobj=fobj)   # custom fobj = the SYNC path
+        finally:
+            faultline.reset()
+        split = bst.feature_importance("split")
+        gain = bst.feature_importance("gain")
+        for i, nm in enumerate(bst.feature_name()):
+            assert obs.REGISTRY.value("lgbm_train_splits_total",
+                                      feature=nm) == split[i]
+            assert obs.REGISTRY.value("lgbm_train_split_gain_total",
+                                      feature=nm) == gain[i]
+
+    def test_off_mode_records_nothing(self):
+        assert obs.mode() == "off"
+        for fam in ("lgbm_train_splits_total", "lgbm_train_leaf_count"):
+            obs.REGISTRY.clear_family(fam)
+        X, y = _problem(n=400, seed=6)
+        _train(X, y, rounds=3)
+        assert obs.REGISTRY.value("lgbm_train_splits_total",
+                                  feature="Column_0") == 0.0
+        n, _ = obs.REGISTRY.histogram_stats("lgbm_train_leaf_count")
+        assert n == 0

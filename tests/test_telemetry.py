@@ -318,6 +318,36 @@ class TestServingMetrics:
         m = re.search(r"lgbm_serving_requests_total(\{\})? (\d+)", text)
         assert m and int(m.group(2)) == st["requests_total"]
 
+    def test_drift_gauges_agree_with_drift_payload(self, served):
+        """ISSUE 14 extension of the scrape-equality contract: the
+        `lgbm_drift_*` gauges on /metrics and the GET /drift JSON read
+        the SAME accumulators — values must agree (modulo the %g gauge
+        formatting), and every profiled feature appears on both."""
+        sess, base, X = served
+        sess.predict("m", X[:200] + 1.0)   # shifted: non-trivial PSI
+        payload = json.loads(self._get(base + "/drift")[1])
+        assert "m@1" in payload["models"]
+        snap = payload["models"]["m@1"]
+        assert snap["rows_sampled"] > 0
+        text = self._get(base + "/metrics")[1]
+        gauges = {}
+        for line in text.splitlines():
+            m = re.match(r'lgbm_drift_psi\{feature="([^"]+)",'
+                         r'model="m@1"\} (-?[0-9.eE+-]+)', line)
+            if m:
+                gauges[m.group(1)] = float(m.group(2))
+        assert set(gauges) == set(snap["features"])
+        for name, f in snap["features"].items():
+            assert gauges[name] == pytest.approx(f["psi"], rel=1e-5,
+                                                 abs=1e-9)
+        m = re.search(r'lgbm_drift_score_js\{model="m@1"\} '
+                      r'(-?[0-9.eE+-]+)', text)
+        assert m and float(m.group(1)) == pytest.approx(
+            snap["score_js_max"], rel=1e-5, abs=1e-9)
+        m = re.search(r'lgbm_drift_sampled_rows\{model="m@1"\} (\d+)',
+                      text)
+        assert m and int(m.group(1)) >= snap["rows_sampled"]
+
     def test_queue_wait_and_dispatch_distributions_populate(self, served):
         sess, base, X = served
         for _ in range(3):

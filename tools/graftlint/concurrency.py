@@ -51,7 +51,15 @@ OWNERSHIP: Dict[Tuple[str, Optional[str]], Dict[str, str]] = {
         "_work": "_lock", "_busy": "_lock"},
     ("serving/stats.py", "ServingStats"): {
         "_fill_rows": "_lock", "_fill_bucket": "_lock",
-        "_queue_depth": "_lock", "_shapes": "_lock"},
+        "_queue_depth": "_lock", "_shapes": "_lock",
+        "_drift_series": "_lock", "_drift_closed": "_lock"},
+    # DriftMonitor._pending is deliberately NOT here: it is a bounded
+    # deque with GIL-atomic append/popleft (the flight-recorder-ring
+    # pattern) written from the dispatch path, which must never lock
+    ("obs/modelhealth.py", "DriftMonitor"): {
+        "_counts": "_lock", "_nan": "_lock", "_unseen": "_lock",
+        "_rows": "_lock", "_score_counts": "_lock",
+        "_warned": "_lock", "_warnings": "_lock"},
     ("serving/stats.py", "CircuitBreaker"): {
         "state": "_lock", "_failures": "_lock", "_entered_at": "_lock",
         "_gen": "_lock"},

@@ -25,7 +25,9 @@ _DOC_TOKEN = re.compile(r"\b((?:tpu|serving)_[a-z0-9_]+)\b")
 # entries by design (each one justified here, not baselined):
 #   tpu_bin_mappers — the saved-model trailer section name (PR 2), a
 #       model-file format token, not a config knob
-_DOC_TOKEN_ALLOWED = {"tpu_bin_mappers"}
+#   tpu_feature_profile — the model-health trailer section name
+#       (ISSUE 14), same model-file format family as tpu_bin_mappers
+_DOC_TOKEN_ALLOWED = {"tpu_bin_mappers", "tpu_feature_profile"}
 
 
 def _registry_params(project: Project) -> Dict[str, int]:
@@ -190,6 +192,113 @@ register(Rule(
         "regenerating, from the lint gate that also runs outside "
         "pytest (multichip dryrun tail)."),
     project_check=lambda p: _check_param_drift(p, "P402")))
+
+# ---------------------------------------------------------------------------
+# P405: metric-name <-> USAGE.md metric-table drift (ISSUE 14)
+# ---------------------------------------------------------------------------
+# metric names never end in '_' — that shape is an f-string head
+# (f"lgbm_serving_{counter}"), collected separately as a dyn prefix
+_METRIC_LIT = re.compile(r"^lgbm_[a-z0-9_]*[a-z0-9]$")
+_METRIC_DOC = re.compile(r"\blgbm_[a-z0-9_*]+")
+# Prometheus exposition derives these suffixes from histogram families;
+# a doc/code mention of either form documents the same metric
+_HIST_SUFFIXES = ("", "_bucket", "_sum", "_count")
+
+
+def _metric_facts(project: Project):
+    """(code_names, dyn_prefixes, doc_tokens) shared by both directions
+    of the P405 check.  code_names = full-match `lgbm_*` string
+    literals anywhere in the linted package (direct registry names AND
+    name-constant assignments like stats._LAT); dyn_prefixes = leading
+    constants of f-strings that BUILD metric names (`f"lgbm_serving_
+    {counter}"`), whose members a static scan cannot enumerate."""
+    cached = getattr(project, "_gl_metric_facts", None)
+    if cached is not None:
+        return cached
+    code: Dict[str, Tuple[str, int]] = {}
+    dyn: Set[str] = set()
+    for fc in project.files:
+        for node in ast.walk(fc.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _METRIC_LIT.fullmatch(node.value):
+                code.setdefault(node.value,
+                                (fc.rel, getattr(node, "lineno", 0)))
+            elif isinstance(node, ast.JoinedStr) and node.values:
+                head = node.values[0]
+                if isinstance(head, ast.Constant) and \
+                        isinstance(head.value, str) and \
+                        head.value.startswith("lgbm_"):
+                    dyn.add(head.value)
+    doc = project.read_text("docs", "USAGE.md")
+    doc_tokens = set(_METRIC_DOC.findall(doc)) if doc is not None \
+        else None
+    cached = project._gl_metric_facts = (code, dyn, doc_tokens)
+    return cached
+
+
+def _doc_matches(token: str, name: str) -> bool:
+    """Does one USAGE token (may contain ``*`` wildcards) document
+    `name` (modulo the Prometheus histogram suffixes)?"""
+    import fnmatch
+
+    for suf in _HIST_SUFFIXES:
+        if fnmatch.fnmatchcase(name + suf, token) or \
+                fnmatch.fnmatchcase(name, token + suf):
+            return True
+    return False
+
+
+def _check_metric_drift(project: Project):
+    code, dyn, doc_tokens = _metric_facts(project)
+    if doc_tokens is None or not code:
+        return  # partial checkout (fixture trees): nothing to check
+    for name, (rel, lineno) in sorted(code.items()):
+        if not any(_doc_matches(tok, name) for tok in doc_tokens):
+            fc = project.file(rel)
+            src = fc.finding if fc is not None else None
+            if src is not None:
+                yield src("P405", lineno,
+                          f"metric {name!r} is registered in code but "
+                          "missing from docs/USAGE.md's metric-names "
+                          "tables: an operator cannot alert on a metric "
+                          "they cannot discover.  Add a table row (or a "
+                          "covering wildcard like lgbm_serving_*_total).")
+    for tok in sorted(doc_tokens):
+        if any(_doc_matches(tok, name) for name in code):
+            continue
+        # dynamically-built families (f"lgbm_serving_{counter}"): a
+        # token is legitimate when its literal head shares a prefix
+        # with a dynamic name constructor — members are not statically
+        # enumerable, so prefix compatibility is the checkable claim
+        head = tok.split("*", 1)[0]
+        if any(head.startswith(p) or p.startswith(head) for p in dyn):
+            continue
+        yield Finding(
+            rule="P405", path="docs/USAGE.md", line=0,
+            message=(f"{tok!r} appears in docs/USAGE.md but no code "
+                     "registers a matching lgbm_* metric: a phantom "
+                     "name readers will build dashboards on.  Fix the "
+                     "doc or register the metric."),
+            snippet=tok)
+
+
+register(Rule(
+    id="P405", name="metric-name-drift", family="drift",
+    summary=("Every lgbm_* metric name registered in code appears in "
+             "USAGE.md's metric-names tables, and no documented metric "
+             "name is phantom."),
+    rationale=(
+        "The metric tables in docs/USAGE.md are the operator contract: "
+        "dashboards and alerts are built from them, not from the "
+        "source.  A metric the code emits but the doc omits is "
+        "undiscoverable; a metric the doc names but nothing emits is a "
+        "dashboard that silently flatlines.  Same shape as P402/P403 "
+        "for params, applied to the `lgbm_*` namespace; wildcard "
+        "tokens (lgbm_serving_*_total) cover dynamically-constructed "
+        "families, matched by prefix against their f-string "
+        "constructors."),
+    project_check=_check_metric_drift))
 
 register(Rule(
     id="P403", name="doc-param-phantom", family="drift",

@@ -1,0 +1,432 @@
+"""Promotion-grade model health report + shadow compare (ISSUE 14).
+
+Renders a JSON/markdown health report for a trained model — learning
+curves (read back from the PR-10 metrics registry when available),
+split/gain importances cross-checked between the model and the
+training-time counters, model shape (leaf/depth distributions), the
+``tpu_feature_profile:`` training-reference summary, and a drift table
+against either a live serving monitor (``--drift-url .../drift``) or a
+second dataset (``--compare-data``).
+
+``--shadow`` is the promotion gate ROADMAP item 4 (continuous
+learning) needs: score a candidate model and the live model on the
+SAME sample, report the prediction-delta distribution, and — when the
+sample carries labels — refuse the candidate if its loss is worse than
+the live model's (exit code 3).  A refused candidate never reaches the
+registry hot-swap.
+
+Usage::
+
+    python tools/model_report.py --model model.txt [--json out.json]
+        [--markdown out.md] [--compare-data data.npz] [--drift-url URL]
+    python tools/model_report.py --shadow --live live.txt
+        --candidate cand.txt --data sample.npz [--tolerance 0.0]
+    python tools/model_report.py --smoke    # CI: train -> report ->
+                                            # shadow -> verify refusal
+
+Exit codes: 0 ok/promote, 3 shadow refused, 2 usage or input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXIT_OK = 0
+EXIT_ERROR = 2
+EXIT_REFUSED = 3
+
+
+# ---------------------------------------------------------------------------
+# data loading
+# ---------------------------------------------------------------------------
+def load_data(path: str) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """(X, y-or-None) from .npz (keys X / y), .npy (matrix), or a
+    numeric CSV (no labels)."""
+    if path.endswith(".npz"):
+        z = np.load(path)
+        X = np.atleast_2d(np.asarray(z["X"], np.float64))
+        y = np.asarray(z["y"], np.float64) if "y" in z else None
+        return X, y
+    if path.endswith(".npy"):
+        return np.atleast_2d(np.asarray(np.load(path), np.float64)), None
+    return np.atleast_2d(np.asarray(np.loadtxt(path, delimiter=","),
+                                    np.float64)), None
+
+
+# ---------------------------------------------------------------------------
+# report sections
+# ---------------------------------------------------------------------------
+def _shape_section(booster) -> Dict:
+    drv = booster._driver
+    leaves = [int(t.num_leaves) for t in drv.models]
+    depths = [int(t.max_depth()) for t in drv.models]
+
+    def dist(v: List[int]) -> Dict:
+        if not v:
+            return {"n": 0}
+        a = np.asarray(v, np.float64)
+        return {"n": len(v), "mean": round(float(a.mean()), 3),
+                "min": int(a.min()), "max": int(a.max()),
+                "p50": float(np.percentile(a, 50)),
+                "p95": float(np.percentile(a, 95))}
+
+    return {"num_trees": booster.num_trees(),
+            "num_class": int(drv.num_class),
+            "trees_per_iteration": int(drv.num_tree_per_iteration),
+            "leaves": dist(leaves), "depth": dist(depths)}
+
+
+def _importance_section(booster, top: int = 20) -> Dict:
+    names = booster.feature_name()
+    split = booster.feature_importance("split")
+    gain = booster.feature_importance("gain")
+    order = np.argsort(-gain)
+    rows = []
+    for i in order[:top]:
+        if split[i] <= 0:
+            continue
+        rows.append({"feature": (names[i] if i < len(names)
+                                 else f"Column_{i}"),
+                     "splits": int(split[i]),
+                     "gain": round(float(gain[i]), 6)})
+    return {"top": rows, "features_used": int((split > 0).sum())}
+
+
+def _curves_section() -> Dict:
+    """Learning curves read back from the registry's lgbm_train_metric
+    sample rings (present when training ran with tpu_telemetry=metrics
+    in this process; marked unavailable otherwise)."""
+    from lightgbm_tpu import obs
+
+    curves: Dict[str, List[float]] = {}
+    for ds in obs.REGISTRY.label_values("lgbm_train_metric", "dataset"):
+        for mt in obs.REGISTRY.label_values("lgbm_train_metric",
+                                            "metric"):
+            samples, truncated = obs.REGISTRY.histogram_samples(
+                "lgbm_train_metric", with_truncated=True,
+                dataset=ds, metric=mt)
+            if samples:
+                curves[f"{ds}/{mt}"] = {
+                    "values": [round(float(v), 6) for v in samples],
+                    "truncated": bool(truncated)}
+    return curves if curves else {"unavailable": (
+        "no lgbm_train_metric series in this process's registry; train "
+        "with tpu_telemetry=metrics and valid_sets to record curves")}
+
+
+def _profile_section(booster) -> Dict:
+    prof = booster._driver.health_profile()
+    if prof is None:
+        return {"unavailable": "model carries no tpu_feature_profile: "
+                               "trailer (tpu_profile_capture=false?)"}
+    out = prof.summary()
+    out["per_feature"] = {
+        f["name"]: {"num_bin": f["num_bin"],
+                    "nan_frac": round(f["nan_frac"], 6),
+                    "zero_frac": round(f["zero_frac"], 6)}
+        for f in prof.features.values()}
+    return out
+
+
+def _drift_section(booster, compare_data: Optional[str],
+                   drift_url: Optional[str]) -> Dict:
+    if drift_url:
+        import urllib.request
+
+        try:
+            # bounded: a wedged serving endpoint (the scenario the
+            # dispatch watchdog exists for) must not hang the report
+            with urllib.request.urlopen(drift_url, timeout=30) as resp:
+                return {"source": drift_url,
+                        **json.loads(resp.read().decode())}
+        except Exception as exc:
+            return {"unavailable":
+                    f"drift fetch from {drift_url} failed: {exc}"}
+    if compare_data:
+        from lightgbm_tpu.obs import modelhealth
+
+        prof = booster._driver.health_profile()
+        ctx = booster._driver._pred_context()
+        if prof is None or ctx is None:
+            return {"unavailable": "drift needs a profile trailer and "
+                                   "bin mappers on the model"}
+        X, _ = load_data(compare_data)
+        snap = modelhealth.compare_dataset(
+            prof, ctx.mappers, X,
+            score_fn=lambda Xs: booster._driver.predict_raw(Xs, -1))
+        return {"source": compare_data, **snap}
+    return {"unavailable": "pass --compare-data or --drift-url"}
+
+
+def build_report(booster, compare_data: Optional[str] = None,
+                 drift_url: Optional[str] = None) -> Dict:
+    return {
+        "model": _shape_section(booster),
+        "importance": _importance_section(booster),
+        "learning_curves": _curves_section(),
+        "profile": _profile_section(booster),
+        "drift": _drift_section(booster, compare_data, drift_url),
+    }
+
+
+def render_markdown(report: Dict, title: str = "Model health report"
+                    ) -> str:
+    lines = [f"# {title}", ""]
+    m = report["model"]
+    lines += ["## Model", "",
+              f"- trees: {m['num_trees']} "
+              f"({m['trees_per_iteration']}/iteration, "
+              f"{m['num_class']} class(es))",
+              f"- leaves: {m['leaves']}", f"- depth: {m['depth']}", ""]
+    imp = report["importance"]
+    lines += ["## Importance (top by gain)", "",
+              "| feature | splits | gain |", "|---|---|---|"]
+    for r in imp["top"]:
+        lines.append(f"| {r['feature']} | {r['splits']} | {r['gain']} |")
+    lines += ["", f"features used: {imp['features_used']}", ""]
+    lines += ["## Learning curves", ""]
+    curves = report["learning_curves"]
+    if "unavailable" in curves:
+        lines.append(f"_{curves['unavailable']}_")
+    else:
+        for key, c in curves.items():
+            v = c["values"]
+            tail = " (ring truncated)" if c["truncated"] else ""
+            lines.append(f"- `{key}`: {v[0]:.6f} -> {v[-1]:.6f} over "
+                         f"{len(v)} recorded iterations{tail}")
+    lines += ["", "## Training profile", ""]
+    prof = report["profile"]
+    if "unavailable" in prof:
+        lines.append(f"_{prof['unavailable']}_")
+    else:
+        lines.append(f"- features profiled: {prof['features']}; label "
+                     f"n={prof['label']['n']} "
+                     f"mean={prof['label']['mean']:.6g}")
+        lines.append(f"- score histogram: {prof['score_bins']} bins x "
+                     f"{prof['score_classes']} class(es)")
+    lines += ["", "## Drift", ""]
+    drift = report["drift"]
+    if "unavailable" in drift:
+        lines.append(f"_{drift['unavailable']}_")
+    elif "features" in drift:
+        lines += [f"source: `{drift.get('source', 'live')}` — "
+                  f"{drift['rows_sampled']} rows, "
+                  f"psi_max={drift['psi_max']:.4f} "
+                  f"({'WARN' if drift['warn'] else 'ok'})", "",
+                  "| feature | PSI | JS | nan_rate | unseen |",
+                  "|---|---|---|---|---|"]
+        for name, f in sorted(drift["features"].items(),
+                              key=lambda kv: -kv[1]["psi"]):
+            lines.append(f"| {name} | {f['psi']:.4f} | {f['js']:.4f} | "
+                         f"{f['nan_rate']:.4f} | "
+                         f"{f['unseen_rate']:.4f} |")
+    else:  # a raw GET /drift payload (possibly several models)
+        lines.append("```json")
+        lines.append(json.dumps(drift, indent=2)[:4000])
+        lines.append("```")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# shadow compare (the promotion gate)
+# ---------------------------------------------------------------------------
+def _loss(booster, X: np.ndarray, y: np.ndarray) -> Tuple[str, float]:
+    """(metric name, loss) — binary logloss for binary objectives,
+    mean squared error otherwise.  Lower is better for both."""
+    obj = str(booster._driver.loaded_params.get(
+        "objective", "") or (booster._driver.objective.to_model_string()
+                             if booster._driver.objective else ""))
+    pred = np.asarray(booster.predict(X), np.float64)
+    if obj.startswith("binary"):
+        p = np.clip(pred, 1e-15, 1.0 - 1e-15)
+        return "binary_logloss", float(
+            -np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
+    if pred.ndim > 1:  # multiclass: negative log-likelihood of y class
+        p = np.clip(pred[np.arange(len(y)), y.astype(int)], 1e-15, 1.0)
+        return "multi_logloss", float(-np.mean(np.log(p)))
+    return "l2", float(np.mean((pred - y) ** 2))
+
+
+def shadow_compare(live, candidate, X: np.ndarray,
+                   y: Optional[np.ndarray] = None,
+                   tolerance: float = 0.0) -> Dict:
+    """Score candidate vs live on the same sample.  Returns the
+    prediction-delta distribution and — with labels — the promote/
+    refuse verdict: promote iff candidate_loss <= live_loss *
+    (1 + tolerance)."""
+    pl = np.asarray(live.predict(X, raw_score=True), np.float64)
+    pc = np.asarray(candidate.predict(X, raw_score=True), np.float64)
+    delta = np.abs(pc - pl).ravel()
+    out: Dict = {
+        "rows": int(X.shape[0]),
+        "delta": {
+            "mean": float(delta.mean()) if delta.size else 0.0,
+            "p50": float(np.percentile(delta, 50)) if delta.size else 0.0,
+            "p95": float(np.percentile(delta, 95)) if delta.size else 0.0,
+            "max": float(delta.max()) if delta.size else 0.0,
+        },
+    }
+    if y is None:
+        out["verdict"] = "no-labels"
+        out["reason"] = ("sample carries no labels; delta distribution "
+                         "only — pass labeled data for a promote/refuse "
+                         "verdict")
+        return out
+    metric, live_loss = _loss(live, X, y)
+    _, cand_loss = _loss(candidate, X, y)
+    out["metric"] = metric
+    out["live_loss"] = live_loss
+    out["candidate_loss"] = cand_loss
+    out["tolerance"] = float(tolerance)
+    promote = (math.isfinite(cand_loss)
+               and cand_loss <= live_loss * (1.0 + float(tolerance)))
+    out["verdict"] = "promote" if promote else "refuse"
+    out["reason"] = (
+        f"candidate {metric} {cand_loss:.6g} vs live {live_loss:.6g} "
+        f"(tolerance {tolerance:g})")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# smoke: train -> report -> shadow -> verify the gate refuses
+# ---------------------------------------------------------------------------
+def run_smoke() -> int:
+    """Self-contained CI smoke (multichip dryrun tail): train a tiny
+    live model WITH telemetry, render both report formats, then
+    shadow-compare a deliberately worse candidate and verify the gate
+    REFUSES it (and promotes the live model against itself)."""
+    import lightgbm_tpu as lgb
+
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(600, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    P = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+         "min_data_in_leaf": 5, "verbosity": -1,
+         "tpu_telemetry": "metrics", "metric": ["binary_logloss"]}
+    ds = lgb.Dataset(X, label=y, params=P)
+    vd = lgb.Dataset(X[:150], label=y[:150], reference=ds, params=P)
+    live = lgb.train(P, ds, num_boost_round=8, valid_sets=[vd],
+                     verbose_eval=False)
+    # worse candidate: trained on permuted labels (pure noise)
+    yb = y.copy()
+    rng.shuffle(yb)
+    dsb = lgb.Dataset(X, label=yb, params=P)
+    cand = lgb.train(P, dsb, num_boost_round=8, verbose_eval=False)
+
+    report = build_report(live)
+    md = render_markdown(report)
+    json.dumps(report)  # must be serializable
+    for want in ("## Model", "## Importance", "## Learning curves",
+                 "## Training profile"):
+        if want not in md:
+            print(f"model_report --smoke: section {want!r} missing")
+            return EXIT_ERROR
+    if "unavailable" in report["learning_curves"]:
+        print("model_report --smoke: learning curves missing despite "
+              "tpu_telemetry=metrics")
+        return EXIT_ERROR
+    if "unavailable" in report["profile"]:
+        print("model_report --smoke: profile trailer missing")
+        return EXIT_ERROR
+
+    sc = shadow_compare(live, cand, X, y)
+    if sc["verdict"] != "refuse":
+        print(f"model_report --smoke: worse candidate NOT refused: {sc}")
+        return EXIT_ERROR
+    sc_self = shadow_compare(live, live, X, y)
+    if sc_self["verdict"] != "promote" or sc_self["delta"]["max"] != 0.0:
+        print(f"model_report --smoke: self-compare broken: {sc_self}")
+        return EXIT_ERROR
+    print("model_report --smoke OK: report sections rendered, worse "
+          f"candidate refused ({sc['reason']}), self-compare promoted")
+    return EXIT_OK
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="model_report.py",
+        description="model health report + shadow promotion gate")
+    ap.add_argument("--model", help="model file for the health report")
+    ap.add_argument("--json", help="write the JSON report here")
+    ap.add_argument("--markdown", help="write the markdown report here")
+    ap.add_argument("--compare-data",
+                    help="dataset (.npz/.npy/.csv) to drift-compare "
+                         "against the model's training profile")
+    ap.add_argument("--drift-url",
+                    help="live serving GET /drift URL to embed")
+    ap.add_argument("--shadow", action="store_true",
+                    help="shadow-compare --candidate vs --live on "
+                         "--data; exit 3 = refused")
+    ap.add_argument("--live", help="live model file (shadow mode)")
+    ap.add_argument("--candidate", help="candidate model file")
+    ap.add_argument("--data", help="sample (.npz with X and optional y)")
+    ap.add_argument("--tolerance", type=float, default=0.0,
+                    help="allowed relative loss regression before "
+                         "refusing (default 0)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI self-test: train tiny model -> report -> "
+                         "shadow-compare -> exit code")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        return run_smoke()
+
+    import lightgbm_tpu as lgb
+
+    if args.shadow:
+        if not (args.live and args.candidate and args.data):
+            ap.error("--shadow needs --live, --candidate and --data")
+        try:
+            live = lgb.Booster(model_file=args.live)
+            cand = lgb.Booster(model_file=args.candidate)
+            X, y = load_data(args.data)
+        except Exception as exc:
+            print(f"model_report: cannot load shadow inputs: {exc}")
+            return EXIT_ERROR
+        sc = shadow_compare(live, cand, X, y,
+                            tolerance=float(args.tolerance))
+        print(json.dumps(sc, indent=2))
+        return EXIT_REFUSED if sc["verdict"] == "refuse" else EXIT_OK
+
+    if not args.model:
+        ap.error("need --model (or --shadow / --smoke)")
+    try:
+        booster = lgb.Booster(model_file=args.model)
+    except Exception as exc:
+        print(f"model_report: cannot load {args.model!r}: {exc}")
+        return EXIT_ERROR
+    try:
+        report = build_report(booster, compare_data=args.compare_data,
+                              drift_url=args.drift_url)
+    except Exception as exc:
+        # input errors (missing --compare-data file, malformed npz)
+        # stay inside the documented 0/2/3 exit contract
+        print(f"model_report: cannot build report: {exc}")
+        return EXIT_ERROR
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    md = render_markdown(report,
+                         title=f"Model health: {os.path.basename(args.model)}")
+    if args.markdown:
+        with open(args.markdown, "w") as f:
+            f.write(md)
+    if not args.json and not args.markdown:
+        print(md)
+    return EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main())
