@@ -465,6 +465,30 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
     finally:
         _shutil.rmtree(ck_dir, ignore_errors=True)
 
+    # ISSUE 15: OOM recovery — injected RESOURCE_EXHAUSTED at the next
+    # guarded train-step allocation -> atomic rollback -> one
+    # degradation-ladder step -> settled completion, timed end to end.
+    # Classification keys on the error SHAPE, which the injection
+    # reproduces, so the number is real on every backend
+    from lightgbm_tpu.utils import faultline as _fl
+    from lightgbm_tpu.utils import membudget as _membudget
+
+    _fl.reset()
+    t0 = time.time()
+    _fl.arm("device_alloc", action="oom", at=1)
+    bst.update()
+    host_sync(bst._driver.train_scores.scores)
+    oom_recovery_s = time.time() - t0
+    _fl.reset()
+
+    # headroom between the enforced HBM budget and the observed train
+    # peak (null on CPU like the other memory_stats-derived fields: no
+    # capacity report means no budget resolves)
+    _budget = _membudget.budget_bytes(bst._driver.config)
+    hbm_budget_headroom_bytes = (
+        None if _budget is None or train_peak_hbm_bytes is None
+        else int(_budget) - int(train_peak_hbm_bytes))
+
     # histogram-kernel throughput at the quantized vs shipping precision:
     # rows bounded so the probe stays a footnote next to the training loop
     hist_rows = min(n_rows, 262144)
@@ -547,6 +571,10 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "resume_elastic_s": round(resume_elastic_s, 2),
         "collective_timeout_recovery_s": round(
             collective_timeout_recovery_s, 2),
+        # ISSUE 15: injected mid-train OOM -> settled completion wall,
+        # and budget-vs-peak headroom (null on CPU, no budget resolves)
+        "oom_recovery_s": round(oom_recovery_s, 2),
+        "hbm_budget_headroom_bytes": hbm_budget_headroom_bytes,
         "hist_int8_rows_per_sec": round(hist_int8, 0),
         "hist_int8_rows_per_sec_min": round(hist_int8_min, 0),
         "hist_hilo_rows_per_sec": round(hist_hilo, 0),

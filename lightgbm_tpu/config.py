@@ -186,6 +186,20 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     "serving_dispatch_timeout_ms": ("float", 30000.0, ()),
     # default flush budget of the drain lifecycle (POST /drain, SIGTERM)
     "serving_drain_timeout_ms": ("float", 10000.0, ()),
+    # --- serving: memory pressure (ISSUE 15) ---
+    # serving-registry HBM budget in bytes (packed model tables +
+    # launch scratch): a load whose predicted bytes would not fit first
+    # evicts cold LRU models, then REFUSES with a structured 507
+    # (ServingMemoryExhausted) instead of warming into a device crash.
+    # 0 = inherit the training budget resolution (tpu_hbm_budget_bytes
+    # / tpu_hbm_budget_frac x device capacity; unenforced on backends
+    # that report no memory stats)
+    "serving_hbm_budget_bytes": ("int", 0, ()),
+    # sustained-pressure eviction threshold: once resident model bytes
+    # exceed this fraction of the serving budget, cold (non-current)
+    # LRU models are evicted ahead of demand so a dispatch never has
+    # to OOM first
+    "serving_hbm_pressure_frac": ("float", 0.85, ()),
     # --- serving: model & data health (ISSUE 14) ---
     # rows per predict batch the drift monitor stride-samples into its
     # accumulator (models carrying a tpu_feature_profile trailer only).
@@ -198,6 +212,38 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # (conventional PSI reading: <0.1 stable, 0.1-0.25 moderate,
     # >0.25 major shift)
     "serving_drift_psi_warn": ("float", 0.25, ()),
+    # --- memory pressure (utils/membudget.py, ISSUE 15) ---
+    # explicit device-memory budget in bytes the preflight planner and
+    # the OOM recovery ladder enforce; 0 = auto (device capacity from
+    # memory_stats()['bytes_limit'] scaled by tpu_hbm_budget_frac;
+    # no enforcement on backends that report no memory stats).  An
+    # explicit value is honored on EVERY backend, so budget behavior is
+    # testable on CPU
+    "tpu_hbm_budget_bytes": ("int", 0, ()),
+    # fraction of reported device capacity the auto budget claims
+    "tpu_hbm_budget_frac": ("float", 0.9, ()),
+    # preflight policy before iteration 0: predict peak HBM from the
+    # closed-form buffer models (binned matrix, [L, G/P, B, 3]
+    # histogram pool, stats planes, scores, packed forest, chunk
+    # scratch) and compare against the budget.
+    #   off     - no preflight
+    #   warn    - log the itemized over-budget plan and proceed
+    #   raise   - refuse with the named, itemized plan
+    #   degrade - auto-apply bitwise-invisible degradation-ladder steps
+    #             (chunk shrink -> scatter aggregation -> fine bucket
+    #             policy) until the plan fits, refusing if it never does
+    "tpu_hbm_preflight": ("str", "warn", ()),
+    # mid-train OOM recovery: a classified RESOURCE_EXHAUSTED at a
+    # guarded device site rolls the iteration back (the PR-7 atomic
+    # rollback), descends ONE deterministic, logged degradation-ladder
+    # step, and retries; every step is bitwise-invisible, so the
+    # settled run's model file is byte-identical to an undisturbed run
+    # at the settled config.  Ladder exhaustion raises a structured
+    # MemoryLadderExhausted after the final checkpoint flush +
+    # blackbox dump.  false = classified OOMs propagate immediately
+    # (multi-host process groups always propagate: a one-sided retry
+    # would desynchronize the collective streams)
+    "tpu_oom_recovery": ("bool", True, ()),
     # --- fault tolerance (utils/checkpoint.py + numeric guardrails) ---
     # atomic training checkpoints: bundle directory (empty = off).  Each
     # checkpoint holds the model string (with its bin-mapper trailer),

@@ -23,7 +23,7 @@ from .. import obs
 from ..config import Config
 from ..io.bin_mapper import BinMapper, MissingType
 from ..io.dataset import TrainingData
-from ..utils import faultline
+from ..utils import faultline, membudget
 from ..ops.predict import (PackedForest, feature_meta_dev, device_tables,
                            forest_class_scores, forest_leaf_values,
                            pack_trees, row_bucket)
@@ -264,6 +264,15 @@ class GBDT:
         # sync-path trees awaiting telemetry until the numerics guard
         # accepts the iteration (train_one_iter)
         self._note_after_guard = None
+        # OOM degradation ladder (ISSUE 15): position persists across
+        # recoveries so repeated OOMs keep descending, never loop
+        self._mem_ladder = membudget.DegradationLadder()
+        # cross-iteration learner state (feature RNG, CEGB planes) held
+        # across a ladder rebuild: set when the old learner's device
+        # buffers are dropped, cleared when a rebuild succeeds — so a
+        # FAILED rebuild followed by a further descent still restores
+        # the stream state onto the eventual replacement (bitwise)
+        self._ladder_carry = None
 
     # ------------------------------------------------------------------
     def init(self, config: Config, train_data: TrainingData) -> None:
@@ -339,6 +348,10 @@ class GBDT:
             # which is the reference's distributed behavior too (each
             # machine subsets its own data, goss.hpp Bagging override)
         self._maybe_make_train_step()
+        # HBM preflight (ISSUE 15): predict peak device bytes from the
+        # live buffers + closed-form models and enforce the budget
+        # BEFORE iteration 0 burns a compile on a doomed configuration
+        self._run_preflight()
 
     def _maybe_make_train_step(self) -> None:
         """(Re)build the fused async step when the configuration supports
@@ -540,13 +553,37 @@ class GBDT:
         (predict / continue-training / checkpoint-flush) after an
         interrupt.  tpu_guard_numerics adds a per-iteration isfinite
         check on the updated scores (warn | raise | skip; skip =
-        rollback + re-bag)."""
+        rollback + re-bag).
+
+        A classified device OOM (membudget.DeviceOutOfMemory from any
+        guarded site inside the iteration) rides the same rollback,
+        then descends one deterministic, bitwise-invisible degradation-
+        ladder step and RETRIES the iteration — ladder exhaustion
+        raises a structured MemoryLadderExhausted instead (ISSUE 15)."""
+        while True:
+            try:
+                return self._train_one_iter_guarded(grad, hess)
+            except membudget.DeviceOutOfMemory as exc:
+                # the partial iteration was already rolled back by the
+                # guarded body; recover (one ladder step) or re-raise
+                # structured
+                self._recover_from_oom(exc)
+
+    def _train_one_iter_guarded(self, grad, hess) -> bool:
         if self._stopped:
             return True
+        if self.learner is None and self._ladder_carry is not None:
+            # a ladder rebuild OOMed and the run ended exhausted;
+            # pressure may have subsided since — retry the rebuild
+            # (classified on failure, riding the same recovery loop) so
+            # continue-training stays possible after an exhaustion
+            self._rebuild_learner()
         self._note_after_guard = None
         snap = self._iter_snapshot()
         try:
-            with obs.span("train/iteration", iteration=self.iter_):
+            with obs.span("train/iteration", iteration=self.iter_), \
+                    membudget.oom_guard("train_step",
+                                        iteration=self.iter_):
                 action = faultline.fire("grow_step", iteration=self.iter_)
                 ret = self._train_one_iter_impl(grad, hess, snap)
         except BaseException:
@@ -702,6 +739,199 @@ class GBDT:
             self.learner.reset_pool()
         self._invalidate_tables()
         self._restore_extra(snap)
+
+    # -- memory-pressure recovery (membudget, ISSUE 15) ----------------
+    def _oom_recoverable(self) -> bool:
+        """May a classified OOM descend the degradation ladder here?
+        Needs a live training context and tpu_oom_recovery=true; multi-
+        process groups always propagate instead — a one-sided retry
+        would desynchronize the collective streams."""
+        if (self.config is None or self.train_data is None
+                or not bool(self.config.tpu_oom_recovery)):
+            return False
+        if self.learner is None:
+            # mid-rebuild (the ladder dropped the old learner and the
+            # replacement OOMed): the parked carry marks a live context
+            return self._ladder_carry is not None
+        return not getattr(self.learner, "_multiproc", False)
+
+    def _recover_from_oom(self, exc: "membudget.DeviceOutOfMemory",
+                          in_recovery: bool = False) -> None:
+        """One ladder descent after a rolled-back OOM iteration, or the
+        structured exhaustion error (blackbox dumped WITH the memory
+        snapshot; engine.train then flushes the final checkpoint).
+
+        `in_recovery` marks re-entry from a failed ladder REBUILD:
+        recoverability was already established for this episode, and the
+        learner reference is legitimately None mid-rebuild."""
+        from ..utils.log import Log
+
+        if not (in_recovery or self._oom_recoverable()):
+            # recovery disabled (or a multi-host group): the classified
+            # OOM propagates AS ITSELF — labeling it ladder exhaustion
+            # would send the postmortem reader chasing a ladder that
+            # was never tried
+            obs.flightrecorder.note(
+                "oom", "oom_propagated", site=exc.site,
+                recovery="off",
+                **{k: v for k, v in membudget.memory_snapshot().items()
+                   if v is not None})
+            obs.flightrecorder.dump("oom_unrecovered", exc=exc)
+            raise exc
+        step = self._mem_ladder.next_step(self.config)
+        if step is None:
+            taken = self._mem_ladder.describe()
+            obs.flightrecorder.note(
+                "oom", "ladder_exhausted", site=exc.site,
+                steps_taken=",".join(taken) or "none",
+                **{k: v for k, v in membudget.memory_snapshot().items()
+                   if v is not None})
+            err = membudget.MemoryLadderExhausted(
+                f"device out of memory at {exc.site!r} and the "
+                "degradation ladder is exhausted "
+                f"(steps taken: {taken or 'none'}); the failed "
+                "iteration was rolled back — the booster is usable and "
+                "a final checkpoint covers the last complete iteration",
+                site=exc.site, info=dict(exc.info))
+            obs.flightrecorder.dump("oom_ladder_exhausted", exc=err)
+            raise err from exc
+        name, overrides = step
+        membudget.note_ladder_step(exc.site, name, overrides)
+        Log.warning(
+            f"device OOM at {exc.site!r} (iteration {self.iter_}): "
+            f"rolled back; degradation ladder step {name!r} applies "
+            f"{overrides} — retrying (bitwise-invisible: the settled "
+            "model is byte-identical to an undisturbed run at this "
+            "config)")
+        try:
+            self.apply_memory_degradation(overrides)
+        except membudget.DeviceOutOfMemory as rebuild_exc:
+            # the learner rebuild itself OOMed on the still-full device:
+            # descend again (no new rollback needed — no iteration is in
+            # flight), so persistent pressure still ends in the
+            # structured exhaustion contract, not a mid-recovery abort
+            self._recover_from_oom(rebuild_exc, in_recovery=True)
+
+    def apply_memory_degradation(self, overrides: Dict) -> None:
+        """Apply ladder-step param overrides to the LIVE training run.
+
+        Chunk-size overrides take effect at the next launch; the
+        aggregation / bucket-policy overrides rebuild the learner (and
+        the fused step) in place — cross-iteration learner state
+        (feature-fraction RNG, CEGB used/paid planes) carries over so
+        the retry stays bitwise vs an undisturbed run at the settled
+        configuration."""
+        if not overrides:
+            return
+        self.config.update(overrides)
+        if not ({"tpu_hist_agg", "tpu_bucket_policy"} & set(overrides)):
+            return  # chunk-only: nothing compiled closes over it
+        if self.train_data is None or (self.learner is None
+                                       and self._ladder_carry is None):
+            return  # no live training context (and not mid-rebuild)
+        if self.learner is not None:
+            self._materialize()  # pending records belong to the OLD grower
+            old = self.learner
+            # carry the cross-iteration learner state out first: the
+            # feature-fraction RNG stream and the CEGB used/paid planes
+            # (cross-tree — a rebuild must not reset what earlier trees
+            # already paid for); held on self until a rebuild SUCCEEDS,
+            # so a failed rebuild + further descent still restores it
+            rng_state = None
+            if getattr(old, "_feature_rng", None) is not None:
+                rng_state = old._feature_rng.bit_generator.state
+            self._ladder_carry = (rng_state, [
+                (attr, key, getattr(old, attr, None))
+                for attr, key in (("_cegb_used", "cegb_used"),
+                                  ("_cegb_paid", "cegb_paid"))])
+            # ...then drop the old generation's device residency
+            # (histogram pool + transposed bins + the step closure
+            # holding both) BEFORE the replacement re-allocates them:
+            # this runs on a device that just OOMed, and holding two
+            # generations of the largest buffers would transiently
+            # double residency and OOM the rebuild itself
+            self._train_step = None
+            self.learner = None
+            old._pool = None
+            old._pool_spec = None
+            if hasattr(old, "bins_t"):
+                old.bins_t = None
+            del old
+        self._rebuild_learner()
+
+    def _rebuild_learner(self) -> None:
+        """(Re)construct the learner for the CURRENT config, restoring
+        the parked cross-iteration state (`_ladder_carry`).  Runs under
+        `oom_guard`: a rebuild-time allocation failure is still an OOM
+        at the train step — classified (counted + blackboxed), never a
+        raw XlaRuntimeError escaping the recovery path unnamed."""
+        with membudget.oom_guard("train_step", stage="ladder_rebuild"):
+            self.learner = TPUTreeLearner(self.config, self.train_data)
+        rng_state, cegb_vals = self._ladder_carry or (None, [])
+        self._ladder_carry = None
+        if rng_state is not None and \
+                getattr(self.learner, "_feature_rng", None) is not None:
+            self.learner._feature_rng.bit_generator.state = rng_state
+        for attr, key, val in cegb_vals:
+            if val is not None and hasattr(self.learner, attr):
+                setattr(self.learner, attr, val)
+                self.learner.meta[key] = val
+        self._invalidate_tables()
+        self._maybe_make_train_step()
+
+    def _run_preflight(self) -> None:
+        """tpu_hbm_preflight before iteration 0: itemized plan vs the
+        budget — warn, refuse with the named plan, or auto-degrade
+        down the same bitwise-invisible ladder mid-train OOMs use."""
+        from ..utils.log import LightGBMError, Log
+
+        mode = str(self.config.tpu_hbm_preflight).strip().lower()
+        if mode not in ("off", "warn", "raise", "degrade"):
+            raise ValueError("tpu_hbm_preflight must be off|warn|raise|"
+                             f"degrade, got {mode!r}")
+        if mode == "off":
+            return
+        plan = membudget.plan_training(self.config, self.learner,
+                                       self.num_tree_per_iteration)
+        membudget.publish_budget_gauge(plan.budget, "training")
+        if plan.fits is not False:
+            return  # fits, or no budget resolves (nothing to enforce)
+        if mode == "degrade":
+            pending: Dict = {}
+            while plan.fits is False:
+                step = self._mem_ladder.next_step(self.config)
+                if step is None:
+                    break
+                name, overrides = step
+                membudget.note_ladder_step("preflight", name, overrides,
+                                           recovery=False)
+                # stage config-only so one learner rebuild covers all
+                self.config.update(overrides)
+                pending.update(overrides)
+                plan = membudget.plan_training(
+                    self.config, self.learner,
+                    self.num_tree_per_iteration)
+            if plan.fits is not False:
+                Log.warning(
+                    "HBM preflight degraded the configuration to fit "
+                    f"the budget: {pending} (bitwise-invisible); "
+                    f"headroom now {plan.headroom:,d} bytes")
+                if {"tpu_hist_agg", "tpu_bucket_policy"} & set(pending):
+                    self.apply_memory_degradation(
+                        {k: pending[k] for k in
+                         ("tpu_hist_agg", "tpu_bucket_policy")
+                         if k in pending})
+                return
+        if mode == "warn":
+            Log.warning("HBM preflight: predicted peak exceeds the "
+                        "budget (tpu_hbm_preflight=warn):\n"
+                        + plan.format_table())
+            return
+        obs.flightrecorder.note("oom", "preflight_refused",
+                                total=plan.total, budget=plan.budget)
+        raise LightGBMError(plan.refuse_message(
+            "training preflight (tpu_hbm_preflight="
+            f"{mode}): this configuration"))
 
     # -- numeric guardrails (tpu_guard_numerics) -----------------------
     def _scores_finite(self) -> bool:
@@ -1376,8 +1606,10 @@ class GBDT:
             tables_dev = device_tables(tables)
             if pack_cache is not None:
                 pack_cache["packed"] = (tables_dev, depth)
-        vals = forest_leaf_values(tables_dev, bins_dev, self._meta_dev(),
-                                  depth, policy=self.bucket_policy())
+        with membudget.oom_guard("score_replay", rows=data.num_data):
+            vals = forest_leaf_values(tables_dev, bins_dev,
+                                      self._meta_dev(), depth,
+                                      policy=self.bucket_policy())
         return vals[0]
 
     def _replay_scores_device(self, state: "_ScoreState", data: TrainingData,
@@ -1406,24 +1638,27 @@ class GBDT:
         for s in range(0, len(trees), t_block):
             tables, depth = pack_trees(list(trees[s:s + t_block]))
             tables_dev = device_tables(tables)
-            if n > chunk:
-                parts = []
-                for lo in range(0, n, chunk):
-                    hi = min(lo + chunk, n)
-                    sub = bins_dev[lo:hi]
-                    if hi - lo < chunk:
-                        # pad the tail so every launch reuses ONE program
-                        sub = jnp.concatenate(
-                            [sub, jnp.zeros((chunk - (hi - lo),
-                                             sub.shape[1]), sub.dtype)])
-                    parts.append(forest_class_scores(
-                        tables_dev, sub, md, k, depth, scale,
-                        policy=self.bucket_policy())[:, :hi - lo])
-                scores = jnp.concatenate(parts, axis=1)
-            else:
-                scores = forest_class_scores(tables_dev, bins_dev, md, k,
-                                             depth, scale,
-                                             policy=self.bucket_policy())
+            with membudget.oom_guard("score_replay", rows=n,
+                                     trees=len(trees)):
+                if n > chunk:
+                    parts = []
+                    for lo in range(0, n, chunk):
+                        hi = min(lo + chunk, n)
+                        sub = bins_dev[lo:hi]
+                        if hi - lo < chunk:
+                            # pad the tail: every launch = ONE program
+                            sub = jnp.concatenate(
+                                [sub, jnp.zeros((chunk - (hi - lo),
+                                                 sub.shape[1]),
+                                                sub.dtype)])
+                        parts.append(forest_class_scores(
+                            tables_dev, sub, md, k, depth, scale,
+                            policy=self.bucket_policy())[:, :hi - lo])
+                    scores = jnp.concatenate(parts, axis=1)
+                else:
+                    scores = forest_class_scores(
+                        tables_dev, bins_dev, md, k, depth, scale,
+                        policy=self.bucket_policy())
             for kk in range(k):
                 state.add(kk, scores[kk])
         return True
@@ -1505,9 +1740,35 @@ class GBDT:
     def predict_chunk_rows(self) -> int:
         """Rows per device-predict launch (file-loaded boosters carry no
         Config; they use the registry default) — the chunk every predict
-        row bucket is computed against."""
-        return max(int(self.config.tpu_predict_chunk_rows)
-                   if self.config is not None else 65536, 1024)
+        row bucket is computed against.  A chunked-predict OOM shrinks
+        this (config param, or the local override for config-less
+        boosters) down to the membudget floor."""
+        if self.config is not None:
+            return max(int(self.config.tpu_predict_chunk_rows), 1024)
+        ov = getattr(self, "_predict_chunk_override", None)
+        return max(int(ov) if ov is not None else 65536, 1024)
+
+    def _shrink_predict_chunk(self) -> bool:
+        """Halve the predict chunk after a classified predict-path OOM;
+        False at the floor (the caller re-raises the structured error).
+        Bitwise-invisible: traversal is row-independent, so chunking
+        never changes an output byte (the PR-3/PR-6 chunk contracts)."""
+        from ..utils.log import Log
+
+        cur = self.predict_chunk_rows()
+        if cur <= membudget.CHUNK_FLOOR:
+            return False
+        new = max(cur // 2, membudget.CHUNK_FLOOR)
+        if self.config is not None:
+            self.config.update({"tpu_predict_chunk_rows": new})
+        else:
+            self._predict_chunk_override = new
+        membudget.note_ladder_step("predict_chunk", "shrink_chunk_rows",
+                                   {"tpu_predict_chunk_rows": new})
+        Log.warning(f"device OOM in chunked predict: shrinking "
+                    f"tpu_predict_chunk_rows {cur} -> {new} and "
+                    "re-running (outputs are chunk-invariant)")
+        return True
 
     def bucket_policy(self) -> str:
         """Launch-shape bucket policy (tpu_bucket_policy) — the ONE
@@ -1522,32 +1783,57 @@ class GBDT:
         """[k, n] f64 host scores from the packed device forest, chunked
         over rows: one bounded [chunk, F] int32 upload per launch, tail
         chunks padded so every launch reuses ONE compiled program.
-        `get_bins(lo, hi)` supplies host bins per chunk."""
-        chunk = self.predict_chunk_rows()
+        `get_bins(lo, hi)` supplies host bins per chunk.
+
+        A classified device OOM shrinks the predict chunk (floor 4096)
+        and resumes AT THE FAILED CHUNK — completed chunks are kept
+        (outputs are chunk-invariant, so the recovered result is
+        byte-identical and no finished device work is re-paid); at the
+        floor the structured DeviceOutOfMemory propagates to the
+        caller (the serving layer then fails the batch over to the
+        native walker)."""
         out = np.zeros((k, n), np.float64)
+        lo = 0
         with obs.resources.phase_peak("predict"):
-            for lo in range(0, max(n, 1), chunk):
+            while True:
+                # the chunk re-reads per launch: a shrink mid-predict
+                # applies from the failed chunk onward
+                chunk = self.predict_chunk_rows()
                 hi = min(lo + chunk, n)
                 rows = hi - lo
-                faultline.fire("h2d_copy", rows=rows)
-                bins = get_bins(lo, hi)
-                # pad every launch to a bucketed row count (row_bucket:
-                # full chunks for multi-chunk predicts, the policy's
-                # geometric ladder below that) so repeated predicts of
-                # varying batch sizes reuse a handful of compiled
-                # programs instead of one per distinct n
-                policy = self.bucket_policy()
-                target = (chunk if n > chunk
-                          else row_bucket(rows, chunk, policy=policy))
-                if rows < target:
-                    bins = np.concatenate(
-                        [bins, np.zeros((target - rows, bins.shape[1]),
-                                        np.int32)])
-                scores = forest_class_scores(tables, jnp.asarray(bins),
-                                             meta_dev, k, depth,
-                                             policy=policy)
-                out[:, lo:hi] = np.asarray(
-                    jax.device_get(scores), np.float64)[:, :rows]
+                try:
+                    faultline.fire("h2d_copy", rows=rows)
+                    bins = get_bins(lo, hi)
+                    # pad every launch to a bucketed row count
+                    # (row_bucket: full chunks for multi-chunk
+                    # predicts, the policy's geometric ladder below
+                    # that) so repeated predicts of varying batch
+                    # sizes reuse a handful of compiled programs
+                    # instead of one per distinct n
+                    policy = self.bucket_policy()
+                    target = (chunk if n > chunk
+                              else row_bucket(rows, chunk,
+                                              policy=policy))
+                    if rows < target:
+                        bins = np.concatenate(
+                            [bins,
+                             np.zeros((target - rows, bins.shape[1]),
+                                      np.int32)])
+                    with membudget.oom_guard("predict_chunk",
+                                             rows=rows):
+                        scores = forest_class_scores(
+                            tables, jnp.asarray(bins), meta_dev, k,
+                            depth, policy=policy)
+                        out[:, lo:hi] = np.asarray(
+                            jax.device_get(scores),
+                            np.float64)[:, :rows]
+                except membudget.DeviceOutOfMemory:
+                    if not self._shrink_predict_chunk():
+                        raise
+                    continue  # retry THIS chunk at the smaller size
+                lo = hi
+                if lo >= n:
+                    break
         return out
 
     def predict_binned_device(self, data: TrainingData,

@@ -41,6 +41,7 @@ import jax
 import jax.numpy as jnp
 
 from ..io.bin_mapper import BinMapper, BinType, MissingType, sort_keys
+from ..utils import membudget
 from ..utils.compile_ledger import ledger_jit
 
 _NAN_KEY = np.int64(np.iinfo(np.int64).max)
@@ -194,9 +195,65 @@ class DeviceBinner:
         return vhi, vlo, cv
 
     def bin_chunk(self, block: np.ndarray) -> jnp.ndarray:
-        """Bin one [rows, F] raw block; pads to the chunk shape so every
-        launch reuses ONE compiled program, slicing the pad off on
-        device."""
+        """Bin one [rows, F] raw block (guarded ingest-upload site).
+
+        A classified device OOM halves `chunk_rows` and re-bins the
+        block in smaller launches — bins are bit-identical at ANY chunk
+        size (the PR-3 chunk-boundary contract), so the recovery is
+        invisible to training; at the kernel's floor the structured
+        DeviceOutOfMemory propagates."""
+        rows = block.shape[0]
+        if rows == 0:
+            return jnp.zeros((0, block.shape[1]), self.out_dtype)
+        parts = []
+        lo = 0
+        while lo < rows:
+            sub = block[lo:lo + self.chunk_rows]
+            try:
+                with membudget.oom_guard("ingest_chunk",
+                                         rows=int(sub.shape[0])):
+                    parts.append(self._bin_chunk_once(sub))
+                lo += sub.shape[0]
+            except membudget.DeviceOutOfMemory:
+                if not self._shrink_chunk():
+                    raise
+        if len(parts) == 1:
+            return parts[0]
+        # the reassembled full block is the single largest allocation
+        # here, and a multi-part reassembly only happens right after a
+        # shrink — i.e. on a nearly-full device.  Shrinking further
+        # cannot help (the output is full-block regardless), so a
+        # failure classifies and propagates structured for the
+        # mid-train ladder above instead of escaping raw
+        with membudget.oom_guard("ingest_chunk", rows=int(rows),
+                                 stage="reassemble"):
+            return jnp.concatenate(parts, axis=0)
+
+    def _shrink_chunk(self) -> bool:
+        """Halve this binner's LOCAL chunk after a classified OOM
+        (floor 256, the kernel minimum — below the ladder's 4096 param
+        floor because the in-flight stream must finish even on a very
+        tight device); logged + counted like every ladder step.  The
+        recorded field names the binner-local width, NOT the
+        tpu_ingest_chunk_rows param — the config is untouched here
+        (the mid-train ladder owns param changes)."""
+        from ..utils.log import Log
+
+        if self.chunk_rows <= 256:
+            return False
+        new = max(self.chunk_rows // 2, 256)
+        membudget.note_ladder_step("ingest_chunk", "shrink_chunk_rows",
+                                   {"binner_chunk_rows": new})
+        Log.warning(f"device OOM in chunked ingest: shrinking the "
+                    f"binning chunk {self.chunk_rows} -> {new} and "
+                    "re-binning (bins are chunk-invariant)")
+        self.chunk_rows = new
+        return True
+
+    def _bin_chunk_once(self, block: np.ndarray) -> jnp.ndarray:
+        """One [rows, F] kernel launch, padded to the chunk shape so
+        every launch reuses ONE compiled program, slicing the pad off
+        on device."""
         rows = block.shape[0]
         pad = self.chunk_rows - rows if rows < self.chunk_rows else 0
         if pad:
@@ -235,8 +292,13 @@ class DeviceBinner:
             pend_rows += b.shape[0]
             while pend_rows >= self.chunk_rows:
                 buf = pend[0] if len(pend) == 1 else np.concatenate(pend)
-                parts.append(self.bin_chunk(buf[:self.chunk_rows]))
-                pend = [buf[self.chunk_rows:]]
+                # snapshot the slice width BEFORE the call: an OOM
+                # recovery inside bin_chunk SHRINKS self.chunk_rows,
+                # and re-reading it for the remainder slice would keep
+                # rows the call already binned (silent duplication)
+                c = self.chunk_rows
+                parts.append(self.bin_chunk(buf[:c]))
+                pend = [buf[c:]]
                 pend_rows = pend[0].shape[0]
         if pend_rows > 0 or not parts:
             if not pend:

@@ -28,7 +28,7 @@ import numpy as np
 
 from ..config import Config, parse_tristate
 from ..ops.predict import _depth_bucket, predict_row_buckets, row_bucket
-from ..utils import faultline, lockcheck
+from ..utils import faultline, lockcheck, membudget
 from ..utils.log import Log
 from .stats import CircuitBreaker, ServingStats
 
@@ -46,7 +46,6 @@ class ModelEntry:
         drv = booster._driver
         drv._materialize()
         self.num_feature = booster.num_feature()
-        self.chunk = drv.predict_chunk_rows()
         # the driver's own bucket policy governs every launch this entry
         # makes, so warmup must enumerate with the SAME ladder
         self.policy = drv.bucket_policy()
@@ -64,16 +63,38 @@ class ModelEntry:
                           and drv._pred_context() is not None
                           and booster.num_trees() > 0)
         self.hbm_bytes = 0
+        # per-launch scratch ([rows, F] bins + [k, rows] scores) this
+        # entry's largest dispatch allocates transiently — dispatches
+        # are serialized, so the budget wall reserves the MAX across
+        # entries, not the sum
+        self.scratch_bytes = 0
+        # set by the registry after construction: a dispatch-path OOM
+        # reports here so sustained pressure can evict cold models
+        # before the next dispatch OOMs too
+        self.pressure_cb = None
         if self.device_on:
-            drv._packed_forest()  # pack + upload the forest tables once
-            # what this model actually costs on device: the packed
-            # table bytes at the num_iteration a default request slices
-            # to — the capacity unit LRU eviction reports in (bytes,
-            # not model count; ROADMAP 2c's quantized tables shrink it)
-            total, _ = drv._model_subset(self.default_num_iteration())
-            self.hbm_bytes = sum(
-                int(v.nbytes)
-                for v in drv._packed_forest().device(total).values())
+            k = max(drv.num_tree_per_iteration, 1)
+            rows = min(self.max_batch_rows, self.chunk)
+            self.scratch_bytes = rows * (self.num_feature * 4 + k * 4)
+            # guarded upload (ISSUE 15): an allocation failure here is
+            # classified and named instead of crashing the load as an
+            # anonymous XlaRuntimeError — the registry retries after
+            # eviction, then refuses with 507
+            with membudget.oom_guard("registry_load", model=self.key):
+                drv._packed_forest()  # pack + upload the tables once
+                # what this model actually costs on device: the FULL
+                # packed tables — PackedForest.device() uploads and
+                # retains every tree regardless of the num_iteration a
+                # request later slices to, so an early-stopped model's
+                # resident bytes are the full pack (counting the slice
+                # would undercount residency AND diverge from the
+                # preflight plan, which prices the full host pack).
+                # This is the capacity unit LRU eviction reports in
+                # (bytes, not model count; ROADMAP 2c's quantized
+                # tables shrink it)
+                self.hbm_bytes = sum(
+                    int(v.nbytes)
+                    for v in drv._packed_forest().device().values())
         # the gauge is set by ModelRegistry.load's registration block,
         # not here: a load that fails after construction (warmup error)
         # must not leave a phantom per-model series
@@ -109,6 +130,15 @@ class ModelEntry:
             stats=stats)
 
     # ------------------------------------------------------------------
+    @property
+    def chunk(self) -> int:
+        """The driver's LIVE predict chunk — read dynamically, never
+        cached: an OOM-driven shrink (gbdt._shrink_predict_chunk) must
+        flow into this entry's launch-bucket accounting immediately, or
+        batch_fill_ratio / the shape series / the scratch reservation
+        would report the pre-shrink launches forever."""
+        return self.booster._driver.predict_chunk_rows()
+
     def default_num_iteration(self) -> int:
         """The num_iteration a None request resolves to — mirrors
         Booster.predict's best_iteration default, and is what warmup
@@ -209,10 +239,32 @@ class ModelEntry:
                     import time as _time
 
                     _time.sleep(3600.0)
-            out = self.booster.predict(X, raw_score=raw_score,
-                                       num_iteration=ni, device="tpu",
-                                       tpu_predict_device="true")
-        except Exception:
+            with membudget.oom_guard(
+                    "registry_warmup" if warmup else "serve_dispatch",
+                    model=self.key):
+                out = self.booster.predict(X, raw_score=raw_score,
+                                           num_iteration=ni,
+                                           device="tpu",
+                                           tpu_predict_device="true")
+        except Exception as exc:
+            # route through the membudget classifier FIRST: a dispatch
+            # OOM is a pressure signal (count it, let the registry
+            # evict cold models) before it is a device failure
+            if membudget.is_oom_error(exc):
+                if warmup:
+                    # warmup must NOT silently walk a model that cannot
+                    # fit: the load path (which owns its own eviction +
+                    # retry + models_refused_hbm accounting — dispatch
+                    # counters stay dispatch-only) retries or refuses
+                    # with 507 instead of admitting a model whose every
+                    # dispatch would OOM
+                    raise
+                self.stats.count("dispatch_oom")
+                if self.pressure_cb is not None:
+                    try:
+                        self.pressure_cb(self.key)
+                    except Exception:  # pragma: no cover - defensive
+                        pass
             # count a fallback only when the host walker actually
             # serves it — a data error raises identically on both paths
             # and must not inflate the device-failure signal
@@ -330,23 +382,37 @@ class ModelRegistry:
             else:
                 self._counts[name] = self._counts.get(name, 0) + 1
                 ver = str(self._counts[name])
-        entry = ModelEntry(name, ver, booster, self.config, self.stats)
-        if bool(self.config.serving_warmup):
-            # dedupe warmup compiles across models sharing a launch-shape
-            # signature (depth bucket, k, table shapes, policy, ...): the
-            # jit cache is process-wide, so a second same-shaped model's
-            # sweep would only re-execute programs that already exist
-            sig = entry.warm_signature()
-            with self._lock:
-                seen = sig is not None and sig in self._warmed
-            entry.warmup(precompiled=seen)
-            # marked warmed only AFTER the sweep succeeds: a failed (or
-            # concurrent, still-compiling) warmup must not make future
-            # same-shaped loads skip theirs and serve cold compiles
-            if sig is not None:
-                with self._lock:
-                    self._warmed.add(sig)
+        # HBM budget preflight (ISSUE 15): predicted packed-table +
+        # launch-scratch bytes BEFORE any upload.  Over budget -> evict
+        # cold models to make room; still over -> structured 507
+        # refusal instead of warming into a device crash
+        self._preflight_load(name, ver, booster)
+        entry = self._build_entry(name, ver, booster)
+        entry.pressure_cb = self._on_dispatch_oom
         with self._lock:
+            # the AUTHORITATIVE budget wall, re-checked under the lock:
+            # the pre-upload preflight read resident bytes without it,
+            # so two concurrent over-half-budget loads could both pass
+            # and jointly breach the wall — admission is serialized
+            # here, where insertion is
+            budget = self._budget()
+            if budget is not None and entry.hbm_bytes:
+                def over():
+                    return self._admission_overflow_locked(
+                        entry.key, entry.hbm_bytes,
+                        entry.scratch_bytes, budget) > 0
+                if over():
+                    self._evict_cold_locked(lambda _f, _n: not over())
+                if over():
+                    self.stats.count("models_refused_hbm")
+                    raise membudget.ServingMemoryExhausted(
+                        f"loading model {entry.key} would put "
+                        f"{self._resident_bytes_locked() + entry.hbm_bytes:,d} "
+                        "resident device bytes (plus launch scratch) "
+                        f"against the {budget:,d}-byte serving HBM "
+                        "budget (a concurrent load took the "
+                        "headroom); retry or raise the budget",
+                        site="registry_load", info={"model": name})
             self._entries[entry.key] = entry
             self._entries.move_to_end(entry.key)
             self.stats.set_model_hbm(entry.key, entry.hbm_bytes)
@@ -362,6 +428,173 @@ class ModelRegistry:
             self.stats.count("models_loaded")
             self._evict_locked()
         return entry
+
+    # -- memory pressure (ISSUE 15) ------------------------------------
+    def _budget(self) -> Optional[int]:
+        return membudget.serving_budget_bytes(self.config)
+
+    def _resident_bytes_locked(self) -> int:
+        return sum(e.hbm_bytes for e in self._entries.values())
+
+    def _admission_overflow_locked(self, key: str, new_tables: int,
+                                   new_scratch: int, budget: int) -> int:
+        """THE serving admission formula — bytes over budget (<= 0
+        fits), shared by the pre-upload preflight and the under-lock
+        registration wall so the two can never drift apart (a mismatch
+        would let an uncontended load pass preflight, burn the upload +
+        warmup, then be refused at the wall): resident tables — minus a
+        same-`key` entry about to be replaced IN PLACE, whose bytes
+        leave as the new ones land — plus the new tables, plus the MAX
+        launch scratch across entries (dispatches serialize, so scratch
+        reserves once)."""
+        resident = sum(e.hbm_bytes for e in self._entries.values()
+                       if e.key != key)
+        scratch = max([e.scratch_bytes for e in self._entries.values()
+                       if e.key != key] + [new_scratch])
+        return resident + new_tables + scratch - budget
+
+    def _preflight_load(self, name: str, ver: str, booster) -> None:
+        """Refuse (507) a load whose PREDICTED device bytes cannot fit
+        the serving budget, evicting cold models first — the planner
+        runs off the host pack, so nothing touches HBM before the
+        verdict.  Applies `_admission_overflow_locked`, the SAME
+        formula the under-lock wall re-checks at registration."""
+        budget = self._budget()
+        if budget is None:
+            return
+        membudget.publish_budget_gauge(budget, "serving")
+        plan = membudget.plan_model_load(booster, self.config)
+        if plan is None:
+            return  # no device path: nothing lands in HBM
+        tables = plan.components.get("packed_tables", 0)
+        if tables > 0:
+            key = f"{name}@{ver}"
+            new_scratch = plan.components.get("launch_scratch", 0)
+            with self._lock:
+                overflow = self._admission_overflow_locked(
+                    key, tables, new_scratch, budget)
+            if overflow > 0:
+                self.relieve_pressure(need_bytes=overflow)
+                with self._lock:
+                    overflow = self._admission_overflow_locked(
+                        key, tables, new_scratch, budget)
+            if overflow > 0:
+                self.stats.count("models_refused_hbm")
+                from ..obs import flightrecorder
+
+                with self._lock:
+                    resident = self._resident_bytes_locked()
+                flightrecorder.note("oom", "load_refused", model=name,
+                                    predicted=plan.total,
+                                    resident=resident, budget=budget)
+                raise membudget.ServingMemoryExhausted(
+                    plan.refuse_message(
+                        f"loading model {name!r} "
+                        f"({resident:,d} bytes already resident)"),
+                    site="registry_load",
+                    info={"model": name, "resident_bytes": resident})
+
+    def _build_entry(self, name: str, ver: str, booster) -> ModelEntry:
+        """Construct + warm the entry; a classified OOM during the
+        upload or warmup evicts cold models and retries ONCE, then
+        refuses with the structured 507 — an under-budget prediction
+        that still OOMs (fragmentation, co-tenants) must not crash the
+        process or silently admit a walker-only model."""
+        for attempt in (0, 1):
+            try:
+                entry = ModelEntry(name, ver, booster, self.config,
+                                   self.stats)
+                if bool(self.config.serving_warmup):
+                    # dedupe warmup compiles across models sharing a
+                    # launch-shape signature: the jit cache is process-
+                    # wide, so a second same-shaped model's sweep would
+                    # only re-execute programs that already exist
+                    sig = entry.warm_signature()
+                    with self._lock:
+                        seen = sig is not None and sig in self._warmed
+                    entry.warmup(precompiled=seen)
+                    # marked warmed only AFTER the sweep succeeds: a
+                    # failed (or concurrent, still-compiling) warmup
+                    # must not make future same-shaped loads skip
+                    # theirs and serve cold compiles
+                    if sig is not None:
+                        with self._lock:
+                            self._warmed.add(sig)
+                return entry
+            except membudget.DeviceOutOfMemory as exc:
+                freed = self.relieve_pressure()
+                if attempt == 1 or not freed:
+                    self.stats.count("models_refused_hbm")
+                    raise membudget.ServingMemoryExhausted(
+                        f"loading model {name!r} ran out of device "
+                        f"memory at {exc.site!r} and eviction could "
+                        "not free enough; refuse instead of serving a "
+                        "model whose every dispatch would OOM",
+                        site=exc.site, info=dict(exc.info)) from exc
+                Log.warning(
+                    f"device OOM at {exc.site!r} while loading "
+                    f"{name!r}: evicted {freed} cold device bytes, "
+                    "retrying the load once")
+
+    def _on_dispatch_oom(self, key: str) -> None:
+        """A dispatch-path OOM reported by an entry: sustained pressure
+        — evict a cold model so the NEXT dispatch has headroom (the
+        failing batch itself was already served by the walker)."""
+        freed = self.relieve_pressure()
+        if freed:
+            Log.warning(f"dispatch OOM on {key}: evicted {freed} cold "
+                        "device bytes under memory pressure")
+
+    def relieve_pressure(self, need_bytes: int = 0) -> int:
+        """Evict cold (non-current) LRU models until `need_bytes` are
+        freed (0 = exactly one victim); returns the bytes actually
+        freed.  Current aliases are never evicted here — shedding the
+        model a caller is actively resolving trades one failure for
+        another."""
+        with self._lock:
+            if need_bytes > 0:
+                done = lambda freed, n: freed >= need_bytes  # noqa: E731
+            else:
+                done = lambda freed, n: n >= 1               # noqa: E731
+            freed = self._evict_cold_locked(done)
+            self._publish_pressure_locked()
+        return freed
+
+    def _evict_cold_locked(self, done) -> int:
+        """Evict cold (non-current) DEVICE-BACKED LRU entries until
+        `done(freed_bytes, victims)` or none remain — the ONE eviction
+        body every pressure path shares (the per-victim bookkeeping
+        must never skew between them).  Zero-byte (walker-only) entries
+        are never pressure victims: evicting them frees no HBM, and a
+        byte-driven sweep would otherwise clear every one of them for
+        nothing (the serving_max_models count cap owns their slots)."""
+        freed = 0
+        n = 0
+        current = set(self._latest.values())
+        while not done(freed, n):
+            victim = next((k for k, e in self._entries.items()
+                           if k not in current and e.hbm_bytes > 0),
+                          None)
+            if victim is None:
+                break
+            got = int(self._entries[victim].hbm_bytes)
+            freed += got
+            n += 1
+            del self._entries[victim]
+            self.stats.count("models_evicted")
+            self.stats.count("evictions_pressure")
+            self.stats.clear_model_hbm(victim)
+            self.stats.clear_drift(victim)
+            Log.info(f"serving registry evicted {victim} under memory "
+                     f"pressure: freed {got} device bytes")
+        return freed
+
+    def _publish_pressure_locked(self) -> None:
+        total = self._resident_bytes_locked()
+        self.stats.set_total_hbm(total)
+        budget = self._budget()
+        if budget:
+            self.stats.set_hbm_pressure(total / budget)
 
     @staticmethod
     def _version_newer(current_key: Optional[str], candidate: str) -> bool:
@@ -395,8 +628,16 @@ class ModelRegistry:
             Log.info(f"serving registry evicted {victim}: freed {freed} "
                      "device bytes "
                      f"({len(self._entries)}/{cap} models resident)")
-        self.stats.set_total_hbm(sum(e.hbm_bytes
-                                     for e in self._entries.values()))
+        # sustained byte pressure (ISSUE 15): past the pressure
+        # fraction of the serving HBM budget, cold (non-current) LRU
+        # models leave ahead of demand — before a dispatch has to OOM
+        budget = self._budget()
+        if budget:
+            frac = float(self.config.serving_hbm_pressure_frac)
+            threshold = int(budget * max(min(frac, 1.0), 0.05))
+            self._evict_cold_locked(
+                lambda _f, _n: self._resident_bytes_locked() <= threshold)
+        self._publish_pressure_locked()
 
     # ------------------------------------------------------------------
     def resolve(self, name: str) -> ModelEntry:
@@ -430,8 +671,7 @@ class ModelRegistry:
                 if e.hbm_bytes:
                     Log.info(f"serving registry unloaded {e.key}: freed "
                              f"{int(e.hbm_bytes)} device bytes")
-            self.stats.set_total_hbm(sum(
-                s.hbm_bytes for s in self._entries.values()))
+            self._publish_pressure_locked()
             gone = set(victims)
             self._latest = {n: k for n, k in self._latest.items()
                             if k not in gone and n != name}

@@ -32,7 +32,9 @@ responses carry a `Retry-After` header):
 | session draining                         | ServingDraining    | 503  |
 | caller wait budget exhausted             | ServingTimeout     | 504  |
 | expired in queue (X-Deadline-Ms)         | ServingExpired     | 504  |
+| load over the serving HBM budget         | ServingMemoryExhausted | 507 |
 | device failure                           | served via failover/breaker (counted, never an error) | — |
+| dispatch OOM                             | served via walker failover + cold-model eviction (counted, never an error) | — |
 
 Drain lifecycle: `POST /drain` (or SIGTERM under `python -m
 lightgbm_tpu serve`) stops admission — new requests get 503 +
@@ -55,6 +57,7 @@ from typing import Dict, Optional
 import numpy as np
 
 from ..config import Config
+from ..utils import membudget
 from .admission import (AdmissionController, ServingDraining,
                         ServingOverloaded, resolve_priority)
 from .batcher import (MicroBatcher, ServingExpired, ServingQueueFull,
@@ -117,7 +120,22 @@ class ServingSession:
         from ..obs import resources
 
         out.update(resources.process_runtime_stats())
+        out.update(self.memory_pressure())
         return out
+
+    def memory_pressure(self) -> Dict:
+        """Serving HBM budget/pressure snapshot (ISSUE 15): explicit
+        None where no budget resolves — `/stats` and `/healthz` both
+        carry it, and `lgbm_serving_hbm_pressure` is the gauge twin."""
+        budget = membudget.serving_budget_bytes(self.config)
+        resident = sum(int(e.hbm_bytes)
+                       for e in self.registry.entries())
+        return {
+            "hbm_budget_bytes": budget,
+            "hbm_models_bytes": resident,
+            "hbm_pressure": (round(resident / budget, 4)
+                             if budget else None),
+        }
 
     def blackbox(self) -> Dict:
         """The live flight-recorder ring (GET /debug/blackbox): what
@@ -351,7 +369,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # rotation before the flush finishes
                 self._json(503, {"ok": False, "draining": True})
             else:
-                self._json(200, {"ok": True})
+                # budget/pressure ride the health probe: a fleet
+                # scheduler can route new model loads away from a
+                # replica already near its HBM budget
+                self._json(200, {"ok": True,
+                                 **session.memory_pressure()})
         else:
             self._json(404, {"error": f"no route {self.path}"})
 
@@ -416,6 +438,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(504, exc, "deadline")
         except ServingTimeout as exc:
             self._error(504, exc, "timeout")
+        except membudget.ServingMemoryExhausted as exc:
+            # 507 Insufficient Storage: the load's predicted bytes do
+            # not fit the serving HBM budget (itemized plan in body)
+            self._error(507, exc, "memory")
         except KeyError as exc:
             self._json(404, {"error": str(exc.args[0]) if exc.args
                              else str(exc)})
@@ -428,6 +454,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # data errors (feature-count mismatch, ...) are the
                 # CALLER's fault, not a server fault
                 self._json(400, {"error": str(exc)})
+            elif membudget.is_oom_error(exc):
+                # a classified device OOM that escaped the failover
+                # layers is still a memory verdict, not an anonymous
+                # 500 — keep the 507 contract
+                self._error(507, exc, "memory")
             else:  # pragma: no cover - defensive
                 self._json(500, {"error": f"{type(exc).__name__}: {exc}"})
 

@@ -43,6 +43,15 @@ _COUNTERS = (
     # model/data health (ISSUE 14): drift_warnings = PSI warn-threshold
     # crossings recorded by the per-model DriftMonitor
     "drift_warnings",
+    # memory pressure (ISSUE 15):
+    # dispatch_oom        = classified device OOMs on the dispatch path
+    #                       (served via walker failover, breaker fed)
+    # models_refused_hbm  = loads refused by the serving HBM budget
+    #                       (the HTTP 507 surface)
+    # evictions_pressure  = cold models evicted by byte pressure or an
+    #                       OOM-triggered relieve (subset of
+    #                       models_evicted)
+    "dispatch_oom", "models_refused_hbm", "evictions_pressure",
 )
 
 # serving latency buckets: sub-ms device hits through multi-second
@@ -337,6 +346,15 @@ class ServingStats:
                                 int(nbytes),
                                 help="packed device-table bytes across "
                                      "all resident models")
+
+    def set_hbm_pressure(self, ratio: float) -> None:
+        """Resident-model bytes / serving HBM budget (only published
+        when a budget resolves — no fictional 0 on budget-less runs)."""
+        self.registry.set_gauge("lgbm_serving_hbm_pressure",
+                                float(ratio),
+                                help="resident model bytes as a "
+                                     "fraction of the serving HBM "
+                                     "budget")
 
     def snapshot_queue_depth(self) -> int:
         """Cheap queue-depth read for the per-request admission gate

@@ -22,6 +22,13 @@ how to apply:
   collective watchdog (`parallel/collective.py`) turns it into a
   deterministic `CollectiveTimeout` so hung-peer degradation paths are
   testable without an actually-hung process.
+* ``oom``      — raises a realistic ``RESOURCE_EXHAUSTED``-shaped
+  device error (jaxlib's own runtime-error type when available) so the
+  `utils/membudget.py` OOM classifier and recovery ladder are
+  exercised through exactly the path a real HBM exhaustion takes.
+  The ``device_alloc`` point fires inside `membudget.oom_guard` at
+  every guarded device site (train step, ingest chunk, chunked
+  predict, score replay, registry load/warmup, serving dispatch).
 
 Points are process-global and thread-safe; `reset()` disarms
 everything.  Hit counters count every `fire` since the last reset, so
@@ -54,13 +61,33 @@ import threading
 from typing import Dict, List, Optional
 
 POINTS = ("grow_step", "h2d_copy", "checkpoint_write", "serve_dispatch",
-          "collective_sync", "binning_allgather", "host_drop")
+          "collective_sync", "binning_allgather", "host_drop",
+          "device_alloc")
 
-_ACTIONS = ("raise", "poison", "truncate", "hang")
+_ACTIONS = ("raise", "poison", "truncate", "hang", "oom")
 
 
 class FaultInjected(RuntimeError):
     """The default exception an armed ``raise`` point throws."""
+
+
+def resource_exhausted_error(point: str, **info) -> BaseException:
+    """A realistic RESOURCE_EXHAUSTED-shaped device error — what the
+    ``oom`` action raises.  Built from jaxlib's own runtime-error type
+    when available so `membudget.is_oom_error` classifies the injected
+    error through EXACTLY the path a real HBM exhaustion takes; the
+    fallback class carries the same name and message shape."""
+    detail = ", ".join(f"{k}={v}" for k, v in info.items())
+    msg = ("RESOURCE_EXHAUSTED: Out of memory while trying to allocate "
+           "2147483648 bytes (injected by faultline "
+           f"{point!r}{': ' + detail if detail else ''})")
+    try:
+        from jax._src.lib import xla_client
+
+        return xla_client.XlaRuntimeError(msg)
+    except Exception:  # pragma: no cover - jaxlib layout drift
+        err_cls = type("XlaRuntimeError", (RuntimeError,), {})
+        return err_cls(msg)
 
 
 class _Spec:
@@ -231,6 +258,10 @@ def fire(point: str, **info) -> Optional[str]:
             exc.args = (f"{exc.args[0] if exc.args else point} "
                         f"({', '.join(f'{k}={v}' for k, v in info.items())})",)
         raise exc
+    if matched.action == "oom":
+        # a realistic RESOURCE_EXHAUSTED so the membudget classifier —
+        # not a test-only code path — turns it into DeviceOutOfMemory
+        raise resource_exhausted_error(point, **info)
     return matched.action
 
 

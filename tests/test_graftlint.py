@@ -296,6 +296,113 @@ class TestJitRules:
 
 
 # ---------------------------------------------------------------------------
+# 2b-ii. J205: OOM classification on device-dispatch paths (ISSUE 15)
+# ---------------------------------------------------------------------------
+class TestOOMClassifierRule:
+    def test_broad_except_on_dispatch_path_fires(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/serving/bad.py": """
+            def run(model, X):
+                try:
+                    return model.predict(X)
+                except Exception:
+                    return None
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "J205"]
+        assert len(fs) == 1 and "membudget" in fs[0].message
+
+    def test_bare_except_and_xla_runtime_error_fire(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/bad2.py": """
+            from jaxlib.xla_extension import XlaRuntimeError
+            def a(kernel, bins):
+                try:
+                    return kernel.block_until_ready()
+                except:
+                    return None
+            def b(tables, bins, meta):
+                try:
+                    return forest_class_scores(tables, bins, meta)
+                except XlaRuntimeError:
+                    return None
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "J205"]
+        assert len(fs) == 2
+
+    def test_tuple_handler_message_names_every_type(self, tmp_path):
+        """A tuple handler is flagged AND its message names the caught
+        types — dotted_name on the raw ast.Tuple would render ''."""
+        root = _tree(tmp_path, {"lightgbm_tpu/serving/bad3.py": """
+            def run(model, X):
+                try:
+                    return model.predict(X)
+                except (RuntimeError, ValueError):
+                    return None
+        """})
+        fs = [f for f in run(["lightgbm_tpu"], root) if f.rule == "J205"]
+        assert len(fs) == 1
+        assert "RuntimeError" in fs[0].message
+        assert "ValueError" in fs[0].message
+
+    def test_classifier_routed_handler_clean(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/serving/good.py": """
+            from ..utils import membudget
+            def run(model, X, stats):
+                try:
+                    return model.predict(X)
+                except Exception as exc:
+                    if membudget.is_oom_error(exc):
+                        stats.count("dispatch_oom")
+                    return None
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "J205"] == []
+
+    def test_bare_reraise_handler_clean(self, tmp_path):
+        """A rollback-and-reraise handler passes the classified error
+        upward unswallowed — the gbdt.train_one_iter shape."""
+        root = _tree(tmp_path, {"lightgbm_tpu/models/good2.py": """
+            def run(model, X, snap):
+                try:
+                    return model.predict(X)
+                except BaseException:
+                    restore(snap)
+                    raise
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "J205"] == []
+
+    def test_specific_handlers_outside_the_rule(self, tmp_path):
+        """ValueError/KeyError cannot catch an OOM; and broad handlers
+        on NON-dispatch paths are someone else's problem."""
+        root = _tree(tmp_path, {"lightgbm_tpu/serving/good3.py": """
+            def run(model, X):
+                try:
+                    return model.predict(X)
+                except (ValueError, KeyError):
+                    return None
+            def host_only(path):
+                try:
+                    return open(path).read()
+                except Exception:
+                    return None
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "J205"] == []
+
+    def test_outside_dispatch_modules_not_scoped(self, tmp_path):
+        """utils/ and parallel/ are outside the rule's scope — the
+        dispatch surface is ops/models/serving."""
+        root = _tree(tmp_path, {"lightgbm_tpu/utils/helper.py": """
+            def run(model, X):
+                try:
+                    return model.predict(X)
+                except Exception:
+                    return None
+        """})
+        assert [f for f in run(["lightgbm_tpu"], root)
+                if f.rule == "J205"] == []
+
+
+# ---------------------------------------------------------------------------
 # 2c. concurrency family
 # ---------------------------------------------------------------------------
 class TestConcurrencyRules:

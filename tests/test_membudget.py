@@ -1,0 +1,961 @@
+"""Memory pressure (ISSUE 15): the HBM budget planner, OOM
+classification + recovery ladder, and pressure-aware serving eviction.
+
+Five layers of proof:
+
+1. **classification** — RESOURCE_EXHAUSTED-shaped errors (including the
+   faultline ``oom`` action's realistic injection) classify into
+   `DeviceOutOfMemory` naming the guarded site; non-OOM errors never
+   do.
+2. **planner math** — the preflight plan's pool/bins components equal
+   the LIVE learner buffers byte-for-byte, the serving plan equals the
+   actually-uploaded packed-table bytes, and the CompileLedger's
+   independent ``memory_analysis()`` oracle is covered by the plan.
+3. **recovery** — an injected mid-train OOM at EVERY guarded site rolls
+   back, descends the deterministic ladder, and completes with a model
+   BYTE-IDENTICAL to an undisturbed run (serial + int8 2-shard); ladder
+   exhaustion leaves a valid final checkpoint, a usable booster, and a
+   blackbox dump naming the site.
+4. **serving pressure** — over-budget loads refuse with the structured
+   507 instead of warming into a crash, sustained pressure evicts cold
+   LRU versions, and a dispatch-path OOM is served via walker failover
+   with zero errors to accepted requests.
+5. **surfaces** — /stats, /healthz and /metrics carry the budget and
+   pressure numbers; bench_diff knows the new fields' directions.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.obs import REGISTRY, flightrecorder
+from lightgbm_tpu.serving import ServingSession
+from lightgbm_tpu.serving.server import serve_http
+from lightgbm_tpu.utils import faultline, membudget
+from lightgbm_tpu.utils.checkpoint import CheckpointManager
+from lightgbm_tpu.utils.log import LightGBMError
+
+BASE = {"objective": "binary", "num_leaves": 7, "max_bin": 15,
+        "min_data_in_leaf": 5, "verbosity": -1}
+
+
+def make_xy(n=800, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def model_str(bst):
+    return bst.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+
+
+def train(params, X, y, rounds=3, **kw):
+    ds = lgb.Dataset(X, label=y, params=params)
+    return lgb.train(params, ds, num_boost_round=rounds,
+                     keep_training_booster=True, verbose_eval=False,
+                     **kw)
+
+
+def counter(metric, **labels):
+    return float(REGISTRY.value(metric, **labels))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+# ---------------------------------------------------------------------------
+# 1. classification
+# ---------------------------------------------------------------------------
+class TestClassifier:
+    def test_resource_exhausted_shapes_classify(self):
+        for msg in (
+                "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                "allocate 17179869184 bytes.",
+                "Resource exhausted: Failed to allocate request for "
+                "2.0GiB",
+                "Execution failed: OOM when allocating tensor",
+                "Out of memory allocating 123 bytes"):
+            assert membudget.is_oom_error(RuntimeError(msg)), msg
+        assert membudget.is_oom_error(MemoryError())
+
+    def test_non_oom_never_classifies(self):
+        assert not membudget.is_oom_error(ValueError("RESOURCE_EXHAUSTED"))
+        assert not membudget.is_oom_error(RuntimeError("shape mismatch"))
+        assert not membudget.is_oom_error(KeyError("x"))
+        # a generic injected fault is NOT an OOM — only the oom action
+        assert not membudget.is_oom_error(
+            faultline.FaultInjected("RESOURCE_EXHAUSTED lookalike"))
+        # the bare acronym matches only as an UPPER-CASE whole word: a
+        # substring/case-folded match would misclassify ordinary words
+        for msg in ("no room left in the queue", "zoom level invalid",
+                    "boom: handler crashed", "the bathroom is closed"):
+            assert not membudget.is_oom_error(RuntimeError(msg)), msg
+
+    def test_faultline_oom_action_is_realistic(self):
+        faultline.arm("device_alloc", action="oom")
+        with pytest.raises(Exception) as ei:
+            faultline.fire("device_alloc", site="test")
+        exc = ei.value
+        assert not isinstance(exc, faultline.FaultInjected)
+        assert "RESOURCE_EXHAUSTED" in str(exc)
+        assert membudget.is_oom_error(exc)
+
+    def test_oom_guard_classifies_and_names_site(self):
+        before = counter("lgbm_oom_events_total", site="predict_chunk")
+        with pytest.raises(membudget.DeviceOutOfMemory) as ei:
+            with membudget.oom_guard("predict_chunk", rows=7):
+                raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+        assert ei.value.site == "predict_chunk"
+        assert counter("lgbm_oom_events_total",
+                       site="predict_chunk") == before + 1
+        # and the flight-recorder ring names the site
+        ent = [e for e in flightrecorder.entries()
+               if e["kind"] == "oom" and e["name"] == "device_oom"]
+        assert ent and ent[-1]["fields"]["site"] == "predict_chunk"
+
+    def test_oom_guard_passes_other_errors_through(self):
+        with pytest.raises(ValueError):
+            with membudget.oom_guard("train_step"):
+                raise ValueError("not a memory problem")
+
+    def test_inner_site_name_wins_through_nested_guards(self):
+        with pytest.raises(membudget.DeviceOutOfMemory) as ei:
+            with membudget.oom_guard("train_step"):
+                with membudget.oom_guard("score_replay"):
+                    raise RuntimeError("RESOURCE_EXHAUSTED: oom")
+        assert ei.value.site == "score_replay"
+
+
+# ---------------------------------------------------------------------------
+# 2. budget resolution + planner math
+# ---------------------------------------------------------------------------
+class TestBudget:
+    def test_explicit_bytes_honored_on_any_backend(self):
+        from lightgbm_tpu.config import Config
+
+        cfg = Config({"tpu_hbm_budget_bytes": 12345})
+        assert membudget.budget_bytes(cfg) == 12345
+
+    def test_auto_budget_scales_capacity(self, monkeypatch):
+        from lightgbm_tpu.config import Config
+
+        monkeypatch.setattr(membudget, "device_capacity_bytes",
+                            lambda: 1000)
+        cfg = Config({"tpu_hbm_budget_frac": 0.5})
+        assert membudget.budget_bytes(cfg) == 500
+
+    def test_no_budget_on_nonreporting_backend(self):
+        from lightgbm_tpu.config import Config
+
+        # CPU reports no memory_stats: nothing resolves, None not 0
+        assert membudget.budget_bytes(Config({})) is None
+
+    def test_serving_budget_falls_back_to_training(self):
+        from lightgbm_tpu.config import Config
+
+        cfg = Config({"tpu_hbm_budget_bytes": 777})
+        assert membudget.serving_budget_bytes(cfg) == 777
+        cfg2 = Config({"tpu_hbm_budget_bytes": 777,
+                       "serving_hbm_budget_bytes": 55})
+        assert membudget.serving_budget_bytes(cfg2) == 55
+
+    def test_device_capacity_memoized_once(self, monkeypatch):
+        """Capacity is static per process: the devices are queried ONCE
+        and the answer memoized — /healthz probes and locked eviction
+        paths must not pay device round-trips to re-derive a constant.
+        An unknown answer (backend not up yet) is never pinned."""
+        import lightgbm_tpu.obs.resources as resources
+
+        calls = []
+
+        def stats():
+            calls.append(1)
+            return [{"bytes_limit": 1000}]
+
+        monkeypatch.setattr(membudget, "_capacity_memo", [])
+        monkeypatch.setattr(resources, "_devices", lambda: ["d0"])
+        monkeypatch.setattr(resources, "all_device_memory_stats", stats)
+        assert membudget.device_capacity_bytes() == 1000
+        assert membudget.device_capacity_bytes() == 1000
+        assert len(calls) == 1
+        # no devices yet -> None returned but NOT cached; the first
+        # post-init call still resolves the real capacity
+        monkeypatch.setattr(membudget, "_capacity_memo", [])
+        monkeypatch.setattr(resources, "_devices", lambda: [])
+        assert membudget.device_capacity_bytes() is None
+        monkeypatch.setattr(resources, "_devices", lambda: ["d0"])
+        assert membudget.device_capacity_bytes() == 1000
+
+
+class TestPlanner:
+    @pytest.fixture(scope="class")
+    def trained(self):
+        X, y = make_xy()
+        bst = train(dict(BASE), X, y, rounds=2)
+        return bst, X, y
+
+    def test_pool_and_bins_components_exact(self, trained):
+        bst, _, _ = trained
+        drv = bst._driver
+        plan = membudget.plan_training(drv.config, drv.learner,
+                                       drv.num_tree_per_iteration)
+        assert plan.components["histogram_pool"] == \
+            int(drv.learner._pool.nbytes)
+        assert plan.components["binned_matrix"] == \
+            int(drv.learner.bins_t.nbytes)
+        # every named component is a positive itemized number
+        for name in ("stats_planes", "score_buffers", "packed_forest",
+                     "ingest_scratch", "predict_scratch"):
+            assert plan.components[name] > 0, name
+
+    def test_plan_fits_semantics_and_table(self, trained):
+        bst, _, _ = trained
+        drv = bst._driver
+        plan = membudget.plan_training(drv.config, drv.learner, 1)
+        assert plan.fits is None          # no budget on CPU
+        from lightgbm_tpu.config import Config
+
+        cfg = Config({**BASE, "tpu_hbm_budget_bytes": 10})
+        tight = membudget.plan_training(cfg, drv.learner, 1)
+        assert tight.fits is False and tight.headroom < 0
+        msg = tight.refuse_message("test")
+        assert "histogram_pool" in msg and "budget" in msg
+
+    def test_plan_vs_ledger_memory_analysis_oracle(self):
+        """The independent oracle: the CompileLedger's captured
+        memory_analysis (forced on CPU) for the grow program must be
+        COVERED by the plan — the plan itemizes every argument buffer
+        XLA counts, plus consumers outside any one program."""
+        from lightgbm_tpu.utils.compile_ledger import LEDGER
+
+        # a UNIQUE shape: the memoized grower + jit cache would satisfy
+        # an already-seen shape without compiling (= nothing captured)
+        X, y = make_xy(n=900, f=7, seed=3)
+        LEDGER.enable()
+        LEDGER.enable_capture()
+        LEDGER.reset()
+        try:
+            bst = train(dict(BASE), X, y, rounds=2)
+            drv = bst._driver
+            plan = membudget.plan_training(drv.config, drv.learner,
+                                           drv.num_tree_per_iteration)
+            check = membudget.ledger_cross_check(plan, site="grow")
+            assert check is not None, "no analyzed grow program captured"
+            assert check["ledger_argument_bytes"] > 0
+            assert check["covered"], check
+        finally:
+            LEDGER.enable_capture(False)
+            LEDGER.enable(False)
+            LEDGER.reset()
+
+    def test_serving_plan_matches_uploaded_bytes(self, trained):
+        bst, _, _ = trained
+        from lightgbm_tpu.config import Config
+
+        cfg = Config({"verbosity": -1})
+        plan = membudget.plan_model_load(bst, cfg)
+        assert plan is not None
+        sess = ServingSession(params={"verbosity": -1})
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            assert plan.components["packed_tables"] == entry.hbm_bytes
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. preflight
+# ---------------------------------------------------------------------------
+class TestPreflight:
+    def test_raise_refuses_with_itemized_plan(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_hbm_budget_bytes=100, tpu_hbm_preflight="raise")
+        with pytest.raises(LightGBMError) as ei:
+            train(p, X, y, rounds=1)
+        msg = str(ei.value)
+        assert "histogram_pool" in msg and "headroom" in msg
+
+    def test_warn_proceeds(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_hbm_budget_bytes=100, tpu_hbm_preflight="warn")
+        before = counter("lgbm_log_warnings_total")
+        bst = train(p, X, y, rounds=1)
+        assert bst.current_iteration() == 1
+        assert counter("lgbm_log_warnings_total") > before
+
+    def test_degrade_fits_and_stays_bitwise(self):
+        X, y = make_xy()
+        ref = model_str(train(dict(BASE), X, y, rounds=3))
+        drv = train(dict(BASE), X, y, rounds=1)._driver
+        full = membudget.plan_training(drv.config, drv.learner,
+                                       drv.num_tree_per_iteration).total
+        p = dict(BASE, tpu_hbm_budget_bytes=full - 1000,
+                 tpu_hbm_preflight="degrade")
+        before = counter("lgbm_oom_ladder_steps_total",
+                         step="shrink_chunk_rows")
+        bst = train(p, X, y, rounds=3)
+        assert model_str(bst) == ref
+        assert counter("lgbm_oom_ladder_steps_total",
+                       step="shrink_chunk_rows") > before
+        # the settled config is visible on the driver
+        assert int(bst._driver.config.tpu_ingest_chunk_rows) < 65536
+
+    def test_degrade_exhausted_refuses(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_hbm_budget_bytes=50,
+                 tpu_hbm_preflight="degrade")
+        with pytest.raises(LightGBMError):
+            train(p, X, y, rounds=1)
+
+    def test_invalid_mode_rejected_at_init(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_hbm_preflight="definitely")
+        with pytest.raises(ValueError):
+            train(p, X, y, rounds=1)
+
+    def test_budget_gauge_published(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_hbm_budget_bytes=10 ** 9)
+        train(p, X, y, rounds=1)
+        assert counter("lgbm_hbm_budget_bytes", scope="training") \
+            == 10 ** 9
+
+
+# ---------------------------------------------------------------------------
+# 4. mid-train recovery + the ladder
+# ---------------------------------------------------------------------------
+class TestMidTrainRecovery:
+    def test_injected_oom_recovers_bitwise(self):
+        X, y = make_xy()
+        ref = model_str(train(dict(BASE), X, y, rounds=4))
+        p = dict(BASE)
+        ds = lgb.Dataset(X, label=y, params=p)
+        bst = Booster(params=p, train_set=ds)
+        before = counter("lgbm_oom_recoveries_total", site="train_step")
+        for it in range(4):
+            if it == 2:
+                faultline.arm("device_alloc", action="oom", at=1)
+            bst.update()
+        assert model_str(bst) == ref
+        assert counter("lgbm_oom_recoveries_total",
+                       site="train_step") == before + 1
+        steps = bst._driver._mem_ladder.describe()
+        assert steps == ["shrink_chunk_rows"]
+        # flight recorder carries the ladder transition
+        ent = [e for e in flightrecorder.entries()
+               if e["kind"] == "oom" and e["name"] == "ladder_step"]
+        assert ent and ent[-1]["fields"]["site"] == "train_step"
+
+    def test_repeated_oom_descends_deterministically(self):
+        X, y = make_xy()
+        ref = model_str(train(dict(BASE), X, y, rounds=3))
+        p = dict(BASE)
+        bst = Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+        bst.update()
+        faultline.arm("device_alloc", action="oom", times=5)
+        bst.update()        # 5 consecutive OOMs -> 5 ladder steps
+        bst.update()
+        assert model_str(bst) == ref
+        steps = bst._driver._mem_ladder.describe()
+        # deterministic order: chunk halvings to the floor, then the
+        # fine bucket policy (no data axis -> no scatter step here)
+        assert steps == ["shrink_chunk_rows"] * 4 + ["bucket_policy_fine"]
+        assert int(bst._driver.config.tpu_predict_chunk_rows) == \
+            membudget.CHUNK_FLOOR
+        assert str(bst._driver.config.tpu_bucket_policy) == "fine"
+
+    def test_recovery_disabled_propagates_structured(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_oom_recovery=False)
+        bst = Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+        bst.update()
+        faultline.arm("device_alloc", action="oom", at=1)
+        with pytest.raises(membudget.DeviceOutOfMemory) as ei:
+            bst.update()
+        # propagates AS the classified error, NOT as exhaustion: the
+        # ladder was never tried and must not be blamed
+        assert not isinstance(ei.value, membudget.MemoryLadderExhausted)
+        assert ei.value.site == "train_step"
+        # the rollback left the booster usable
+        assert bst.current_iteration() == 1
+        assert np.isfinite(bst.predict(X[:8], raw_score=True)).all()
+
+    def test_ladder_rebuild_oom_is_classified(self):
+        """An allocation failure during the ladder's learner REBUILD
+        (agg/policy steps re-create the pool + transposed bins) is
+        classified and named like any other train-step OOM — a raw
+        XlaRuntimeError escaping the recovery path unnamed would be
+        exactly the pre-ISSUE-15 failure the ladder exists to prevent."""
+        X, y = make_xy()
+        p = dict(BASE)
+        bst = Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+        bst.update()
+        faultline.arm("device_alloc", action="oom", at=1)
+        with pytest.raises(membudget.DeviceOutOfMemory) as ei:
+            bst._driver.apply_memory_degradation(
+                {"tpu_bucket_policy": "fine"})
+        assert ei.value.site == "train_step"
+
+    def test_exhaustion_checkpoint_booster_and_blackbox(self, tmp_path):
+        X, y = make_xy()
+        flightrecorder.configure(dump_dir=str(tmp_path))
+        try:
+            p = dict(BASE, tpu_checkpoint_dir=str(tmp_path / "ck"))
+            ds = lgb.Dataset(X, label=y, params=p)
+            faultline.arm("device_alloc", action="oom", at=3, times=10 ** 6)
+            with pytest.raises(membudget.MemoryLadderExhausted):
+                lgb.train(p, ds, num_boost_round=6, verbose_eval=False)
+            faultline.reset()
+            # a valid final checkpoint covers the last COMPLETE iteration
+            found = CheckpointManager(str(tmp_path / "ck")).load_latest()
+            assert found is not None and found[0] >= 1
+            # the blackbox dump names the failing site (the exhaustion
+            # dump lands first; engine.train's post-checkpoint dump
+            # overwrites the reason but keeps the same oom ring)
+            dump = json.load(open(tmp_path / "blackbox-host0.json"))
+            assert dump["reason"] in (
+                "oom_ladder_exhausted",
+                "train_interrupt:MemoryLadderExhausted")
+            oom = [e for e in dump["entries"] if e["kind"] == "oom"]
+            assert any(e["fields"].get("site") == "train_step"
+                       for e in oom if e.get("fields"))
+            assert any(e["name"] == "ladder_exhausted" for e in oom)
+            # resume trains on from the flushed checkpoint
+            bst = lgb.train(p, lgb.Dataset(X, label=y, params=p),
+                            num_boost_round=6, resume=True,
+                            verbose_eval=False,
+                            keep_training_booster=True)
+            assert bst.current_iteration() == 6
+        finally:
+            flightrecorder.configure(dump_dir="")
+
+    def test_continue_training_after_rebuild_oom_exhaustion(self):
+        """A rebuild-OOM exhaustion parks the learner reference; a
+        later update() retries the rebuild — once pressure subsides,
+        continue-training works instead of dying on an unstructured
+        AttributeError, and the carried RNG state is restored."""
+        X, y = make_xy()
+        p = dict(BASE)
+        bst = Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+        bst.update()
+        faultline.arm("device_alloc", action="oom", at=1, times=10 ** 6)
+        with pytest.raises(membudget.MemoryLadderExhausted):
+            bst.update()
+        faultline.reset()
+        # the ladder's final rebuild OOMed: the learner is parked
+        assert bst._driver.learner is None
+        assert bst._driver._ladder_carry is not None
+        bst.update()   # pressure subsided: lazy rebuild + train on
+        assert bst.current_iteration() == 2
+        assert bst._driver.learner is not None
+        assert np.isfinite(bst.predict(X[:8], raw_score=True)).all()
+
+    @pytest.mark.slow
+    def test_int8_2shard_recovery_bitwise(self):
+        X, y = make_xy(n=1200, f=8, seed=11)
+        p = dict(BASE, tpu_hist_precision="int8", tree_learner="data",
+                 num_machines=2, num_leaves=13, max_bin=31,
+                 tpu_quant_refit_leaves=False, tpu_hist_agg="psum")
+        ref = model_str(train(dict(p), X, y, rounds=5))
+        bst = Booster(params=dict(p),
+                      train_set=lgb.Dataset(X, label=y, params=dict(p)))
+        for it in range(5):
+            if it == 2:
+                # push past the chunk floor INTO the scatter switch:
+                # 4 halvings + hist_agg_scatter + one clean retry
+                faultline.arm("device_alloc", action="oom", times=5)
+            bst.update()
+        faultline.reset()
+        assert model_str(bst) == ref
+        steps = bst._driver._mem_ladder.describe()
+        assert "hist_agg_scatter" in steps
+        assert bst._driver.learner.hist_agg == "scatter"
+
+
+# ---------------------------------------------------------------------------
+# 5. the other guarded sites
+# ---------------------------------------------------------------------------
+class TestChunkSites:
+    def test_ingest_oom_recovers_bitwise(self):
+        X, y = make_xy(n=1000)
+        ref = lgb.Dataset(X, label=y, params=dict(BASE))
+        ref.construct()
+        p = dict(BASE, tpu_ingest_device="true", tpu_ingest_min_rows=1,
+                 tpu_ingest_chunk_rows=2048)
+        faultline.arm("device_alloc", action="oom", at=1)
+        dev = lgb.Dataset(X, label=y, params=p)
+        dev.construct()
+        assert np.array_equal(np.asarray(ref._inner.bins),
+                              np.asarray(dev._inner.bins))
+
+    def test_ingest_oom_multichunk_no_row_duplication(self):
+        """Regression: a chunk shrink on chunk i must not re-slice the
+        stream with the NEW chunk size — rows the shrunk call already
+        binned would re-enter the pending buffer and the dataset would
+        silently grow (reproduced: 6024 rows from a 5000-row matrix)."""
+        X, y = make_xy(n=5000, f=4, seed=7)
+        ref = lgb.Dataset(X, label=y, params=dict(BASE))
+        ref.construct()
+        p = dict(BASE, tpu_ingest_device="true", tpu_ingest_min_rows=1,
+                 tpu_ingest_chunk_rows=2048)
+        faultline.arm("device_alloc", action="oom", at=1)
+        dev = lgb.Dataset(X, label=y, params=p)
+        dev.construct()
+        assert np.asarray(dev._inner.bins).shape[0] == 5000
+        assert np.array_equal(np.asarray(ref._inner.bins),
+                              np.asarray(dev._inner.bins))
+
+    def test_ingest_reassemble_oom_is_classified(self):
+        """The multi-part reassembly concatenate — the single largest
+        ingest allocation, reached exactly when a shrink just proved
+        the device nearly full — classifies instead of escaping raw.
+        Fire 1 = first launch (OOM -> shrink), 2-3 = halved launches,
+        4 = the reassemble guard."""
+        X, y = make_xy(n=4000, f=4, seed=9)
+        p = dict(BASE, tpu_ingest_device="true", tpu_ingest_min_rows=1,
+                 tpu_ingest_chunk_rows=4096)
+        faultline.arm("device_alloc", action="oom", at=1)
+        faultline.arm("device_alloc", action="oom", at=4)
+        ds = lgb.Dataset(X, label=y, params=p)
+        with pytest.raises(membudget.DeviceOutOfMemory) as ei:
+            ds.construct()
+        assert ei.value.site == "ingest_chunk"
+        assert ei.value.info.get("stage") == "reassemble"
+
+    def test_ingest_floor_propagates_structured(self):
+        X, y = make_xy(n=1000)
+        p = dict(BASE, tpu_ingest_device="true", tpu_ingest_min_rows=1,
+                 tpu_ingest_chunk_rows=256)
+        faultline.arm("device_alloc", action="oom", times=10)
+        ds = lgb.Dataset(X, label=y, params=p)
+        with pytest.raises(membudget.DeviceOutOfMemory) as ei:
+            ds.construct()
+        assert ei.value.site == "ingest_chunk"
+
+    def test_predict_chunk_oom_recovers_identically(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_predict_chunk_rows=16384)
+        bst = train(p, X, y, rounds=2)
+        # device-vs-device is the bitwise claim (chunk invariance);
+        # the native walker accumulates in f64 and is only close
+        dev_ref = bst.predict(X, raw_score=True, device="tpu",
+                              tpu_predict_device="true")
+        faultline.arm("device_alloc", action="oom", at=1)
+        dev = bst.predict(X, raw_score=True, device="tpu",
+                          tpu_predict_device="true")
+        np.testing.assert_array_equal(dev_ref, dev)
+        np.testing.assert_allclose(bst.predict(X, raw_score=True), dev,
+                                   rtol=1e-6, atol=1e-6)
+        assert int(bst._driver.config.tpu_predict_chunk_rows) == 8192
+
+    def test_score_replay_oom_is_classified(self):
+        X, y = make_xy()
+        p = dict(BASE, tpu_predict_device="true")
+        bst = train(dict(p), X, y, rounds=2)
+        # re-open a training context so add_valid replays on device
+        ds = lgb.Dataset(X, label=y, params=dict(p))
+        b2 = Booster(params=dict(p), train_set=ds)
+        for _ in range(2):
+            b2.update()
+        b2.current_iteration()  # materialize the pending trees
+        faultline.arm("device_alloc", action="oom", times=100)
+        vs = lgb.Dataset(X[:256], label=y[:256], reference=ds,
+                         params=dict(p))
+        with pytest.raises(membudget.DeviceOutOfMemory) as ei:
+            b2.add_valid(vs, "v")
+        assert ei.value.site in ("score_replay", "train_step")
+        del bst
+
+    def test_every_guarded_site_has_a_chaos_path(self):
+        """The OOM_SITES vocabulary is covered: each site either has a
+        dedicated test above/below or is exercised here via the label
+        on lgbm_oom_events_total after this module ran its course —
+        the vocabulary itself must not drift silently."""
+        assert set(membudget.OOM_SITES) == {
+            "train_step", "ingest_chunk", "predict_chunk",
+            "score_replay", "registry_load", "registry_warmup",
+            "serve_dispatch"}
+
+
+# ---------------------------------------------------------------------------
+# 6. pressure-aware serving
+# ---------------------------------------------------------------------------
+class TestServingPressure:
+    @pytest.fixture()
+    def booster(self):
+        X, y = make_xy()
+        return train(dict(BASE), X, y, rounds=2), X
+
+    def test_over_budget_load_refused_507(self, booster):
+        bst, _ = booster
+        sess = ServingSession(params={"verbosity": -1,
+                                      "serving_hbm_budget_bytes": 64})
+        try:
+            before = sess.stats()["models_refused_hbm"]
+            with pytest.raises(membudget.ServingMemoryExhausted) as ei:
+                sess.load("m", booster=bst)
+            assert getattr(ei.value, "http_status", None) == 507
+            assert "packed_tables" in str(ei.value)
+            st = sess.stats()
+            assert st["models_refused_hbm"] == before + 1
+            assert st["hbm_budget_bytes"] == 64
+            # nothing was registered: the name stays unknown
+            with pytest.raises(KeyError):
+                sess.registry.resolve("m")
+        finally:
+            sess.close()
+
+    def test_pressure_evicts_cold_version_for_new_load(self, booster):
+        bst, X = booster
+        from lightgbm_tpu.config import Config
+
+        # a small batch bound keeps launch scratch from dwarfing the
+        # packed tables (the quantity pressure eviction manages)
+        base_cfg = {"verbosity": -1, "serving_max_batch_rows": 16}
+        plan = membudget.plan_model_load(bst, Config(base_cfg))
+        tables = plan.components["packed_tables"]
+        # budget fits both loads at preflight; the pressure threshold
+        # sits between one and two resident models' packed bytes, so
+        # registering v2 pushes past it and the (now-cold) v1 yields
+        budget = plan.total * 3
+        frac = (tables * 1.5) / budget
+        assert frac >= 0.05  # below the clamp the threshold never bites
+        sess = ServingSession(params={
+            **base_cfg,
+            "serving_hbm_budget_bytes": budget,
+            "serving_hbm_pressure_frac": frac})
+        try:
+            sess.load("m", booster=bst)          # v1
+            before = sess.stats()["evictions_pressure"]
+            sess.load("m", booster=bst)          # v2: v1 must yield
+            st = sess.stats()
+            assert st["evictions_pressure"] >= before + 1
+            keys = [m["key"] for m in sess.models()]
+            assert "m@2" in keys and "m@1" not in keys
+            out = sess.predict("m", np.nan_to_num(X[:8]), raw_score=True)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            sess.close()
+
+    def test_relieve_pressure_one_victim_and_skips_walker_only(
+            self, booster):
+        """relieve_pressure(0) evicts exactly ONE device-backed cold
+        entry; zero-byte (walker-only) entries are never pressure
+        victims — evicting them frees no HBM."""
+        bst, _ = booster
+        sess = ServingSession(params={"verbosity": -1,
+                                      "serving_max_models": 10})
+        try:
+            txt = bst.model_to_string()
+            walker = {"tpu_predict_device": "false", "verbosity": -1}
+            sess.load("w", model_str=txt, params=walker)   # w@1: 0 bytes
+            sess.load("w", model_str=txt, params=walker)   # w@1 cold
+            assert sess.registry.resolve("w@1").hbm_bytes == 0
+            sess.load("d", booster=bst)                    # d@1
+            sess.load("d", booster=bst)                    # d@1 cold
+            sess.load("d", booster=bst)                    # d@2 cold too
+            freed = sess.registry.relieve_pressure()
+            assert freed > 0
+            keys = [m["key"] for m in sess.models()]
+            # exactly one device-backed cold victim left; the walker-
+            # only cold version survived untouched
+            assert "w@1" in keys
+            assert sum(k in ("d@1", "d@2") for k in keys) == 1
+        finally:
+            sess.close()
+
+    def test_same_key_reload_in_place_near_budget(self, booster):
+        """Replacing name@N IN PLACE must not double-count the
+        departing copy: its bytes leave as the new ones land, so a
+        reload of the current version fits a budget sized for ONE
+        resident model instead of being refused 507 with a message
+        blaming a concurrent load."""
+        bst, X = booster
+        from lightgbm_tpu.config import Config
+
+        base_cfg = {"verbosity": -1, "serving_max_batch_rows": 16}
+        plan = membudget.plan_model_load(bst, Config(base_cfg))
+        budget = plan.total + 1   # room for one copy, never two
+        sess = ServingSession(params={
+            **base_cfg, "serving_hbm_budget_bytes": budget})
+        try:
+            sess.load("m", booster=bst, version=3)
+            sess.load("m", booster=bst, version=3)   # in-place reload
+            assert [m["key"] for m in sess.models()] == ["m@3"]
+            out = sess.predict("m", np.nan_to_num(X[:8]), raw_score=True)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            sess.close()
+
+    def test_walker_only_model_admits_under_tiny_budget(self, booster):
+        """An explicit tpu_predict_device=false model uploads nothing:
+        the preflight plan is None, so it admits under ANY budget
+        instead of being refused 507 (and evicting device-backed
+        models) for packed bytes it will never upload."""
+        bst, X = booster
+        txt = bst.model_to_string()
+        sess = ServingSession(params={"verbosity": -1,
+                                      "serving_hbm_budget_bytes": 64})
+        try:
+            sess.load("w", model_str=txt,
+                      params={"tpu_predict_device": "false",
+                              "verbosity": -1})
+            assert sess.registry.resolve("w").hbm_bytes == 0
+            out = sess.predict("w", np.nan_to_num(X[:8]), raw_score=True)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            sess.close()
+
+    def test_early_stopped_entry_bytes_match_plan(self, booster):
+        """PackedForest.device() uploads and retains the FULL pack
+        regardless of the best_iteration slice a request resolves to:
+        the entry's hbm_bytes must report that full residency, equal to
+        the preflight plan's packed_tables — a sliced undercount would
+        let admissions pass preflight on one number and occupy another."""
+        bst, _ = booster
+        bst.best_iteration = 1   # early-stopped: slice < full pack
+        from lightgbm_tpu.config import Config
+
+        plan = membudget.plan_model_load(bst, Config({"verbosity": -1}))
+        sess = ServingSession(params={"verbosity": -1})
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            assert entry.hbm_bytes > 0
+            assert entry.hbm_bytes == plan.components["packed_tables"]
+        finally:
+            sess.close()
+
+    def test_uncontended_load_never_hits_the_concurrency_wall(self):
+        """Preflight and the under-lock wall apply the SAME formula
+        (resident tables + new tables + MAX launch scratch across
+        entries): a load the wall would refuse is refused at preflight,
+        BEFORE any upload or warmup — a formula mismatch would let an
+        uncontended load burn the upload and then be refused with a
+        message falsely blaming a concurrent load."""
+        from lightgbm_tpu.config import Config
+
+        Xw, yw = make_xy(f=20, seed=3)
+        wide = train(dict(BASE), Xw, yw, rounds=2)
+        Xs, ys = make_xy(f=2, seed=4)
+        small = train(dict(BASE), Xs, ys, rounds=2)
+        base_cfg = {"verbosity": -1, "serving_max_batch_rows": 8}
+        cfg = Config(base_cfg)
+        pa, pb = (membudget.plan_model_load(b, cfg) for b in (wide, small))
+        ta, sa = (pa.components[k] for k in ("packed_tables",
+                                             "launch_scratch"))
+        tb, sb = (pb.components[k] for k in ("packed_tables",
+                                             "launch_scratch"))
+        # the discriminating budget: admitting `small` fits with its
+        # OWN scratch but not with the wide resident's larger scratch
+        assert sa > sb + 1
+        budget = ta + tb + (sa + sb) // 2
+        assert budget >= ta + sa    # `wide` alone admits cleanly
+        sess = ServingSession(params={
+            **base_cfg, "serving_hbm_budget_bytes": budget})
+        try:
+            sess.load("wide", booster=wide)
+            before = sess.stats()["models_loaded"]
+            with pytest.raises(membudget.ServingMemoryExhausted) as ei:
+                sess.load("small", booster=small)
+            # refused by the itemized PREFLIGHT plan, not the wall's
+            # concurrent-load diagnosis (no concurrency happened)
+            assert "packed_tables" in str(ei.value)
+            assert "concurrent" not in str(ei.value)
+            assert sess.stats()["models_loaded"] == before
+            with pytest.raises(KeyError):
+                sess.registry.resolve("small")
+        finally:
+            sess.close()
+
+    def test_concurrent_admission_wall_holds_under_lock(self, booster):
+        """The check-then-act race: the budget wall is re-checked at
+        registration (under the lock), so racing loads cannot jointly
+        breach it even though the preflight read was lock-free."""
+        bst, _ = booster
+        from lightgbm_tpu.config import Config
+
+        base_cfg = {"verbosity": -1, "serving_max_batch_rows": 16}
+        plan = membudget.plan_model_load(bst, Config(base_cfg))
+        tables = plan.components["packed_tables"]
+        # room for ONE resident model (+ its launch scratch), not two
+        budget = plan.total + tables // 2
+        sess = ServingSession(params={
+            **base_cfg, "serving_hbm_budget_bytes": budget})
+        try:
+            results, errors = [], []
+
+            def one(name):
+                try:
+                    results.append(sess.load(name, booster=bst))
+                except membudget.ServingMemoryExhausted as exc:
+                    errors.append(exc)
+
+            ts = [threading.Thread(target=one, args=(n,))
+                  for n in ("a", "b")]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            # both current aliases -> neither is cold-evictable, so at
+            # most one admission fits the wall; the other refused
+            resident = sum(m["hbm_bytes"] for m in sess.models())
+            assert resident <= budget
+            assert len(results) == 1 and len(errors) == 1, \
+                (results, errors)
+        finally:
+            sess.close()
+
+    def test_load_oom_retries_after_eviction_then_507(self, booster):
+        bst, _ = booster
+        sess = ServingSession(params={"verbosity": -1})
+        try:
+            sess.load("a", booster=bst)
+            sess.load("a", booster=bst)   # a@1 becomes cold
+            # one injected OOM at the upload: eviction frees a@1 and
+            # the retry succeeds — a recovery, not a refusal
+            faultline.arm("device_alloc", action="oom", at=1)
+            sess.load("b", booster=bst)
+            assert any(m["key"] == "b@1" for m in sess.models())
+            # with nothing cold left, a persistent OOM is a 507
+            faultline.arm("device_alloc", action="oom", times=10 ** 6)
+            with pytest.raises(membudget.ServingMemoryExhausted):
+                sess.load("c", booster=bst)
+            faultline.reset()
+        finally:
+            sess.close()
+
+    def test_dispatch_oom_zero_errors_to_accepted(self, booster):
+        bst, X = booster
+        sess = ServingSession(params={"verbosity": -1,
+                                      "serving_max_batch_rows": 256})
+        try:
+            sess.load("m", booster=bst)
+            Xq = np.nan_to_num(X[:64])
+            want = sess.predict("m", Xq, raw_score=True)
+            faultline.arm("device_alloc", action="oom", times=3)
+            errors, outs = [], []
+
+            def hit():
+                try:
+                    outs.append(sess.predict("m", Xq, raw_score=True))
+                except Exception as exc:  # pragma: no cover - the bug
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=hit) for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            faultline.reset()
+            assert not errors, errors
+            assert len(outs) == 6
+            for out in outs:
+                # walker-served batches accumulate in f64 (vs the
+                # device's f32): equal values, not equal bytes
+                np.testing.assert_allclose(np.asarray(out),
+                                           np.asarray(want),
+                                           rtol=1e-6, atol=1e-6)
+            st = sess.stats()
+            assert st["dispatch_oom"] >= 1
+            assert st["device_fallbacks"] >= 1
+        finally:
+            sess.close()
+
+    def test_warmup_oom_refuses_instead_of_walking(self, booster):
+        bst, _ = booster
+        sess = ServingSession(params={"verbosity": -1})
+        try:
+            # the upload survives, every warmup launch OOMs: the load
+            # must refuse, not admit a model that can only walk
+            faultline.arm("device_alloc", action="oom", at=2,
+                          times=10 ** 6)
+            with pytest.raises(membudget.ServingMemoryExhausted) as ei:
+                sess.load("m", booster=bst)
+            assert ei.value.site in ("registry_warmup", "predict_chunk")
+            faultline.reset()
+        finally:
+            sess.close()
+
+
+class TestHTTPSurfaces:
+    @pytest.fixture()
+    def served(self):
+        X, y = make_xy()
+        bst = train(dict(BASE), X, y, rounds=2)
+        sess = ServingSession(params={"verbosity": -1,
+                                      "serving_hbm_budget_bytes": 64})
+        server = serve_http(sess, "127.0.0.1", 0)
+        port = server.server_address[1]
+        yield f"http://127.0.0.1:{port}", sess, bst
+        server.shutdown()
+        sess.close()
+
+    @staticmethod
+    def _post(url, payload):
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as resp:
+            return resp.status, json.loads(resp.read())
+
+    def test_load_maps_to_507_with_code_memory(self, served, tmp_path):
+        base, _sess, bst = served
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(base + "/load", {"name": "m", "model_file": path})
+        assert ei.value.code == 507
+        body = json.loads(ei.value.read())
+        assert body["code"] == "memory"
+        assert "packed_tables" in body["error"]
+
+    def test_healthz_and_stats_carry_pressure(self, served):
+        base, _sess, _bst = served
+        with urllib.request.urlopen(base + "/healthz") as resp:
+            hz = json.loads(resp.read())
+        assert hz["ok"] is True
+        assert hz["hbm_budget_bytes"] == 64
+        assert "hbm_pressure" in hz and "hbm_models_bytes" in hz
+        with urllib.request.urlopen(base + "/stats") as resp:
+            st = json.loads(resp.read())
+        for key in ("hbm_budget_bytes", "hbm_models_bytes",
+                    "hbm_pressure", "models_refused_hbm",
+                    "dispatch_oom", "evictions_pressure"):
+            assert key in st, key
+
+
+# ---------------------------------------------------------------------------
+# 7. bench_diff knows the new fields
+# ---------------------------------------------------------------------------
+class TestBenchDiffFields:
+    def test_directions_and_tolerances(self):
+        import tools.bench_diff as bd
+
+        direction, tol = bd.METRICS["oom_recovery_s"]
+        assert direction == -1 and tol > 0
+        direction, tol = bd.METRICS["hbm_budget_headroom_bytes"]
+        assert direction == +1 and tol > 0
+
+    def test_bench_emits_the_oom_fields(self):
+        src = open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py")).read()
+        for key in ('"oom_recovery_s"', '"hbm_budget_headroom_bytes"'):
+            assert key in src, f"bench.py no longer records {key}"

@@ -60,6 +60,16 @@ METRICS = {
     "resume_s": (-1, 0.30),
     "resume_elastic_s": (-1, 0.30),
     "collective_timeout_recovery_s": (-1, 0.30),
+    # OOM recovery (ISSUE 15): rollback + ladder step + retried
+    # iteration — wide slack, it embeds one training iteration's wall
+    "oom_recovery_s": (-1, 0.50),
+    # budget minus observed train peak: MORE headroom is better; null
+    # on CPU rounds (no capacity report -> no budget resolves).  The
+    # slack is WIDE on purpose: headroom is a small difference of two
+    # large numbers, so ordinary peak jitter swings it by large
+    # fractions — only losing more than the whole baseline headroom
+    # (crossing toward over-budget) scores as a regression
+    "hbm_budget_headroom_bytes": (+1, 1.00),
     "compile_s": (-1, 0.20),
     "n_programs": (-1, 0.0),                  # program zoo: exact gate
     "n_programs_train": (-1, 0.0),
@@ -156,12 +166,18 @@ def diff(old, new, tolerance_scale=1.0):
             continue
         ratio = b / a
         tol = tol * tolerance_scale
-        if direction > 0:            # higher better: b < a*(1-tol) bad
-            bad = b < a * (1.0 - tol)
-            improved = b > a * (1.0 + tol)
-        else:                        # lower better: b > a*(1+tol) bad
-            bad = b > a * (1.0 + tol)
-            improved = b < a * (1.0 - tol)
+        # tolerance band scaled by |a|, compared as a signed DELTA: a
+        # multiplicative band inverts for negative baselines (headroom
+        # can legitimately go negative — an over-budget round improving
+        # from -1.0e9 to -0.9e9 must not score as a regression)
+        band = tol * abs(a)
+        delta = b - a
+        if direction > 0:            # higher better: a big drop is bad
+            bad = delta < -band
+            improved = delta > band
+        else:                        # lower better: a big rise is bad
+            bad = delta > band
+            improved = delta < -band
         verdict = "REGRESSION" if bad else ("improved" if improved else "ok")
         rows.append((metric, a, b, ratio, verdict))
     return rows

@@ -322,6 +322,114 @@ register(Rule(
         "pipeline the train loop depends on."),
     scope=in_package, check=lambda fc: _check_host_call_in_jit(fc)))
 
+# ---------------------------------------------------------------------------
+# J205: broad exception handlers on device-dispatch paths must route
+# through the membudget OOM classifier (ISSUE 15)
+# ---------------------------------------------------------------------------
+_J205_SCOPE = re.compile(r"(^|/)lightgbm_tpu/(ops|models|serving)/")
+
+#: callee leaves that reach the device from ops/models/serving — a try
+#: body containing one of these is a device-dispatch path
+_J205_DISPATCH = {"predict", "warmup", "_native_predict",
+                  "forest_class_scores", "forest_leaf_values",
+                  "bin_chunk", "bin_matrix", "bin_stream",
+                  "block_until_ready", "device_put", "device_get"}
+
+#: handler types broad enough to swallow an unclassified RESOURCE_
+#: EXHAUSTED (specific handlers — ValueError, KeyError — cannot)
+_J205_BROAD = {"Exception", "BaseException", "XlaRuntimeError",
+               "JaxRuntimeError", "RuntimeError"}
+
+#: names whose presence in a handler body means the error is routed
+#: through the membudget classifier (or re-raised classified)
+_J205_ROUTERS = {"membudget", "is_oom_error", "oom_guard",
+                 "DeviceOutOfMemory", "MemoryLadderExhausted",
+                 "ServingMemoryExhausted"}
+
+
+def dispatch_scope(rel: str) -> bool:
+    return bool(_J205_SCOPE.search(rel))
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    return any(dotted_name(t).rsplit(".", 1)[-1] in _J205_BROAD
+               for t in types)
+
+
+def _handler_routes_or_reraises(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True  # bare re-raise: classification passes upward
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            names = set(subtree_names(node)) | {dotted_name(node)
+                                               .rsplit(".", 1)[-1]}
+            if names & _J205_ROUTERS:
+                return True
+    return False
+
+
+def _check_oom_classifier(fc: FileContext):
+    for node in ast.walk(fc.tree):
+        if not isinstance(node, ast.Try):
+            continue
+        dispatches = False
+        for stmt in node.body:
+            for call in ast.walk(stmt):
+                if isinstance(call, ast.Call) and \
+                        dotted_name(call.func).rsplit(".", 1)[-1] \
+                        in _J205_DISPATCH:
+                    dispatches = True
+                    break
+            if dispatches:
+                break
+        if not dispatches:
+            continue
+        for handler in node.handlers:
+            if not _handler_is_broad(handler):
+                continue
+            if _handler_routes_or_reraises(handler):
+                continue
+            if handler.type is None:
+                caught = "bare except"
+            elif isinstance(handler.type, ast.Tuple):
+                caught = "except (" + ", ".join(
+                    dotted_name(t) for t in handler.type.elts) + ")"
+            else:
+                caught = f"except {dotted_name(handler.type)}"
+            yield fc.finding(
+                "J205", handler,
+                f"{caught} on a device-dispatch path swallows "
+                "unclassified RESOURCE_EXHAUSTED: route through the "
+                "membudget classifier (membudget.is_oom_error / "
+                "oom_guard) or re-raise bare, so a device OOM stays a "
+                "counted, named, recoverable event instead of a "
+                "silent fallback.")
+
+
+register(Rule(
+    id="J205", name="unclassified-oom-handler", family="jit",
+    summary=("Broad except handlers (bare / Exception / "
+             "XlaRuntimeError) on device-dispatch paths in ops/, "
+             "models/, serving/ must route through the membudget OOM "
+             "classifier or re-raise."),
+    rationale=(
+        "ISSUE 15 made device memory a budgeted, recoverable resource: "
+        "every HBM exhaustion must classify into DeviceOutOfMemory so "
+        "it is counted (lgbm_oom_events_total), noted in the flight "
+        "recorder with a memory snapshot, and eligible for the "
+        "degradation ladder / serving eviction.  A broad handler that "
+        "swallows the raw XlaRuntimeError re-creates the pre-ISSUE-15 "
+        "world: the OOM becomes an anonymous fallback and the pressure "
+        "signal is lost.  Handlers that call membudget.is_oom_error, "
+        "sit under an oom_guard re-raise, or re-raise bare are "
+        "compliant; specific handlers (ValueError, KeyError) are "
+        "outside the rule — they cannot catch an OOM."),
+    scope=dispatch_scope, check=lambda fc: _check_oom_classifier(fc)))
+
 register(Rule(
     id="J204", name="static-argname-of-folded-mode-param", family="jit",
     summary=("static_argnames must not name params canonical_params "

@@ -743,6 +743,79 @@ def run_faults(n=4000, f=6, iters=5):
             f"final={[m['breaker'] for m in sess.models()]}")
     sess.close()
 
+    # ---- device_alloc oom x guarded site (ISSUE 15): a classified
+    # RESOURCE_EXHAUSTED at each guarded allocation site must recover
+    # (ladder / chunk shrink / walker failover) or surface structured
+    from lightgbm_tpu.obs import REGISTRY
+    from lightgbm_tpu.utils import membudget
+
+    def oom_count(metric, **labels):
+        return int(REGISTRY.value(metric, **labels))
+
+    # train_step: rollback -> ladder step -> bitwise retry
+    faultline.reset()
+    p = dict(base_params)
+    bst_o = Booster(params=p, train_set=lgb.Dataset(X, label=y, params=p))
+    bst_o.update()
+    faultline.arm("device_alloc", action="oom", at=1)
+    bst_o.update()
+    outcome("device_alloc/oom", "train",
+            f"recovered to {bst_o.current_iteration()} iters, "
+            f"recoveries={oom_count('lgbm_oom_recoveries_total', site='train_step')} "
+            f"ladder={bst_o._driver._mem_ladder.describe()}")
+
+    # predict_chunk: chunk shrink -> identical output
+    faultline.reset()
+    native = bst_o.predict(X[:512], raw_score=True)
+    faultline.arm("device_alloc", action="oom", at=1)
+    dev = bst_o.predict(X[:512], raw_score=True, device="tpu",
+                        tpu_predict_device="true")
+    outcome("device_alloc/oom", "pred",
+            f"recovered, outputs equal={bool(np.allclose(native, dev))}")
+
+    # ingest_chunk: binning chunk shrink -> bit-identical bins
+    faultline.reset()
+    pi = dict(base_params, tpu_ingest_device="true", tpu_ingest_min_rows=1,
+              tpu_ingest_chunk_rows=2048)
+    faultline.arm("device_alloc", action="oom", at=1)
+    ds_i = lgb.Dataset(X, label=y, params=pi)
+    ds_i.construct()
+    faultline.reset()
+    ds_h = lgb.Dataset(X, label=y, params=base_params)
+    ds_h.construct()
+    same = bool(np.array_equal(np.asarray(ds_i._inner.bins),
+                               np.asarray(ds_h._inner.bins)))
+    outcome("device_alloc/oom", "ingest",
+            f"recovered via chunk shrink, bins bit-identical={same}")
+
+    # serve_dispatch: walker failover, zero errors to the caller
+    faultline.reset()
+    sess_o = ServingSession(params={"verbosity": -1})
+    sess_o.load("m", booster=bst_o)
+    faultline.arm("device_alloc", action="oom", times=2)
+    ok = bool(np.isfinite(np.asarray(
+        sess_o.predict("m", X[:64], raw_score=True))).all())
+    st_o = sess_o.stats()
+    outcome("device_alloc/oom", "serve",
+            f"served={ok} dispatch_oom={st_o['dispatch_oom']} "
+            f"fallbacks={st_o['device_fallbacks']}")
+    faultline.reset()
+    sess_o.close()
+
+    # ladder exhaustion: structured error, usable booster
+    faultline.arm("device_alloc", action="oom", times=1000)
+    try:
+        bst_o.update()
+        outcome("device_alloc/oom", "exh", "NOT reached (no exhaustion)")
+    except membudget.MemoryLadderExhausted as exc:
+        faultline.reset()
+        usable = bool(np.isfinite(
+            bst_o.predict(X[:64], raw_score=True)).all())
+        outcome("device_alloc/oom", "exh",
+                f"MemoryLadderExhausted at {exc.site!r}, booster "
+                f"usable={usable}")
+    faultline.reset()
+
 
 def run_faults_multihost(hosts=2, iters=4, n=1200):
     """Distributed chaos sweep (ISSUE 8): a (point x armed-host x
