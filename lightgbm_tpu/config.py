@@ -244,6 +244,36 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # (multi-host process groups always propagate: a one-sided retry
     # would desynchronize the collective streams)
     "tpu_oom_recovery": ("bool", True, ()),
+    # --- out-of-core streaming (ops/stream.py, ISSUE 16) ---
+    # training layout: resident keeps the binned matrix device-resident
+    # (the classic path); streamed keeps it host-resident and streams
+    # fixed-size row blocks through double-buffered device slots each
+    # iteration, so rows x features stops being capped by HBM.  auto
+    # lets membudget.plan_training pick: resident when the itemized
+    # plan fits the budget, streamed when the binned matrix pushes it
+    # over.  int8/int16 streamed models are BYTE-IDENTICAL to resident
+    # (int32 histogram sums are associative across blocks)
+    "tpu_stream_mode": ("str", "auto", ()),
+    # rows per streamed block (rounded to a multiple of the device
+    # histogram scan block); 0 = auto (a block sized so two device
+    # slots fit comfortably under ~1/8 of the HBM budget, floored at
+    # 64k rows)
+    "tpu_stream_block_rows": ("int", 0, ()),
+    # overlap block i+1's H2D copy with block i's histogram contraction
+    # via two device slots; false = one slot, fully serial copies
+    # (debugging / host-memory ceiling)
+    "tpu_stream_double_buffer": ("bool", True, ()),
+    # GOSS-style gradient-based block sampling for the streamed layout:
+    # keep the top fraction of blocks by sum(|grad*hess|) every
+    # iteration...
+    "tpu_stream_goss_top": ("float", 0.0, ()),
+    # ...plus this fraction of the remaining blocks, drawn by a PCG
+    # hash keyed on each block's first GLOBAL row index (invariant to
+    # padding and shard count) and amplified by the standard GOSS
+    # (1-top)/other weight.  Both 0.0 = stream every block.  Block
+    # sampling changes which rows build each tree, so it trades the
+    # bitwise-vs-resident guarantee for fewer H2D copies per iteration
+    "tpu_stream_goss_other": ("float", 0.0, ()),
     # --- fault tolerance (utils/checkpoint.py + numeric guardrails) ---
     # atomic training checkpoints: bundle directory (empty = off).  Each
     # checkpoint holds the model string (with its bin-mapper trailer),

@@ -28,7 +28,7 @@ from ..ops.predict import (PackedForest, feature_meta_dev, device_tables,
                            forest_class_scores, forest_leaf_values,
                            pack_trees, row_bucket)
 from ..utils import timer
-from .learner import TPUTreeLearner
+from .learner import TPUTreeLearner, make_tree_learner
 from .metrics import Metric, create_metrics
 from .objectives import (Objective, create_objective,
                          create_objective_from_model_string)
@@ -294,7 +294,7 @@ class GBDT:
             self.num_tree_per_iteration = self.objective.num_model_per_iteration()
         else:
             self.num_tree_per_iteration = self.num_class
-        self.learner = TPUTreeLearner(config, train_data)
+        self.learner = make_tree_learner(config, train_data)
         self.metrics = create_metrics(
             config, self.objective.name if self.objective else "")
         for m in self.metrics:
@@ -369,6 +369,10 @@ class GBDT:
                 # placement (put_global); the fused step mixes local
                 # score state into the global-mesh program
                 and not self.learner._multiproc
+                # the streamed layout has no device-resident bins_t for
+                # the fused step to close over: its train() drives the
+                # per-block host loop (ops/stream.py) — sync path only
+                and not self.learner.stream_layout
                 and all(self.objective.class_need_train(k)
                         for k in range(self.num_tree_per_iteration))):
             self._train_step = self.learner.make_train_step(
@@ -411,7 +415,7 @@ class GBDT:
                              "reference=the original dataset")
         self._materialize()
         self.train_data = data
-        self.learner = TPUTreeLearner(self.config, data)
+        self.learner = make_tree_learner(self.config, data)
         if self.objective is not None:
             self.objective.init(data.metadata, data.num_data)
         self.metrics = create_metrics(
@@ -824,7 +828,8 @@ class GBDT:
         if not overrides:
             return
         self.config.update(overrides)
-        if not ({"tpu_hist_agg", "tpu_bucket_policy"} & set(overrides)):
+        if not ({"tpu_hist_agg", "tpu_bucket_policy", "tpu_stream_mode"}
+                & set(overrides)):
             return  # chunk-only: nothing compiled closes over it
         if self.train_data is None or (self.learner is None
                                        and self._ladder_carry is None):
@@ -866,7 +871,7 @@ class GBDT:
         at the train step — classified (counted + blackboxed), never a
         raw XlaRuntimeError escaping the recovery path unnamed."""
         with membudget.oom_guard("train_step", stage="ladder_rebuild"):
-            self.learner = TPUTreeLearner(self.config, self.train_data)
+            self.learner = make_tree_learner(self.config, self.train_data)
         rng_state, cegb_vals = self._ladder_carry or (None, [])
         self._ladder_carry = None
         if rng_state is not None and \
@@ -916,10 +921,12 @@ class GBDT:
                     "HBM preflight degraded the configuration to fit "
                     f"the budget: {pending} (bitwise-invisible); "
                     f"headroom now {plan.headroom:,d} bytes")
-                if {"tpu_hist_agg", "tpu_bucket_policy"} & set(pending):
+                if {"tpu_hist_agg", "tpu_bucket_policy",
+                        "tpu_stream_mode"} & set(pending):
                     self.apply_memory_degradation(
                         {k: pending[k] for k in
-                         ("tpu_hist_agg", "tpu_bucket_policy")
+                         ("tpu_hist_agg", "tpu_bucket_policy",
+                          "tpu_stream_mode")
                          if k in pending})
                 return
         if mode == "warn":
@@ -2042,7 +2049,7 @@ class GBDT:
         self.config = config
         self.shrinkage_rate = float(config.learning_rate)
         if self.learner is not None:
-            self.learner = TPUTreeLearner(config, self.train_data)
+            self.learner = make_tree_learner(config, self.train_data)
             self._bag_cfg = self._bagging_config()
             self._maybe_make_train_step()
 

@@ -45,6 +45,11 @@ def _to_bitset(values) -> list:
 
 
 class TPUTreeLearner:
+    # True on StreamedTreeLearner (ops/stream.py): the binned matrix
+    # stays HOST-resident and serial placement routes through the
+    # _place_serial_bins hook instead of a device transpose/pack
+    stream_layout = False
+
     def __init__(self, config: Config, train_data: TrainingData):
         self.config = config
         self.td = train_data
@@ -197,7 +202,8 @@ class TPUTreeLearner:
             Log.info(f"EFB bundling is inactive under tree_learner="
                      f"{strategy}; training on plain columns")
         if (bool(config.enable_bundle) and strategy in ("serial", "data")
-                and not forced and self.num_features > 1):
+                and not forced and self.num_features > 1
+                and not self.stream_layout):
             from ..io.bundling import (EFB_SAMPLE_ROWS, find_bundles,
                                        find_bundles_multihost)
 
@@ -555,7 +561,8 @@ class TPUTreeLearner:
             self._sparse_arrays = None
             # partitioned: only this process's rows, at its local width
             width = self._local_width if self._partitioned else self.n_pad
-            if dev_src is not None and strategy == "serial":
+            if (dev_src is not None and strategy == "serial"
+                    and not self.stream_layout):
                 # device-side layout: transpose + pad the device-
                 # resident ingest matrix in HBM — the host [n, F]
                 # matrix never exists on this path
@@ -582,6 +589,7 @@ class TPUTreeLearner:
         eff_block = min(block, local_rows)
         self.packed_bins = (
             bool(config.tpu_pack_bins) and B <= 16
+            and not self.stream_layout
             and hist_impl in ("pallas", "pallas2") and plan is None
             and self._sparse_arrays is None and not self._partitioned
             and str(config.tpu_partition_impl) in ("select", "vselect")
@@ -607,9 +615,7 @@ class TPUTreeLearner:
 
         if strategy == "serial":
             self.mesh = None
-            self.bins_t = jnp.asarray(bins_t)
-            ones = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
-            self._ones_mask = ones
+            self._place_serial_bins(bins_t, n)
         else:
             self.mesh = make_mesh(num_data_shards=self.d_shards,
                                   num_feature_shards=self.f_shards)
@@ -827,6 +833,15 @@ class TPUTreeLearner:
         shape, pdt, sharding = self._pool_spec
         self._pool = (jnp.zeros(shape, pdt, device=sharding)
                       if sharding is not None else jnp.zeros(shape, pdt))
+
+    def _place_serial_bins(self, bins_t, n: int) -> None:
+        """Place the serial-layout transposed bin matrix.
+
+        The resident default commits the whole [g_pad, n_pad] matrix to
+        device memory; StreamedTreeLearner overrides this to keep it
+        host-resident as fixed-size row blocks (ops/stream.py)."""
+        self.bins_t = jnp.asarray(bins_t)
+        self._ones_mask = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -1343,3 +1358,111 @@ class TPUTreeLearner:
             tree.leaf_value[:tree.num_leaves] = np.asarray(
                 leaf_output[:tree.num_leaves], np.float64)
         return tree
+
+
+class StreamedTreeLearner(TPUTreeLearner):
+    """Out-of-core serial learner: host-resident bins, blocked H2D.
+
+    Same construction surface as TPUTreeLearner, but the transposed bin
+    matrix never lands on device as a whole — `_place_serial_bins`
+    partitions it into C-contiguous host row blocks and train() drives
+    the streamed grower (ops/stream.py), which double-buffers each
+    block's H2D copy under the previous block's histogram contraction.
+    For int8/int16 precisions the resulting model files are
+    BYTE-IDENTICAL to the resident layout's (int32 histogram sums are
+    associative across blocks; same n_pad, same quantization grid, same
+    stochastic-rounding hash on GLOBAL row indices).
+
+    Restrictions are validated loudly at construction (StreamGrower /
+    stream_supported): serial only, numerical only, no EFB / sparse /
+    CEGB / forced splits / per-node sampling / packed bins.
+    """
+    stream_layout = True
+
+    def __init__(self, config: Config, train_data: TrainingData):
+        if resolve_tree_learner(config.tree_learner) != "serial":
+            raise NotImplementedError(
+                "tpu_stream_mode=streamed requires tree_learner=serial")
+        super().__init__(config, train_data)
+        from ..ops.stream import StreamGrower
+
+        # the resident external-pool/donation machinery is bypassed: the
+        # streamed round state owns its pool (stream.root_finish) and
+        # per-program donation is wired inside ops/stream.py
+        self._donate = False
+        self._external_pool = False
+        self._stream = StreamGrower(
+            self.params, self.g_pad, self.n_pad, self._stream_R,
+            double_buffer=bool(config.tpu_stream_double_buffer),
+            goss_top=float(config.tpu_stream_goss_top),
+            goss_other=float(config.tpu_stream_goss_other))
+        Log.info(
+            f"streamed layout: {len(self._host_blocks)} host blocks x "
+            f"{self._stream_R} rows "
+            f"({self._host_blocks[0].nbytes >> 20} MiB/block, "
+            f"double_buffer={self._stream.double_buffer})")
+
+    def reset_pool(self) -> None:
+        # no external donated pool: the streamed grower's pool lives in
+        # its device round state and is rebuilt per tree
+        self._pool_spec = None
+        self._pool = None
+
+    def _place_serial_bins(self, bins_t, n: int) -> None:
+        from ..ops.stream import make_host_blocks, resolve_stream_rows
+        from ..utils import membudget
+
+        if not isinstance(bins_t, np.ndarray):
+            # defensive: the device-transpose fast path is gated off for
+            # stream_layout, so this only fires on exotic ingest sources
+            bins_t = np.asarray(bins_t)
+        precision = self._resolve_precision(self.config)
+        _, block = self._resolve_hist_impl(self.config, self.num_bins,
+                                           precision)
+        self._stream_R = resolve_stream_rows(
+            int(self.config.tpu_stream_block_rows), self.n_pad,
+            bytes_per_row=int(bins_t.shape[0]) * bins_t.dtype.itemsize,
+            inner_block=min(block, self.n_pad),
+            budget_bytes=membudget.budget_bytes(self.config))
+        self._host_blocks = make_host_blocks(bins_t, self._stream_R)
+        self.bins_t = None  # never device-resident on this layout
+        self._ones_mask = jnp.ones(self.n_pad, jnp.float32).at[n:].set(0.0)
+
+    def train(self, grad: jnp.ndarray, hess: jnp.ndarray,
+              row_mask: Optional[jnp.ndarray] = None
+              ) -> Tuple[Tree, jnp.ndarray, Dict]:
+        """Grow one tree via the streamed grower.
+
+        RNG consumption order (sample_features THEN the key draw) is the
+        resident train()'s — seeded streamed and resident runs consume
+        identical randomness, which the bitwise-equality tests pin."""
+        fmask = self.sample_features()
+        key = jax.random.PRNGKey(int(self._feature_rng.integers(2 ** 31)))
+        mask = self._ones_mask if row_mask is None else \
+            self.pad_vector(row_mask) * self._ones_mask
+        out = self._stream.grow(self._host_blocks, self.pad_vector(grad),
+                                self.pad_vector(hess), mask, fmask,
+                                self.meta, key)
+        tree = self.build_tree(out)
+        return tree, out["leaf_ids"][:self.n], out
+
+    @property
+    def stream_stats(self) -> Dict[str, float]:
+        """Last tree's streaming telemetry (overlap %, H2D wall, blocks
+        streamed/skipped) — read by bench.py and perf_probe stream."""
+        return dict(self._stream.last_stats)
+
+
+def make_tree_learner(config: Config,
+                      train_data: TrainingData) -> TPUTreeLearner:
+    """Layout-dispatching learner constructor — gbdt.py's single entry
+    point.  ``tpu_stream_mode`` picks resident (the classic
+    device-resident matrix), streamed (host-resident blocks), or auto,
+    where membudget.select_layout keeps the resident layout unless its
+    pre-construction estimate says the binned matrix would blow the HBM
+    budget AND the run is streamable."""
+    from ..utils import membudget
+
+    if membudget.select_layout(config, train_data) == "streamed":
+        return StreamedTreeLearner(config, train_data)
+    return TPUTreeLearner(config, train_data)

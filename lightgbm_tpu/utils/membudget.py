@@ -73,9 +73,12 @@ OOM_SITES = ("train_step", "ingest_chunk", "predict_chunk",
 #: binning kernel's own minimum is far lower and never the binding one)
 CHUNK_FLOOR = 4096
 
-#: ladder step vocabulary, in descent order
+#: ladder step vocabulary, in descent order; the final rung trades the
+#: device-resident binned matrix for the streamed layout (ops/stream.py)
+#: instead of raising MemoryLadderExhausted — slower, but the run
+#: completes (and stays bitwise for int8/int16 precisions)
 LADDER_STEPS = ("shrink_chunk_rows", "hist_agg_scatter",
-                "bucket_policy_fine")
+                "bucket_policy_fine", "stream_layout")
 
 _OOM_RE = re.compile(
     r"RESOURCE[ _]EXHAUSTED|out of memory|"
@@ -362,6 +365,80 @@ def packed_forest_bytes(num_trees: int, num_leaves: int) -> int:
     return max(int(num_trees), 0) * per_tree + 4
 
 
+def stream_config_blockers(config) -> List[str]:
+    """Config-visible reasons the streamed layout (ops/stream.py) cannot
+    serve this run — shared by the auto layout selection and the OOM
+    ladder's final rung, so neither proposes a layout the streamed
+    learner would reject at construction.  Dataset-derived blockers
+    (categorical columns discovered by auto detection) are caught by
+    select_layout when train_data is in hand, and loudly by the learner
+    otherwise."""
+    reasons = []
+    try:
+        from ..parallel.strategies import resolve_tree_learner
+
+        strategy = resolve_tree_learner(
+            str(config.get("tree_learner", "serial")))
+    except Exception:
+        strategy = str(config.get("tree_learner", "serial"))
+    if strategy != "serial":
+        reasons.append(f"tree_learner={strategy}")
+    if float(config.get("tpu_sparse_threshold", 0.0) or 0.0) > 0.0:
+        reasons.append("sparse COO storage (tpu_sparse_threshold)")
+    if str(config.get("forcedsplits_filename", "") or ""):
+        reasons.append("forced splits")
+    if float(config.get("feature_fraction_bynode", 1.0) or 1.0) < 1.0:
+        reasons.append("feature_fraction_bynode")
+    coupled = [float(v) for v in
+               config.get("cegb_penalty_feature_coupled", []) or []]
+    lazy = [float(v) for v in
+            config.get("cegb_penalty_feature_lazy", []) or []]
+    if (any(v != 0.0 for v in coupled) or any(v != 0.0 for v in lazy)
+            or float(config.get("cegb_penalty_split", 0.0) or 0.0) != 0.0):
+        reasons.append("CEGB penalties")
+    if str(config.get("categorical_feature", "") or ""):
+        reasons.append("categorical features")
+    return reasons
+
+
+def select_layout(config, train_data=None) -> str:
+    """Resolve ``tpu_stream_mode`` to the concrete training layout:
+    "resident" or "streamed".
+
+    Explicit modes are honored as-is (a streamed pin that the streamed
+    learner cannot serve raises there, loudly).  auto keeps the classic
+    resident layout unless (a) the run is streamable and (b) the
+    closed-form binned-matrix estimate would eat more than half the
+    enforced HBM budget — the matrix is the dominant resident and the
+    plan's other components (pool, stats planes, scores, scratch) need
+    the rest."""
+    mode = str(config.get("tpu_stream_mode", "auto") or "auto").lower()
+    if mode == "streamed":
+        return "streamed"
+    if mode == "resident":
+        return "resident"
+    if mode != "auto":
+        raise ValueError("tpu_stream_mode must be auto|resident|streamed,"
+                         f" got {mode!r}")
+    if stream_config_blockers(config):
+        return "resident"
+    budget = budget_bytes(config)
+    if budget is None or train_data is None:
+        return "resident"
+    try:
+        if train_data.feature_arrays()["is_categorical"].any():
+            return "resident"
+        n = int(train_data.num_data)
+        F = int(train_data.num_features)
+        item = 1 if int(train_data.feature_arrays()["num_bin"].max()) \
+            <= 256 else 4
+    except Exception:
+        return "resident"
+    if n * F * item > budget // 2:
+        return "streamed"
+    return "resident"
+
+
 def plan_training(config, learner, num_class: int) -> MemoryPlan:
     """Itemized pre-iteration-0 HBM prediction for one training run,
     anchored to the LIVE learner buffers where they exist (the binned
@@ -372,7 +449,32 @@ def plan_training(config, learner, num_class: int) -> MemoryPlan:
     k = max(int(num_class), 1)
     comps: Dict[str, int] = {}
     bins_t = getattr(learner, "bins_t", None)
-    if bins_t is not None:
+    streamed = (bool(getattr(learner, "stream_layout", False))
+                or str(config.get("tpu_stream_mode", "auto")) == "streamed")
+    if streamed:
+        # streamed layout: the matrix stays host-resident; the device
+        # cost is TWO double-buffered block slots.  Live host blocks are
+        # exact; a pending rebuild into streamed (the ladder's final
+        # rung re-plans BEFORE the learner is reconstructed) estimates
+        # the slot closed-form from the same sizing rule the learner
+        # will use
+        blocks = getattr(learner, "_host_blocks", None)
+        if blocks:
+            slot = max(int(b.nbytes) for b in blocks)
+        else:
+            from ..ops.stream import resolve_stream_rows
+
+            per_row = (int(bins_t.nbytes) // max(n_pad, 1)
+                       if bins_t is not None
+                       else max(int(getattr(learner, "g_pad", 1)), 1))
+            rows = resolve_stream_rows(
+                int(config.get("tpu_stream_block_rows", 0) or 0), n_pad,
+                per_row,
+                int(config.get("tpu_block_rows", 0) or 0) or 16384,
+                budget_bytes(config))
+            slot = rows * per_row
+        comps["stream_slots"] = 2 * slot
+    elif bins_t is not None:
         comps["binned_matrix"] = int(bins_t.nbytes) // d
     comps["histogram_pool"] = _pool_bytes(learner, config)
     precision = str(getattr(learner.params, "precision", "hilo"))
@@ -502,6 +604,16 @@ class DegradationLadder:
             return "hist_agg_scatter", {"tpu_hist_agg": "scatter"}
         if str(config.get("tpu_bucket_policy", "wide")) == "wide":
             return "bucket_policy_fine", {"tpu_bucket_policy": "fine"}
+        # the last rung: give up device residency of the binned matrix
+        # and stream it from host RAM (ops/stream.py).  Only under
+        # tpu_stream_mode=auto (an explicit resident pin — or an
+        # already-streamed run — has nothing left to give) and only when
+        # the configuration is streamable; NOT bitwise-invisible for
+        # float histogram precisions (the int precisions stay bitwise —
+        # int32 block sums are associative)
+        if (str(config.get("tpu_stream_mode", "auto")) == "auto"
+                and not stream_config_blockers(config)):
+            return "stream_layout", {"tpu_stream_mode": "streamed"}
         return None
 
     def describe(self) -> List[str]:

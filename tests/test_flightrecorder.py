@@ -11,6 +11,7 @@ note staying negligible beside a training iteration.
 
 import json
 import os
+import subprocess
 import threading
 import time
 
@@ -145,6 +146,22 @@ class TestDump:
         path = fr.dump("unit_test", path=str(d))
         assert path == str(d / "blackbox-host0.json")
         assert _read_dump(path)["reason"] == "unit_test"
+
+    def test_no_blackbox_dump_is_tracked_or_stranded(self):
+        """Regression for the stale `blackbox-host0.json` that sat at
+        the repo root (removed in ISSUE 16): no dump may be committed
+        — the .gitignore pattern must cover every canonical dump name,
+        and the repo root must not accumulate unignored dumps."""
+        root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        if not os.path.isdir(os.path.join(root, ".git")):
+            pytest.skip("not a git checkout")
+        tracked = subprocess.run(
+            ["git", "ls-files", "--cached", "*blackbox*"],
+            cwd=root, capture_output=True, text=True).stdout.split()
+        assert tracked == [], f"blackbox dumps are tracked: {tracked}"
+        gitignore = open(os.path.join(root, ".gitignore")).read()
+        assert "blackbox-host*.json" in gitignore.split()
 
     def test_dump_on_injected_collective_hang_names_the_site(self,
                                                              tmp_path):

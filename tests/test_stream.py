@@ -1,0 +1,425 @@
+"""Out-of-core streamed training (ISSUE 16): the host-resident block
+layout in ops/stream.py and its wiring through the learner factory,
+the membudget planner, and the OOM degradation ladder.
+
+What is pinned here:
+
+1. **bitwise** — a streamed run produces a model BYTE-IDENTICAL to the
+   resident layout for the integer histogram precisions (int8/int16),
+   serial and against an int8 2-shard resident run.  The resident
+   reference runs its SYNC path (fused-train-step disabled): the fused
+   step computes gradients inside the jitted program and its float
+   rounding differs from host-side gradients — a pre-existing
+   fused-vs-sync divergence unrelated to streaming.  Streaming's own
+   claim is exact: int32 histogram block sums are associative, so
+   accumulating per stream block equals the one-shot contraction bit
+   for bit.
+2. **geometry** — the last partial block and the single-block
+   degenerate case stream correctly, and `resolve_stream_rows` always
+   returns a multiple of the inner histogram block.
+3. **GOSS** — gradient-based block sampling is deterministic under
+   re-run and invariant to perf-only knobs (double-buffering), because
+   its uniforms are keyed on the GLOBAL row index of each block start,
+   not on anything layout-dependent.
+4. **selection** — `tpu_stream_mode=auto` picks the streamed layout
+   exactly when the binned matrix would eat more than half the HBM
+   budget, explicit pins are honored, and `plan_training` swaps the
+   binned-matrix component for two double-buffer slots.
+5. **ladder** — the recovery ladder's final rung degrades a resident
+   run to streaming instead of raising MemoryLadderExhausted.
+6. **checkpoint/resume** — a streamed run interrupted at the midpoint
+   resumes to the same bytes as an uninterrupted streamed run.
+7. **compile discipline** — streaming compiles a BOUNDED number of
+   programs (one per distinct block width, i.e. at most two for the
+   per-block sites); more iterations add zero recompiles.
+8. **observability** — `stream_h2d` / `stream_block` spans land under
+   `hist_build`, and the per-tree `stream_tree` event reports an
+   overlap percentage > 0 when double-buffering is on.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import TrainingData
+from lightgbm_tpu.models import gbdt as gbdt_mod
+from lightgbm_tpu.models.learner import (StreamedTreeLearner,
+                                         TPUTreeLearner,
+                                         make_tree_learner)
+from lightgbm_tpu.ops.stream import (make_host_blocks,
+                                     resolve_stream_rows,
+                                     stream_supported)
+from lightgbm_tpu.utils import faultline, membudget
+from lightgbm_tpu.utils.compile_ledger import LEDGER
+
+# int16 everywhere: the streamed-vs-resident bitwise contract holds
+# for the integer histogram precisions (int32 partial sums are
+# associative); float precisions reassociate across the block seam
+_P = {"objective": "binary", "num_leaves": 7, "max_bin": 31,
+      "min_data_in_leaf": 5, "seed": 7, "verbosity": -1,
+      "tpu_block_rows": 256, "tpu_hist_precision": "int16"}
+
+
+def _data(n=1500, f=8, seed=3):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.3 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _model(bst):
+    # strip the parameters echo: [tpu_stream_mode: ...] legitimately
+    # differs between the two layouts of the same model
+    return bst.model_to_string(num_iteration=-1).split("\nparameters:")[0]
+
+
+def _train(params, X, y, rounds=5, **kw):
+    ds = lgb.Dataset(X, label=y, params=params)
+    return lgb.train(params, ds, num_boost_round=rounds,
+                     keep_training_booster=True, verbose_eval=False,
+                     **kw)
+
+
+@pytest.fixture
+def sync_resident(monkeypatch):
+    """Pin the resident reference to the sync train path (see module
+    docstring): the streamed layout always computes gradients on host,
+    so bitwise comparisons must hold the resident side to the same."""
+    monkeypatch.setattr(
+        gbdt_mod.GBDT, "_maybe_make_train_step",
+        lambda self: setattr(self, "_train_step", None))
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+def _learner_pair(X, y, stream_rows, seedp=None, **extra):
+    """A resident and a streamed learner over the same binned data."""
+    out = []
+    for mode in ("resident", "streamed"):
+        p = dict(_P, tpu_stream_mode=mode,
+                 tpu_stream_block_rows=stream_rows, **(extra or {}))
+        cfg = Config(p)
+        td = TrainingData.from_matrix(X, y, cfg)
+        cls = StreamedTreeLearner if mode == "streamed" else TPUTreeLearner
+        out.append(cls(cfg, td))
+    return out
+
+
+def _grow_once(learner, grad, hess):
+    import jax.numpy as jnp
+    _, leaf_ids, out = learner.train(jnp.asarray(grad), jnp.asarray(hess))
+    return np.asarray(out["records"]), np.asarray(leaf_ids)
+
+
+# ---------------------------------------------------------------------------
+# 1. bitwise streamed vs resident
+# ---------------------------------------------------------------------------
+class TestBitwise:
+    @pytest.mark.parametrize("precision", ["int8", "int16"])
+    def test_streamed_equals_resident_serial(self, sync_resident,
+                                             precision):
+        X, y = _data()
+        p = dict(_P, tpu_hist_precision=precision,
+                 tpu_stream_block_rows=512)
+        ref = _model(_train(dict(p, tpu_stream_mode="resident"), X, y))
+        got = _model(_train(dict(p, tpu_stream_mode="streamed"), X, y))
+        assert got == ref
+
+    def test_streamed_equals_resident_2shard_int8(self, sync_resident):
+        """The ISSUE 16 acceptance triangle: serial-streamed must match
+        the int8 2-shard resident run (which test_collective already
+        pins to serial-resident)."""
+        X, y = _data()
+        p = dict(_P, tpu_hist_precision="int8",
+                 tpu_quant_refit_leaves=False,
+                 tpu_stream_block_rows=512)
+        ref = _model(_train(dict(p, tpu_stream_mode="resident",
+                                 tree_learner="data", num_machines=2),
+                            X, y))
+        got = _model(_train(dict(p, tpu_stream_mode="streamed"), X, y))
+        assert got == ref
+
+    def test_streamed_refuses_sharded_learner(self):
+        X, y = _data(n=600)
+        p = dict(_P, tpu_stream_mode="streamed", tree_learner="data",
+                 num_machines=2)
+        cfg = Config(p)
+        td = TrainingData.from_matrix(X, y, cfg)
+        with pytest.raises(NotImplementedError, match="serial"):
+            StreamedTreeLearner(cfg, td)
+
+
+# ---------------------------------------------------------------------------
+# 2. block geometry
+# ---------------------------------------------------------------------------
+class TestBlockGeometry:
+    def test_partial_tail_block(self):
+        """n_pad not divisible by the stream width: the tail block is
+        shorter, and the accumulated histograms still match resident
+        bit for bit at the grower level."""
+        X, y = _data()
+        rng = np.random.default_rng(11)
+        grad = rng.normal(size=len(y)).astype(np.float32)
+        hess = np.abs(rng.normal(size=len(y))).astype(np.float32) + 0.1
+        res, stream = _learner_pair(X, y, stream_rows=1024)
+        widths = [b.shape[1] for b in stream._host_blocks]
+        assert len(widths) >= 2 and widths[-1] < widths[0]
+        assert sum(widths) == stream.n_pad
+        r1, l1 = _grow_once(res, grad, hess)
+        r2, l2 = _grow_once(stream, grad, hess)
+        assert np.array_equal(r1, r2)
+        assert np.array_equal(l1, l2)
+
+    def test_single_block_degenerate(self):
+        X, y = _data(n=600)
+        rng = np.random.default_rng(12)
+        grad = rng.normal(size=len(y)).astype(np.float32)
+        hess = np.abs(rng.normal(size=len(y))).astype(np.float32) + 0.1
+        res, stream = _learner_pair(X, y, stream_rows=10 ** 9)
+        assert stream._stream.nbs == 1
+        r1, l1 = _grow_once(res, grad, hess)
+        r2, l2 = _grow_once(stream, grad, hess)
+        assert np.array_equal(r1, r2)
+        assert np.array_equal(l1, l2)
+
+    def test_resolve_stream_rows_is_inner_block_multiple(self):
+        for cfg_rows, n_pad, inner in ((0, 8192, 512), (700, 8192, 512),
+                                       (512, 512, 512), (10 ** 9, 4096,
+                                                         1024)):
+            r = resolve_stream_rows(cfg_rows, n_pad, bytes_per_row=32,
+                                    inner_block=inner)
+            assert inner <= r <= n_pad
+            assert r % inner == 0
+        # budget-derived default: two slots must fit in 1/8 of budget
+        r = resolve_stream_rows(0, 1 << 20, bytes_per_row=64,
+                                inner_block=256,
+                                budget_bytes=256 * (1 << 20))
+        assert 2 * r * 64 <= (256 * (1 << 20)) // 8
+
+    def test_host_blocks_cover_matrix(self):
+        bins_t = np.arange(7 * 1280, dtype=np.uint8).reshape(7, 1280)
+        blocks = make_host_blocks(bins_t, 512)
+        assert [b.shape[1] for b in blocks] == [512, 512, 256]
+        assert all(b.flags["C_CONTIGUOUS"] for b in blocks)
+        assert np.array_equal(np.concatenate(blocks, axis=1), bins_t)
+
+    def test_stream_supported_blockers(self):
+        res, _ = _learner_pair(*_data(n=600), stream_rows=512)
+        ok = res.params
+        assert stream_supported(ok) is None
+        assert "categorical" in stream_supported(
+            ok._replace(has_cat=True))
+        assert stream_supported(ok._replace(has_bundles=True))
+        assert stream_supported(ok._replace(has_sparse=True))
+        assert stream_supported(ok._replace(has_cegb=True))
+        assert stream_supported(
+            ok._replace(feature_fraction_bynode=0.5))
+
+
+# ---------------------------------------------------------------------------
+# 3. GOSS block sampling
+# ---------------------------------------------------------------------------
+class TestGoss:
+    GOSS = dict(tpu_stream_goss_top=0.34, tpu_stream_goss_other=0.25,
+                tpu_stream_block_rows=256)
+
+    def test_rerun_is_deterministic(self):
+        X, y = _data()
+        p = dict(_P, tpu_stream_mode="streamed", **self.GOSS)
+        a = _model(_train(p, X, y))
+        b = _model(_train(p, X, y))
+        assert a == b
+
+    def test_goss_skips_blocks_and_stays_deterministic_at_learner(self):
+        X, y = _data()
+        rng = np.random.default_rng(13)
+        grad = rng.normal(size=len(y)).astype(np.float32)
+        hess = np.abs(rng.normal(size=len(y))).astype(np.float32) + 0.1
+        outs = []
+        for _ in range(2):
+            _, stream = _learner_pair(X, y, stream_rows=256,
+                                      tpu_stream_goss_top=0.34,
+                                      tpu_stream_goss_other=0.25)
+            outs.append(_grow_once(stream, grad, hess))
+            assert stream.stream_stats["blocks_skipped"] > 0
+        assert np.array_equal(outs[0][0], outs[1][0])
+        assert np.array_equal(outs[0][1], outs[1][1])
+
+    def test_invariant_under_double_buffer_knob(self):
+        """Double-buffering is a perf knob: it must not leak into the
+        sampled block set or the grown trees (the GOSS uniforms key on
+        global row indices, not on copy scheduling)."""
+        X, y = _data()
+        p = dict(_P, tpu_stream_mode="streamed", **self.GOSS)
+        a = _model(_train(dict(p, tpu_stream_double_buffer=True), X, y))
+        b = _model(_train(dict(p, tpu_stream_double_buffer=False), X, y))
+        assert a == b
+
+
+# ---------------------------------------------------------------------------
+# 4. layout selection + planner
+# ---------------------------------------------------------------------------
+class TestSelection:
+    def _cfg_td(self, X, y, **extra):
+        cfg = Config(dict(_P, **extra))
+        return cfg, TrainingData.from_matrix(X, y, cfg)
+
+    def test_auto_streams_over_budget(self):
+        X, y = _data()
+        cfg, td = self._cfg_td(X, y, tpu_stream_mode="auto",
+                               tpu_hbm_budget_bytes=2 * len(y))
+        assert membudget.select_layout(cfg, td) == "streamed"
+        assert isinstance(make_tree_learner(cfg, td),
+                          StreamedTreeLearner)
+
+    def test_auto_resident_under_budget(self):
+        X, y = _data()
+        cfg, td = self._cfg_td(X, y, tpu_stream_mode="auto",
+                               tpu_hbm_budget_bytes=1 << 32)
+        assert membudget.select_layout(cfg, td) == "resident"
+        assert isinstance(make_tree_learner(cfg, td), TPUTreeLearner)
+        assert not isinstance(make_tree_learner(cfg, td),
+                              StreamedTreeLearner)
+
+    def test_explicit_pins_and_validation(self):
+        X, y = _data(n=600)
+        cfg, td = self._cfg_td(X, y, tpu_stream_mode="streamed")
+        assert membudget.select_layout(cfg, td) == "streamed"
+        cfg, td = self._cfg_td(X, y, tpu_stream_mode="resident",
+                               tpu_hbm_budget_bytes=2 * len(y))
+        assert membudget.select_layout(cfg, td) == "resident"
+        cfg, _ = self._cfg_td(X, y)
+        cfg.params["tpu_stream_mode"] = "bogus"
+        with pytest.raises(ValueError, match="tpu_stream_mode"):
+            membudget.select_layout(cfg, td)
+
+    def test_config_blockers_force_resident(self):
+        X, y = _data(n=600)
+        cfg, td = self._cfg_td(X, y, tpu_stream_mode="auto",
+                               tpu_hbm_budget_bytes=2 * len(y),
+                               tree_learner="data", num_machines=2)
+        assert membudget.stream_config_blockers(cfg)
+        assert membudget.select_layout(cfg, td) == "resident"
+
+    def test_plan_training_swaps_matrix_for_slots(self):
+        X, y = _data()
+        cfg, td = self._cfg_td(X, y, tpu_stream_mode="streamed",
+                               tpu_stream_block_rows=512)
+        lr = make_tree_learner(cfg, td)
+        plan = membudget.plan_training(cfg, lr, 1)
+        assert "binned_matrix" not in plan.components
+        slots = plan.components["stream_slots"]
+        biggest = max(b.nbytes for b in lr._host_blocks)
+        assert slots == 2 * biggest
+
+
+# ---------------------------------------------------------------------------
+# 5. the ladder's final rung
+# ---------------------------------------------------------------------------
+class TestLadderDegrade:
+    def test_degrades_to_streaming_instead_of_exhausting(self):
+        """Six consecutive OOMs burn through every resident rung; the
+        final rung swaps the layout to streaming, the retry succeeds,
+        and training completes — no MemoryLadderExhausted."""
+        X, y = _data(n=800, f=6, seed=0)
+        p = dict(_P)
+        bst = Booster(params=p,
+                      train_set=lgb.Dataset(X, label=y, params=p))
+        bst.update()
+        faultline.arm("device_alloc", action="oom", times=6)
+        bst.update()
+        bst.update()
+        faultline.reset()
+        steps = bst._driver._mem_ladder.describe()
+        assert steps[-1] == "stream_layout"
+        assert str(bst._driver.config.tpu_stream_mode) == "streamed"
+        assert isinstance(bst._driver.learner, StreamedTreeLearner)
+        assert bst.current_iteration() == 3
+        assert np.isfinite(bst.predict(X[:8], raw_score=True)).all()
+
+
+# ---------------------------------------------------------------------------
+# 6. checkpoint / resume mid-streamed-run
+# ---------------------------------------------------------------------------
+class TestCheckpointResume:
+    def test_resume_mid_streamed_run_is_bitwise(self, tmp_path):
+        X, y = _data()
+        p = dict(_P, tpu_stream_mode="streamed",
+                 tpu_stream_block_rows=512)
+        base = _model(_train(p, X, y, rounds=6))
+        pc = dict(p, tpu_checkpoint_dir=str(tmp_path),
+                  tpu_checkpoint_interval=1)
+        _train(pc, X, y, rounds=3)
+        resumed = _train(pc, X, y, rounds=6, resume=True)
+        assert isinstance(resumed._driver.learner, StreamedTreeLearner)
+        assert _model(resumed) == base
+
+
+# ---------------------------------------------------------------------------
+# 7. compile discipline: no per-block retrace
+# ---------------------------------------------------------------------------
+class TestCompileLedger:
+    def test_bounded_programs_across_blocks_and_rounds(self):
+        """Per-block programs may see at most TWO operand shapes (the
+        full stream width and the partial tail); everything else is one
+        program.  Extra boosting rounds must add zero recompiles."""
+        X, y = _data()
+        p = dict(_P, tpu_stream_mode="streamed",
+                 tpu_stream_block_rows=512)
+        LEDGER.enable()
+        LEDGER.reset()
+        try:
+            bst = _train(p, X, y, rounds=3)
+            assert bst._driver.learner._stream.nbs >= 2
+            for site in ("stream.root_block", "stream.block_step",
+                         "stream.replay_block"):
+                assert LEDGER.n_programs(site) <= 2, site
+            for site in ("stream.prep", "stream.root_finish",
+                         "stream.round_head", "stream.round_update",
+                         "stream.finish"):
+                assert LEDGER.n_programs(site) <= 1, site
+            before = LEDGER.n_programs()
+            bst.update()
+            bst.update()
+            assert LEDGER.n_programs() == before
+        finally:
+            LEDGER.enable(False)
+            LEDGER.reset()
+
+
+# ---------------------------------------------------------------------------
+# 8. spans + overlap telemetry
+# ---------------------------------------------------------------------------
+class TestSpans:
+    def test_stream_spans_nest_under_hist_build_with_overlap(self):
+        X, y = _data()
+        p = dict(_P, tpu_stream_mode="streamed",
+                 tpu_stream_block_rows=512)
+        obs.configure(mode="trace")
+        obs.reset_events()
+        try:
+            _train(p, X, y, rounds=2)
+            evs = obs.events()
+        finally:
+            obs.configure(mode="off", trace_dir="")
+            obs.reset_events()
+        spans = [e for e in evs if e["kind"] == "span"]
+        blocks = [e for e in spans if e["name"] == "stream_block"]
+        h2d = [e for e in spans if e["name"] == "stream_h2d"]
+        assert blocks and h2d
+        assert all(e["tags"]["parent"] == "hist_build" for e in blocks)
+        assert any(e["tags"].get("streamed") for e in spans
+                   if e["name"] == "hist_build")
+        trees = [e for e in evs if e["kind"] == "event"
+                 and e["name"] == "stream_tree"]
+        assert trees
+        assert any(t["tags"]["overlap_pct"] > 0 for t in trees)
+        assert all(t["tags"]["rows_per_sec"] > 0 for t in trees)

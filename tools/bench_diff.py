@@ -79,6 +79,13 @@ METRICS = {
     # scale, so the slack is wide — the hard bound lives in the
     # telemetry off-overhead test, this just tracks the trend
     "drift_overhead_pct": (-1, 1.00),
+    # out-of-core streaming (ISSUE 16): throughput at 4x the resident
+    # cap, and the fraction of H2D copy wall hidden behind histogram
+    # work.  Both noisy on CPU rounds (copy/compute ratio is nothing
+    # like the PCIe/ICI one), hence wide slack; the hard guarantees
+    # (bitwise models, bounded programs) live in tests/test_stream.py
+    "stream_rows_per_sec": (+1, 0.35),
+    "stream_overlap_pct": (+1, 0.50),
 }
 
 

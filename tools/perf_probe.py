@@ -966,6 +966,62 @@ def run_drift_probe(n=20000, reps=30):
         sess.close()
 
 
+def run_stream_sweep(n=200_000, f=28, iters=5, leaves=63, bins=255):
+    """Out-of-core streaming sweep (ISSUE 16): stream block rows x
+    double-buffering x GOSS fractions.  Prints the H2D copy wall beside
+    the histogram wall and the achieved overlap ratio — the number the
+    double-buffer exists to maximize.  GOSS rows show how much copy
+    traffic gradient-based block sampling removes (its models are NOT
+    bitwise vs the full stream; the bitwise rows are goss=off)."""
+    import lightgbm_tpu as lgb
+
+    X, y = make_data(n, f=f)
+    block_rows = [int(s) for s in
+                  os.environ.get("STREAM_ROWS", "16384,65536,262144")
+                  .split(",")]
+    goss = [(0.0, 0.0), (0.2, 0.1)]
+    print(f"streamed training: n={n} f={f} iters={iters} "
+          f"leaves={leaves} bins={bins}")
+    print(f"{'rows/block':>10} {'dbuf':>5} {'goss':>9} {'ms/tree':>9} "
+          f"{'h2d_ms':>8} {'hist_ms':>8} {'overlap':>8} "
+          f"{'skip':>5} {'Mrows/s':>8}")
+    for rows in block_rows:
+        for dbuf in (True, False):
+            for top, other in goss:
+                p = {"objective": "binary", "num_leaves": leaves,
+                     "max_bin": bins, "verbosity": -1,
+                     "tpu_stream_mode": "streamed",
+                     "tpu_stream_block_rows": rows,
+                     "tpu_stream_double_buffer": dbuf,
+                     "tpu_stream_goss_top": top,
+                     "tpu_stream_goss_other": other}
+                ds = lgb.Dataset(X, label=y, params=p)
+                bst = lgb.Booster(params=p, train_set=ds)
+                bst.update()                    # warm compiles
+                tot = dict(tree=0.0, h2d=0.0, hist=0.0, est=0.0,
+                           hidden=0.0, skip=0.0)
+                for _ in range(iters):
+                    bst.update()
+                    s = bst._driver.learner.stream_stats
+                    tot["tree"] += s["tree_wall_s"]
+                    tot["h2d"] += s["h2d_wall_s"]
+                    tot["hist"] += s["hist_wall_s"]
+                    tot["est"] += s["copy_est_s"]
+                    tot["hidden"] += (s["overlap_pct"] / 100.0
+                                      * s["copy_est_s"])
+                    tot["skip"] += s["blocks_skipped"]
+                overlap = (100.0 * tot["hidden"] / tot["est"]
+                           if tot["est"] else 0.0)
+                gs = f"{top}/{other}" if top else "off"
+                mrows = n * iters / tot["tree"] / 1e6
+                print(f"{rows:>10} {str(dbuf):>5} {gs:>9} "
+                      f"{tot['tree'] / iters * 1e3:>9.1f} "
+                      f"{tot['h2d'] / iters * 1e3:>8.1f} "
+                      f"{tot['hist'] / iters * 1e3:>8.1f} "
+                      f"{overlap:>7.1f}% "
+                      f"{tot['skip'] / iters:>5.1f} {mrows:>8.2f}")
+
+
 def main():
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
     if arg == "drift":
@@ -979,6 +1035,13 @@ def main():
             return
         run_faults(n=int(os.environ.get("N", 4000)),
                    iters=int(os.environ.get("ITERS", 5)))
+        return
+    if arg == "stream":
+        run_stream_sweep(n=int(os.environ.get("N", 200_000)),
+                         f=int(os.environ.get("F", 28)),
+                         iters=int(os.environ.get("ITERS", 5)),
+                         leaves=int(os.environ.get("LEAVES", 63)),
+                         bins=int(os.environ.get("BINS", 255)))
         return
     if arg == "mem":
         run_mem(n=int(os.environ.get("N", 20000)),
