@@ -50,6 +50,8 @@ class Application:
             self.convert_model()
         elif task in ("serve", "serving"):
             self.serve()
+        elif task == "continual":
+            self.continual()
         else:
             raise ValueError(f"unknown task {task!r}")
 
@@ -74,6 +76,55 @@ class Application:
               "(POST /predict, POST /load, POST /drain, GET /stats, "
               "GET /models; SIGTERM drains)")
         serve_forever(session, str(cfg.serving_host), int(cfg.serving_port))
+
+    # ------------------------------------------------------------------
+    def continual(self) -> None:
+        """task=continual: serve input_model over HTTP AND run the
+        train-behind-serve loop (lightgbm_tpu/continual) against it —
+        drift / row-count / cadence triggers retrain, the shadow gate
+        promotes or refuses, `lgbm_continual_*` metrics ride the
+        session's /metrics scrape.  An optional `data=<file>` labeled
+        stream pre-feeds the ingest buffer (the offline stand-in for a
+        production label join); production callers push labeled batches
+        through `ContinualController.observe`."""
+        from .continual import ContinualController
+        from .serving import ServingSession
+        from .serving.server import serve_http
+
+        cfg = self.config
+        if not cfg.input_model:
+            raise ValueError("continual needs input_model=<file>")
+        session = ServingSession(params=dict(self.raw_params))
+        name = str(cfg.serving_model_name)
+        session.load(name, model_file=str(cfg.input_model),
+                     params=dict(self.raw_params))
+        server = serve_http(session, str(cfg.serving_host),
+                            int(cfg.serving_port))
+        ctl = ContinualController(session, name,
+                                  params=dict(self.raw_params))
+        if cfg.data:
+            from .io.parser import load_text_file
+
+            X, y, _, _, _, _ = load_text_file(
+                str(cfg.data), label_column=str(cfg.label_column or ""))
+            chunk = max(int(cfg.tpu_ingest_chunk_rows), 1)
+            for lo in range(0, len(X), chunk):
+                ctl.observe(X[lo:lo + chunk], y[lo:lo + chunk])
+            print(f"[lightgbm_tpu] continual buffer pre-fed "
+                  f"{ctl.buffer.rows} labeled rows from {cfg.data}")
+        port = server.server_address[1]
+        print(f"[lightgbm_tpu] continual loop behind {name} on "
+              f"http://{cfg.serving_host}:{port} — triggers: psi_warn"
+              f" / {ctl.buffer.retain_rows} rows / "
+              f"{float(cfg.tpu_continual_interval_s):g}s cadence; "
+              "lgbm_continual_* on GET /metrics; ^C stops")
+        try:
+            ctl.run()
+        except KeyboardInterrupt:  # pragma: no cover - operator stop
+            pass
+        finally:
+            ctl.stop()
+            server.shutdown()
 
     # ------------------------------------------------------------------
     def convert_model(self) -> None:
@@ -197,5 +248,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # `python -m lightgbm_tpu serve ...` sugar for task=serve
     if argv[0] in ("serve", "serving"):
         argv = ["task=serve"] + list(argv[1:])
+    # `python -m lightgbm_tpu continual ...` sugar for task=continual
+    elif argv[0] == "continual":
+        argv = ["task=continual"] + list(argv[1:])
     Application(argv).run()
     return 0

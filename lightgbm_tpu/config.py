@@ -368,6 +368,46 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # bins of the profile's raw-score histogram (equal-width over the
     # end-of-training score range)
     "tpu_profile_score_bins": ("int", 32, ()),
+    # --- continual learning (lightgbm_tpu/continual, ISSUE 17) ---
+    # bounded retention window of the incremental ingest buffer: once
+    # buffered rows exceed it, the OLDEST binned blocks are evicted
+    # (the buffer is a sliding window over the live stream, not an
+    # unbounded accumulator)
+    "tpu_continual_buffer_rows": ("int", 262144, ()),
+    # row-count retrain trigger: a retrain fires once this many fresh
+    # rows have accumulated since the last one (0 = off)
+    "tpu_continual_min_rows": ("int", 4096, ()),
+    # wall-clock retrain cadence in seconds (0 = off)
+    "tpu_continual_interval_s": ("float", 0.0, ()),
+    # retrain policy: auto (drift trigger -> boost-K / re-sketch
+    # escalation, row-count & cadence triggers -> leaf refit), or pin
+    # one of refit | boost | resketch
+    "tpu_continual_policy": ("str", "auto", ()),
+    # K extra boosting rounds per warm-continue (init_model) retrain
+    "tpu_continual_boost_rounds": ("int", 10, ()),
+    # leaf-refit blend: new leaf = decay*old + (1-decay)*refit
+    "tpu_continual_refit_decay": ("float", 0.9, ()),
+    # shadow gate tolerance: promote iff candidate_loss <=
+    # live_loss * (1 + tolerance) on the mirrored sample
+    "tpu_continual_tolerance": ("float", 0.0, ()),
+    # GOSS-style freshness weighting of buffered blocks in the boost-K
+    # training set: a block's weight decays by this factor per
+    # RETENTION-WINDOW age step (newest block = 1.0); 1.0 = unweighted
+    "tpu_continual_fresh_decay": ("float", 0.7, ()),
+    # re-sketch escalation threshold: when the drift trigger fires AND
+    # at least this fraction of buffered rows landed in a feature's
+    # overflow/tail bin, the binning itself is stale — the policy
+    # escalates to a full re-sketch retrain instead of reusing the
+    # frozen mappers
+    "tpu_continual_resketch_tail_frac": ("float", 0.25, ()),
+    # rows of mirrored live traffic the shadow gate scores a candidate
+    # on before the promote/refuse verdict
+    "tpu_continual_shadow_rows": ("int", 2048, ()),
+    # controller state + mid-retrain checkpoints (PR-7 manager) land
+    # here so a killed controller resumes; "" = stateless (no resume)
+    "tpu_continual_dir": ("str", "", ()),
+    # seconds between controller trigger polls in the run_forever loop
+    "tpu_continual_poll_s": ("float", 10.0, ()),
     # --- objective ---
     "num_class": ("int", 1, ("num_classes",)),
     "is_unbalance": ("bool", False, ("unbalance", "unbalanced_sets")),

@@ -1,0 +1,28 @@
+"""Continual learning (ISSUE 17): the drift-triggered train-behind-serve
+loop that closes live traffic back into training and out to serving with
+zero downtime.
+
+Four pieces compose substrate shipped by earlier PRs:
+
+* `buffer`   — incremental ingest: streaming rows bin through the FROZEN
+  training mappers (PR-3 chunked ingest kernel) into PR-16 `[G, rows]`
+  host blocks with a bounded retention window.
+* `trainer`  — retrain policies: leaf refit vs boost-K-more-trees (warm
+  `init_model` continue), fired by psi_warn / row-count / cadence
+  triggers, checkpointed through the PR-7 manager.
+* `promote`  — shadow-gated promotion: candidate loads under a shadow
+  name (PR-15 budget preflight or DEFER), `shadow_verdict()` scores it
+  on mirrored traffic, the bare-name alias swaps atomically, and a
+  refuse/breaker/drift regression auto-rolls back.
+* `controller` — the long-running driver `python -m lightgbm_tpu
+  continual` wires to a serving session, with `lgbm_continual_*`
+  metrics and faultline points at every stage boundary.
+"""
+
+from .buffer import RowBuffer
+from .controller import ContinualController
+from .promote import promote_candidate, shadow_verdict
+from .trainer import ContinualTrainer
+
+__all__ = ["RowBuffer", "ContinualTrainer", "ContinualController",
+           "promote_candidate", "shadow_verdict"]

@@ -1,0 +1,252 @@
+"""Incremental ingest buffer: streaming rows -> binned row blocks.
+
+Rows arriving from live traffic accumulate through the FROZEN training
+bin mappers (the model's ``tpu_bin_mappers:`` snapshot) via the PR-3
+chunked ingest kernel (`ops/binning.py DeviceBinner`), falling back to
+host per-column binning when the kernel declines the mapper set.  Each
+ingest lands one transposed C-contiguous ``[G, rows]`` block — the PR-16
+out-of-core block layout — so `host_blocks()` feeds the stream grower
+(or any block consumer) without a relayout.
+
+The buffer is a bounded SLIDING WINDOW (`tpu_continual_buffer_rows`):
+oldest blocks evict as new ones land, so a long-running controller's
+memory is flat regardless of stream length.  Raw rows + labels ride
+beside the bins because both retrain paths consume raw values (leaf
+refit re-predicts leaves; a boost-K Dataset re-bins through a reference
+or re-sketches).
+
+Re-sketch escalation: binning through frozen mappers saturates when the
+live distribution walks off the training range — drifted values pile
+into each feature's overflow/tail bin.  `tail_fraction()` tracks the
+worst per-feature fraction of buffered rows landing in the last bin;
+the policy engine escalates a drift-triggered retrain to a full
+re-sketch when it crosses `tpu_continual_resketch_tail_frac` (high PSI
+concentrated in tail bins means the MAPPERS are stale, not just the
+occupancy).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils import faultline, lockcheck
+
+
+class _Block:
+    """One ingested block: binned [G, rows] + the raw rows behind it."""
+
+    __slots__ = ("bins_t", "X", "y", "tail", "seq")
+
+    def __init__(self, bins_t: np.ndarray, X: np.ndarray,
+                 y: Optional[np.ndarray], tail: np.ndarray, seq: int):
+        self.bins_t = bins_t    # [G, rows] C-contiguous (PR-16 layout)
+        self.X = X              # [rows, F] raw f64
+        self.y = y              # [rows] labels (None = unlabeled)
+        self.tail = tail        # [G] rows landing in each feature's last bin
+        self.seq = seq
+
+    @property
+    def rows(self) -> int:
+        return int(self.X.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.bins_t.nbytes + self.X.nbytes
+                   + (self.y.nbytes if self.y is not None else 0))
+
+
+class RowBuffer:
+    """Bounded binned-row window behind one served model.
+
+    Thread-safe: `ingest` may run on a traffic-mirroring thread while
+    the retrain side reads `raw()`/`host_blocks()` — all mutable state
+    is guarded by `_lock` (graftlint C301 OWNERSHIP).  The expensive
+    work (binning) runs OUTSIDE the lock; only list/counter updates
+    hold it.
+    """
+
+    def __init__(self, booster, config: Optional[Config] = None):
+        cfg = config if config is not None else Config({})
+        drv = booster._driver
+        drv._materialize()
+        ctx = drv._pred_context()
+        if ctx is None:
+            raise ValueError(
+                "continual buffer needs the model's bin-mapper snapshot "
+                "(tpu_bin_mappers: trailer) — the FROZEN training "
+                "binning is what incremental ingest bins through")
+        self._mappers = ctx.mappers
+        self._used = [int(c) for c in ctx.used_feature_idx]
+        self.num_feature = int(booster.num_feature())
+        max_bin = max((self._mappers[c].num_bin for c in self._used),
+                      default=2)
+        self._dtype = np.uint8 if max_bin <= 256 else np.uint16
+        from ..ops.binning import DeviceBinner
+
+        # PR-3 chunked ingest kernel; None (huge categorical LUTs) falls
+        # back to exact host per-column binning — same bins either way
+        self._binner = DeviceBinner.build(
+            self._mappers, self._used, self._dtype,
+            int(cfg.tpu_ingest_chunk_rows))
+        self.retain_rows = max(int(cfg.tpu_continual_buffer_rows), 1)
+        self._lock = lockcheck.make_lock("continual.buffer")
+        # guarded by _lock:
+        self._blocks: List[_Block] = []
+        self._rows = 0
+        self._seq = 0
+        self._ingested_total = 0
+        self._evicted_total = 0
+
+    # -- ingest --------------------------------------------------------
+    def ingest(self, X, y=None) -> int:
+        """Bin + buffer one batch of streaming rows; returns the rows
+        accepted.  Oldest blocks evict past the retention window."""
+        X = np.ascontiguousarray(np.atleast_2d(
+            np.asarray(X, np.float64)))
+        if X.shape[0] == 0:
+            return 0
+        if X.shape[1] != self.num_feature:
+            raise ValueError(
+                f"ingest row width {X.shape[1]} != model feature count "
+                f"{self.num_feature}")
+        yv = None
+        if y is not None:
+            yv = np.asarray(y, np.float64).ravel()
+            if yv.size != X.shape[0]:
+                raise ValueError(
+                    f"{yv.size} labels for {X.shape[0]} rows")
+        faultline.fire("continual_ingest", rows=int(X.shape[0]))
+        bins = self._bin(X)                       # [rows, G]
+        bins_t = np.ascontiguousarray(bins.T)     # [G, rows] block layout
+        tail = np.empty(len(self._used), np.int64)
+        for j, c in enumerate(self._used):
+            tail[j] = int((bins[:, j] ==
+                           self._mappers[c].num_bin - 1).sum())
+        with self._lock:
+            self._seq += 1
+            self._blocks.append(_Block(bins_t, X, yv, tail, self._seq))
+            self._rows += int(X.shape[0])
+            self._ingested_total += int(X.shape[0])
+            while self._rows > self.retain_rows and len(self._blocks) > 1:
+                gone = self._blocks.pop(0)
+                self._rows -= gone.rows
+                self._evicted_total += gone.rows
+        self._publish()
+        return int(X.shape[0])
+
+    def _bin(self, X: np.ndarray) -> np.ndarray:
+        if self._binner is not None:
+            return np.asarray(self._binner.bin_matrix(X))
+        out = np.empty((X.shape[0], len(self._used)), self._dtype)
+        for j, c in enumerate(self._used):
+            out[:, j] = self._mappers[c].values_to_bins(
+                np.ascontiguousarray(X[:, c])).astype(self._dtype,
+                                                      copy=False)
+        return out
+
+    def _publish(self) -> None:
+        from ..obs import REGISTRY
+
+        with self._lock:
+            rows, nbytes = self._rows, sum(b.nbytes for b in self._blocks)
+        REGISTRY.set_gauge("lgbm_continual_buffer_rows", rows,
+                           help="rows resident in the continual ingest "
+                                "buffer (bounded retention window)")
+        REGISTRY.set_gauge("lgbm_continual_buffer_bytes", nbytes,
+                           help="host bytes (bins + raw rows + labels) "
+                                "of the continual ingest buffer")
+
+    # -- reads ---------------------------------------------------------
+    @property
+    def rows(self) -> int:
+        with self._lock:
+            return self._rows
+
+    @property
+    def nbytes(self) -> int:
+        with self._lock:
+            return sum(b.nbytes for b in self._blocks)
+
+    @property
+    def ingested_total(self) -> int:
+        """Monotone rows-ever-ingested counter (the row-count trigger
+        diffs it; window eviction never rewinds it)."""
+        with self._lock:
+            return self._ingested_total
+
+    def tail_fraction(self) -> float:
+        """Worst per-feature fraction of buffered rows sitting in that
+        feature's overflow/tail bin — the re-sketch escalation signal
+        (drifted values saturate the frozen mappers' last bins)."""
+        with self._lock:
+            if not self._blocks or self._rows == 0:
+                return 0.0
+            tails = np.sum([b.tail for b in self._blocks], axis=0)
+            rows = self._rows
+        return float(tails.max()) / float(rows) if tails.size else 0.0
+
+    def host_blocks(self, stream_rows: Optional[int] = None
+                    ) -> List[np.ndarray]:
+        """Buffered bins as C-contiguous [G, rows] blocks (the PR-16
+        out-of-core unit).  Default: one block per ingest batch; pass
+        `stream_rows` to re-partition into stream-grower-width blocks
+        (ops/stream.make_host_blocks semantics)."""
+        with self._lock:
+            blocks = [b.bins_t for b in self._blocks]
+        if stream_rows is None or not blocks:
+            return blocks
+        from ..ops.stream import make_host_blocks
+
+        bins_t = blocks[0] if len(blocks) == 1 else \
+            np.concatenate(blocks, axis=1)
+        return make_host_blocks(bins_t, int(stream_rows))
+
+    def raw(self, fresh_decay: float = 1.0
+            ) -> Tuple[np.ndarray, Optional[np.ndarray], np.ndarray]:
+        """(X, y, weight) across the window, newest-last.  `weight` is
+        the GOSS-style freshness weighting: the newest block weighs 1.0
+        and each older block decays by `fresh_decay` — incremental
+        rounds lean toward fresh traffic without discarding the tail
+        (the small-gradient analog of GOSS's amplified 'other' sample).
+        y is None when ANY buffered block arrived unlabeled."""
+        with self._lock:
+            blocks = list(self._blocks)
+        if not blocks:
+            return (np.zeros((0, self.num_feature)), None, np.zeros(0))
+        X = np.concatenate([b.X for b in blocks], axis=0)
+        y = None
+        if all(b.y is not None for b in blocks):
+            y = np.concatenate([b.y for b in blocks])
+        decay = min(max(float(fresh_decay), 0.0), 1.0)
+        ages = range(len(blocks) - 1, -1, -1)   # oldest first -> max age
+        w = np.concatenate([
+            np.full(b.rows, decay ** age, np.float64)
+            for b, age in zip(blocks, ages)])
+        return X, y, w
+
+    def reference_data(self) -> object:
+        """A mapper-only `TrainingData` shim usable as a Dataset
+        binning reference: a boost-K continue built against it bins its
+        rows through the SAME frozen mappers this buffer ingests
+        through (`_adopt_reference_mappers` reads exactly these
+        fields)."""
+        from ..io.dataset import TrainingData
+
+        ref = TrainingData()
+        ref.mappers = self._mappers
+        ref.used_feature_idx = list(self._used)
+        ref.num_total_features = self.num_feature
+        return ref
+
+    def drain(self) -> int:
+        """Drop every buffered block (after a successful re-sketch the
+        old window described the OLD binning); returns rows dropped."""
+        with self._lock:
+            dropped = self._rows
+            self._blocks = []
+            self._rows = 0
+        self._publish()
+        return dropped

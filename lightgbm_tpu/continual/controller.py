@@ -1,0 +1,245 @@
+"""The train-behind-serve loop: one controller per served model name.
+
+`observe(X, y)` is the traffic mirror — every labeled batch lands in
+the ingest buffer.  `step()` is one control cycle: scrape drift, check
+triggers, retrain, shadow-gate, promote (or defer/refuse), and watch a
+fresh promotion for regressions.  `run()` loops `step()` on the
+`tpu_continual_poll_s` cadence until stopped.
+
+Failure containment is the controller's core contract: a collective
+timeout inside a retrain, a device OOM during the candidate load, a
+refused shadow, an injected fault at any `continual_*` faultline point
+— each ends THAT cycle (counted in `lgbm_continual_deferred_total` or
+the refusal counter, flight-recorded) and the loop lives; accepted
+serving requests never see an error from the train-behind side.
+
+Metrics (process-global obs registry, so they ride the serving
+session's `/metrics` scrape):
+
+* `lgbm_continual_retrains_total{trigger,policy}` — retrains fired
+* `lgbm_continual_promotions_total` / `_refusals_total` /
+  `_rollbacks_total` / `_deferred_total{reason}`
+* `lgbm_continual_buffer_rows` / `_bytes` — ingest window (buffer.py)
+* `lgbm_continual_swap_seconds` — alias-flip gap histogram
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from ..config import Config
+from ..utils import faultline, membudget
+from .buffer import RowBuffer
+from .promote import promote_candidate, rollback
+from .trainer import ContinualTrainer
+
+# alias-flip gap: a dict write under the registry lock — single-digit
+# microseconds healthy, milliseconds means lock contention
+_SWAP_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0)
+
+# how many post-promote cycles a fresh candidate stays on watch:
+# breaker-open or re-warned drift inside the window auto-rolls back
+_WATCH_STEPS = 3
+
+
+class ContinualController:
+    """Drift-triggered retrain + shadow-gated promotion for one model."""
+
+    def __init__(self, session, name: str,
+                 config: Optional[Config] = None,
+                 params: Optional[Dict] = None):
+        self.session = session
+        self.registry = session.registry
+        self.name = str(name)
+        self.cfg = config if config is not None else session.config
+        live = self.registry.resolve(self.name)   # must already serve
+        self.buffer = RowBuffer(live.booster, self.cfg)
+        self.trainer = ContinualTrainer(self.buffer, self.cfg, params)
+        self._lock = threading.Lock()
+        # guarded by _lock (graftlint C301): post-promote watch state
+        self._watch: Optional[Dict] = None
+        self._stop = threading.Event()
+
+    # -- ingest (the traffic mirror) -----------------------------------
+    def observe(self, X, y=None) -> int:
+        """Mirror one batch of live traffic (with labels when the join
+        has them) into the retrain window."""
+        return self.buffer.ingest(X, y)
+
+    # -- one control cycle ---------------------------------------------
+    def step(self) -> Dict:
+        """Run one cycle; returns a status dict (`status` in idle /
+        retrained+promoted / refused / deferred / rolled_back /
+        watching).  NEVER raises: every failure mode folds into a
+        counted, flight-recorded deferral so the loop survives."""
+        try:
+            return self._step_inner()
+        except Exception as exc:  # noqa: BLE001 — containment boundary
+            self._count_deferred(type(exc).__name__)
+            from ..obs import flightrecorder
+
+            flightrecorder.note("continual", "cycle_error",
+                                model=self.name,
+                                error=f"{type(exc).__name__}: "
+                                      f"{str(exc)[:200]}")
+            return {"status": "deferred", "reason": str(exc)}
+
+    def _step_inner(self) -> Dict:
+        rolled = self._watch_promoted()
+        if rolled is not None:
+            return rolled
+        warn = self._drift_warn_active()
+        trigger = self.trainer.pending_trigger(warn)
+        if trigger is None:
+            return {"status": "idle", "drift_warn": warn,
+                    "buffer_rows": self.buffer.rows}
+        live = self.registry.resolve(self.name)
+        try:
+            cand, policy = self.trainer.retrain(live.booster, trigger)
+        except (ValueError, membudget.ServingMemoryExhausted,
+                faultline.FaultInjected) as exc:
+            self._count_deferred("retrain_failed")
+            return {"status": "deferred", "trigger": trigger,
+                    "reason": str(exc)}
+        except Exception as exc:
+            # collective timeout, device loss, ... — the retrain side
+            # died; serving never noticed
+            self._count_deferred(type(exc).__name__)
+            return {"status": "deferred", "trigger": trigger,
+                    "reason": str(exc)}
+        self._metric("lgbm_continual_retrains_total", trigger=trigger,
+                     policy=policy,
+                     help="continual retrains fired, by trigger and "
+                          "retrain policy")
+        Xs, ys = self._shadow_sample()
+        res = promote_candidate(self.registry, self.name, cand, Xs, ys,
+                                tolerance=float(
+                                    self.cfg.tpu_continual_tolerance))
+        out = {"status": res["status"], "trigger": trigger,
+               "policy": policy}
+        if res["status"] == "deferred":
+            self._count_deferred("candidate_load")
+            out["reason"] = res.get("reason", "")
+        elif res["status"] == "refused":
+            self._metric("lgbm_continual_refusals_total",
+                         help="shadow-gate refusals (candidate scored "
+                              "worse than live)")
+            out["verdict"] = res["verdict"]
+        else:  # promoted
+            self._metric("lgbm_continual_promotions_total",
+                         help="shadow-gated promotions (bare-name alias "
+                              "flips)")
+            from ..obs import REGISTRY
+
+            REGISTRY.observe("lgbm_continual_swap_seconds",
+                             float(res["swap_seconds"]),
+                             buckets=_SWAP_BUCKETS)
+            with self._lock:
+                self._watch = {"prev_key": res["prev_key"],
+                               "shadow_key": res["shadow_key"],
+                               "steps": _WATCH_STEPS}
+            if policy == "resketch":
+                # the promoted model carries FRESH mappers; rebuild the
+                # ingest window so it bins through them (the old window
+                # described the old binning)
+                promoted = self.registry.resolve(self.name)
+                self.buffer = RowBuffer(promoted.booster, self.cfg)
+                self.trainer.buffer = self.buffer
+            out.update(verdict=res["verdict"],
+                       swap_seconds=res["swap_seconds"])
+        return out
+
+    # -- internals -----------------------------------------------------
+    def _drift_warn_active(self) -> bool:
+        """Scrape-then-poll: `session.drift()` absorbs pending sampled
+        traffic (the dispatch tap only stashes), then the live entry's
+        monitor answers whether PSI sits at/above the warn line."""
+        self.session.drift()
+        try:
+            entry = self.registry.resolve(self.name)
+        except KeyError:
+            return False
+        mon = getattr(entry, "drift", None)
+        return bool(mon is not None and mon.warn_active())
+
+    def _watch_promoted(self) -> Optional[Dict]:
+        """Post-promote regression watch: a just-promoted candidate
+        whose breaker opens or whose drift re-warns inside the watch
+        window rolls back to the displaced version."""
+        with self._lock:
+            watch = self._watch
+        if watch is None:
+            return None
+        try:
+            entry = self.registry.resolve(self.name)
+        except KeyError:
+            with self._lock:
+                self._watch = None
+            return None
+        if entry.key != watch["shadow_key"]:
+            # operator moved the alias themselves; stand down
+            with self._lock:
+                self._watch = None
+            return None
+        reason = None
+        if not entry.healthy:
+            reason = "breaker_open"
+        else:
+            self.session.drift()
+            mon = getattr(entry, "drift", None)
+            if mon is not None and mon.warn_active():
+                reason = "drift_regression"
+        if reason is None:
+            with self._lock:
+                watch["steps"] -= 1
+                if watch["steps"] <= 0:
+                    self._watch = None
+            return None
+        rollback(self.registry, self.name, watch["prev_key"],
+                 watch["shadow_key"], reason)
+        self._metric("lgbm_continual_rollbacks_total",
+                     help="post-promote auto-rollbacks (breaker open or "
+                          "drift regression inside the watch window)")
+        with self._lock:
+            self._watch = None
+        return {"status": "rolled_back", "reason": reason}
+
+    def _shadow_sample(self):
+        """Newest buffered rows (mirrored live traffic) as the shadow
+        scoring sample — the candidate is judged on what traffic looks
+        like NOW."""
+        X, y, _w = self.buffer.raw()
+        n = max(int(self.cfg.tpu_continual_shadow_rows), 1)
+        if X.shape[0] > n:
+            X = X[-n:]
+            y = y[-n:] if y is not None else None
+        return X, y
+
+    def _count_deferred(self, reason: str) -> None:
+        self._metric("lgbm_continual_deferred_total", reason=reason,
+                     help="continual cycles that ended without a "
+                          "promotion attempt completing, by reason")
+
+    def _metric(self, name: str, help: str = "", **labels) -> None:
+        from ..obs import REGISTRY
+
+        REGISTRY.inc(name, 1, help=help, **labels)
+
+    # -- the long-running loop -----------------------------------------
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, max_cycles: Optional[int] = None) -> int:
+        """Loop `step()` on the poll cadence until `stop()` (or
+        `max_cycles`); returns cycles run."""
+        poll = max(float(self.cfg.tpu_continual_poll_s), 0.05)
+        cycles = 0
+        while not self._stop.is_set():
+            self.step()
+            cycles += 1
+            if max_cycles is not None and cycles >= max_cycles:
+                break
+            self._stop.wait(poll)
+        return cycles

@@ -1,0 +1,194 @@
+"""Shadow-gated zero-downtime promotion.
+
+A retrained candidate never serves blind: it loads into the registry
+under a SHADOW name (`<name>.shadow`) beside the live model — the PR-15
+HBM planner must clear the joint residency first, or the attempt is
+DEFERRED rather than OOM-crashed — then `shadow_verdict()` scores both
+models on the same mirrored live sample.  Only a promote verdict flips
+the bare-name alias (`ModelRegistry.promote`, one dict write under the
+registry lock), so in-flight requests finish on whichever entry they
+resolved and no request is dropped or double-answered.  A refuse, an
+open breaker, or a post-promote drift regression rolls the alias back
+the same way and flight-records the event.
+
+`shadow_verdict` is the SINGLE implementation of the promotion gate:
+`tools/model_report.py --shadow` (the operator CLI) and the continual
+controller both import it, so the offline verdict and the automated one
+can never disagree.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..utils import faultline, membudget
+
+
+# ---------------------------------------------------------------------------
+# the verdict (shared with tools/model_report.py --shadow)
+# ---------------------------------------------------------------------------
+def _loss(booster, X: np.ndarray, y: np.ndarray) -> Tuple[str, float]:
+    """(metric name, loss) — binary logloss for binary objectives,
+    mean squared error otherwise.  Lower is better for both."""
+    obj = str(booster._driver.loaded_params.get(
+        "objective", "") or (booster._driver.objective.to_model_string()
+                             if booster._driver.objective else ""))
+    pred = np.asarray(booster.predict(X), np.float64)
+    if obj.startswith("binary"):
+        p = np.clip(pred, 1e-15, 1.0 - 1e-15)
+        return "binary_logloss", float(
+            -np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
+    if pred.ndim > 1:  # multiclass: negative log-likelihood of y class
+        p = np.clip(pred[np.arange(len(y)), y.astype(int)], 1e-15, 1.0)
+        return "multi_logloss", float(-np.mean(np.log(p)))
+    return "l2", float(np.mean((pred - y) ** 2))
+
+
+def shadow_verdict(live, candidate, X: np.ndarray,
+                   y: Optional[np.ndarray] = None,
+                   tolerance: float = 0.0) -> Dict:
+    """Score candidate vs live on the same sample.  Returns the
+    prediction-delta distribution and — with labels — the promote/
+    refuse verdict: promote iff candidate_loss <= live_loss *
+    (1 + tolerance)."""
+    X = np.ascontiguousarray(np.atleast_2d(np.asarray(X, np.float64)))
+    pl = np.asarray(live.predict(X, raw_score=True), np.float64)
+    pc = np.asarray(candidate.predict(X, raw_score=True), np.float64)
+    delta = np.abs(pc - pl).ravel()
+    out: Dict = {
+        "rows": int(X.shape[0]),
+        "delta": {
+            "mean": float(delta.mean()) if delta.size else 0.0,
+            "p50": float(np.percentile(delta, 50)) if delta.size else 0.0,
+            "p95": float(np.percentile(delta, 95)) if delta.size else 0.0,
+            "max": float(delta.max()) if delta.size else 0.0,
+        },
+    }
+    if y is None:
+        out["verdict"] = "no-labels"
+        out["reason"] = ("sample carries no labels; delta distribution "
+                         "only — pass labeled data for a promote/refuse "
+                         "verdict")
+        return out
+    y = np.asarray(y, np.float64).ravel()
+    metric, live_loss = _loss(live, X, y)
+    _, cand_loss = _loss(candidate, X, y)
+    out["metric"] = metric
+    out["live_loss"] = live_loss
+    out["candidate_loss"] = cand_loss
+    out["tolerance"] = float(tolerance)
+    promote = (math.isfinite(cand_loss)
+               and cand_loss <= live_loss * (1.0 + float(tolerance)))
+    out["verdict"] = "promote" if promote else "refuse"
+    out["reason"] = (
+        f"candidate {metric} {cand_loss:.6g} vs live {live_loss:.6g} "
+        f"(tolerance {tolerance:g})")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the promotion pipeline
+# ---------------------------------------------------------------------------
+def shadow_name(name: str) -> str:
+    return f"{name}.shadow"
+
+
+def promote_candidate(registry, name: str, candidate,
+                      X: np.ndarray, y: Optional[np.ndarray],
+                      tolerance: float = 0.0) -> Dict:
+    """Run one candidate through the full shadow gate.
+
+    Returns a status dict; `status` is one of
+
+    * ``deferred``  — the PR-15 planner could not clear candidate+live
+      joint residency (cold-model eviction included); nothing touched
+      the device, the controller retries next cycle.
+    * ``refused``   — the candidate loaded and scored worse than the
+      live model on the mirrored sample; it was unloaded again.  The
+      verdict dict rides along.
+    * ``promoted``  — the bare-name alias now points at the candidate.
+      `prev_key` (the displaced live key, possibly None) and
+      `shadow_key` ride along so the caller can `rollback()`;
+      `swap_seconds` is the measured alias-flip gap.
+
+    The load itself is the only stage that can raise past the DEFER
+    preflight (e.g. a real device OOM mid-upload after the plan
+    cleared) — `ServingMemoryExhausted` from it is also folded into
+    ``deferred`` so a transient squeeze never kills the loop.
+    """
+    from ..obs import flightrecorder
+
+    faultline.fire("continual_shadow_load", model=name)
+    # DEFER preflight: same PR-15 plan + admission formula the registry
+    # applies, but WITHOUT burning the upload/warmup when it cannot fit
+    # even after shedding cold third models (the live alias is never an
+    # eviction victim)
+    plan = membudget.plan_model_load(candidate, registry.config)
+    if plan is not None:
+        tables = plan.components.get("packed_tables", 0)
+        scratch = plan.components.get("launch_scratch", 0)
+        headroom = registry.admission_headroom(tables, scratch)
+        if headroom is not None and headroom < 0:
+            registry.relieve_pressure(need_bytes=-headroom)
+            headroom = registry.admission_headroom(tables, scratch)
+        if headroom is not None and headroom < 0:
+            flightrecorder.note("continual", "promotion_deferred",
+                                model=name, predicted=plan.total,
+                                headroom=headroom)
+            return {"status": "deferred",
+                    "reason": f"candidate needs {tables:,d} device bytes "
+                              f"but the serving budget is "
+                              f"{-headroom:,d} bytes short of joint "
+                              "candidate+live residency"}
+    sname = shadow_name(name)
+    try:
+        entry = registry.load(sname, booster=candidate)
+    except membudget.ServingMemoryExhausted as exc:
+        flightrecorder.note("continual", "promotion_deferred",
+                            model=name, error=str(exc)[:200])
+        return {"status": "deferred", "reason": str(exc)}
+    live = registry.resolve(name)
+    verdict = shadow_verdict(live.booster, entry.booster, X, y,
+                             tolerance=tolerance)
+    if verdict["verdict"] != "promote":
+        # exact key, not the bare shadow name: after an earlier
+        # cross-name promotion the LIVE alias points at a previous
+        # `<name>.shadow@k` entry, and a bare-name unload would evict
+        # every resident version of the shadow name — live included
+        registry.unload(entry.key)
+        flightrecorder.note("continual", "promotion_refused", model=name,
+                            reason=verdict.get("reason", ""))
+        return {"status": "refused", "verdict": verdict}
+    faultline.fire("continual_promote", model=name)
+    t0 = time.perf_counter()
+    prev_key = registry.promote(name, entry.key)
+    swap = time.perf_counter() - t0
+    flightrecorder.note("continual", "promoted", model=name,
+                        key=entry.key, prev=prev_key,
+                        swap_seconds=round(swap, 6))
+    return {"status": "promoted", "verdict": verdict,
+            "shadow_key": entry.key, "prev_key": prev_key,
+            "swap_seconds": swap}
+
+
+def rollback(registry, name: str, prev_key: Optional[str],
+             shadow_key: str, reason: str) -> None:
+    """Undo a promotion: re-alias `name` to the displaced live key and
+    drop the candidate.  Flight-recorded with the triggering reason
+    (breaker open, drift regression, operator)."""
+    from ..obs import flightrecorder
+
+    if prev_key is not None:
+        registry.promote(name, prev_key)
+    try:
+        registry.unload(shadow_key if "@" in shadow_key else
+                        shadow_name(name))
+    except KeyError:
+        pass  # already evicted under pressure — the alias flip stands
+    flightrecorder.note("continual", "rolled_back", model=name,
+                        candidate=shadow_key, restored=prev_key,
+                        reason=reason)

@@ -1,0 +1,185 @@
+"""Retrain policies: WHEN to retrain and HOW MUCH model to move.
+
+Three triggers, checked in priority order once the buffer holds at
+least `tpu_continual_min_rows` labeled rows:
+
+* ``drift``    — the serving drift monitor's `psi_warn` is active
+  (sampled live traffic sits at/above `serving_drift_psi_warn`);
+* ``rows``     — a full retention window of rows has arrived since the
+  last retrain (the model has never seen any of the buffered traffic);
+* ``interval`` — `tpu_continual_interval_s` wall-clock cadence.
+
+Each trigger maps (policy ``auto``) to the cheapest response that can
+plausibly fix it:
+
+* ``refit``    — `Booster.refit`: keep every tree's structure, re-fit
+  the leaf values on the buffered window.  Cheap (no growing, no new
+  compiles downstream — the candidate is same-shaped by construction);
+  right for rows/cadence triggers where the relationship is stable and
+  only the magnitudes moved.
+* ``boost``    — K more trees via a warm `init_model` continue on the
+  buffered rows, binned through the FROZEN training mappers (the
+  buffer's reference shim) and GOSS-style weighted toward fresh blocks;
+  right for a drift trigger where the model needs new structure.
+* ``resketch`` — same warm continue, but bin finding runs FRESH over
+  the buffered rows: the escalation for drift whose PSI mass sits in
+  the frozen mappers' overflow/tail bins (`tail_fraction()` at/above
+  `tpu_continual_resketch_tail_frac`) — the live distribution walked
+  off the training range, so re-fitting inside stale bins cannot see
+  it.  After a promoted resketch the controller rebuilds the ingest
+  buffer from the candidate's new mappers.
+
+Boost/resketch runs checkpoint through the PR-7 manager (dir
+`<tpu_continual_dir>/retrain`): a controller killed mid-retrain resumes
+the interrupted boost on restart instead of re-paying completed rounds;
+the directory is cleared after a completed retrain so a FINISHED run
+never masquerades as an interrupted one.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Dict, Optional, Tuple
+
+from ..config import Config
+from ..utils import faultline
+
+TRIGGERS = ("drift", "rows", "interval")
+POLICIES = ("auto", "refit", "boost", "resketch")
+
+# num-iteration aliases engine.train lets OVERRIDE its argument; a base
+# model's params carrying one would silently replace boost-K with a
+# full-length retrain
+_NUM_ITER_ALIASES = ("num_boost_round", "num_iterations", "num_iteration",
+                     "n_iter", "num_tree", "num_trees", "num_round",
+                     "num_rounds", "n_estimators")
+
+
+class ContinualTrainer:
+    """Policy engine + retrain launcher over one RowBuffer."""
+
+    def __init__(self, buffer, config: Optional[Config] = None,
+                 params: Optional[Dict] = None):
+        self.buffer = buffer
+        self.cfg = config if config is not None else Config({})
+        if str(self.cfg.tpu_continual_policy) not in POLICIES:
+            raise ValueError(
+                f"tpu_continual_policy must be one of {POLICIES}, got "
+                f"{self.cfg.tpu_continual_policy!r}")
+        # extra training params for the boost paths (layered over the
+        # base model's own params)
+        self.params = dict(params or {})
+        self._rows_at_last = 0
+        self._last_retrain_t = time.monotonic()
+
+    # -- triggers ------------------------------------------------------
+    def pending_trigger(self, drift_warn: bool) -> Optional[str]:
+        """Highest-priority trigger currently firing, or None."""
+        if self.buffer.rows < int(self.cfg.tpu_continual_min_rows):
+            return None
+        if drift_warn:
+            return "drift"
+        rows_since = self.buffer.ingested_total - self._rows_at_last
+        if rows_since >= self.buffer.retain_rows:
+            return "rows"
+        interval = float(self.cfg.tpu_continual_interval_s)
+        if interval > 0 and \
+                time.monotonic() - self._last_retrain_t >= interval:
+            return "interval"
+        return None
+
+    def choose_policy(self, trigger: str) -> str:
+        pinned = str(self.cfg.tpu_continual_policy)
+        if pinned != "auto":
+            return pinned
+        if trigger == "drift":
+            tail = self.buffer.tail_fraction()
+            if tail >= float(self.cfg.tpu_continual_resketch_tail_frac):
+                return "resketch"
+            return "boost"
+        return "refit"
+
+    # -- retrain -------------------------------------------------------
+    def retrain(self, base, trigger: str) -> Tuple[object, str]:
+        """Produce a candidate Booster from `base` + the buffered
+        window; returns (candidate, policy-used).  Raises ValueError
+        when the window carries no labels (every retrain path is
+        supervised) — the controller folds that into a deferral."""
+        policy = self.choose_policy(trigger)
+        X, y, w = self.buffer.raw(
+            float(self.cfg.tpu_continual_fresh_decay))
+        if y is None or X.shape[0] == 0:
+            raise ValueError(
+                "buffered window has no labels; every retrain path is "
+                "supervised — ingest labeled rows (delayed-label joins "
+                "happen upstream of observe())")
+        faultline.fire("continual_retrain", trigger=trigger,
+                       policy=policy, rows=int(X.shape[0]))
+        if policy == "refit":
+            cand = base.refit(
+                X, y,
+                decay_rate=float(self.cfg.tpu_continual_refit_decay))
+        else:
+            cand = self._boost(base, X, y, w, frozen=(policy == "boost"))
+        self._rows_at_last = self.buffer.ingested_total
+        self._last_retrain_t = time.monotonic()
+        return cand, policy
+
+    @staticmethod
+    def _base_params(base) -> Dict:
+        """Training params reusable from the base model.  A booster
+        loaded from a model FILE carries its objective in model-string
+        form ('binary sigmoid:1') plus metadata keys that are not
+        training params — normalize both so a warm continue from a
+        loaded model trains under the objective it was saved with."""
+        params = dict(getattr(base, "params", None) or {})
+        params.pop("feature_infos", None)
+        obj = str(params.get("objective", "") or "")
+        if " " in obj:
+            toks = obj.split()
+            params["objective"] = toks[0]
+            for t in toks[1:]:
+                if ":" in t:
+                    k, v = t.split(":", 1)
+                    params.setdefault(k, v)
+        return params
+
+    def _boost(self, base, X, y, w, frozen: bool):
+        """K-more-trees warm continue (engine.train init_model merge)."""
+        from .. import engine
+        from ..basic import Dataset
+
+        params = self._base_params(base)
+        params.update(self.params)
+        for alias in _NUM_ITER_ALIASES:
+            params.pop(alias, None)
+        ckpt_dir = self._checkpoint_dir()
+        resume = False
+        if ckpt_dir:
+            params["tpu_checkpoint_dir"] = ckpt_dir
+            resume = os.path.isdir(ckpt_dir) and any(
+                os.scandir(ckpt_dir))
+        ds = Dataset(X, label=y, weight=w, params=params)
+        if frozen:
+            # bin the window through the model's FROZEN training
+            # mappers (the buffer's shim is a mapper-only reference):
+            # structure learned by the continue lines up bin-for-bin
+            # with what incremental ingest accumulated
+            ref = Dataset(None, params=params)
+            ref._inner = self.buffer.reference_data()
+            ds.reference = ref
+        cand = engine.train(
+            params, ds,
+            num_boost_round=int(self.cfg.tpu_continual_boost_rounds),
+            init_model=base, verbose_eval=False, resume=resume)
+        if ckpt_dir:
+            # a COMPLETED retrain must not leave checkpoints for the
+            # next one to "resume" from
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        return cand
+
+    def _checkpoint_dir(self) -> str:
+        root = str(self.cfg.tpu_continual_dir or "")
+        return os.path.join(root, "retrain") if root else ""

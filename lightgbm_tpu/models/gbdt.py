@@ -1998,15 +1998,23 @@ class GBDT:
         md = Metadata(num_data=n, label=np.asarray(label, np.float32))
         # a fresh objective instance bound to the NEW labels (never re-init
         # the live training objective)
-        obj = create_objective(cfg)
+        try:
+            obj = create_objective(cfg)
+        except ValueError:
+            # a loaded booster's params carry the MODEL-STRING objective
+            # format ('binary sigmoid:1'), which the config-side factory
+            # rejects — fall through to the model-string parser
+            obj = None
         if obj is None:
             obj = create_objective_from_model_string(
-                self.loaded_params.get("objective", ""))
+                self.loaded_params.get("objective", "")
+                or str(cfg.objective or ""))
         if obj is None:
             raise ValueError("cannot refit without an objective")
         obj.init(md, n)
 
-        self._refit_by_leaf_preds(leaf_preds, obj, decay_rate, cfg)
+        scores = self._refit_by_leaf_preds(leaf_preds, obj, decay_rate, cfg)
+        self._recapture_profile_scores(scores, np.asarray(label, np.float64))
 
     def _refit_by_leaf_preds(self, leaf_preds: np.ndarray, obj,
                              decay_rate: float, cfg: Config) -> None:
@@ -2043,6 +2051,43 @@ class GBDT:
                                     + (1.0 - decay) * new_out * tree.shrinkage)
             scores[cid] += tree.leaf_value[leaves]
         self._invalidate_tables()  # leaf values changed in place
+        return scores
+
+    def _recapture_profile_scores(self, scores: np.ndarray,
+                                  label: np.ndarray) -> None:
+        """Carry the model-health profile through refit: tree structure
+        and the per-feature bin occupancy stay the TRAINING reference,
+        but the raw-score histogram (and label stats) must describe the
+        REFIT scores — a drift monitor comparing the stale histogram
+        against post-refit traffic would flag the refit itself as a
+        score shift."""
+        base = self.health_profile()
+        if base is None:
+            return
+        from ..obs import modelhealth
+
+        s = np.asarray(scores, np.float64)
+        if s.ndim == 1:
+            s = s[None, :]
+        fin = s[np.isfinite(s)]
+        lo = float(fin.min()) if fin.size else 0.0
+        hi = float(fin.max()) if fin.size else 1.0
+        if hi <= lo:
+            hi = lo + 1.0
+        nb = max(len(base.score_edges) - 1, 2)
+        edges = [float(x) for x in np.linspace(lo, hi, nb + 1)]
+        counts = [[int(x) for x in
+                   modelhealth.score_hist_counts(edges, row)]
+                  for row in s]
+        y = np.asarray(label, np.float64)
+        lab = {"n": int(y.size),
+               "mean": float(y.mean()) if y.size else 0.0,
+               "std": float(y.std()) if y.size else 0.0,
+               "min": float(y.min()) if y.size else 0.0,
+               "max": float(y.max()) if y.size else 0.0}
+        self._profile = modelhealth.FeatureProfile(
+            {c: dict(f) for c, f in base.features.items()},
+            lab, edges, counts)
 
     def reset_config(self, config: Config) -> None:
         self._materialize()

@@ -501,10 +501,22 @@ class DriftMonitor:
             self._stats.set_drift_psi(self.model, name, f["psi"])
         self._stats.set_drift_score_js(self.model, snap["score_js_max"])
         self._stats.set_drift_rows(self.model, snap["rows_sampled"])
+        # warn-threshold state as a gauge (ISSUE 17): 1 while PSI sits
+        # at/above the threshold, 0 once it re-arms — the pollable twin
+        # of the one-shot psi_warn flight-recorder event
+        self._stats.set_drift_warn_active(self.model, snap["warn"])
 
     def warnings(self) -> int:
         with self._lock:
             return int(self._warnings)
+
+    def warn_active(self) -> bool:
+        """True while the last snapshot sat at/above the PSI warn
+        threshold (the state the `lgbm_drift_warn_active` gauge
+        mirrors) — what the continual controller polls as its drift
+        trigger, without re-reading log text."""
+        with self._lock:
+            return bool(self._warned)
 
 
 # ---------------------------------------------------------------------------

@@ -640,6 +640,42 @@ class ModelRegistry:
         self._publish_pressure_locked()
 
     # ------------------------------------------------------------------
+    def admission_headroom(self, new_tables: int,
+                           new_scratch: int = 0) -> Optional[int]:
+        """Serving-budget bytes left for a NEW entry of the given size
+        (negative = would not fit; None = no budget configured, always
+        admissible).  The continual controller preflights a candidate
+        retrain against this BEFORE spending the training wall: the
+        two-generation swap needs candidate+live resident together, so
+        a candidate that cannot be admitted defers the retrain instead
+        of OOM-crashing the shadow load."""
+        budget = self._budget()
+        if budget is None:
+            return None
+        with self._lock:
+            return -self._admission_overflow_locked(
+                "", int(new_tables), int(new_scratch), budget)
+
+    def promote(self, name: str, key: str) -> Optional[str]:
+        """Atomically re-alias bare `name` to an ALREADY-RESIDENT entry
+        (shadow-gated promotion, ISSUE 17): the candidate was loaded,
+        warmed and scored under a shadow name; the flip here is one
+        dict store under the registry lock — in-flight requests that
+        already resolved the old entry finish against it, new resolves
+        see the promoted one.  Zero requests are dropped or double-
+        answered because nothing else changes.  Returns the previously
+        aliased key (None when `name` had no alias) so the caller can
+        roll back with another `promote(name, prev_key)`."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                raise KeyError(
+                    f"no resident entry {key!r} to promote as {name!r}")
+            prev = self._latest.get(name)
+            self._latest[name] = key
+            self._entries.move_to_end(key)  # LRU touch: now current
+            return prev
+
     def resolve(self, name: str) -> ModelEntry:
         """`name` (current version) or exact `name@version` -> entry."""
         with self._lock:
@@ -663,6 +699,12 @@ class ModelRegistry:
             else:
                 victims = [k for k, e in self._entries.items()
                            if e.name == name]
+                alias = self._latest.get(name)
+                if alias is not None and alias not in victims:
+                    # a cross-name promotion (a shadow entry aliased
+                    # under this name) must leave with the name it
+                    # serves, not survive as an unreachable resident
+                    victims.append(alias)
             removed = [self._entries.pop(k) for k in victims
                        if k in self._entries]
             for e in removed:

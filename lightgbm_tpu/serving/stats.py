@@ -321,6 +321,18 @@ class ServingStats:
             help="rows absorbed by the drift monitor since model load",
             model=str(model))
 
+    def set_drift_warn_active(self, model: str, active: bool) -> None:
+        """1 while the model's PSI sits at/above serving_drift_psi_warn,
+        0 otherwise — the pollable twin of the log-only psi_warn re-arm
+        (ISSUE 17): the continual trigger and operators read state, not
+        log text.  Same tombstone discipline as every drift series."""
+        self._set_drift_gauge(
+            ("lgbm_drift_warn_active", str(model), None),
+            1.0 if active else 0.0,
+            help="1 while sampled-traffic PSI is at or above "
+                 "serving_drift_psi_warn (re-arms below it)",
+            model=str(model))
+
     def reopen_drift(self, model: str) -> None:
         """Re-arm drift publishing for a (re)loaded model key — undoes
         a prior clear_drift tombstone."""

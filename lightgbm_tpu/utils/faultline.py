@@ -62,7 +62,11 @@ from typing import Dict, List, Optional
 
 POINTS = ("grow_step", "h2d_copy", "checkpoint_write", "serve_dispatch",
           "collective_sync", "binning_allgather", "host_drop",
-          "device_alloc")
+          "device_alloc",
+          # continual-learning stage boundaries (ISSUE 17): buffer
+          # ingest, retrain launch, shadow candidate load, alias swap
+          "continual_ingest", "continual_retrain",
+          "continual_shadow_load", "continual_promote")
 
 _ACTIONS = ("raise", "poison", "truncate", "hang", "oom")
 

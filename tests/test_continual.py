@@ -1,0 +1,713 @@
+"""Continual learning (ISSUE 17): the drift-triggered train-behind-serve
+loop with shadow-gated zero-downtime promotion.
+
+The contract under test:
+
+* `RowBuffer` bins streaming rows through the model's FROZEN training
+  mappers bit-for-bit (vs the per-column `values_to_bins` oracle), into
+  the PR-16 `[G, rows]` C-contiguous block layout, under a bounded
+  retention window with freshness-decayed raw reads;
+* `ContinualTrainer` fires triggers in priority order (drift > rows >
+  interval) only past `tpu_continual_min_rows`, and policy `auto` maps
+  drift -> boost (escalating to resketch on tail-bin saturation) and
+  everything else -> refit;
+* `Booster.refit` carries the model-health profile trailer forward and
+  RECAPTURES the score histogram on the refit window (satellite 1);
+* `lgbm_drift_warn_active{model}` is a pollable gauge twin of the PSI
+  warning: 1 while warned, 0 once re-armed, gone after unload
+  (satellite 2);
+* the shadow gate defers on HBM headroom (nothing touched the device),
+  refuses + unloads worse candidates (alias untouched), and promotes
+  via an atomic alias flip that a concurrent 16-thread hammer never
+  observes as an error, with a post-promote regression auto-rolling
+  back — the E2E acceptance flow;
+* an int8/int16 warm continue (`init_model`) stays BITWISE identical
+  across 1/2/4 data-parallel shards (satellite 3, slow);
+* a steady-state refit cycle (same-shaped candidate) compiles ZERO new
+  XLA programs: retrain, shadow load (warm-signature dedupe), verdict
+  scoring, promotion, and post-promote predicts all reuse the warmed
+  caches — the compile-ledger acceptance gate.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.continual import (ContinualController, ContinualTrainer,
+                                    RowBuffer, shadow_verdict)
+from lightgbm_tpu.continual.promote import promote_candidate, rollback
+from lightgbm_tpu.serving import ServingSession
+from lightgbm_tpu.utils import faultline, membudget
+from lightgbm_tpu.utils.compile_ledger import LEDGER
+
+_P = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+      "min_data_in_leaf": 5, "tpu_block_rows": 512, "verbosity": -1}
+
+
+def _problem(n=800, f=5, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def _train(X, y, params=None, rounds=5, **kw):
+    p = dict(_P, **(params or {}))
+    ds = lgb.Dataset(X, label=y, params=p)
+    return lgb.train(p, ds, num_boost_round=rounds, verbose_eval=False,
+                     **kw)
+
+
+def _ccfg(**over):
+    return Config({"verbosity": -1, **over})
+
+
+@pytest.fixture(autouse=True)
+def _faultline_isolation():
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+@pytest.fixture(scope="module")
+def base_model():
+    X, y = _problem(n=800, seed=1)
+    return _train(X, y), X, y
+
+
+# ---------------------------------------------------------------------------
+# RowBuffer: frozen-mapper binning, block layout, retention
+# ---------------------------------------------------------------------------
+class TestRowBuffer:
+    def test_bins_match_mapper_oracle_in_block_layout(self, base_model):
+        bst, X, _ = base_model
+        buf = RowBuffer(bst, _ccfg())
+        rng = np.random.default_rng(2)
+        Xq = rng.normal(size=(257, X.shape[1])) * 2.0
+        buf.ingest(Xq)
+        blocks = buf.host_blocks()
+        assert len(blocks) == 1
+        blk = blocks[0]
+        assert blk.flags["C_CONTIGUOUS"]
+        ctx = bst._driver._pred_context()
+        used = [int(c) for c in ctx.used_feature_idx]
+        assert blk.shape == (len(used), 257)
+        for j, c in enumerate(used):
+            oracle = ctx.mappers[c].values_to_bins(
+                np.ascontiguousarray(Xq[:, c]))
+            np.testing.assert_array_equal(blk[j], oracle)
+
+    def test_retention_window_evicts_oldest(self, base_model):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg(tpu_continual_buffer_rows=100))
+        for lo in (0, 60, 120):
+            buf.ingest(X[lo:lo + 60], y[lo:lo + 60])
+        # 180 ingested, window 100: two oldest blocks evicted
+        assert buf.rows == 60
+        assert buf.ingested_total == 180
+        Xw, yw, _ = buf.raw()
+        np.testing.assert_array_equal(Xw, X[120:180])
+        np.testing.assert_array_equal(yw, y[120:180])
+
+    def test_raw_freshness_decay_newest_block_weighs_one(self, base_model):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        for lo in (0, 10, 20):
+            buf.ingest(X[lo:lo + 10], y[lo:lo + 10])
+        _, _, w = buf.raw(fresh_decay=0.5)
+        np.testing.assert_allclose(w[:10], 0.25)
+        np.testing.assert_allclose(w[10:20], 0.5)
+        np.testing.assert_allclose(w[20:], 1.0)
+
+    def test_any_unlabeled_block_means_no_labels(self, base_model):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        buf.ingest(X[:20], y[:20])
+        buf.ingest(X[20:40])                     # unlabeled
+        _, yw, _ = buf.raw()
+        assert yw is None
+
+    def test_tail_fraction_saturates_on_off_range_values(self, base_model):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        buf.ingest(X[:100], y[:100])
+        assert buf.tail_fraction() < 0.5
+        buf.drain()
+        buf.ingest(np.full((50, X.shape[1]), 1e6))
+        assert buf.tail_fraction() == 1.0
+
+    def test_host_blocks_repartition(self, base_model):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        for lo in (0, 100, 200):
+            buf.ingest(X[lo:lo + 100], y[lo:lo + 100])
+        whole = np.concatenate(buf.host_blocks(), axis=1)
+        parts = buf.host_blocks(stream_rows=128)
+        assert all(b.shape[1] <= 128 for b in parts)
+        assert all(b.flags["C_CONTIGUOUS"] for b in parts)
+        np.testing.assert_array_equal(
+            np.concatenate(parts, axis=1), whole)
+
+    def test_drain_and_width_validation(self, base_model):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        buf.ingest(X[:30], y[:30])
+        assert buf.drain() == 30
+        assert buf.rows == 0
+        with pytest.raises(ValueError, match="width"):
+            buf.ingest(X[:5, :3])
+
+    def test_reference_shim_carries_frozen_mappers(self, base_model):
+        bst, X, _ = base_model
+        buf = RowBuffer(bst, _ccfg())
+        ref = buf.reference_data()
+        ctx = bst._driver._pred_context()
+        assert ref.used_feature_idx == [int(c) for c in
+                                        ctx.used_feature_idx]
+        assert ref.num_total_features == bst.num_feature()
+        assert ref.mappers is ctx.mappers
+
+
+# ---------------------------------------------------------------------------
+# trainer: triggers and policies
+# ---------------------------------------------------------------------------
+class _StubBuffer:
+    def __init__(self, rows=0, ingested=0, retain=1000, tail=0.0):
+        self.rows = rows
+        self.ingested_total = ingested
+        self.retain_rows = retain
+        self._tail = tail
+
+    def tail_fraction(self):
+        return self._tail
+
+
+class TestTrainerPolicy:
+    def test_min_rows_gates_every_trigger(self):
+        t = ContinualTrainer(_StubBuffer(rows=10),
+                             _ccfg(tpu_continual_min_rows=100))
+        assert t.pending_trigger(drift_warn=True) is None
+
+    def test_trigger_priority_drift_over_rows(self):
+        buf = _StubBuffer(rows=500, ingested=2000, retain=500)
+        t = ContinualTrainer(buf, _ccfg(tpu_continual_min_rows=100))
+        assert t.pending_trigger(drift_warn=True) == "drift"
+        assert t.pending_trigger(drift_warn=False) == "rows"
+
+    def test_interval_trigger(self):
+        buf = _StubBuffer(rows=500, ingested=500, retain=10_000)
+        t = ContinualTrainer(buf, _ccfg(tpu_continual_min_rows=100,
+                                        tpu_continual_interval_s=0.01))
+        assert t.pending_trigger(drift_warn=False) is None
+        time.sleep(0.02)
+        assert t.pending_trigger(drift_warn=False) == "interval"
+
+    def test_auto_policy_mapping(self):
+        cfg = _ccfg(tpu_continual_resketch_tail_frac=0.25)
+        t = ContinualTrainer(_StubBuffer(tail=0.1), cfg)
+        assert t.choose_policy("drift") == "boost"
+        assert t.choose_policy("rows") == "refit"
+        assert t.choose_policy("interval") == "refit"
+        t2 = ContinualTrainer(_StubBuffer(tail=0.3), cfg)
+        assert t2.choose_policy("drift") == "resketch"
+
+    def test_pinned_policy_wins(self):
+        t = ContinualTrainer(_StubBuffer(tail=0.9),
+                             _ccfg(tpu_continual_policy="refit"))
+        assert t.choose_policy("drift") == "refit"
+        with pytest.raises(ValueError, match="tpu_continual_policy"):
+            ContinualTrainer(_StubBuffer(),
+                             _ccfg(tpu_continual_policy="bogus"))
+
+    def test_unlabeled_window_raises(self, base_model):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        buf.ingest(X[:300])                      # no labels
+        t = ContinualTrainer(buf, _ccfg(tpu_continual_min_rows=100))
+        with pytest.raises(ValueError, match="no labels"):
+            t.retrain(bst, "rows")
+
+    def test_all_three_retrain_paths_produce_usable_models(
+            self, base_model, tmp_path):
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        buf.ingest(X[:400], y[:400])
+        for policy, check in (
+                ("refit", lambda c: c.num_trees() == bst.num_trees()),
+                ("boost", lambda c: c.num_trees() == bst.num_trees() + 2),
+                ("resketch", lambda c: c.num_trees() ==
+                 bst.num_trees() + 2)):
+            t = ContinualTrainer(buf, _ccfg(
+                tpu_continual_policy=policy,
+                tpu_continual_boost_rounds=2,
+                tpu_continual_dir=str(tmp_path)),
+                params={"verbosity": -1})
+            cand, used = t.retrain(bst, "rows")
+            assert used == policy
+            assert check(cand)
+            pred = np.asarray(cand.predict(X[:50]))
+            assert np.isfinite(pred).all()
+        # a COMPLETED boost retrain leaves no checkpoints behind for a
+        # later run to masquerade-resume from
+        assert not (tmp_path / "retrain").exists()
+
+    def test_boost_keeps_frozen_bins(self, base_model):
+        """The boost continue's new trees split on the SAME bin edges
+        the buffer ingests through: thresholds of continued trees stay
+        inside the frozen mappers' upper bounds."""
+        bst, X, y = base_model
+        buf = RowBuffer(bst, _ccfg())
+        buf.ingest(X[:400], y[:400])
+        t = ContinualTrainer(buf, _ccfg(tpu_continual_policy="boost",
+                                        tpu_continual_boost_rounds=2),
+                             params={"verbosity": -1})
+        cand, _ = t.retrain(bst, "rows")
+        ctx = bst._driver._pred_context()
+        for tree in cand._driver.models[bst.num_trees():]:
+            ni = tree.num_leaves - 1
+            for f, thr in zip(tree.split_feature[:ni],
+                              tree.threshold_in_bin[:ni]):
+                assert 0 <= int(thr) < ctx.mappers[int(f)].num_bin
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: refit profile carry-forward + score recapture
+# ---------------------------------------------------------------------------
+class TestRefitProfileCarryForward:
+    def _trailer(self, model_str):
+        lines = [ln for ln in model_str.splitlines()
+                 if ln.startswith("tpu_feature_profile:")]
+        return lines[0] if lines else None
+
+    def test_loaded_booster_refit_no_crash(self, base_model, tmp_path):
+        bst, X, y = base_model
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        loaded = lgb.Booster(model_file=path)
+        out = loaded.refit(X[:300], y[:300], decay_rate=0.9)
+        pred = np.asarray(out.predict(X[:50]))
+        assert np.isfinite(pred).all()
+        assert out.num_trees() == bst.num_trees()
+
+    def test_loaded_booster_boost_continue(self, base_model, tmp_path):
+        # a file-loaded booster round-trips its objective in
+        # model-string form ('binary sigmoid:1') and carries metadata
+        # keys; the boost path must normalize both instead of handing
+        # them straight back to engine.train
+        bst, X, y = base_model
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        loaded = lgb.Booster(model_file=path)
+        cfg = _ccfg(tpu_continual_policy="boost",
+                    tpu_continual_min_rows=64,
+                    tpu_continual_boost_rounds=2)
+        buf = RowBuffer(loaded, cfg)
+        buf.ingest(X[:400], y[:400])
+        tr = ContinualTrainer(buf, config=cfg)
+        cand, policy = tr.retrain(loaded, "drift")
+        assert policy == "boost"
+        assert cand.num_trees() == bst.num_trees() + 2
+        assert np.isfinite(np.asarray(cand.predict(X[:50]))).all()
+
+    def test_refit_keeps_trailer_and_recaptures_scores(self, base_model):
+        bst, X, y = base_model
+        base_prof = bst._driver.health_profile()
+        assert base_prof is not None
+        # refit on a SHIFTED window: leaf values move, so the score
+        # histogram must be recaptured (a stale baseline would flag the
+        # refit model's own outputs as drift)
+        out = bst.refit(X[:300] + 1.0, y[:300], decay_rate=0.5)
+        trailer = self._trailer(out.model_to_string())
+        assert trailer is not None, "refit dropped the profile trailer"
+        prof = out._driver.health_profile()
+        assert prof is not None
+        assert prof.score_counts != base_prof.score_counts
+        # each class row of the recaptured histogram covers the refit
+        # window exactly
+        for row in prof.score_counts:
+            assert sum(row) == 300
+        # feature occupancy (training-data facts) carries forward
+        assert set(prof.features) == set(base_prof.features)
+        for c in prof.features:
+            assert prof.features[c]["cnt"] == base_prof.features[c]["cnt"]
+
+    def test_refit_model_serves_with_drift_monitor(self, base_model):
+        bst, X, y = base_model
+        out = bst.refit(X[:300], y[:300])
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("r", booster=out)
+            assert sess.registry.resolve("r").drift is not None
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# satellite 2: the lgbm_drift_warn_active gauge
+# ---------------------------------------------------------------------------
+class TestDriftWarnGauge:
+    def test_gauge_sets_rearms_and_clears(self, base_model):
+        bst, X, y = base_model
+        sess = ServingSession(params={
+            "serving_drift_sample_rows": 256,
+            "serving_drift_psi_warn": 0.25, "verbosity": -1},
+            start=False)
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            entry.predict(X[:200] + 2.5)         # shifted traffic
+            entry.drift.snapshot()
+            text = sess._stats.to_prometheus_text()
+            assert 'lgbm_drift_warn_active{model="m@1"} 1' in text
+            assert entry.drift.warn_active()
+            # a clean flood dilutes cumulative PSI below the warn
+            # line: the gauge re-arms.  Fresh draws, not replays — a
+            # repeated fixed subset keeps its finite-sample divergence
+            # vs the training baseline forever
+            rng = np.random.default_rng(42)
+            for _ in range(24):
+                entry.predict(rng.normal(size=(256, X.shape[1])))
+            entry.drift.snapshot()
+            text = sess._stats.to_prometheus_text()
+            assert 'lgbm_drift_warn_active{model="m@1"} 0' in text
+            assert not entry.drift.warn_active()
+            sess.unload("m")
+            assert "lgbm_drift_warn_active{" not in \
+                sess._stats.to_prometheus_text()
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# the shadow gate: defer / refuse / promote / rollback
+# ---------------------------------------------------------------------------
+class TestPromotionGate:
+    def test_verdict_promotes_better_refuses_worse(self, base_model):
+        bst, X, y = base_model
+        rng = np.random.default_rng(5)
+        yb = y.copy()
+        rng.shuffle(yb)
+        worse = _train(X, yb)
+        v = shadow_verdict(bst, worse, X[:300], y[:300])
+        assert v["verdict"] == "refuse"
+        assert v["candidate_loss"] > v["live_loss"]
+        v2 = shadow_verdict(bst, bst, X[:300], y[:300])
+        assert v2["verdict"] == "promote"
+        v3 = shadow_verdict(bst, worse, X[:300])
+        assert v3["verdict"] == "no-labels"
+
+    def test_refused_candidate_is_unloaded_alias_untouched(
+            self, base_model):
+        bst, X, y = base_model
+        rng = np.random.default_rng(6)
+        yb = y.copy()
+        rng.shuffle(yb)
+        worse = _train(X, yb)
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            res = promote_candidate(sess.registry, "m", worse,
+                                    X[:300], y[:300])
+            assert res["status"] == "refused"
+            assert sess.registry.resolve("m").key == "m@1"
+            with pytest.raises(KeyError):
+                sess.registry.resolve("m.shadow")
+        finally:
+            sess.close()
+
+    def test_promote_flips_alias_and_rollback_restores(self, base_model):
+        bst, X, y = base_model
+        better = _train(X, y, rounds=10)
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            res = promote_candidate(sess.registry, "m", better,
+                                    X[:300], y[:300], tolerance=0.5)
+            assert res["status"] == "promoted"
+            assert res["prev_key"] == "m@1"
+            assert sess.registry.resolve("m").key == res["shadow_key"]
+            assert res["shadow_key"].startswith("m.shadow@")
+            rollback(sess.registry, "m", res["prev_key"],
+                     res["shadow_key"], "test")
+            assert sess.registry.resolve("m").key == "m@1"
+            with pytest.raises(KeyError):
+                sess.registry.resolve(res["shadow_key"])
+        finally:
+            sess.close()
+
+    def test_no_headroom_defers_without_touching_the_alias(
+            self, base_model):
+        bst, X, y = base_model
+        plan = membudget.plan_model_load(bst, Config({"verbosity": -1}))
+        assert plan is not None
+        tables = plan.components.get("packed_tables", 0)
+        assert tables > 0
+        # budget admits the live model but NOT joint live+candidate
+        # residency (launch scratch reserves once — dispatches
+        # serialize — so the squeeze must come from TABLE bytes); the
+        # live alias is never an eviction victim, so the gate must
+        # defer before anything touches the device
+        sess = ServingSession(params={
+            "serving_hbm_budget_bytes": int(plan.total + tables // 2),
+            "verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            res = promote_candidate(sess.registry, "m", bst,
+                                    X[:300], y[:300])
+            assert res["status"] == "deferred"
+            assert "short" in res["reason"]
+            assert sess.registry.resolve("m").key == "m@1"
+            with pytest.raises(KeyError):
+                sess.registry.resolve("m.shadow")
+        finally:
+            sess.close()
+
+    def test_injected_fault_at_shadow_load_is_contained(self, base_model):
+        """An armed continual_shadow_load fault surfaces as a counted
+        deferral through the controller, never an exception."""
+        bst, X, y = base_model
+        sess = ServingSession(params={"verbosity": -1}, start=False)
+        try:
+            sess.load("m", booster=bst)
+            ctl = ContinualController(
+                sess, "m",
+                config=_ccfg(tpu_continual_min_rows=64,
+                             tpu_continual_interval_s=0.001,
+                             tpu_continual_policy="refit"),
+                params={"verbosity": -1})
+            ctl.observe(X[:256], y[:256])
+            time.sleep(0.01)
+            faultline.arm("continual_shadow_load", action="oom")
+            res = ctl.step()
+            assert res["status"] == "deferred"
+            assert sess.registry.resolve("m").key == "m@1"
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# the E2E acceptance flow
+# ---------------------------------------------------------------------------
+class TestContinualAcceptance:
+    def test_drift_to_promotion_to_rollback_under_hammer(self):
+        """Shifted traffic crosses psi_warn; the rows trigger exercises
+        the refit path and the drift trigger the boost path; the gate
+        refuses a worse candidate and promotes a better one; a 16-thread
+        hammer sees every request answered exactly once with zero errors
+        across both alias flips; an injected post-promote regression
+        auto-rolls back."""
+        X, y = _problem(n=1200, seed=11)
+        live = _train(X, y, rounds=6)
+        rng = np.random.default_rng(5)
+        yb = y.copy()
+        rng.shuffle(yb)
+        worse = _train(X, yb, rounds=6)          # the gate's punchbag
+        sess = ServingSession(params={
+            "serving_max_batch_rows": 512,
+            "serving_drift_sample_rows": 256,
+            "serving_drift_psi_warn": 0.25, "verbosity": -1})
+        cfg = _ccfg(tpu_continual_buffer_rows=600,
+                    tpu_continual_min_rows=256,
+                    tpu_continual_policy="auto",
+                    tpu_continual_boost_rounds=3,
+                    tpu_continual_shadow_rows=256,
+                    tpu_continual_tolerance=0.25,
+                    tpu_continual_resketch_tail_frac=0.9)
+        ok = [0] * 16
+        err = [0] * 16
+        stop = threading.Event()
+        # the hammer serves whatever "live traffic" currently looks
+        # like; phase transitions swap this pool in place, the way a
+        # real distribution shift hits every request, not a side
+        # channel.  Each request slides a fresh window over the pool —
+        # replaying one fixed batch would pin the drift monitor's
+        # cumulative occupancy to that subset's finite-sample noise
+        traffic = [X]
+
+        def _hammer(w):
+            k = 0
+            while not stop.is_set():
+                pool = traffic[0]
+                lo = (37 * w + 32 * k) % (len(pool) - 32)
+                k += 1
+                try:
+                    out = sess.predict("m", pool[lo:lo + 32],
+                                       raw_score=True)
+                    # answered exactly once: one result per request,
+                    # row-complete and finite
+                    if len(np.asarray(out)) == 32 and \
+                            np.isfinite(np.asarray(out)).all():
+                        ok[w] += 1
+                    else:
+                        err[w] += 1
+                except Exception:
+                    err[w] += 1
+
+        def _pump_until_warn(Xp):
+            """Predict `Xp` until the cumulative sampled occupancy
+            crosses psi_warn on the CURRENT live entry (bounded: the
+            hammer is pushing the same distribution concurrently)."""
+            for _ in range(300):
+                sess.predict("m", Xp)
+                models = sess.drift().get("models", {})
+                if any(m["warn"] for m in models.values()):
+                    return
+            pytest.fail("psi_warn never crossed on shifted traffic")
+
+        threads = [threading.Thread(target=_hammer, args=(w,))
+                   for w in range(16)]
+        try:
+            sess.load("m", booster=live)
+            for t in threads:
+                t.start()
+            ctl = ContinualController(sess, "m", config=cfg,
+                                      params={"verbosity": -1})
+            # -- phase A: a full window of clean rows -> rows trigger,
+            # auto policy -> refit -> promote
+            ctl.observe(X[:600], y[:600])
+            ra = ctl.step()
+            assert ra["status"] == "promoted", f"refit cycle failed: {ra}"
+            assert ra["trigger"] == "rows" and ra["policy"] == "refit"
+            key_a = sess.registry.resolve("m").key
+            assert key_a.startswith("m.shadow@")
+            # drain the post-promote watch with clean idle cycles
+            for _ in range(3):
+                assert ctl.step()["status"] == "idle"
+            # -- gate check: a label-permuted candidate is refused and
+            # the alias does not move
+            assert promote_candidate(sess.registry, "m", worse, X[:256],
+                                     y[:256])["status"] == "refused"
+            assert sess.registry.resolve("m").key == key_a
+            # -- phase B: covariate-shifted traffic crosses psi_warn ->
+            # drift trigger, auto policy -> boost -> promote (the
+            # candidate trained on the shifted window beats the clean
+            # live model on shifted shadow rows)
+            Xsh = X[:600] + 2.0
+            ysh = (Xsh[:, 0] + 0.5 * Xsh[:, 1] > 0).astype(np.float64)
+            traffic[0] = Xsh
+            _pump_until_warn(Xsh[:512])
+            ctl.observe(Xsh, ysh)
+            rb = ctl.step()
+            assert rb["status"] == "promoted", f"boost cycle failed: {rb}"
+            assert rb["trigger"] == "drift" and rb["policy"] == "boost"
+            key_b = sess.registry.resolve("m").key
+            assert key_b != key_a
+            # -- phase C: a post-promote regression inside the watch
+            # window (traffic walks far off the candidate's own
+            # training distribution): the controller rolls the alias
+            # back to the displaced version on its own
+            traffic[0] = X + 8.0
+            sess.predict("m", X[:512] + 8.0)
+            rc = ctl.step()
+            assert rc["status"] == "rolled_back", f"no rollback: {rc}"
+            assert rc["reason"] == "drift_regression"
+            assert sess.registry.resolve("m").key == key_a
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            sess.close()
+        assert sum(err) == 0, f"hammer saw {sum(err)} failed requests"
+        assert sum(ok) > 0
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: warm continue stays bitwise across shard counts
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestShardedWarmContinue:
+    @pytest.mark.parametrize("prec", ["int8", "int16"])
+    def test_init_model_continue_bitwise_across_shards(self, prec):
+        """+K rounds continued from the same base model emit BITWISE
+        identical model files at 1, 2, and 4 data-parallel shards for
+        the quantized precisions (int32 histogram sums are associative;
+        refit-leaves off keeps f32 psum order out of the model)."""
+        rng = np.random.default_rng(7)
+        X = rng.normal(size=(4096, 8))
+        y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+        base_p = dict(_P, tpu_hist_precision=prec,
+                      tpu_quant_refit_leaves=False,
+                      tpu_shape_buckets=0)
+        base = lgb.train(base_p, lgb.Dataset(X, label=y, params=base_p),
+                         num_boost_round=3, verbose_eval=False)
+        texts = []
+        for shards in (1, 2, 4):
+            p = dict(base_p)
+            if shards > 1:
+                p.update(tree_learner="data", num_machines=shards)
+            ds = lgb.Dataset(X, label=y, params=p)
+            cont = lgb.train(p, ds, num_boost_round=3, init_model=base,
+                             verbose_eval=False)
+            assert cont.num_trees() == 6
+            texts.append(cont.model_to_string().split(
+                "\nparameters:")[0])
+        assert texts[0] == texts[1] == texts[2]
+
+
+# ---------------------------------------------------------------------------
+# the compile-ledger acceptance gate
+# ---------------------------------------------------------------------------
+# off-beat shapes (17 leaves / 53 bins): no other suite warms these jit
+# caches, so the steady-state zero-new-programs assertion is about THIS
+# lifecycle's reuse, not another test's leftovers
+P_LEDGER = {"objective": "binary", "num_leaves": 17, "max_bin": 53,
+            "min_data_in_leaf": 5, "tpu_block_rows": 512,
+            "verbosity": -1}
+
+
+class TestContinualCompileStability:
+    def test_steady_state_refit_cycle_compiles_nothing(self):
+        """Cycle 1 warms every stage (retrain, shadow load + warmup,
+        verdict scoring, promotion, serving predicts).  Cycle 2 — a
+        same-shaped refit candidate through the same gate — must compile
+        ZERO new programs: refit preserves tree shapes, the registry's
+        warm-signature dedupe skips the shadow warmup, and every predict
+        rides the warmed launch buckets."""
+        rng = np.random.default_rng(23)
+        X = rng.normal(size=(1024, 6))
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+        live = lgb.train(P_LEDGER,
+                         lgb.Dataset(X, label=y, params=P_LEDGER),
+                         num_boost_round=4, verbose_eval=False)
+        cfg = _ccfg(tpu_continual_buffer_rows=512,
+                    tpu_continual_min_rows=256,
+                    tpu_continual_policy="refit",
+                    tpu_continual_shadow_rows=256,
+                    tpu_continual_tolerance=10.0)
+        # Drift monitors off: the test replays the same fixed 64-row
+        # batch, whose cumulative finite-sample PSI would otherwise pin
+        # above the warn bar and roll the cycle-2 promotion back.  The
+        # cycle trigger here is rows-based; drift plays no part.
+        sess = ServingSession(params={"serving_drift_sample_rows": 0,
+                                      "verbosity": -1})
+        LEDGER.enable()
+        LEDGER.reset()
+        try:
+            sess.load("m", booster=live)
+            sess.predict("m", X[:64])
+            ctl = ContinualController(sess, "m", config=cfg,
+                                      params={"verbosity": -1})
+            # cycle 1: warm the full lifecycle
+            ctl.observe(X[:512], y[:512])
+            r1 = ctl.step()
+            assert r1["status"] == "promoted", f"warm cycle failed: {r1}"
+            sess.predict("m", X[:64])
+            warmed = LEDGER.n_programs()
+            # cycle 2: the steady state — same shapes end to end
+            ctl.observe(X[512:1024], y[512:1024])
+            r2 = ctl.step()
+            assert r2["status"] == "promoted", \
+                f"steady-state cycle failed: {r2}"
+            assert r2["policy"] == "refit"
+            sess.predict("m", X[:64])
+            assert LEDGER.n_programs() == warmed, (
+                "a steady-state refit promotion compiled "
+                f"{LEDGER.n_programs() - warmed} new program(s); "
+                "same-shaped candidates must ride the warmed caches")
+        finally:
+            LEDGER.enable(False)
+            sess.close()

@@ -31,7 +31,7 @@ from typing import Dict, Iterable, Optional, Tuple
 from .core import FileContext, Rule, dotted_name, enclosing_withs, \
     parents, register
 
-_SCOPE = re.compile(r"(^|/)lightgbm_tpu/(serving|obs)/")
+_SCOPE = re.compile(r"(^|/)lightgbm_tpu/(serving|obs|continual)/")
 
 
 def concurrent_scope(rel: str) -> bool:
@@ -66,6 +66,17 @@ OWNERSHIP: Dict[Tuple[str, Optional[str]], Dict[str, str]] = {
     ("serving/admission.py", "AdmissionController"): {
         "_level": "_lock", "_window_s": "_lock", "_projection_s": "_lock",
         "_next_update": "_lock", "_draining": "_lock"},
+    # the continual loop's shared state (ISSUE 17): the ingest buffer is
+    # written by the traffic-mirror thread while the retrain side reads;
+    # the controller's watch dict flips between step() and rollback.
+    # The dispatch-path drift tap feeding the trigger is DriftMonitor's
+    # lock-free deque — the retrain side never adds a lock to it, so
+    # C302 stays clean on the serve path by construction.
+    ("continual/buffer.py", "RowBuffer"): {
+        "_blocks": "_lock", "_rows": "_lock", "_seq": "_lock",
+        "_ingested_total": "_lock", "_evicted_total": "_lock"},
+    ("continual/controller.py", "ContinualController"): {
+        "_watch": "_lock"},
     ("obs/metrics.py", "MetricsRegistry"): {
         "_families": "_lock"},
     ("obs/metrics.py", "_Family"): {

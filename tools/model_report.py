@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import math
 import os
 import sys
 from typing import Dict, List, Optional, Tuple
@@ -239,61 +238,21 @@ def render_markdown(report: Dict, title: str = "Model health report"
 # ---------------------------------------------------------------------------
 # shadow compare (the promotion gate)
 # ---------------------------------------------------------------------------
-def _loss(booster, X: np.ndarray, y: np.ndarray) -> Tuple[str, float]:
-    """(metric name, loss) — binary logloss for binary objectives,
-    mean squared error otherwise.  Lower is better for both."""
-    obj = str(booster._driver.loaded_params.get(
-        "objective", "") or (booster._driver.objective.to_model_string()
-                             if booster._driver.objective else ""))
-    pred = np.asarray(booster.predict(X), np.float64)
-    if obj.startswith("binary"):
-        p = np.clip(pred, 1e-15, 1.0 - 1e-15)
-        return "binary_logloss", float(
-            -np.mean(y * np.log(p) + (1.0 - y) * np.log(1.0 - p)))
-    if pred.ndim > 1:  # multiclass: negative log-likelihood of y class
-        p = np.clip(pred[np.arange(len(y)), y.astype(int)], 1e-15, 1.0)
-        return "multi_logloss", float(-np.mean(np.log(p)))
-    return "l2", float(np.mean((pred - y) ** 2))
-
-
 def shadow_compare(live, candidate, X: np.ndarray,
                    y: Optional[np.ndarray] = None,
                    tolerance: float = 0.0) -> Dict:
     """Score candidate vs live on the same sample.  Returns the
     prediction-delta distribution and — with labels — the promote/
     refuse verdict: promote iff candidate_loss <= live_loss *
-    (1 + tolerance)."""
-    pl = np.asarray(live.predict(X, raw_score=True), np.float64)
-    pc = np.asarray(candidate.predict(X, raw_score=True), np.float64)
-    delta = np.abs(pc - pl).ravel()
-    out: Dict = {
-        "rows": int(X.shape[0]),
-        "delta": {
-            "mean": float(delta.mean()) if delta.size else 0.0,
-            "p50": float(np.percentile(delta, 50)) if delta.size else 0.0,
-            "p95": float(np.percentile(delta, 95)) if delta.size else 0.0,
-            "max": float(delta.max()) if delta.size else 0.0,
-        },
-    }
-    if y is None:
-        out["verdict"] = "no-labels"
-        out["reason"] = ("sample carries no labels; delta distribution "
-                         "only — pass labeled data for a promote/refuse "
-                         "verdict")
-        return out
-    metric, live_loss = _loss(live, X, y)
-    _, cand_loss = _loss(candidate, X, y)
-    out["metric"] = metric
-    out["live_loss"] = live_loss
-    out["candidate_loss"] = cand_loss
-    out["tolerance"] = float(tolerance)
-    promote = (math.isfinite(cand_loss)
-               and cand_loss <= live_loss * (1.0 + float(tolerance)))
-    out["verdict"] = "promote" if promote else "refuse"
-    out["reason"] = (
-        f"candidate {metric} {cand_loss:.6g} vs live {live_loss:.6g} "
-        f"(tolerance {tolerance:g})")
-    return out
+    (1 + tolerance).
+
+    Thin wrapper over `lightgbm_tpu.continual.promote.shadow_verdict` —
+    the SAME gate the continual controller applies before flipping the
+    serving alias, so the offline `--shadow` verdict and the automated
+    one can never disagree."""
+    from lightgbm_tpu.continual.promote import shadow_verdict
+
+    return shadow_verdict(live, candidate, X, y, tolerance=tolerance)
 
 
 # ---------------------------------------------------------------------------
