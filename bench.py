@@ -86,6 +86,77 @@ def hist_rows_per_sec(bins_np, num_bins, precision, reps=3):
     return rates
 
 
+def fused_frontier_rows_per_sec_probe(bins_np, num_bins, reps=3, k=8):
+    """Fused frontier megakernel rows/s (histogram + in-kernel 2K-child
+    split scan, ops/fused.py fused_hist_scan) at int8 over an already-
+    binned matrix — the one-program frontier step ISSUE 18 makes the
+    grower's measured default on validated backends."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.fused import fused_hist_scan
+    from lightgbm_tpu.ops.histogram import bench_hist_operands
+
+    block = min(8192, bins_np.shape[0])
+    bins_tb, stats, n_use = bench_hist_operands(bins_np, "int8", block)
+    nb = n_use // block
+    F = bins_np.shape[1]
+    rng = np.random.default_rng(0)
+    leaf_b = jnp.asarray(rng.integers(0, k, size=n_use).astype(np.int32)
+                         .reshape(nb, block))
+    slots = jnp.arange(k, dtype=jnp.int32)
+    C = 2 * k
+    ctx_np = np.zeros((C + 1, 8), np.float32)
+    ctx_np[:C, 0] = 100.0
+    ctx_np[:C, 1] = 200.0
+    ctx_np[:C, 2] = float(n_use) / C
+    ctx_np[:C, 3] = -1e30
+    ctx_np[:C, 4] = 1e30
+    ctx_np[:C, 5] = (np.arange(C) % 2).astype(np.float32)
+    ctx_np[C, :3] = (0.5, 0.25, 1.0)
+    ctx = jnp.asarray(ctx_np)
+    meta_i = jnp.zeros((F, 8), jnp.int32).at[:, 0].set(num_bins)
+    meta_f = jnp.ones((F, 8), jnp.float32)
+    parent = jnp.full((k, F, num_bins, 3), n_use // k, jnp.int32)
+    kw = dict(l1=0.0, l2=1.0, max_delta_step=0.0, min_data_in_leaf=1.0,
+              min_sum_hessian=1e-3, min_gain_to_split=0.0)
+    fn = jax.jit(lambda b, s, l: fused_hist_scan(
+        b, s, l, slots, parent, ctx, meta_i, meta_f, num_bins, "int8",
+        split_kw=kw))
+    # block_until_ready: the kernel returns a (hist, records) pytree
+    jax.block_until_ready(fn(bins_tb, stats, leaf_b))  # compile
+    rates = []
+    for _ in range(max(reps, 3)):
+        t0 = time.time()
+        jax.block_until_ready(fn(bins_tb, stats, leaf_b))
+        rates.append(n_use / max(time.time() - t0, 1e-9))
+    return rates
+
+
+def autotune_resolve_ms_probe(num_bins):
+    """Wall ms of the steady-state autotune path: load the persisted
+    profile and resolve one shape bucket (the cost every learner
+    construction under tpu_autotune=load pays).  The measurement tunes a
+    throwaway profile first so the timed part is pure load+resolve."""
+    import tempfile
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.utils.autotune import resolve_autotune
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "autotune_profile.json")
+        cfg_tune = Config({"objective": "binary", "tpu_autotune": "tune",
+                           "tpu_autotune_profile": path})
+        resolve_autotune(cfg_tune, 8192, 8, num_bins, "int8")
+        cfg_load = Config({"objective": "binary", "tpu_autotune": "load",
+                           "tpu_autotune_profile": path})
+        t0 = time.time()
+        entry = resolve_autotune(cfg_load, 8192, 8, num_bins, "int8")
+        ms = (time.time() - t0) * 1e3
+        if entry is None:
+            raise RuntimeError("autotune round-trip lost its own entry")
+    return ms
+
+
 def spread(rates):
     """(median, min) of a repeat series — every timed metric reports its
     own variance (VERDICT item 7) instead of a single unqualified
@@ -537,6 +608,12 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         hist_rows_per_sec(bins_np, hist_bins, "int8"))
     hist_hilo, hist_hilo_min = spread(
         hist_rows_per_sec(bins_np, hist_bins, "hilo"))
+    # ISSUE 18: fused frontier megakernel throughput + the autotune
+    # profile round-trip cost, as first-class bench metrics with
+    # bench_diff rows
+    fused_frontier, fused_frontier_min = spread(
+        fused_frontier_rows_per_sec_probe(bins_np, hist_bins))
+    autotune_ms = autotune_resolve_ms_probe(hist_bins)
     n_programs = LEDGER.n_programs()
     ledger_sites = {a["site"]: a["programs"] for a in LEDGER.report()}
 
@@ -618,6 +695,13 @@ def run(n_rows, num_leaves, max_bin, bench_iters, degraded, comparable):
         "hist_int8_rows_per_sec_min": round(hist_int8_min, 0),
         "hist_hilo_rows_per_sec": round(hist_hilo, 0),
         "hist_hilo_rows_per_sec_min": round(hist_hilo_min, 0),
+        # ISSUE 18: per-iteration grow wall (the fused-frontier headline
+        # in ms terms), the grow megakernel's probe throughput, and the
+        # steady-state autotune profile load+resolve cost
+        "grow_iter_ms": round(1000.0 * train_s / max(bench_iters, 1), 2),
+        "fused_frontier_rows_per_sec": round(fused_frontier, 0),
+        "fused_frontier_rows_per_sec_min": round(fused_frontier_min, 0),
+        "autotune_resolve_ms": round(autotune_ms, 2),
         "ingest_rows_per_sec": round(ingest_rows_per_sec, 0),
         # ISSUE 16: out-of-core streaming — throughput at 4x the base
         # row count, overlap achieved, and the full scaling curve

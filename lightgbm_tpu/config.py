@@ -467,6 +467,22 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # at first device use (jax_compilation_cache_dir); CPU-destined
     # processes get a host-fingerprinted subdir (utils/backend.py)
     "tpu_compile_cache_dir": ("str", "", ()),
+    # persisted perf autotuning (utils/autotune.py): off | load | tune.
+    #   off  - every "auto" resolves from the built-in heuristics
+    #   load - resolve "auto" (hist impl x block, hist_agg) from the
+    #          measured profile file when a matching (backend, topology,
+    #          shape-bucket) entry exists; a profile recorded on a
+    #          DIFFERENT platform or device count is refused loudly
+    #          (AutotuneStaleProfile), never silently applied
+    #   tune - run the measurement sweep for this dataset's shape bucket
+    #          first (tools/perf_probe.py's hist sweep), persist the
+    #          winners, then resolve like load.  `perf_probe tune` runs
+    #          the same sweep standalone
+    "tpu_autotune": ("str", "off", ()),
+    # autotune profile path; empty = autotune_profile.json beside the
+    # persistent XLA compile cache (tpu_compile_cache_dir), or the
+    # in-repo .lgbtpu_autotune.json when no cache dir is set
+    "tpu_autotune_profile": ("str", "", ()),
     # rows per histogram scan block (device-side); 0 = auto (256 for the
     # pallas backend — its VMEM-resident accumulator wants short blocks —
     # 16384 for the xla scan, tuned for HBM streaming)
@@ -479,11 +495,19 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # tracks strict best-first closely even while histogramming K leaves
     # per pass
     "tpu_split_batch": ("int", 0, ()),
-    # batched-histogram backend: auto | xla | pallas | pallas2.  auto picks
-    # the hardware-validated pallas kernel on TPU when its VMEM working set
-    # fits (measured 1.9x over the xla scan on Higgs-1M: the one-hot never
-    # round-trips to HBM), else xla.  pallas2 = per-feature one-hot variant
-    # running 2-8k-row blocks (experimental until timed on hardware)
+    # batched-histogram backend: auto | xla | pallas | pallas2 | fused.
+    # auto picks the hardware-validated pallas kernel on TPU when its VMEM
+    # working set fits (measured 1.9x over the xla scan on Higgs-1M: the
+    # one-hot never round-trips to HBM), else xla.  pallas2 = per-feature
+    # one-hot variant running 2-8k-row blocks.  fused = the grow
+    # megakernel (ops/fused.py): pallas2's accumulator PLUS in-VMEM
+    # sibling subtraction and the split gain scan, emitting per-feature
+    # best-split records so split search never leaves the device.  The
+    # in-kernel scan engages on serial quantized (int8/int16) plain dense
+    # training — bit-identical models to the unfused path — and degrades
+    # to pallas2 + device select() everywhere else.  auto promotes
+    # int8/int16 to fused on TPU only after the runtime validation probe
+    # (fused.fused_scan_ok) passes; a Mosaic failure falls back LOUDLY
     "tpu_hist_impl": ("str", "auto", ()),
     # data-axis histogram aggregation (tree_learner=data / voting /
     # data_feature): psum | scatter | auto.
@@ -511,12 +535,17 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # only batch leaves whose gain >= alpha * the round's best gain (near
     # ties); keeps batched split order close to strict best-first
     "tpu_split_batch_alpha": ("float", 0.0, ()),
-    # row-partition lowering: select | vselect | gather (ops/grower.py
-    # GrowerParams.partition_impl; honored by every tree learner).
-    # vselect fuses the K unrolled select passes into one [K, n] block —
-    # fewer program points, but its CATEGORICAL path gathers per-row from
-    # a tiny table (the pattern select avoids); prefer select on
-    # categorical-heavy data until vselect is hardware-timed there
+    # row-partition lowering: select | vselect | gather | kernel
+    # (ops/grower.py GrowerParams.partition_impl; honored by every tree
+    # learner).  vselect fuses the K unrolled select passes into one
+    # [K, n] block — fewer program points, but its CATEGORICAL path
+    # gathers per-row from a tiny table (the pattern select avoids);
+    # prefer select on categorical-heavy data until vselect is
+    # hardware-timed there.  kernel = the pallas row->leaf partition
+    # (ops/fused.py partition_rows): vselect's exact integer math as one
+    # VMEM pass over the row blocks instead of a separate XLA program
+    # point — plain dense numerical columns only (no categoricals, EFB,
+    # sparse storage, or 4-bit packing)
     "tpu_partition_impl": ("str", "select", ()),
     # frontier ramp: unrolled K'=1,2,4,... pre-rounds before the full-K
     # loop (bit-identical trees, removes early rounds' dead-slot MXU

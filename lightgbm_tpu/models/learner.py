@@ -162,13 +162,16 @@ class TPUTreeLearner:
                                  "processes for pre_partition")
             self._partitioned = True
 
-        for key, allowed in (("tpu_partition_impl", ("select", "vselect", "gather")),
-                             ("tpu_hist_impl", ("auto", "xla", "pallas", "pallas2")),
+        for key, allowed in (("tpu_partition_impl", ("select", "vselect",
+                                                     "gather", "kernel")),
+                             ("tpu_hist_impl", ("auto", "xla", "pallas",
+                                                "pallas2", "fused")),
                              ("tpu_hist_precision", ("hilo", "bf16", "f32",
                                                      "f64", "int8", "int16")),
                              ("tpu_quant_round", ("stochastic", "nearest")),
                              ("tpu_hist_agg", ("auto", "psum", "scatter")),
-                             ("tpu_bucket_policy", ("fine", "wide"))):
+                             ("tpu_bucket_policy", ("fine", "wide")),
+                             ("tpu_autotune", ("off", "load", "tune"))):
             if str(getattr(config, key)) not in allowed:
                 raise ValueError(f"{key}={getattr(config, key)!r}; "
                                  f"expected one of {allowed}")
@@ -325,8 +328,19 @@ class TPUTreeLearner:
         # while the padded row count below depends on the resolved block.
         # (The perfeature kernel chunks the feature axis itself, so the
         # VMEM fit depends only on the bin count, not the feature width.)
-        hist_impl, block = self._resolve_hist_impl(config, B, precision)
-        if hist_impl == "pallas2":
+        # persisted autotune profile (utils/autotune.py): measured winners
+        # for this (platform, device count, shape bucket) override the
+        # "auto" heuristics below; a stale profile (other topology) raises
+        # AutotuneStaleProfile here rather than training on wrong winners
+        self._autotune_entry = None
+        if str(config.tpu_autotune) != "off":
+            from ..utils.autotune import resolve_autotune
+
+            self._autotune_entry = resolve_autotune(
+                config, n, self.num_features, B, precision)
+        hist_impl, block = self._resolve_hist_impl(
+            config, B, precision, tuned=self._autotune_entry)
+        if hist_impl in ("pallas2", "fused"):
             # the perfeature kernel chunks its feature grid in
             # sublane-aligned (multiple-of-32) divisors (ops/histogram.py
             # _hist_pallas); pad the histogram column axis so every width
@@ -863,9 +877,14 @@ class TPUTreeLearner:
         return "psum"
 
     @staticmethod
-    def _resolve_hist_impl(config: Config, num_bins: int,
-                           precision: str) -> Tuple[str, int]:
+    def _resolve_hist_impl(config: Config, num_bins: int, precision: str,
+                           tuned: Optional[dict] = None) -> Tuple[str, int]:
         """Resolve (tpu_hist_impl, tpu_block_rows), honoring "auto"/0.
+
+        `tuned` is the autotune profile entry for this shape bucket
+        (utils/autotune.resolve_autotune): its measured winners replace
+        the heuristics below wherever the config says "auto"/0 — an
+        explicit impl or block always wins over the profile.
 
         Auto picks the perfeature pallas kernel ("pallas2") on TPU: its
         largest VMEM temporary is a [Bp, block] one-hot (not the flat
@@ -880,6 +899,11 @@ class TPUTreeLearner:
         """
         impl = str(config.tpu_hist_impl)
         block = int(config.tpu_block_rows)
+        if tuned:
+            if impl == "auto" and tuned.get("hist_impl"):
+                impl = str(tuned["hist_impl"])
+            if block <= 0 and int(tuned.get("block_rows", 0) or 0) > 0:
+                block = int(tuned["block_rows"])
         if impl == "auto":
             from ..ops.histogram import _PERFEATURE_OUT_BUDGET
 
@@ -909,12 +933,31 @@ class TPUTreeLearner:
             # honors f32 via Precision.HIGHEST inside _hist_pallas).
             # int8 rides the same kernel (int8 MXU dots, int32 VMEM
             # accumulator; the [3, n] stats plane is leaner than hilo's
-            # [5, n]); int16 stays on xla in auto until Mosaic int16
-            # dots are hardware-validated — explicit pallas2 still works
+            # [5, n]).  int16 is no longer pinned to xla: the
+            # mosaic_int16_ok runtime probe (ops/fused.py) compiles and
+            # runs a tiny int16 perfeature kernel against the xla oracle
+            # on THIS backend, so auto promotes int16 exactly where the
+            # Mosaic int16 dot is hardware-validated and falls back
+            # loudly (probe logs a warning) where it is not
+            mosaic_ok = precision in ("hilo", "bf16", "int8")
+            if precision == "int16" and on_tpu and chunk_fits and block_ok:
+                from ..ops.fused import mosaic_int16_ok
+
+                mosaic_ok = mosaic_int16_ok()
             impl = ("pallas2" if on_tpu and chunk_fits and block_ok
-                    and precision in ("hilo", "bf16", "int8") else "xla")
+                    and mosaic_ok else "xla")
+            # fused promotion: the quantized precisions additionally run
+            # the split scan inside the grow megakernel when the traced
+            # scan validates against the unfused oracle on this backend
+            # (fused_scan_ok — again a loud fallback, never a silent one)
+            if impl == "pallas2" and precision in ("int8", "int16"):
+                from ..ops.fused import fused_scan_ok
+
+                if fused_scan_ok(precision):
+                    impl = "fused"
         if block <= 0:
-            block = {"pallas": 256, "pallas2": 8192}.get(impl, 16384)
+            block = {"pallas": 256, "pallas2": 8192,
+                     "fused": 8192}.get(impl, 16384)
         return impl, block
 
     @staticmethod
@@ -934,7 +977,7 @@ class TPUTreeLearner:
         if precision in ("int8", "int16"):
             return precision
         jax.config.update("jax_enable_x64", True)
-        if str(config.tpu_hist_impl).startswith("pallas"):
+        if str(config.tpu_hist_impl) in ("pallas", "pallas2", "fused"):
             raise ValueError(
                 "deterministic=true requires tpu_hist_impl=xla")
         return "f64"

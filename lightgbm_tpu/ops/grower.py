@@ -99,12 +99,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.compile_ledger import ledger_jit
+from .fused import fused_hist_scan, partition_rows
 from .histogram import (build_histogram_batched_t, build_histogram_sparse,
                         build_histogram_t, key_words, pack_stats,
                         quant_limit, quantize_values, unpack2d)
 from .split import (K_MIN_SCORE, SplitResult, argbest, finalize_split,
-                    leaf_output, leaf_split_gain, per_feature_best_split,
-                    per_feature_best_split_categorical,
+                    leaf_output, leaf_split_gain, numeric_go_left,
+                    per_feature_best_split,
+                    per_feature_best_split_categorical, unpack_pf_records,
                     MISSING_NAN, MISSING_ZERO)
 
 
@@ -371,6 +373,14 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             "sparse train-time storage (tpu_sparse_threshold) requires "
             "tree_learner=serial/data/voting, a select-family partition "
             "lowering, and no EFB bundling / 4-bit packing")
+    if params.partition_impl == "kernel" and (
+            params.has_cat or params.has_bundles or params.has_sparse
+            or params.packed_bins):
+        raise ValueError(
+            "tpu_partition_impl=kernel (the pallas row-partition) covers "
+            "plain dense numerical columns only — categorical splits, EFB "
+            "bundles, sparse storage, and 4-bit packing keep the "
+            "select-family lowerings")
     precision = params.precision
     # quantized-gradient mode (tpu_hist_precision=int16|int8): stats ride
     # the MXU as narrow ints, histograms/pool/psum/subtraction stay in
@@ -525,6 +535,23 @@ def _build_grower(params, num_features, data_axis, feature_axis,
         return gain, fin
 
     bynode = params.feature_fraction_bynode < 1.0
+
+    # in-kernel split scan (hist_impl="fused"): the frontier megakernel
+    # runs sibling subtraction + the gain scan in VMEM and the round body
+    # consumes its per-feature best records instead of calling select().
+    # It engages only where its records provably reproduce select() bit
+    # for bit: the serial learner on plain dense quantized columns (the
+    # int32 cumsums are exact; every excluded feature — sharding, voting,
+    # per-node masks, categorical/EFB/sparse/CEGB/forced, packed bins —
+    # reshapes the search itself).  Everywhere else "fused" still rides
+    # the perfeature VMEM histogram accumulator and the device-resident
+    # select(), so the mode degrades, never errors.
+    fused_scan = (params.hist_impl == "fused" and quantized
+                  and data_axis is None and feature_axis is None
+                  and not voting_k and not bynode
+                  and not params.has_cat and not params.has_bundles
+                  and not params.has_sparse and not params.has_cegb
+                  and not params.forced and not params.packed_bins)
 
     def grow(bins_t: jnp.ndarray,       # [G, n_pad] uint8/int32 (rows on
              #                            lanes; cols >= n zero-filled)
@@ -969,6 +996,23 @@ def _build_grower(params, num_features, data_axis, feature_axis,
         bins_blocks = jnp.moveaxis(bins_hist_t.reshape(Gd, nb, bcols), 1, 0)
         stats_blocks = stats.reshape(S, nb, block)
 
+        if fused_scan:
+            # static per-feature tables for the megakernel's in-VMEM scan
+            # (ops/fused.py layout); feature_mask is baked in because the
+            # fused predicate excludes per-node masks
+            zi = jnp.zeros(F, jnp.int32)
+            fmeta_i = jnp.stack(
+                [meta["num_bin"].astype(jnp.int32),
+                 meta["missing_type"].astype(jnp.int32),
+                 meta["default_bin"].astype(jnp.int32),
+                 meta["monotone"].astype(jnp.int32),
+                 zi, zi, zi, zi], axis=1)
+            zf = jnp.zeros(F, jnp.float32)
+            fmeta_f = jnp.stack(
+                [meta["penalty"].astype(jnp.float32),
+                 feature_mask.astype(jnp.float32),
+                 zf, zf, zf, zf, zf, zf], axis=1)
+
         if params.has_sparse:
             sp_idx_t = meta["sparse_idx"]
             sp_bin_t = meta["sparse_bin"]
@@ -1000,7 +1044,7 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             merged = jnp.concatenate([dense_h, sp], axis=-3)
             return jnp.take(merged, meta["hist_perm"], axis=-3)
         with jax.named_scope("hist_build"):
-            if params.hist_impl.startswith("pallas"):
+            if params.hist_impl in ("pallas", "pallas2", "fused"):
                 # reuse the batched VMEM kernel (slot 0 = the all-zero
                 # root leaf ids): the xla scan at pallas-sized short
                 # blocks would round-trip a materialized one-hot per
@@ -1151,15 +1195,6 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             in_rng = (rel >= 1) & (rel < nbf)
             return jnp.where(fixed, jnp.where(in_rng, rel, 0), raw)
 
-        def numeric_go_left(col, mt, nbf, db, thr, dleft):
-            """Numerical split decision incl. missing-value routing
-            (reference dense_bin.hpp Split semantics); elementwise, the
-            single source of truth for both partition lowerings."""
-            is_miss = jnp.where(
-                mt == MISSING_NAN, col == nbf - 1,
-                jnp.where(mt == MISSING_ZERO, col == db, False))
-            return jnp.where(is_miss, dleft, col <= thr)
-
         def exec_round(state, sel, vals, do_k, sel_feat, sel_thr, sel_dleft,
                        sel_iscat, cmask_sel, lg, lh, lc, lo, ro):
             """Execute up to Kr splits (slot k: leaf sel[k] on feature
@@ -1298,6 +1333,16 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                 moved_to = jnp.max(
                     jnp.where(move, new_ids[:, None], -1), axis=0)
                 leaf_ids = jnp.where(moved_to >= 0, moved_to, leaf_ids)
+            elif params.partition_impl == "kernel":
+                # pallas row-partition (ops/fused.py): one VMEM pass over
+                # the row blocks with the exact "vselect" integer math —
+                # plain dense numerical columns only (validated at build)
+                cols = bins_t[sel_feat]                      # [K, n_pad]
+                leaf_ids = partition_rows(
+                    cols, leaf_ids, sel, new_ids, sel_thr, sel_dleft,
+                    meta["missing_type"][sel_feat],
+                    meta["num_bin"][sel_feat],
+                    meta["default_bin"][sel_feat], do_k, nb, block)
             else:
                 # single-pass gather form: row->slot via an [L]-table
                 # lookup, then [K]-table lookups per row
@@ -1334,36 +1379,10 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                 leaf_ids = jnp.where(valid_r & (~go_left), new_ids[kk_r],
                                      leaf_ids)
 
-            # ---- histograms: all K smaller children in one contraction,
-            # siblings by subtraction (on the aggregated slice) ----
-            smaller_is_left = lc <= rc
-            smaller_ids = jnp.where(
-                do_k, jnp.where(smaller_is_left, sel, new_ids), -1)
-            # named_scope: the telemetry span names (hist_build /
-            # split_search) appear inside xprof device traces too —
-            # trace-time metadata, zero runtime cost
-            with jax.named_scope("hist_build"):
-                h_local = build_histogram_batched_t(
-                    bins_blocks, stats_blocks, leaf_ids.reshape(nb, block),
-                    smaller_ids, B, precision,
-                    impl=params.hist_impl,
-                    packed_rows=params.packed_bins)          # [K, F, B, 3]
-                h_local = merge_sparse_hist(h_local, leaf_ids, smaller_ids)
-                if sparse_tot:
-                    tot_small = preduce_scalar(jnp.sum(
-                        h_local[:, meta["dense_ref"][0]], axis=1))  # [K, 3]
-                hist_small = agg_hist(h_local)           # [K, F/P, B, 3]
-            parent_hist = state["pool"][sel]             # [K, F/P, B, 3]
-            hist_large = parent_hist - hist_small
-            sl = smaller_is_left[:, None, None, None]
-            hist_left = jnp.where(sl, hist_small, hist_large)
-            hist_right = jnp.where(sl, hist_large, hist_small)
-
-            pool = scatter_set(state["pool"], sel, hist_left, do_k)
-            pool = scatter_set(pool, new_ids, hist_right, do_k)
-
             # ---- monotone constraint propagation -----------------------
-            # (reference serial_tree_learner.cpp:840-851)
+            # (reference serial_tree_learner.cpp:840-851); computed before
+            # the histograms because the fused megakernel's in-VMEM scan
+            # needs the child constraint bounds in its ctx operand
             p_min = state["leaf_min"][sel]
             p_max = state["leaf_max"][sel]
             mono_k = meta["monotone"][sel_feat]
@@ -1372,6 +1391,64 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             l_max = jnp.where(mono_k > 0, mid, p_max)
             r_min = jnp.where(mono_k > 0, mid, p_min)
             r_max = jnp.where(mono_k < 0, mid, p_max)
+
+            # ---- histograms: all K smaller children in one contraction,
+            # siblings by subtraction (on the aggregated slice) ----
+            smaller_is_left = lc <= rc
+            smaller_ids = jnp.where(
+                do_k, jnp.where(smaller_is_left, sel, new_ids), -1)
+            parent_hist = state["pool"][sel]             # [K, F/P, B, 3]
+            if fused_scan:
+                # megakernel: histogram build + sibling subtraction + the
+                # split gain scan leave the kernel as [2K, F, RW] records;
+                # dead slots (do_k false) carry garbage records that the
+                # do_k-gated scatters below drop, exactly like the unfused
+                # path's garbage SplitResults
+                Cr = 2 * Kr
+                use_small = jnp.concatenate(
+                    [smaller_is_left, ~smaller_is_left]).astype(jnp.float32)
+                ctx = jnp.zeros((Cr + 1, 8), jnp.float32)
+                ctx = (ctx.at[:Cr, 0].set(jnp.concatenate([lg, rg]))
+                       .at[:Cr, 1].set(jnp.concatenate([lh, rh]))
+                       .at[:Cr, 2].set(jnp.concatenate([lc, rc]))
+                       .at[:Cr, 3].set(jnp.concatenate([l_min, r_min]))
+                       .at[:Cr, 4].set(jnp.concatenate([l_max, r_max]))
+                       .at[:Cr, 5].set(use_small)
+                       .at[Cr, 0].set(qscale[0])
+                       .at[Cr, 1].set(qscale[1])
+                       .at[Cr, 2].set(qscale[2]))
+                with jax.named_scope("fused_grow"):
+                    h_local, srecs = fused_hist_scan(
+                        bins_blocks, stats_blocks,
+                        leaf_ids.reshape(nb, block), smaller_ids,
+                        parent_hist, ctx, fmeta_i, fmeta_f, B, precision,
+                        split_kw=split_kw)
+                hist_small = h_local        # serial: agg_hist is identity
+            else:
+                # named_scope: the telemetry span names (hist_build /
+                # split_search) appear inside xprof device traces too —
+                # trace-time metadata, zero runtime cost
+                with jax.named_scope("hist_build"):
+                    h_local = build_histogram_batched_t(
+                        bins_blocks, stats_blocks,
+                        leaf_ids.reshape(nb, block),
+                        smaller_ids, B, precision,
+                        impl=params.hist_impl,
+                        packed_rows=params.packed_bins)      # [K, F, B, 3]
+                    h_local = merge_sparse_hist(h_local, leaf_ids,
+                                                smaller_ids)
+                    if sparse_tot:
+                        tot_small = preduce_scalar(jnp.sum(
+                            h_local[:, meta["dense_ref"][0]],
+                            axis=1))                         # [K, 3]
+                    hist_small = agg_hist(h_local)       # [K, F/P, B, 3]
+            hist_large = parent_hist - hist_small
+            sl = smaller_is_left[:, None, None, None]
+            hist_left = jnp.where(sl, hist_small, hist_large)
+            hist_right = jnp.where(sl, hist_large, hist_small)
+
+            pool = scatter_set(state["pool"], sel, hist_left, do_k)
+            pool = scatter_set(pool, new_ids, hist_right, do_k)
 
             # ---- best splits for all 2K children -----------------------
             new_state = dict(state)
@@ -1443,13 +1520,37 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             else:
                 delta = None
             with jax.named_scope("split_search"):
-                ch = vselect(
-                    jnp.concatenate([hist_left, hist_right], axis=0),
-                    jnp.concatenate([lg, rg]), jnp.concatenate([lh, rh]),
-                    jnp.concatenate([lc, rc]),
-                    jnp.concatenate([l_min, r_min]),
-                    jnp.concatenate([l_max, r_max]),
-                    child_masks, delta, tot_children)
+                if fused_scan:
+                    # consume the megakernel's device records: per child,
+                    # plain argmax over per-feature gains (features ascend,
+                    # so first-max == the serial lowest-feature tie-break)
+                    # and the same finalize_split the unfused fin_plain
+                    # applies — select() never sees these children
+                    def child_from_records(rec_c, sgc, shc, min_c, max_c):
+                        pf = unpack_pf_records(rec_c)
+                        bf = jnp.argmax(pf.gain).astype(jnp.int32)
+                        res = finalize_split(
+                            pf, bf, sgc, shc, l1=params.l1, l2=params.l2,
+                            max_delta_step=params.max_delta_step,
+                            min_constraint=min_c, max_constraint=max_c)
+                        return res._replace(
+                            is_cat=jnp.asarray(False),
+                            cat_mask=jnp.zeros(CB, jnp.float32))
+
+                    ch = jax.vmap(child_from_records)(
+                        srecs, jnp.concatenate([lg, rg]),
+                        jnp.concatenate([lh, rh]),
+                        jnp.concatenate([l_min, r_min]),
+                        jnp.concatenate([l_max, r_max]))
+                else:
+                    ch = vselect(
+                        jnp.concatenate([hist_left, hist_right], axis=0),
+                        jnp.concatenate([lg, rg]),
+                        jnp.concatenate([lh, rh]),
+                        jnp.concatenate([lc, rc]),
+                        jnp.concatenate([l_min, r_min]),
+                        jnp.concatenate([l_max, r_max]),
+                        child_masks, delta, tot_children)
 
             new_state["leaf_ids"] = leaf_ids
             new_state["pool"] = pool

@@ -296,7 +296,11 @@ def build_histogram_batched_t(bins_t_blocks, stats_blocks, leaf_blocks,
     impl: "xla" (lax.scan + dot_general) or "pallas" (fused VMEM kernel)
     Returns [K, F, B, 3] f32.
     """
-    if impl in ("pallas", "pallas2"):
+    if impl in ("pallas", "pallas2", "fused"):
+        # "fused" rides the perfeature VMEM accumulator here: the in-kernel
+        # split scan lives in ops/fused.py and only engages on the grower's
+        # frontier step — every other call site (root pass, streamed
+        # blocks, probes) builds plain histograms with the same kernel
         return _hist_pallas(
             bins_t_blocks, stats_blocks, leaf_blocks, slot_leaf_ids,
             num_bins, precision,

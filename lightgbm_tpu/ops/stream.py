@@ -225,7 +225,11 @@ def _build_stream_programs(params: GrowerParams, G: int, n_pad: int):
         stats_blk = jax.lax.dynamic_slice(stats, (0, row0), (S, rows))
         stats_blocks = stats_blk.reshape(S, nbi, block)
         with jax.named_scope("hist_build"):
-            if params.hist_impl.startswith("pallas"):
+            # "fused" rides the same perfeature contraction here: the
+            # streamed round body keeps its own partition/scan structure,
+            # so fused degrades to pallas2-equivalent hist + the shared
+            # select() — bit-identical by int32 associativity
+            if params.hist_impl in ("pallas", "pallas2", "fused"):
                 root_slots = jnp.full(K, -1, jnp.int32).at[0].set(0)
                 part = build_histogram_batched_t(
                     bins_blocks, stats_blocks,

@@ -31,6 +31,22 @@ _mod.pin_cpu_backend(force_device_count=8)
 import numpy as np
 import pytest
 
+
+@pytest.fixture(scope="session", autouse=True)
+def _blackbox_dumps_stay_out_of_the_repo(tmp_path_factory):
+    """Crash-path tests (OOM exhaustion, collective chaos) dump a
+    blackbox to the configured dir > $LIGHTGBM_TPU_BLACKBOX_DIR > cwd;
+    cwd is the repo root under pytest, which is exactly how the stale
+    `blackbox-host0.json` kept regrowing at the root (ISSUEs 16/18).
+    Default the env fallback to a session temp dir so no test can
+    strand a dump in the checkout; tests that assert on dump placement
+    still override via monkeypatch.setenv / fr.configure(dump_dir=...)."""
+    os.environ.setdefault(
+        "LIGHTGBM_TPU_BLACKBOX_DIR",
+        str(tmp_path_factory.mktemp("blackbox")))
+    yield
+
+
 REFERENCE_DIR = "/root/reference"
 ORACLE_BIN = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                           ".refbuild", "lightgbm")

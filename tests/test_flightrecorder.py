@@ -9,6 +9,7 @@ tpu_obs_* configuration wiring, and the off-mode overhead of a ring
 note staying negligible beside a training iteration.
 """
 
+import glob
 import json
 import os
 import subprocess
@@ -149,9 +150,12 @@ class TestDump:
 
     def test_no_blackbox_dump_is_tracked_or_stranded(self):
         """Regression for the stale `blackbox-host0.json` that sat at
-        the repo root (removed in ISSUE 16): no dump may be committed
-        — the .gitignore pattern must cover every canonical dump name,
-        and the repo root must not accumulate unignored dumps."""
+        the repo root (removed in ISSUE 16, then REGREW by ISSUE 18 —
+        the gitignore hid it from `git status` so nothing noticed): no
+        dump may be committed, the .gitignore pattern must cover every
+        canonical dump name, AND the repo root itself must hold no
+        on-disk dump — ignored-but-present is exactly the failure mode
+        this test exists to catch."""
         root = os.path.dirname(os.path.dirname(os.path.abspath(
             __file__)))
         if not os.path.isdir(os.path.join(root, ".git")):
@@ -162,6 +166,11 @@ class TestDump:
         assert tracked == [], f"blackbox dumps are tracked: {tracked}"
         gitignore = open(os.path.join(root, ".gitignore")).read()
         assert "blackbox-host*.json" in gitignore.split()
+        stranded = glob.glob(os.path.join(root, "blackbox-host*.json"))
+        assert stranded == [], (
+            f"stranded blackbox dumps at the repo root: {stranded} — "
+            "crash-path tests must dump into tmp_path (fr.dump(path=...))"
+            " and ad-hoc debugging runs must clean up after themselves")
 
     def test_dump_on_injected_collective_hang_names_the_site(self,
                                                              tmp_path):

@@ -86,6 +86,14 @@ METRICS = {
     # (bitwise models, bounded programs) live in tests/test_stream.py
     "stream_rows_per_sec": (+1, 0.35),
     "stream_overlap_pct": (+1, 0.50),
+    # fused frontier growth (ISSUE 18): per-iteration grow wall, the
+    # grow-megakernel probe throughput, and the steady-state autotune
+    # profile load+resolve cost.  The bitwise and program-count
+    # guarantees live in tests/test_fused_grow.py; these rows track the
+    # speed the fusion exists for
+    "grow_iter_ms": (-1, 0.30),
+    "fused_frontier_rows_per_sec": (+1, 0.30),
+    "autotune_resolve_ms": (-1, 0.50),
 }
 
 

@@ -31,6 +31,28 @@ def make_data(n, f=28, seed=42):
 _DS_CACHE = {}
 
 
+def _exc_inline(exc, limit=400):
+    """One-line failure description for keep-going sweeps.
+
+    The old truncation (`str(exc)[:120]`) routinely cut a jax trace-time
+    error before the part that names the failing primitive, and NEVER
+    showed the `__cause__` chain — a Mosaic lowering rejection surfaces
+    as a generic XlaRuntimeError whose cause carries the real story.
+    Keep the exception CLASS of every link in the chain plus the first
+    line of each message."""
+    parts = []
+    seen = set()
+    e = exc
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        msg = str(e).strip()
+        first = msg.splitlines()[0] if msg else ""
+        parts.append(f"{type(e).__name__}: {first}" if first
+                     else type(e).__name__)
+        e = e.__cause__
+    return " <- ".join(parts)[:limit]
+
+
 def run_one(X, y, k, block, impl, iters=8, leaves=255, bins=255,
             partition="select", precision="hilo", ramp=False, alpha=0.0):
     import lightgbm_tpu as lgb
@@ -87,8 +109,7 @@ def sweep(X, y, configs, iters=6, reraise=False):
         except Exception as exc:
             if reraise:
                 raise
-            print(f"{label}: FAILED {type(exc).__name__}: {str(exc)[:150]}",
-                  flush=True)
+            print(f"{label}: FAILED {_exc_inline(exc)}", flush=True)
 
 
 def run_predict_sweep(X, y, rounds=50, leaves=255, bins=255):
@@ -226,8 +247,84 @@ def run_hist_sweep(X, y, bins=255, reps=4):
                     print(f"{label}: {rps:14.0f} rows/s ({n_use} rows)",
                           flush=True)
                 except Exception as exc:
-                    print(f"{label}: FAILED {type(exc).__name__}: "
-                          f"{str(exc)[:120]}", flush=True)
+                    print(f"{label}: FAILED {_exc_inline(exc)}", flush=True)
+
+    # ---- frontier step (hist + split scan): the fused megakernel next
+    # to the exact unfused composition it replaces (perfeature hist +
+    # the vmapped 2K-child per-feature scan).  This is the acceptance
+    # microbench for tpu_hist_impl=fused: auto only claims fused on a
+    # backend where the fused rows beat the best unfused ones here ----
+    def one_frontier(precision, impl, block):
+        from lightgbm_tpu.ops import fused as FU
+        from lightgbm_tpu.ops import split as SP
+
+        n_cap = n_all if (on_tpu or impl == "xla") \
+            else min(n_all, max(4096, block))
+        if n_cap < block:
+            raise ValueError(f"need >= {block} rows, have {n_cap}")
+        bins_tb, stats, n_use = bench_hist_operands(
+            bins_np[:n_cap], precision, block)
+        nb = n_use // block
+        leaf_b = jnp.asarray(
+            rng.integers(0, K, size=n_use).astype(np.int32)
+            .reshape(nb, block))
+        slots = jnp.arange(K, dtype=jnp.int32)
+        C = 2 * K
+        ctx_np = np.zeros((C + 1, 8), np.float32)
+        ctx_np[:C, 0] = 100.0
+        ctx_np[:C, 1] = 200.0
+        ctx_np[:C, 2] = float(n_use) / C
+        ctx_np[:C, 3] = -1e30
+        ctx_np[:C, 4] = 1e30
+        ctx_np[:C, 5] = (np.arange(C) % 2).astype(np.float32)
+        ctx_np[C, :3] = (0.5, 0.25, 1.0)
+        ctx = jnp.asarray(ctx_np)
+        meta_i = jnp.zeros((F, 8), jnp.int32).at[:, 0].set(B)
+        meta_f = jnp.ones((F, 8), jnp.float32)
+        parent = jnp.ones((K, F, B, 3), jnp.int32) * (n_use // K)
+        kw = dict(l1=0.0, l2=1.0, max_delta_step=0.0, min_data_in_leaf=1.0,
+                  min_sum_hessian=1e-3, min_gain_to_split=0.0)
+        if impl == "fused":
+            fn = jax.jit(lambda b, s, l: FU.fused_hist_scan(
+                b, s, l, slots, parent, ctx, meta_i, meta_f, B, precision,
+                split_kw=kw))
+        else:
+            def unfused(b, s, l):
+                hist = build_histogram_batched_t(b, s, l, slots, B,
+                                                 precision, impl=impl)
+
+                def child(j):
+                    k = j % K
+                    small = hist[k]
+                    hs = jnp.where(ctx[j, 5] > 0, small, parent[k] - small)
+                    return SP.per_feature_best_split(
+                        hs, ctx[j, 0], ctx[j, 1], ctx[j, 2],
+                        meta_i[:, 0], meta_i[:, 1], meta_i[:, 2],
+                        meta_i[:, 3], meta_f[:, 0], meta_f[:, 1],
+                        min_constraint=ctx[j, 3], max_constraint=ctx[j, 4],
+                        acc_scale=ctx[C, :3], **kw)
+                return hist, jax.vmap(child)(jnp.arange(C))
+            fn = jax.jit(unfused)
+        # block_until_ready, not host_sync: both variants return a
+        # (hist, records/pf) pytree, not a single array
+        jax.block_until_ready(fn(bins_tb, stats, leaf_b))  # compile
+        t0 = time.time()
+        for _ in range(reps):
+            jax.block_until_ready(fn(bins_tb, stats, leaf_b))
+        return n_use * reps / max(time.time() - t0, 1e-9), n_use
+
+    print("\nfrontier step (hist + 2K-child split scan), fused vs "
+          "unfused:", flush=True)
+    for precision in ("int8", "int16"):
+        for impl, block in (("xla", 16384), ("pallas2", 8192),
+                            ("fused", 8192)):
+            label = f"prec={precision:<5s} impl={impl:<7s} block={block}"
+            try:
+                rps, n_use = one_frontier(precision, impl, block)
+                print(f"{label}: {rps:14.0f} rows/s ({n_use} rows)",
+                      flush=True)
+            except Exception as exc:
+                print(f"{label}: FAILED {_exc_inline(exc)}", flush=True)
 
     print("\nauto-selection (tpu_hist_impl=auto on this backend):",
           flush=True)
@@ -236,6 +333,45 @@ def run_hist_sweep(X, y, bins=255, reps=4):
                       "max_bin": bins, "tpu_hist_precision": precision})
         impl, block = TPUTreeLearner._resolve_hist_impl(cfg, B, precision)
         print(f"  {precision:<5s} -> impl={impl} block={block}", flush=True)
+
+
+def run_tune(bins=255):
+    """Autotune round-trip: measure + persist the profile for the bench
+    shape bucket, then print what tpu_hist_impl=auto resolves to FROM
+    the profile — the durable form of the hist sweep's verdict.
+
+        N=131072 PROFILE=/tmp/at.json python tools/perf_probe.py tune
+    """
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.models.learner import TPUTreeLearner
+    from lightgbm_tpu.utils import autotune as AT
+
+    n = int(os.environ.get("N", 131072))
+    f = int(os.environ.get("F", 28))
+    B = bins + 1
+    cfg = None
+    for precision in ("int8", "int16", "hilo"):
+        params = {"objective": "binary", "num_leaves": 255,
+                  "max_bin": bins, "tpu_hist_precision": precision,
+                  "tpu_autotune": "tune"}
+        if os.environ.get("PROFILE"):
+            params["tpu_autotune_profile"] = os.environ["PROFILE"]
+        cfg = Config(params)
+        try:
+            entry = AT.resolve_autotune(cfg, n, f, B, precision)
+        except Exception as exc:
+            print(f"{precision:<5s}: FAILED {_exc_inline(exc)}", flush=True)
+            continue
+        print(f"{precision:<5s} bucket={AT.shape_bucket(n, f, B)} -> "
+              f"{entry['hist_impl']}:{entry['block_rows']} "
+              f"({entry['rows_per_sec']:.0f} rows/s)", flush=True)
+        for ck, rps in sorted(entry.get("table", {}).items()):
+            print(f"    {ck:<14s} {rps:14.0f} rows/s", flush=True)
+        impl, block = TPUTreeLearner._resolve_hist_impl(
+            cfg, B, precision, tuned=entry)
+        print(f"    resolved auto -> impl={impl} block={block}", flush=True)
+    if cfg is not None:
+        print(f"profile: {AT.profile_path(cfg)}", flush=True)
 
 
 def run_ingest_sweep(X, y, bins=255):
@@ -1079,6 +1215,9 @@ def main():
             spec.loader.exec_module(mod)
             mod.pin_cpu_backend(force_device_count=max(shard_counts))
         run_comm_sweep(shard_counts)
+        return
+    if arg == "tune":
+        run_tune(bins=int(os.environ.get("BINS", 255)))
         return
     n = int(os.environ.get("N", 1_000_000))
     X, y = make_data(n)
