@@ -200,6 +200,29 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # LRU models are evicted ahead of demand so a dispatch never has
     # to OOM first
     "serving_hbm_pressure_frac": ("float", 0.85, ()),
+    # --- serving: fleet-scale dispatch (ISSUE 19) ---
+    # devices each model's packed forest replicates across (the batcher
+    # grows one dispatch worker per device, least-loaded routed).
+    # 0 = auto: every local device on accelerator backends, ONE on CPU
+    # hosts (forced virtual CPU devices share the same physical cores —
+    # replication there multiplies warmup compiles without adding
+    # throughput).  Capped at the local device count
+    "serving_devices": ("int", 0, ()),
+    # packed-table storage precision for serving replicas:
+    #   f32   — byte-identical to the training pack (default)
+    #   bf16  — leaf values stored bfloat16 (identical decision path;
+    #           per-leaf value error <= 2^-8 relative)
+    #   int16 — node tables AND leaf values int16; leaves dequantize
+    #           per-tree with an f32 scale (exact decision-path parity:
+    #           bin-space thresholds are small ints that fit int16)
+    "serving_table_precision": ("str", "f32", ()),
+    # AOT executable cache directory: every bucket-ladder launch shape
+    # is jit-lowered, compiled and serialized here at load time, so a
+    # cold replica (process restart, continual-learning promotion, LRU
+    # re-load) serves its first batch with ZERO new compiled programs.
+    # "" = derive `<tpu_compile_cache_dir>/serving_aot` when the
+    # persistent compile cache is configured, else AOT serving is off
+    "serving_aot_cache_dir": ("str", "", ()),
     # --- serving: model & data health (ISSUE 14) ---
     # rows per predict batch the drift monitor stride-samples into its
     # accumulator (models carrying a tpu_feature_profile trailer only).

@@ -34,12 +34,13 @@ answer "who hung first".
 
 The dump directory resolves: `configure(dump_dir=...)` (the
 ``tpu_obs_blackbox_dir`` param) > ``LIGHTGBM_TPU_BLACKBOX_DIR`` env >
-the live ``tpu_trace_dir`` > the working directory.  Wherever it
-lands, the FILENAME is always the canonical ``blackbox-host<k>.json``
-— the exact pattern the repo's .gitignore carries — so a dump written
-into a source checkout (the working-directory fallback) never turns
-into an accidentally-committed artifact; callers that pass `path=` a
-directory get the canonical name joined under it.
+the live ``tpu_trace_dir`` > the working directory — EXCEPT when the
+working directory is a source checkout (a ``.git`` entry is present),
+which falls through to the system temp dir instead: .gitignore or
+not, a crash artifact must never regrow at a repo root and ride into
+a commit.  Wherever it lands, the FILENAME is always the canonical
+``blackbox-host<k>.json``; callers that pass `path=` a directory get
+the canonical name joined under it.
 """
 
 from __future__ import annotations
@@ -144,7 +145,17 @@ def blackbox_dir() -> str:
     from .trace import trace_dir
 
     td = trace_dir()
-    return td if td else os.getcwd()
+    if td:
+        return td
+    cwd = os.getcwd()
+    if os.path.exists(os.path.join(cwd, ".git")):
+        # a source checkout: a crash dump written here would sit at the
+        # repo root waiting to be committed — park it in temp instead
+        # (an EXPLICIT dir via param/env/path is always honored as-is)
+        import tempfile
+
+        return tempfile.gettempdir()
+    return cwd
 
 
 def dump(reason: str, path: Optional[str] = None,

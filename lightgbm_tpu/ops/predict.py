@@ -41,6 +41,58 @@ from ..utils.compile_ledger import ledger_jit
 _NODE_KEYS = ("split_feature", "threshold", "decision_type",
               "left_child", "right_child", "cat_start", "cat_width")
 
+# serving-table storage precisions (serving_table_precision):
+#   f32   — the training pack verbatim (byte-identical path)
+#   bf16  — node tables int16 where ranges fit, leaf values bfloat16
+#   int16 — node tables AND leaf values int16; leaves dequantize
+#           per-tree through an f32 `leaf_scale` column
+SERVING_PRECISIONS = ("f32", "bf16", "int16")
+
+
+def check_serving_precision(precision: str) -> str:
+    if precision not in SERVING_PRECISIONS:
+        raise ValueError(
+            f"serving_table_precision={precision!r}; expected one of "
+            f"{SERVING_PRECISIONS}")
+    return precision
+
+
+def quantize_tables(tables: Dict[str, np.ndarray],
+                    precision: str) -> Dict[str, np.ndarray]:
+    """Serving-precision copy of a host `pack_trees` table dict.
+
+    Bin-space thresholds, feature ids and child codes are small ints, so
+    every node table narrows to int16 whenever its value range fits —
+    the traversal compares/steps the SAME integers, keeping decision-path
+    parity exact (a table whose range overflows int16, e.g. a >32767-bin
+    threshold column or a huge `cat_start` pool offset, stays int32).
+    Leaf values store bfloat16 (`bf16`) or int16 with a per-tree f32
+    dequantization scale (`int16`, scale = max|leaf|/32767); `f32`
+    returns a shallow copy so the default path stays byte-identical.
+    The `cat_words` bitset pool is shared uint32 either way.
+    """
+    p = check_serving_precision(precision)
+    out = dict(tables)
+    if p == "f32":
+        return out
+    for key in _NODE_KEYS + ("init_node",):
+        v = tables[key]
+        if v.size == 0 or (int(v.min()) >= -32768 and int(v.max()) <= 32767):
+            out[key] = v.astype(np.int16)
+    lv = np.asarray(tables["leaf_value"], np.float32)
+    if p == "bf16":
+        from ml_dtypes import bfloat16
+
+        out["leaf_value"] = lv.astype(bfloat16)
+    else:
+        absmax = np.abs(lv).max(axis=1) if lv.size else np.zeros(
+            lv.shape[0], np.float32)
+        scale = np.where(absmax > 0, absmax / 32767.0, 1.0).astype(np.float32)
+        out["leaf_value"] = np.clip(
+            np.rint(lv / scale[:, None]), -32767, 32767).astype(np.int16)
+        out["leaf_scale"] = scale
+    return out
+
 # ---- launch-shape bucket policy -------------------------------------------
 # The ONE quantization rule shared by training-time score replay, the
 # chunked predict path, serving warmup enumeration, and bench — so every
@@ -208,7 +260,10 @@ def _leaf_values_impl(tables, bins, num_bin, default_bin, missing_type,
     """
     bins_t = bins.T                                        # [F, n]
     T = tables["leaf_value"].shape[0]
-    node0 = jnp.broadcast_to(tables["init_node"][:, None],
+    # int32 traversal state regardless of table storage width: quantized
+    # serving tables (int16 node columns) promote through the compares
+    # and child steps, so the walked path is the same exact integers
+    node0 = jnp.broadcast_to(tables["init_node"].astype(jnp.int32)[:, None],
                              (T, bins_t.shape[1]))
 
     def body(_, node):
@@ -235,12 +290,18 @@ def _leaf_values_impl(tables, bins, num_bin, default_bin, missing_type,
         nxt = jnp.where(go_left,
                         jnp.take_along_axis(tables["left_child"], nid, axis=1),
                         jnp.take_along_axis(tables["right_child"], nid,
-                                            axis=1))
+                                            axis=1)).astype(jnp.int32)
         return jnp.where(node >= 0, nxt, node)
 
     node = lax.fori_loop(0, depth, body, node0)
     leaf = jnp.where(node < 0, ~node, 0)
-    return jnp.take_along_axis(tables["leaf_value"], leaf, axis=1)
+    vals = jnp.take_along_axis(tables["leaf_value"], leaf, axis=1)
+    if vals.dtype != jnp.float32:
+        # quantized serving storage: accumulate in f32 regardless
+        vals = vals.astype(jnp.float32)
+    if "leaf_scale" in tables:
+        vals = vals * tables["leaf_scale"][:, None]
+    return vals
 
 
 # the standalone jitted entry; `_class_scores_kernel` inlines the impl
@@ -357,6 +418,18 @@ class PackedForest:
                     [self._host["cat_words"], tables["cat_words"][1:]])
             self._count = need
         return self._count
+
+    def host(self, num_trees: int = -1) -> Dict[str, np.ndarray]:
+        """HOST tables for the first `num_trees` trees (-1 = all) — the
+        same slicing contract as `device`, zero uploads: the fleet
+        registry quantizes from these before placing per-device
+        replicas (ISSUE 19)."""
+        host = {k: (v[:self._count] if k != "cat_words" else v)
+                for k, v in self._host.items()}
+        if num_trees < 0 or num_trees >= self._count:
+            return host
+        return {k: (v[:num_trees] if k != "cat_words" else v)
+                for k, v in host.items()}
 
     def device(self, num_trees: int = -1) -> Dict[str, jnp.ndarray]:
         """Device tables for the first `num_trees` trees (-1 = all)."""

@@ -103,7 +103,7 @@ class AdmissionController:
                  interval_ms: float = 100.0, step_rows: int = 512,
                  backoff: float = 0.5, min_wait_ms: float = 0.0,
                  max_wait_ms: float = 2.0, retry_after_ms: float = 1000.0,
-                 enabled: bool = True):
+                 enabled: bool = True, devices: int = 1):
         self.stats = stats
         self.slo_s = max(float(slo_ms), 1e-3) / 1e3
         self.queue_rows = max(int(queue_rows), 1)
@@ -111,7 +111,11 @@ class AdmissionController:
         # crushed by a long outage still serves probes that re-grow it
         self.min_level = min(max(int(max_batch_rows), 1), self.queue_rows)
         self.interval_s = max(float(interval_ms), 1.0) / 1e3
-        self.step_rows = max(int(step_rows), 1)
+        # the additive re-probe scales with dispatch lanes (ISSUE 19):
+        # an 8-device fleet regains admitted capacity 8x as fast after
+        # a shed, matching its 8x drain rate — the multiplicative
+        # backoff stays per-SLO, capacity-independent
+        self.step_rows = max(int(step_rows), 1) * max(int(devices), 1)
         self.backoff = min(max(float(backoff), 0.05), 0.95)
         self.min_wait_s = max(float(min_wait_ms), 0.0) / 1e3
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3

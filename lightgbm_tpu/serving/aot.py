@@ -1,0 +1,137 @@
+"""AOT-compiled serving cold starts: serialized bucket executables.
+
+A cold replica — process restart, continual-learning promotion, LRU
+re-load — used to pay one XLA compile per bucket-ladder launch shape
+before its first request could meet the p99 SLO.  This module closes
+that gap: at load time every (device, row-bucket) launch of the
+class-scores kernel is `lower().compile()`d once and serialized beside
+the model through `jax.experimental.serialize_executable`; the next
+load of the same model `deserialize_and_load`s the executables and the
+first served batch runs with ZERO new compiled programs (the compile
+ledger proves it — the AOT path never enters the jit cache at all).
+
+Cache layout: one file per (model signature, device, bucket) under
+`serving_aot_cache_dir` (or `<tpu_compile_cache_dir>/serving_aot` when
+only the PR-4 persistent XLA cache is configured):
+
+    <sig16>-d<device_id>-b<bucket>.aotx
+
+`<sig16>` hashes the PR-6 `warm_signature` (chunk, batch rows, bucket
+policy, feature count, class count, depth bucket, table shapes+dtypes
+— quantization precision changes the dtypes, so each precision keys
+its own executables) together with the jax version, backend platform
+and device kind.  Serialized executables are pinned to the device they
+compiled on, hence the `d<device_id>` coordinate.  Invalidation is by
+construction: any drift in the signature, jax version or device simply
+hashes to a file that does not exist.  A corrupted or stale blob fails
+`load_bucket` and the registry degrades to a logged warm compile — a
+bad cache entry can slow a load, never fail it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import tempfile
+from typing import Optional
+
+_MAGIC = "lgbm-aotx-v1"
+
+
+def cache_dir(config) -> Optional[str]:
+    """The AOT executable cache root, or None when AOT serving is off.
+
+    `serving_aot_cache_dir` wins; otherwise ride beside the persistent
+    XLA compile cache when one is configured."""
+    explicit = str(getattr(config, "serving_aot_cache_dir", "") or "")
+    if explicit:
+        return explicit
+    base = str(getattr(config, "tpu_compile_cache_dir", "") or "")
+    if base:
+        return os.path.join(base, "serving_aot")
+    return None
+
+
+def signature_hash(warm_sig, device) -> str:
+    """16-hex content key for one model's executables on one device
+    kind.  Everything that can change the compiled program is in the
+    preimage; the device id rides in the file name (executables are
+    device-pinned), the kind in the hash (a TPU blob must never match
+    a CPU host)."""
+    import jax
+
+    payload = repr((warm_sig, jax.__version__, device.platform,
+                    getattr(device, "device_kind", "")))
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+def bucket_path(dirpath: str, sig: str, device_id: int, bucket: int) -> str:
+    return os.path.join(dirpath, f"{sig}-d{int(device_id)}-b{int(bucket)}.aotx")
+
+
+def compile_bucket(tables_dev, num_feature: int, bucket: int, meta_dev,
+                   depth_bucket: int, k: int):
+    """One warm AOT compile of the class-scores kernel for `bucket`
+    rows on the device holding `tables_dev`.
+
+    Goes through `_class_scores_kernel.lower().compile()` — NOT the
+    kernel's `__call__` — so neither the jit cache nor the compile
+    ledger grows a program; the returned executable is invoked directly
+    by the replica predict path."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.predict import _class_scores_kernel
+
+    sharding = jax.sharding.SingleDeviceSharding(
+        next(iter(tables_dev["init_node"].devices())))
+    bins_aval = jax.ShapeDtypeStruct((int(bucket), int(num_feature)),
+                                     jnp.int32, sharding=sharding)
+    nb, db, mt = meta_dev
+    scale = jax.device_put(jnp.float32(1.0), sharding)
+    lowered = _class_scores_kernel.lower(
+        tables_dev, bins_aval, nb, db, mt, scale,
+        depth=int(depth_bucket), has_cat=bool(
+            int(tables_dev["cat_words"].shape[0]) > 1), k=int(k))
+    return lowered.compile()
+
+
+def save_bucket(path: str, compiled) -> None:
+    """Serialize one compiled executable atomically (tmp+rename, like
+    every other artifact writer in the repo — a torn .aotx must never
+    exist under the canonical name)."""
+    from jax.experimental import serialize_executable as se
+
+    blob, in_tree, out_tree = se.serialize(compiled)
+    payload = pickle.dumps({"magic": _MAGIC, "blob": blob,
+                            "in_tree": in_tree, "out_tree": out_tree},
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path) or ".",
+                               prefix=os.path.basename(path) + ".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def load_bucket(path: str):
+    """Deserialize one executable; raises on ANY corruption/staleness
+    (missing file, bad magic, unpicklable tree, runtime rejection) —
+    the caller turns that into a logged warm compile, never a failed
+    model load."""
+    from jax.experimental import serialize_executable as se
+
+    with open(path, "rb") as f:
+        payload = pickle.loads(f.read())
+    if not isinstance(payload, dict) or payload.get("magic") != _MAGIC:
+        raise ValueError(f"not a {_MAGIC} executable: {path}")
+    return se.deserialize_and_load(payload["blob"], payload["in_tree"],
+                                   payload["out_tree"])

@@ -13,8 +13,14 @@ compile) each.  The batcher:
   `serving_min_wait_ms` and `serving_max_wait_ms`; static
   `serving_max_wait_ms` without a controller), dispatching early once
   `max_batch_rows` rows have coalesced,
-* runs batches on ONE worker thread (device access is serialized; jit
-  caches and packed-forest tables never see concurrent mutation),
+* runs batches on one dispatch worker PER SERVING DEVICE (ISSUE 19):
+  a replicated model's batches route to the least-loaded worker
+  (queued rows + in-flight rows) whose device the entry reports
+  routable, so a wedged or OOMing device routes around, not down;
+  non-replicated runners pin to worker 0, which preserves the original
+  serialized-dispatch semantics (each worker serializes ITS device's
+  access; jit caches and packed-forest tables never see concurrent
+  mutation because replicas are per-device objects),
 * scatters each request's row slice back and wakes its caller,
 * sheds load at admission time: past `queue_rows` queued rows new
   requests fail immediately with `ServingQueueFull` instead of growing
@@ -102,14 +108,23 @@ class _Request:
 
 
 class _KeyState:
-    """Per-batch-key dispatch plumbing: the runner plus its failover."""
+    """Per-batch-key dispatch plumbing: the runner plus its failover.
 
-    __slots__ = ("runner", "fallback", "on_error")
+    `per_device` runners accept a `device=` kwarg (the worker index the
+    batch landed on); `device_ok(index)` is the registry's NON-consuming
+    routability filter (per-replica breaker peek) the router applies
+    before load scoring."""
 
-    def __init__(self, runner, fallback=None, on_error=None):
+    __slots__ = ("runner", "fallback", "on_error", "per_device",
+                 "device_ok")
+
+    def __init__(self, runner, fallback=None, on_error=None,
+                 per_device=False, device_ok=None):
         self.runner = runner
         self.fallback = fallback
         self.on_error = on_error
+        self.per_device = bool(per_device)
+        self.device_ok = device_ok
 
 
 class _SerialDispatcher:
@@ -166,14 +181,113 @@ class _SerialDispatcher:
         return done, box
 
 
+class _DeviceWorker:
+    """One device's dispatch lane: a bounded hand-off queue, a thread
+    that runs batches strictly one at a time, and its own serial
+    watchdog helper (an abandoned dispatch wedges THIS device's lane;
+    siblings keep serving).  Per-device goodput accounting feeds
+    `MicroBatcher.device_snapshot()` (the `serve_bench --devices`
+    breakdown) without touching the shared stats lock on the hot path.
+
+    Lock order: `MicroBatcher._cv` and `_DeviceWorker._cv` are never
+    held together — the router reads `load()` and calls `put()` after
+    releasing the batcher lock, and `_run`'s completion accounting
+    (`_batch_done`) takes only the batcher lock."""
+
+    _LAT_RING = 512  # bounded per-device batch-wall samples (p99 window)
+
+    def __init__(self, batcher: "MicroBatcher", index: int):
+        self.batcher = batcher
+        self.index = int(index)
+        self._cv = threading.Condition(
+            lockcheck.make_lock(f"serving.worker{index}"))
+        self._work: deque = deque()
+        self._queued_rows = 0
+        self._inflight_rows = 0
+        self._stop = False
+        self._dispatches = 0
+        self._rows_done = 0
+        self._wall_s = 0.0
+        self._lat: deque = deque(maxlen=self._LAT_RING)
+        self.dispatcher = _SerialDispatcher()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        with self._cv:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop,
+                    name=f"lgbm-serving-worker{self.index}", daemon=True)
+                self._thread.start()
+
+    def close(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+    def load(self) -> int:
+        """Routing score: rows queued on + in flight through this lane."""
+        with self._cv:
+            return self._queued_rows + self._inflight_rows
+
+    def put(self, ks: _KeyState, batch, rows: int) -> None:
+        with self._cv:
+            self._work.append((ks, batch, int(rows)))
+            self._queued_rows += int(rows)
+            self._cv.notify_all()
+
+    def note(self, rows: int, wall_s: float) -> None:
+        with self._cv:
+            self._dispatches += 1
+            self._rows_done += int(rows)
+            self._wall_s += float(wall_s)
+            self._lat.append(float(wall_s))
+
+    def snapshot(self) -> dict:
+        with self._cv:
+            lat = sorted(self._lat)
+            p99 = lat[min(int(0.99 * (len(lat) - 1) + 0.5),
+                          len(lat) - 1)] if lat else 0.0
+            return {"device": self.index,
+                    "dispatches": self._dispatches,
+                    "rows": self._rows_done,
+                    "wall_s": round(self._wall_s, 6),
+                    "dispatch_p99_ms": round(p99 * 1e3, 3),
+                    "queued_rows": self._queued_rows,
+                    "inflight_rows": self._inflight_rows}
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._work and not self._stop:
+                    self._cv.wait()
+                if not self._work:
+                    return  # stopping with an empty lane: nothing lost
+                ks, batch, rows = self._work.popleft()
+                self._queued_rows -= rows
+                self._inflight_rows += rows
+            try:
+                # _run completes the batch end-to-end (dispatch,
+                # failover, scatter, _batch_done accounting)
+                self.batcher._run(ks, batch, device=self.index,
+                                  worker=self)
+            finally:
+                with self._cv:
+                    self._inflight_rows -= rows
+
+
 class MicroBatcher:
-    """Bounded coalescing queue + single dispatch worker."""
+    """Bounded coalescing queue + one dispatch worker per device."""
 
     def __init__(self, max_batch_rows: int = 4096, max_wait_ms: float = 2.0,
                  queue_rows: int = 65536,
                  stats: Optional[ServingStats] = None,
                  window_fn: Optional[Callable[[], float]] = None,
-                 dispatch_timeout_ms: float = 0.0):
+                 dispatch_timeout_ms: float = 0.0,
+                 devices: int = 1):
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self.max_wait_s = max(float(max_wait_ms), 0.0) / 1e3
         self.queue_rows = max(int(queue_rows), 1)
@@ -182,14 +296,30 @@ class MicroBatcher:
         self.window_fn = window_fn
         self.dispatch_timeout_s = max(float(dispatch_timeout_ms), 0.0) / 1e3
         self._cv = threading.Condition()
-        self._dispatcher = _SerialDispatcher()
+        self._workers = [_DeviceWorker(self, i)
+                         for i in range(max(int(devices), 1))]
         self._queues: "OrderedDict[Hashable, deque]" = OrderedDict()
         self._runners: "dict[Hashable, _KeyState]" = {}
+        # rows IN THE SYSTEM: queued here, handed to a worker lane, or
+        # in flight on a device.  Decremented when the batch COMPLETES
+        # (`_batch_done`), not at pop — `queue_rows` stays a true bound
+        # on admitted-but-unfinished work, and the admission gate sees
+        # the real backlog across every lane.  (Expired/abandoned rows
+        # leave at pop; they never reach a lane.)
         self._pending_rows = 0
         self._stop = False
         self._draining = False
         self._drained = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    @property
+    def devices(self) -> int:
+        return len(self._workers)
+
+    def device_snapshot(self) -> list:
+        """Per-device dispatch accounting (the `serve_bench --devices`
+        breakdown): dispatches, rows, wall, p99, live lane depth."""
+        return [w.snapshot() for w in self._workers]
 
     # ------------------------------------------------------------------
     def start(self) -> "MicroBatcher":
@@ -202,6 +332,8 @@ class MicroBatcher:
                     target=self._loop, name="lgbm-serving-batcher",
                     daemon=True)
                 self._thread.start()
+        for w in self._workers:
+            w.start()
         return self
 
     def drain(self, timeout_s: float = 10.0) -> bool:
@@ -212,14 +344,15 @@ class MicroBatcher:
         call twice; `close()` implies it."""
         with self._cv:
             self._draining = True
-            if not self._queues and (self._thread is None
-                                     or not self._thread.is_alive()):
+            if not self._queues and self._pending_rows == 0 \
+                    and (self._thread is None
+                         or not self._thread.is_alive()):
                 self._drained.set()
             self._cv.notify_all()
         if self._thread is None or not self._thread.is_alive():
             # no worker: queued requests can never flush; report state
             with self._cv:
-                return not self._queues
+                return not self._queues and self._pending_rows == 0
         return self._drained.wait(timeout_s)
 
     @property
@@ -233,6 +366,9 @@ class MicroBatcher:
             self._cv.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+        # workers flush their lanes before exiting (zero requests lost)
+        for w in self._workers:
+            w.close()
 
     # ------------------------------------------------------------------
     def submit(self, key: Hashable, runner: Callable[[np.ndarray], np.ndarray],
@@ -248,7 +384,9 @@ class MicroBatcher:
                     runner: Callable[[np.ndarray], np.ndarray],
                     slices, deadline: Optional[float] = None,
                     fallback: Optional[Callable] = None,
-                    on_error: Optional[Callable] = None) -> list:
+                    on_error: Optional[Callable] = None,
+                    per_device: bool = False,
+                    device_ok: Optional[Callable] = None) -> list:
         """Enqueue the slices of ONE logical request atomically:
         admission is all-or-nothing (a mid-request shed would leave
         already-queued slices burning device time for a caller that
@@ -257,7 +395,10 @@ class MicroBatcher:
         deadline: absolute monotonic expiry propagated from the caller
         (X-Deadline-Ms); slices still queued past it are cancelled at
         pop time instead of dispatched.  fallback/on_error: the
-        device-failover hooks (see module docstring)."""
+        device-failover hooks (see module docstring).  per_device: the
+        runner accepts `device=` and batches may route to any worker;
+        device_ok(index): non-consuming routability filter applied
+        before least-loaded selection."""
         group: dict = {}
         reqs = [_Request(X, deadline, group) for X in slices]
         if not reqs:
@@ -279,7 +420,8 @@ class MicroBatcher:
             if key not in self._queues:
                 self._queues[key] = deque()
             self._queues[key].extend(reqs)
-            self._runners[key] = _KeyState(runner, fallback, on_error)
+            self._runners[key] = _KeyState(runner, fallback, on_error,
+                                           per_device, device_ok)
             self._pending_rows += total
             self.stats.set_queue_depth(self._pending_rows)
             self._cv.notify_all()
@@ -318,9 +460,11 @@ class MicroBatcher:
         while True:
             with self._cv:
                 while not self._stop and not self._queues:
-                    if self._draining:
-                        # flushed: report drain completion, then park
-                        # (close() wakes us to exit)
+                    if self._draining and self._pending_rows == 0:
+                        # flushed AND every lane ran dry (_pending_rows
+                        # counts in-flight work; _batch_done notifies):
+                        # report drain completion, then park (close()
+                        # wakes us to exit)
                         self._drained.set()
                     self._cv.wait()
                 if self._stop and not self._queues:
@@ -378,29 +522,65 @@ class MicroBatcher:
                     # device forest included) long past LRU eviction
                     del self._queues[key]
                     del self._runners[key]
-                self._pending_rows -= take + dropped
+                # only dropped rows leave the system here; dispatched
+                # rows stay in _pending_rows until _batch_done
+                self._pending_rows -= dropped
                 self.stats.set_queue_depth(self._pending_rows)
             if batch:
-                self._run(ks, batch)
+                # hand off OUTSIDE the cv: load reads and put() take the
+                # worker's own lock (never nested with self._cv)
+                self._pick_worker(ks).put(ks, batch, take)
+
+    def _pick_worker(self, ks: _KeyState) -> _DeviceWorker:
+        """Least-loaded routing (queued + in-flight rows) over the
+        workers whose device the entry reports routable; a runner that
+        is not per-device pins to worker 0 (single serialized lane —
+        the pre-fleet semantics raw runners and tests rely on).  When
+        EVERY device is filtered out the router falls back to all of
+        them: the dispatch path's own breaker/failover machinery gets
+        to decide, rather than the batch dying in queue."""
+        workers = self._workers
+        if not ks.per_device or len(workers) == 1:
+            return workers[0]
+        eligible = workers
+        if ks.device_ok is not None:
+            try:
+                ok = [w for w in workers if ks.device_ok(w.index)]
+            except Exception:  # pragma: no cover - defensive
+                ok = []
+            if ok:
+                eligible = ok
+        return min(eligible, key=lambda w: w.load())
+
+    def _batch_done(self, rows: int) -> None:
+        """A dispatched batch finished (served, failed over, or
+        errored): its rows leave the system and the drain/admission
+        accounting re-checks."""
+        with self._cv:
+            self._pending_rows -= int(rows)
+            self.stats.set_queue_depth(self._pending_rows)
+            self._cv.notify_all()
 
     # ------------------------------------------------------------------
-    def _dispatch(self, runner, X):
+    def _dispatch(self, runner, X, worker: _DeviceWorker):
         """One runner call, bounded by dispatch_timeout_s when armed.
 
         A hang is indistinguishable from slow device work from inside
-        this thread, so the bounded form runs the runner on the serial
-        helper thread and abandons the WAIT on expiry (the helper keeps
-        running; try_submit refuses new device work until it finishes,
-        so an abandoned dispatch never overlaps a fresh one — refused
-        batches fail over to the walker and the breaker keeps later
-        requests off the device path).  Returns (ok, value_or_exc)."""
+        the worker thread, so the bounded form runs the runner on the
+        worker's serial helper thread and abandons the WAIT on expiry
+        (the helper keeps running; try_submit refuses new device work
+        until it finishes, so an abandoned dispatch never overlaps a
+        fresh one ON THAT DEVICE — refused batches fail over to the
+        walker and the breaker keeps later requests off the device
+        path; sibling lanes are untouched).  Returns (ok,
+        value_or_exc)."""
         lockcheck.check_dispatch("batcher.dispatch")
         if self.dispatch_timeout_s <= 0:
             try:
                 return True, runner(X)
             except BaseException as exc:
                 return False, exc
-        sub = self._dispatcher.try_submit(runner, X)
+        sub = worker.dispatcher.try_submit(runner, X)
         if sub is None:
             # a previously-abandoned dispatch still owns the device:
             # NOT a new timeout (dispatch_timeouts counts real expiries)
@@ -418,16 +598,23 @@ class MicroBatcher:
             return False, box["exc"]
         return True, box["out"]
 
-    def _run(self, ks: _KeyState, batch) -> None:
+    def _run(self, ks: _KeyState, batch, device: int = 0,
+             worker: Optional[_DeviceWorker] = None) -> None:
         from .. import obs
 
         X = batch[0].X if len(batch) == 1 else \
             np.concatenate([r.X for r in batch], axis=0)
+        rows = sum(r.n for r in batch)
+        # per-device runners get told which device lane they landed on
+        call = (lambda Xb: ks.runner(Xb, device=device)) if ks.per_device \
+            else ks.runner
         t0 = time.monotonic()
         out = None
+        err = None
         try:
-            with obs.span("serve/dispatch", rows=int(X.shape[0])):
-                ok, val = self._dispatch(ks.runner, X)
+            with obs.span("serve/dispatch", rows=int(X.shape[0]),
+                          device=int(device)):
+                ok, val = self._dispatch(call, X, worker)
             if not ok:
                 # device-path failure (raise OR hang): report to the
                 # registry health hook, then fail the BATCH over to the
@@ -438,7 +625,11 @@ class MicroBatcher:
                 failover = ks.fallback is not None
                 if ks.on_error is not None:
                     try:
-                        failover = bool(ks.on_error(val)) and failover
+                        # per-device runners report WHICH device failed
+                        # so the right replica's breaker is fed
+                        verdict = (ks.on_error(val, device=device)
+                                   if ks.per_device else ks.on_error(val))
+                        failover = bool(verdict) and failover
                     except Exception:  # pragma: no cover - defensive
                         pass
                 if not failover:
@@ -449,12 +640,21 @@ class MicroBatcher:
             else:
                 out = val
         except BaseException as exc:  # delivered to every waiter
+            err = exc
+        finally:
+            wall = time.monotonic() - t0
+            self.stats.record_dispatch(wall)
+            if worker is not None:
+                worker.note(rows, wall)
+                self.stats.note_device_dispatch(device, rows)
+        # rows leave the system BEFORE any waiter wakes: a caller
+        # returning from wait() must observe the freed queue capacity
+        self._batch_done(rows)
+        if err is not None:
             for r in batch:
-                r.error = exc
+                r.error = err
                 r.done.set()
             return
-        finally:
-            self.stats.record_dispatch(time.monotonic() - t0)
         off = 0
         for r in batch:
             # axis-0 slice works for [n] and [n, k] outputs alike; padded

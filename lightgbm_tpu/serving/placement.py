@@ -1,0 +1,115 @@
+"""Fleet placement: which devices hold which model's serving tables.
+
+ISSUE 19 multiplies the serving runtime across every local device: the
+registry replicates each model's packed forest onto a per-model device
+set (default: all local devices) and the batcher grows one dispatch
+worker per device.  This module owns the two pieces both sides share:
+
+* `resolve_serving_devices` — the ONE reading of `serving_devices`
+  (0 = auto: every local device on accelerator backends, a single
+  device on CPU hosts, where forced virtual devices share the same
+  physical cores and replication would multiply warmup compiles
+  without adding throughput),
+* `Replica` — one device's copy of a model: the device-resident
+  quantized tables, the per-feature bin metadata pinned to the same
+  device, a per-device circuit breaker (a wedged or OOMing device
+  routes around, not down), and the per-bucket AOT executables,
+* `PlacementTable` — the model-key -> device-index-set routing source
+  of truth the batcher's least-loaded router filters against.
+
+A replica is immutable after construction except its breaker and AOT
+map; the PlacementTable is the only mutable shared state and takes its
+own lock (graftlint C301 owns `_sets` to `_lock`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import lockcheck
+
+
+def resolve_serving_devices(config) -> List:
+    """The device list a serving session replicates models across.
+
+    `serving_devices` <= 0 means auto: every local device on accelerator
+    backends, ONE on CPU (virtual CPU devices are the same silicon).
+    An explicit count is clamped to [1, local device count] so tests can
+    ask for 8 forced-host devices and a 4-chip host config degrades
+    instead of erroring.
+    """
+    import jax
+
+    devs = list(jax.local_devices())
+    n = int(getattr(config, "serving_devices", 0) or 0)
+    if n <= 0:
+        n = 1 if devs[0].platform == "cpu" else len(devs)
+    return devs[:max(1, min(n, len(devs)))]
+
+
+class Replica:
+    """One device's copy of a model's packed serving tables."""
+
+    __slots__ = ("index", "device", "tables", "meta_dev", "scale_dev",
+                 "nbytes", "breaker", "aot")
+
+    def __init__(self, index: int, device, tables: Dict, meta_dev: Tuple,
+                 breaker) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        self.index = index              # position in the entry's device set
+        self.device = device            # jax.Device
+        self.tables = tables            # full device table dict (all trees)
+        self.meta_dev = meta_dev        # (num_bin, default_bin, missing_type)
+        # committed unit scale: the AOT executables were lowered with a
+        # device-resident f32 scale operand (serving always post-scales
+        # on the host via _model_subset's divisor)
+        self.scale_dev = jax.device_put(jnp.float32(1.0), device)
+        self.nbytes = sum(int(v.nbytes) for v in tables.values())
+        self.breaker = breaker          # per-device CircuitBreaker
+        self.aot = {}                   # row bucket -> AOT executable
+
+    def sliced(self, num_trees: int) -> Dict:
+        """Device tables for the first `num_trees` trees (same slicing
+        contract as `PackedForest.device`: every key but the shared
+        `cat_words` pool narrows; `leaf_scale` is per-tree too)."""
+        total = int(self.tables["init_node"].shape[0])
+        if num_trees < 0 or num_trees >= total:
+            return self.tables
+        return {k: (v if k == "cat_words" else v[:num_trees])
+                for k, v in self.tables.items()}
+
+    def healthy(self) -> bool:
+        """Routable right now: the per-device breaker admits traffic
+        (closed, or open-and-cooled-down enough for a half-open probe)."""
+        return self.breaker.allow()
+
+
+class PlacementTable:
+    """model key -> device-index tuple; the fleet routing truth.
+
+    The batcher's router asks `devices_for(key)` on every batch; the
+    registry writes rows on load/unload.  Lock-ordered leaf: nothing is
+    called while `_lock` is held.
+    """
+
+    def __init__(self) -> None:
+        self._lock = lockcheck.make_lock("serving.placement")
+        self._sets: Dict[str, Tuple[int, ...]] = {}
+
+    def place(self, key: str, device_indices) -> None:
+        with self._lock:
+            self._sets[key] = tuple(int(i) for i in device_indices)
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._sets.pop(key, None)
+
+    def devices_for(self, key: str) -> Optional[Tuple[int, ...]]:
+        with self._lock:
+            return self._sets.get(key)
+
+    def snapshot(self) -> Dict[str, Tuple[int, ...]]:
+        with self._lock:
+            return dict(self._sets)

@@ -21,15 +21,20 @@ the runtime discipline around that:
 
 from __future__ import annotations
 
+import os
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..config import Config, parse_tristate
-from ..ops.predict import _depth_bucket, predict_row_buckets, row_bucket
+from ..ops.predict import (_depth_bucket, check_serving_precision,
+                           forest_class_scores, predict_row_buckets,
+                           quantize_tables, row_bucket)
 from ..utils import faultline, lockcheck, membudget
 from ..utils.log import Log
+from . import aot
+from .placement import PlacementTable, Replica, resolve_serving_devices
 from .stats import CircuitBreaker, ServingStats
 
 
@@ -37,7 +42,7 @@ class ModelEntry:
     """One resident model: booster + device tables + launch accounting."""
 
     def __init__(self, name: str, version: str, booster, config: Config,
-                 stats: ServingStats):
+                 stats: ServingStats, devices=None):
         self.name = name
         self.version = version
         self.key = f"{name}@{version}"
@@ -46,6 +51,15 @@ class ModelEntry:
         drv = booster._driver
         drv._materialize()
         self.num_feature = booster.num_feature()
+        # fleet placement (ISSUE 19): the device set this entry
+        # replicates onto (None/[] = the process default device only,
+        # the pre-fleet behavior direct constructions get)
+        self.precision = check_serving_precision(
+            str(config.serving_table_precision))
+        self.devices = list(devices) if devices else None
+        self.replicas: List[Replica] = []
+        # tree count the AOT executables were compiled for (-1 = no AOT)
+        self._aot_total = -1
         # the driver's own bucket policy governs every launch this entry
         # makes, so warmup must enumerate with the SAME ladder
         self.policy = drv.bucket_policy()
@@ -72,29 +86,64 @@ class ModelEntry:
         # reports here so sustained pressure can evict cold models
         # before the next dispatch OOMs too
         self.pressure_cb = None
+        self._k = max(drv.num_tree_per_iteration, 1)
+        self._depth = 1
+        self.hbm_total_bytes = 0
         if self.device_on:
-            k = max(drv.num_tree_per_iteration, 1)
+            import jax
+
             rows = min(self.max_batch_rows, self.chunk)
-            self.scratch_bytes = rows * (self.num_feature * 4 + k * 4)
-            # guarded upload (ISSUE 15): an allocation failure here is
-            # classified and named instead of crashing the load as an
-            # anonymous XlaRuntimeError — the registry retries after
-            # eviction, then refuses with 507
-            with membudget.oom_guard("registry_load", model=self.key):
-                drv._packed_forest()  # pack + upload the tables once
-                # what this model actually costs on device: the FULL
-                # packed tables — PackedForest.device() uploads and
-                # retains every tree regardless of the num_iteration a
-                # request later slices to, so an early-stopped model's
-                # resident bytes are the full pack (counting the slice
-                # would undercount residency AND diverge from the
-                # preflight plan, which prices the full host pack).
-                # This is the capacity unit LRU eviction reports in
-                # (bytes, not model count; ROADMAP 2c's quantized
-                # tables shrink it)
-                self.hbm_bytes = sum(
-                    int(v.nbytes)
-                    for v in drv._packed_forest().device().values())
+            self.scratch_bytes = rows * (self.num_feature * 4
+                                         + self._k * 4)
+            ctx = drv._pred_context()
+            devs = self.devices or [jax.local_devices()[0]]
+            breaker_kw = dict(
+                threshold=int(config.serving_breaker_failures),
+                cooldown_s=float(config.serving_breaker_cooldown_ms) / 1e3,
+                stats=stats)
+            host_q = None  # quantized host pack, built once, placed N×
+            for i, dev in enumerate(devs):
+                # guarded upload (ISSUE 15): an allocation failure here
+                # is classified and named — and carries the DEVICE
+                # index, so `device_alloc` chaos can target one replica
+                # — instead of crashing the load as an anonymous
+                # XlaRuntimeError; the registry retries after eviction,
+                # then refuses with 507
+                with membudget.oom_guard("registry_load", model=self.key,
+                                         device=i):
+                    if i == 0 and self.precision == "f32":
+                        # the driver's own cached upload (default
+                        # device): replica 0 at full precision shares
+                        # it, so the pre-fleet single-device load pays
+                        # exactly one upload, same as before
+                        pf = drv._packed_forest()
+                        self._depth = pf.depth
+                        tables = pf.device()
+                        meta = ctx.meta_dev()
+                    else:
+                        if host_q is None:
+                            pf = drv._packed_forest()
+                            self._depth = pf.depth
+                            host_q = quantize_tables(pf.host(),
+                                                     self.precision)
+                        tables = {kk: jax.device_put(v, dev)
+                                  for kk, v in host_q.items()}
+                        meta = tuple(jax.device_put(m, dev)
+                                     for m in ctx.meta_dev())
+                    self.replicas.append(
+                        Replica(i, dev, tables, meta,
+                                CircuitBreaker(**breaker_kw)))
+            # what this model costs on EACH device: the full packed
+            # (possibly quantized) tables — replicas retain every tree
+            # regardless of the num_iteration a request later slices
+            # to.  `hbm_bytes` stays the PER-DEVICE unit every budget
+            # formula prices in (the serving budget bounds one device's
+            # HBM; replication multiplies fleet bytes, not per-device
+            # pressure); `hbm_total_bytes` is the fleet-wide sum the
+            # describe()/bench surfaces report
+            self.hbm_bytes = self.replicas[0].nbytes
+            self.hbm_total_bytes = sum(r.nbytes for r in self.replicas)
+            self._setup_aot(config)
         # the gauge is set by ModelRegistry.load's registration block,
         # not here: a load that fails after construction (warmup error)
         # must not leave a phantom per-model series
@@ -122,12 +171,71 @@ class ModelEntry:
                 # scrape path may not steal device time from dispatch
                 score_fn=lambda Xs: drv.predict_raw(Xs, -1))
         # circuit breaker on the device path: threshold failures open it
-        # (requests short-circuit to the native walker), a timed
-        # half-open probe retries the device path
-        self.breaker = CircuitBreaker(
-            threshold=int(config.serving_breaker_failures),
-            cooldown_s=float(config.serving_breaker_cooldown_ms) / 1e3,
-            stats=stats)
+        # (requests short-circuit to a sibling replica, then the native
+        # walker), a timed half-open probe retries the device path.
+        # With replicas the entry-level breaker IS replica 0's (the
+        # pre-fleet single-breaker API keeps working)
+        if self.replicas:
+            self.breaker = self.replicas[0].breaker
+        else:
+            self.breaker = CircuitBreaker(
+                threshold=int(config.serving_breaker_failures),
+                cooldown_s=float(config.serving_breaker_cooldown_ms) / 1e3,
+                stats=stats)
+
+    def _setup_aot(self, config: Config) -> None:
+        """AOT-compiled cold start (ISSUE 19): at load time, every
+        (replica, row-bucket) launch of the default-num_iteration
+        predict either deserializes from the AOT cache (`aot_cache_hits`
+        — ZERO new compiled programs; the executables never enter the
+        jit cache, so the compile ledger proves the cold start) or warm-
+        compiles via lower().compile() and is serialized for the next
+        cold process (`aot_cache_misses`).  Any per-bucket failure
+        degrades to the jitted path with a logged warning — a bad cache
+        entry can slow a load, never fail one."""
+        dirpath = aot.cache_dir(config)
+        if dirpath is None:
+            return
+        drv = self.booster._driver
+        ni = self.default_num_iteration()
+        total, _ = drv._model_subset(-1 if ni is None else ni)
+        if total <= 0:
+            return
+        sig = self.warm_signature()
+        buckets = predict_row_buckets(self.max_batch_rows, self.chunk,
+                                      policy=self.policy)
+        depth_b = _depth_bucket(self._depth, self.policy)
+        for replica in self.replicas:
+            sh = aot.signature_hash(sig, replica.device)
+            tables = replica.sliced(total)
+            for b in buckets:
+                path = aot.bucket_path(dirpath, sh, replica.index, b)
+                exe = None
+                if os.path.exists(path):
+                    try:
+                        exe = aot.load_bucket(path)
+                        self.stats.count("aot_cache_hits")
+                    except Exception as exc:
+                        Log.warning(
+                            f"AOT cache entry {os.path.basename(path)} "
+                            f"for {self.key} rejected ({exc}); falling "
+                            "back to a warm compile")
+                if exe is None:
+                    self.stats.count("aot_cache_misses")
+                    try:
+                        exe = aot.compile_bucket(
+                            tables, self.num_feature, b,
+                            replica.meta_dev, depth_b, self._k)
+                        aot.save_bucket(path, exe)
+                    except Exception as exc:
+                        Log.warning(
+                            f"AOT compile of bucket {b} on device "
+                            f"{replica.index} for {self.key} failed "
+                            f"({exc}); this bucket serves via the "
+                            "jitted path")
+                        continue
+                replica.aot[b] = exe
+        self._aot_total = total
 
     # ------------------------------------------------------------------
     @property
@@ -158,12 +266,15 @@ class ModelEntry:
         drv = self.booster._driver
         ni = self.default_num_iteration()
         total, _ = drv._model_subset(-1 if ni is None else ni)
-        tables = drv._packed_forest().device(total)
+        # shapes+dtypes off replica 0's resident tables (no re-upload):
+        # quantized precisions change the dtypes, so each precision
+        # keys its own programs AND its own AOT cache files
+        tables = self.replicas[0].sliced(total)
         shapes = tuple(sorted((k, tuple(v.shape), str(v.dtype))
                               for k, v in tables.items()))
         return (self.chunk, self.max_batch_rows, self.policy,
-                self.num_feature, max(drv.num_tree_per_iteration, 1),
-                _depth_bucket(drv._packed_forest().depth, self.policy),
+                self.num_feature, self._k,
+                _depth_bucket(self._depth, self.policy),
                 shapes)
 
     def warmup(self, precompiled: bool = False) -> int:
@@ -178,22 +289,47 @@ class ModelEntry:
         buckets = predict_row_buckets(self.max_batch_rows, self.chunk,
                                       policy=self.policy)
         ni = self.default_num_iteration()
-        for b in buckets:
-            if precompiled:
-                self.stats.note_shape((self.key, ni, b), warmup=True)
-            else:
-                self.predict(np.zeros((b, self.num_feature), np.float64),
-                             num_iteration=ni, warmup=True)
+        for replica in self.replicas:
+            # a replica whose every bucket deserialized from the AOT
+            # cache needs NO warmup launches: the executables exist
+            # outside the jit cache, so the first served batch runs
+            # with zero new compiled programs (the cold-start contract)
+            aot_ready = (self._aot_total >= 0
+                         and all(b in replica.aot for b in buckets))
+            for b in buckets:
+                if precompiled or aot_ready:
+                    # aot_ready shapes charge NO compile ledger: the
+                    # executable was deserialized, not compiled
+                    self.stats.note_shape(
+                        self._shape_key(ni, b, replica.index),
+                        warmup=True, compiled=not aot_ready)
+                else:
+                    self.predict(
+                        np.zeros((b, self.num_feature), np.float64),
+                        num_iteration=ni, warmup=True,
+                        device_index=replica.index)
         return len(buckets)
 
+    def _shape_key(self, ni: int, bucket: int, index: int):
+        """Launch-shape accounting key: single-device entries keep the
+        pre-fleet (key, ni, bucket) form; replicated entries key per
+        device (each device's jit/AOT program is its own compile)."""
+        if len(self.replicas) <= 1:
+            return (self.key, ni, bucket)
+        return (self.key, ni, bucket, index)
+
     def predict(self, X: np.ndarray, raw_score: bool = False,
-                num_iteration: int = -1, warmup: bool = False) -> np.ndarray:
+                num_iteration: int = -1, warmup: bool = False,
+                device_index: Optional[int] = None) -> np.ndarray:
         """The batch runner: one device predict with launch-shape
-        accounting.  A device failure serves THIS batch via the native
-        host walker and feeds the circuit breaker; past the failure
-        threshold the breaker opens and requests short-circuit to the
-        walker (zero device attempts) until a timed half-open probe
-        finds the device path healthy again."""
+        accounting.  `device_index` is the batcher worker the batch
+        routed to (None = first routable replica).  A device failure
+        serves THIS batch via a SIBLING replica (counted
+        `replica_failovers`, the failed device's breaker fed) before
+        degrading to the native host walker; past the failure threshold
+        a replica's breaker opens and requests route around it (zero
+        device attempts there) until a timed half-open probe finds that
+        device healthy again."""
         ni = -1 if num_iteration is None else int(num_iteration)
         if not warmup and self.drift is not None:
             # drift tap BEFORE any path split: input drift is a property
@@ -207,74 +343,190 @@ class ModelEntry:
             return self._native_predict(X, raw_score, ni)
         n = int(X.shape[0])
         bucket = row_bucket(n, self.chunk, policy=self.policy)
-        if not warmup and not self.breaker.allow():
-            # breaker open: no device launch happens, so account this
-            # batch like the native path (unpadded rows)
+        order = self._route(device_index, warmup)
+        if not order:
+            # every replica's breaker is open: no device launch happens,
+            # so account this batch like the native path (unpadded rows)
             self.stats.note_batch(n, n)
             return self._native_predict(X, raw_score, ni)
         if not warmup:
             # a batch wider than the predict chunk runs ceil(n/chunk)
-            # padded launches inside _chunked_device_scores — account
-            # them all, or batch_fill_ratio would exceed 1.0
+            # padded launches inside the chunked scorer — account them
+            # all, or batch_fill_ratio would exceed 1.0
             launches = -(-n // self.chunk) if n > self.chunk else 1
             self.stats.note_batch(n, launches * bucket, launches=launches)
-        self.stats.note_shape((self.key, ni, bucket), warmup=warmup)
-        # generation snapshot: if the dispatch watchdog abandons this
-        # call and records a failure while it runs, the success below
-        # becomes stale and must not reset/close the breaker
-        gen = self.breaker.generation
-        # device walls are unbounded from the host's view: entering one
-        # holding any serving/obs lock would stall every thread queued
-        # on it (lockcheck flags it under tests)
-        lockcheck.check_dispatch("registry.predict")
-        try:
-            if not warmup:
-                action = faultline.fire("serve_dispatch", model=self.key)
-                if action == "hang":
-                    # simulate a wedged device stream: never return.
-                    # The batcher's dispatch watchdog
-                    # (serving_dispatch_timeout_ms) abandons this
-                    # thread, fails the batch over to the native
-                    # walker, and feeds the breaker
-                    import time as _time
-
-                    _time.sleep(3600.0)
-            with membudget.oom_guard(
-                    "registry_warmup" if warmup else "serve_dispatch",
-                    model=self.key):
-                out = self.booster.predict(X, raw_score=raw_score,
-                                           num_iteration=ni,
-                                           device="tpu",
-                                           tpu_predict_device="true")
-        except Exception as exc:
-            # route through the membudget classifier FIRST: a dispatch
-            # OOM is a pressure signal (count it, let the registry
-            # evict cold models) before it is a device failure
-            if membudget.is_oom_error(exc):
+        self.stats.note_shape(self._shape_key(ni, bucket, order[0].index),
+                              warmup=warmup)
+        failed: List[Replica] = []
+        for replica in order:
+            # generation snapshot: if the dispatch watchdog abandons
+            # this call and records a failure while it runs, the
+            # success below becomes stale and must not reset/close the
+            # breaker
+            gen = replica.breaker.generation
+            # device walls are unbounded from the host's view: entering
+            # one holding any serving/obs lock would stall every thread
+            # queued on it (lockcheck flags it under tests)
+            lockcheck.check_dispatch("registry.predict")
+            try:
+                out = self._dispatch_replica(replica, X, raw_score, ni,
+                                             warmup)
+            except Exception as exc:
+                # route through the membudget classifier FIRST: a
+                # dispatch OOM is a pressure signal (count it, let the
+                # registry evict cold models) before it is a device
+                # failure
+                if membudget.is_oom_error(exc):
+                    if warmup:
+                        # warmup must NOT silently walk a model that
+                        # cannot fit: the load path (which owns its own
+                        # eviction + retry + models_refused_hbm
+                        # accounting) retries or refuses with 507
+                        raise
+                    self.stats.count("dispatch_oom")
+                    if self.pressure_cb is not None:
+                        try:
+                            self.pressure_cb(self.key)
+                        except Exception:  # pragma: no cover - defensive
+                            pass
                 if warmup:
-                    # warmup must NOT silently walk a model that cannot
-                    # fit: the load path (which owns its own eviction +
-                    # retry + models_refused_hbm accounting — dispatch
-                    # counters stay dispatch-only) retries or refuses
-                    # with 507 instead of admitting a model whose every
-                    # dispatch would OOM
                     raise
-                self.stats.count("dispatch_oom")
-                if self.pressure_cb is not None:
-                    try:
-                        self.pressure_cb(self.key)
-                    except Exception:  # pragma: no cover - defensive
-                        pass
-            # count a fallback only when the host walker actually
-            # serves it — a data error raises identically on both paths
-            # and must not inflate the device-failure signal
-            out = self._native_predict(X, raw_score, ni)
-            self.stats.count("device_fallbacks")
+                failed.append(replica)
+                continue  # next replica in routing order
             if not warmup:
-                self.breaker.record_failure()
+                # the failed siblings' breakers are fed only once the
+                # batch actually lands somewhere device-side — a data
+                # error that raises on EVERY path must not open
+                # breakers (the walker below re-raises it first)
+                for f in failed:
+                    f.breaker.record_failure()
+                if failed:
+                    self.stats.count("replica_failovers")
+                replica.breaker.record_success(gen)
             return out
+        # every attempted replica raised: serve via the native walker.
+        # A caller/data error raises identically here and propagates
+        # WITHOUT feeding any breaker or fallback counter — failing
+        # over would mask a 400 and poison the device-failure signal
+        out = self._native_predict(X, raw_score, ni)
+        self.stats.count("device_fallbacks")
         if not warmup:
-            self.breaker.record_success(gen)
+            for f in failed:
+                f.breaker.record_failure()
+        return out
+
+    def _route(self, device_index: Optional[int],
+               warmup: bool) -> List[Replica]:
+        """Replica attempt order.  A pinned `device_index` (the batcher
+        worker the batch landed on) goes first with its siblings as
+        failover; warmup pins EXACTLY one replica (its compiles must
+        land on its device, and warmup errors must raise, not roam).
+        `allow()` is the consuming breaker gate — one probe slot per
+        actual attempt."""
+        reps = self.replicas
+        if not reps:
+            return []
+        if device_index is not None:
+            pinned = reps[int(device_index) % len(reps)]
+            if warmup:
+                return [pinned]
+            rest = [r for r in reps
+                    if r is not pinned and r.breaker.allow()]
+            if pinned.breaker.allow():
+                return [pinned] + rest
+            return rest
+        if warmup:
+            return [reps[0]]
+        return [r for r in reps if r.breaker.allow()]
+
+    def _dispatch_replica(self, replica: Replica, X: np.ndarray,
+                          raw_score: bool, ni: int,
+                          warmup: bool) -> np.ndarray:
+        """One device attempt on one replica, chaos- and OOM-guarded
+        with the device coordinate attached (single-device fault
+        targeting: `where={"device": k}`)."""
+        if not warmup:
+            action = faultline.fire("serve_dispatch", model=self.key,
+                                    device=replica.index)
+            if action == "hang":
+                # simulate a wedged device stream: never return.  The
+                # batcher's per-device dispatch watchdog
+                # (serving_dispatch_timeout_ms) abandons this thread,
+                # fails the batch over, and feeds the breaker; sibling
+                # workers keep serving
+                import time as _time
+
+                _time.sleep(3600.0)
+        with membudget.oom_guard(
+                "registry_warmup" if warmup else "serve_dispatch",
+                model=self.key, device=replica.index):
+            if replica.index == 0 and self.precision == "f32" \
+                    and not replica.aot:
+                # the pre-fleet dispatch: booster.predict owns the
+                # shrink ladder + chunked scorer on the default device
+                return self.booster.predict(X, raw_score=raw_score,
+                                            num_iteration=ni,
+                                            device="tpu",
+                                            tpu_predict_device="true")
+            return self._replica_predict(replica, X, raw_score, ni)
+
+    def _replica_predict(self, replica: Replica, X: np.ndarray,
+                         raw_score: bool, ni: int) -> np.ndarray:
+        drv = self.booster._driver
+        total, div = drv._model_subset(ni)
+        if total == 0:
+            return self._native_predict(X, raw_score, ni)
+        raw = self._replica_scores(replica, np.asarray(X, np.float64),
+                                   total) / div
+        return drv._finish_predict(raw, raw_score)
+
+    def _replica_scores(self, replica: Replica, X: np.ndarray,
+                        total: int) -> np.ndarray:
+        """[k, n] f64 scores off ONE replica's resident tables, chunked
+        over rows like gbdt._chunked_device_scores but pinned to the
+        replica's device.  Buckets the AOT executables cover dispatch
+        through them — zero jit-cache programs; everything else rides
+        the jitted kernel (per-device programs, warmed at load).
+        Quantized tables dequantize inside the kernel; accumulation is
+        f64 on host either way, so the drift monitor and every score
+        consumer see plain f32-dequantized scores."""
+        import jax
+
+        drv = self.booster._driver
+        ctx = drv._pred_context()
+        k = self._k
+        n = int(X.shape[0])
+        out = np.zeros((k, n), np.float64)
+        tables = replica.sliced(total)
+        aot_ok = total == self._aot_total
+        lo = 0
+        while lo < n:
+            chunk = self.chunk
+            hi = min(lo + chunk, n)
+            rows = hi - lo
+            faultline.fire("h2d_copy", rows=rows, device=replica.index)
+            bins = ctx.bin_rows(X[lo:hi])
+            target = (chunk if n > chunk
+                      else row_bucket(rows, chunk, policy=self.policy))
+            if rows < target:
+                bins = np.concatenate(
+                    [bins, np.zeros((target - rows, bins.shape[1]),
+                                    np.int32)])
+            bins_dev = jax.device_put(
+                np.ascontiguousarray(bins.astype(np.int32)),
+                replica.device)
+            exe = replica.aot.get(target) if aot_ok else None
+            if exe is not None:
+                nb, db, mt = replica.meta_dev
+                scores = exe(tables, bins_dev, nb, db, mt,
+                             replica.scale_dev)
+            else:
+                scores = forest_class_scores(
+                    tables, bins_dev, replica.meta_dev, k, self._depth,
+                    policy=self.policy)
+            out[:, lo:hi] = np.asarray(jax.device_get(scores),
+                                       np.float64)[:, :rows]
+            lo = hi
         return out
 
     def _native_predict(self, X: np.ndarray, raw_score: bool,
@@ -285,9 +537,24 @@ class ModelEntry:
     # -- failover hooks (the batcher's on_error / fallback pair) -------
     @property
     def healthy(self) -> bool:
-        """False while the device-path breaker is OPEN (requests are
-        short-circuiting to the native walker)."""
+        """False while EVERY replica's device-path breaker is OPEN
+        (requests are short-circuiting to the native walker); a fleet
+        with one live device is degraded, not unhealthy."""
+        if self.replicas:
+            return any(r.breaker.state != "open" for r in self.replicas)
         return self.breaker.state != "open"
+
+    def replica_ok(self, index: int) -> bool:
+        """The batcher router's NON-consuming device filter: True when
+        replica `index` could take traffic right now (closed/half-open
+        breaker, or open with the cooldown elapsed).  Deliberately not
+        `allow()` — a routing peek must not consume half-open probe
+        slots (the dispatch path's own allow() takes exactly one per
+        attempt)."""
+        if not self.replicas:
+            return index == 0
+        return self.replicas[int(index) % len(self.replicas)] \
+            .breaker.routable
 
     def native_runner(self, raw_score: bool, ni: int):
         """The failover target: a pure host-walker runner for this
@@ -300,21 +567,26 @@ class ModelEntry:
             return self._native_predict(Xb, raw_score, ni)
         return run
 
-    def record_dispatch_error(self, exc: BaseException) -> bool:
+    def record_dispatch_error(self, exc: BaseException,
+                              device: Optional[int] = None) -> bool:
         """Classify a dispatch failure for the batcher: True = device-
-        path failure (feed the breaker, fail the batch over to the
-        native runner); False = caller error (malformed rows raise
-        identically on both paths — failing over would mask a 400 as a
-        fallback and poison the breaker signal)."""
+        path failure (feed THAT device's breaker, fail the batch over
+        to the native runner); False = caller error (malformed rows
+        raise identically on both paths — failing over would mask a 400
+        as a fallback and poison the breaker signal)."""
         from ..utils.log import LightGBMError
 
         if isinstance(exc, (LightGBMError, ValueError, KeyError,
                             TypeError)):
             return False
         # device/XLA error or a hang promoted to ServingTimeout by the
-        # dispatch watchdog: the breaker keeps later requests off the
-        # device path until a half-open probe finds it healthy
-        self.breaker.record_failure()
+        # dispatch watchdog: the breaker keeps later requests off that
+        # device's path until a half-open probe finds it healthy
+        breaker = self.breaker
+        if device is not None and self.replicas:
+            breaker = self.replicas[int(device)
+                                    % len(self.replicas)].breaker
+        breaker.record_failure()
         return True
 
     def describe(self) -> Dict:
@@ -322,8 +594,15 @@ class ModelEntry:
                 "num_feature": self.num_feature,
                 "num_trees": self.booster.num_trees(),
                 "device": bool(self.device_on),
+                "devices": len(self.replicas),
+                "precision": self.precision,
                 "hbm_bytes": int(self.hbm_bytes),
+                "hbm_total_bytes": int(self.hbm_total_bytes),
+                "aot_buckets": (len(self.replicas[0].aot)
+                                if self.replicas else 0),
                 "breaker": self.breaker.state,
+                "breakers": {r.index: r.breaker.state
+                             for r in self.replicas},
                 "healthy": self.healthy,
                 "drift_monitor": self.drift is not None}
 
@@ -335,6 +614,11 @@ class ModelRegistry:
                  stats: Optional[ServingStats] = None):
         self.config = config if config is not None else Config({})
         self.stats = stats if stats is not None else ServingStats()
+        # fleet device set (ISSUE 19): resolved ONCE per registry; every
+        # entry replicates onto it and the placement table tells the
+        # batcher's router which worker indices hold which model
+        self.devices = resolve_serving_devices(self.config)
+        self.placement = PlacementTable()
         self._lock = lockcheck.make_rlock("serving.registry")
         self._entries: "OrderedDict[str, ModelEntry]" = OrderedDict()
         self._latest: Dict[str, str] = {}   # name -> current key
@@ -415,6 +699,8 @@ class ModelRegistry:
                         site="registry_load", info={"model": name})
             self._entries[entry.key] = entry
             self._entries.move_to_end(entry.key)
+            self.placement.place(entry.key,
+                                 [r.index for r in entry.replicas])
             self.stats.set_model_hbm(entry.key, entry.hbm_bytes)
             # a reloaded key re-arms drift publishing (clear_drift
             # tombstones it on unload/eviction so an in-flight scrape
@@ -503,7 +789,7 @@ class ModelRegistry:
         for attempt in (0, 1):
             try:
                 entry = ModelEntry(name, ver, booster, self.config,
-                                   self.stats)
+                                   self.stats, devices=self.devices)
                 if bool(self.config.serving_warmup):
                     # dedupe warmup compiles across models sharing a
                     # launch-shape signature: the jit cache is process-
@@ -581,6 +867,7 @@ class ModelRegistry:
             freed += got
             n += 1
             del self._entries[victim]
+            self.placement.remove(victim)
             self.stats.count("models_evicted")
             self.stats.count("evictions_pressure")
             self.stats.clear_model_hbm(victim)
@@ -595,6 +882,14 @@ class ModelRegistry:
         budget = self._budget()
         if budget:
             self.stats.set_hbm_pressure(total / budget)
+        # per-DEVICE residency, zeros included: an eviction of a
+        # replicated model must visibly free bytes on EVERY device
+        per_dev = {i: 0 for i in range(max(len(self.devices), 1))}
+        for e in self._entries.values():
+            for r in e.replicas:
+                per_dev[r.index] = per_dev.get(r.index, 0) + r.nbytes
+        for i, nbytes in per_dev.items():
+            self.stats.set_device_hbm(i, nbytes)
 
     @staticmethod
     def _version_newer(current_key: Optional[str], candidate: str) -> bool:
@@ -622,6 +917,7 @@ class ModelRegistry:
                                 if k != victim}
             freed = int(self._entries[victim].hbm_bytes)
             del self._entries[victim]
+            self.placement.remove(victim)
             self.stats.count("models_evicted")
             self.stats.clear_model_hbm(victim)
             self.stats.clear_drift(victim)
@@ -708,6 +1004,7 @@ class ModelRegistry:
             removed = [self._entries.pop(k) for k in victims
                        if k in self._entries]
             for e in removed:
+                self.placement.remove(e.key)
                 self.stats.clear_model_hbm(e.key)
                 self.stats.clear_drift(e.key)
                 if e.hbm_bytes:

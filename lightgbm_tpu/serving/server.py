@@ -77,6 +77,10 @@ class ServingSession:
         obs.configure_from_config(cfg)  # tpu_telemetry / tpu_trace_dir
         self._stats = ServingStats(window=int(cfg.serving_stats_window))
         self.registry = ModelRegistry(cfg, self._stats)
+        # fleet dispatch (ISSUE 19): one batcher worker per serving
+        # device; the AIMD step scales with dispatch lanes (capacity
+        # re-probes proportionally to the fleet, not to one device)
+        devices = len(self.registry.devices)
         self.admission = AdmissionController(
             self._stats, slo_ms=float(cfg.serving_slo_ms),
             queue_rows=int(cfg.serving_queue_rows),
@@ -87,14 +91,16 @@ class ServingSession:
             min_wait_ms=float(cfg.serving_min_wait_ms),
             max_wait_ms=float(cfg.serving_max_wait_ms),
             retry_after_ms=float(cfg.serving_retry_after_ms),
-            enabled=bool(cfg.serving_admission))
+            enabled=bool(cfg.serving_admission),
+            devices=devices)
         self.batcher = MicroBatcher(
             max_batch_rows=int(cfg.serving_max_batch_rows),
             max_wait_ms=float(cfg.serving_max_wait_ms),
             queue_rows=int(cfg.serving_queue_rows),
             stats=self._stats,
             window_fn=self.admission.batch_window_s,
-            dispatch_timeout_ms=float(cfg.serving_dispatch_timeout_ms))
+            dispatch_timeout_ms=float(cfg.serving_dispatch_timeout_ms),
+            devices=devices)
         self._drain_lock = threading.Lock()
         self._drained = False
         if start:
@@ -217,8 +223,13 @@ class ServingSession:
         # feature width is part of the batch key: a wrong-width request
         # must fail alone, never poison the batch it would coalesce into
         key = (entry.key, bool(raw_score), ni, Xm.shape[1])
-        runner = lambda Xb: entry.predict(Xb, raw_score=raw_score,  # noqa: E731
-                                          num_iteration=ni)
+        # replicated entries take per-device routing: the batcher tells
+        # the runner which worker/device the batch landed on and filters
+        # candidates through the entry's non-consuming breaker peek
+        per_device = len(entry.replicas) > 1
+        runner = lambda Xb, device=None: entry.predict(  # noqa: E731
+            Xb, raw_score=raw_score, num_iteration=ni,
+            device_index=device)
         timeout_s = (float(self.config.serving_timeout_ms)
                      if timeout_ms is None else float(timeout_ms)) / 1e3
         if deadline_ms is not None:
@@ -239,7 +250,8 @@ class ServingSession:
                  for lo in range(0, max(Xm.shape[0], 1), max_rows)],
                 deadline=abs_deadline,
                 fallback=entry.native_runner(bool(raw_score), ni),
-                on_error=entry.record_dispatch_error)
+                on_error=entry.record_dispatch_error,
+                per_device=per_device, device_ok=entry.replica_ok)
         except RuntimeError as exc:
             if self.batcher.draining:
                 raise ServingDraining(

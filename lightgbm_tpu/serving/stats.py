@@ -52,6 +52,16 @@ _COUNTERS = (
     #                       OOM-triggered relieve (subset of
     #                       models_evicted)
     "dispatch_oom", "models_refused_hbm", "evictions_pressure",
+    # fleet-scale serving (ISSUE 19):
+    # replica_failovers = batches re-dispatched to a sibling replica
+    #                     after one device's attempt raised (distinct
+    #                     from dispatch_failovers, the native-walker
+    #                     escape once EVERY replica refused)
+    # aot_cache_hits    = per-(device, bucket) executables deserialized
+    #                     from the AOT cache at model load
+    # aot_cache_misses  = buckets that fell back to a warm compile
+    #                     (absent, corrupt, or stale .aotx)
+    "replica_failovers", "aot_cache_hits", "aot_cache_misses",
 )
 
 # serving latency buckets: sub-ms device hits through multi-second
@@ -125,6 +135,22 @@ class CircuitBreaker:
                 flightrecorder.note("breaker", "half_open")
                 return True
             return False
+
+    @property
+    def routable(self) -> bool:
+        """Non-consuming routability peek for the fleet router.
+
+        `allow()` CONSUMES the half-open probe slot (it transitions
+        open -> half_open), so a router that merely FILTERS candidate
+        replicas must not call it — two peeks would grant two probes.
+        This answers "could a request be sent here right now" without
+        touching state: closed/half_open, or open with the cooldown
+        elapsed (the dispatch path's own allow() will then take the
+        probe slot exactly once)."""
+        with self._lock:
+            if self.state != "open":
+                return True
+            return time.monotonic() - self._entered_at >= self.cooldown_s
 
     def record_success(self, gen: int = None) -> None:
         with self._lock:
@@ -221,12 +247,16 @@ class ServingStats:
         self.registry.inc("lgbm_serving_batch_padded_rows_total",
                           max(int(bucket), 1))
 
-    def note_shape(self, key: Hashable, warmup: bool = False) -> bool:
+    def note_shape(self, key: Hashable, warmup: bool = False,
+                   compiled: bool = True) -> bool:
         """Record one jit launch shape; returns True when it is new.
 
         New shapes during warmup count as `compiles_warmup`; new shapes
         afterwards are `compile_cache_misses` (the number the
-        zero-cold-compile acceptance test asserts on)."""
+        zero-cold-compile acceptance test asserts on).  `compiled=False`
+        registers the shape without charging either ledger — the
+        AOT-deserialized executables (ISSUE 19) exist without ANY
+        compile, and the ledger must say so."""
         with self._lock:
             if key in self._shapes:
                 new = False
@@ -236,7 +266,9 @@ class ServingStats:
         if not new:
             self.count("compile_cache_hits")
             return False
-        self.count("compiles_warmup" if warmup else "compile_cache_misses")
+        if compiled:
+            self.count("compiles_warmup" if warmup
+                       else "compile_cache_misses")
         return True
 
     def set_queue_depth(self, rows: int) -> None:
@@ -274,6 +306,29 @@ class ServingStats:
                                 help="packed device-table bytes of one "
                                      "resident model",
                                 model=str(key))
+
+    # -- fleet-scale serving (ISSUE 19) --------------------------------
+    def set_device_hbm(self, index: int, nbytes: int) -> None:
+        """Per-DEVICE resident serving-table bytes (summed over every
+        replica placed there).  Published for all devices in the
+        serving set, zeros included, so eviction tests can assert a
+        replicated model's bytes left EVERY device."""
+        self.registry.set_gauge("lgbm_serving_device_hbm_bytes",
+                                int(nbytes),
+                                help="resident serving-table bytes on "
+                                     "one device of the fleet",
+                                device=str(int(index)))
+
+    def note_device_dispatch(self, device: int, rows: int) -> None:
+        """One coalesced batch completed on one device's worker — the
+        per-device goodput ledger `tools/serve_bench.py --devices`
+        breaks down."""
+        self.registry.inc("lgbm_serving_device_dispatches_total", 1,
+                          help="coalesced batches dispatched per device",
+                          device=str(int(device)))
+        self.registry.inc("lgbm_serving_device_rows_total", int(rows),
+                          help="real rows served per device",
+                          device=str(int(device)))
 
     def clear_model_hbm(self, key: str) -> None:
         """Remove a departed model's gauge series entirely (unload /

@@ -95,10 +95,11 @@ def resource_exhausted_error(point: str, **info) -> BaseException:
 
 
 class _Spec:
-    __slots__ = ("action", "exc", "at", "times", "host", "end")
+    __slots__ = ("action", "exc", "at", "times", "host", "end", "where")
 
     def __init__(self, action: str, exc, at: int, times: int,
-                 host: Optional[int] = None, end: Optional[int] = None):
+                 host: Optional[int] = None, end: Optional[int] = None,
+                 where: Optional[Dict] = None):
         self.action = action
         self.exc = exc
         self.at = int(at)
@@ -109,6 +110,11 @@ class _Spec:
         # armed after its call has passed must not drift onto a later
         # call, or the (host, call-index) pair stops naming one event
         self.end = None if end is None else int(end)
+        # field filter: the spec only matches fires whose `info` kwargs
+        # carry every (key, value) pair — how a fleet chaos run kills
+        # ONE device's dispatches (`where={"device": 3}`) while its
+        # siblings keep serving
+        self.where = dict(where) if where else None
 
 
 _lock = threading.Lock()
@@ -161,7 +167,7 @@ def host_index() -> int:
 
 def arm(point: str, action: str = "raise", exc=None, at: int = 1,
         times: int = 1, host: Optional[int] = None,
-        absolute: bool = False) -> None:
+        absolute: bool = False, where: Optional[Dict] = None) -> None:
     """Arm `point`: starting at its `at`-th hit from now, apply `action`
     for the next `times` hits.  With `absolute=True` the window is
     EXACT: hits `[at, at + times)` counted since the last `reset()` —
@@ -169,7 +175,9 @@ def arm(point: str, action: str = "raise", exc=None, at: int = 1,
     onto a later call, or the (host, call-index) pair stops naming one
     event).  `host=k` restricts the spec to the process whose
     `host_index()` is k, so a multihost chaos run can kill host k at
-    call-index i reproducibly.  `exc` (an exception instance or class)
+    call-index i reproducibly.  `where={"device": 3}` restricts it to
+    fires whose info kwargs match every pair — single-device chaos in a
+    replicated serving fleet.  `exc` (an exception instance or class)
     overrides the default `FaultInjected` for ``raise`` actions."""
     _check_point(point)
     if action not in _ACTIONS:
@@ -182,7 +190,7 @@ def arm(point: str, action: str = "raise", exc=None, at: int = 1,
         times = max(int(times), 1)
         _armed.setdefault(point, []).append(
             _Spec(action, exc, start, times, host=host,
-                  end=start + times if absolute else None))
+                  end=start + times if absolute else None, where=where))
 
 
 def disarm(point: Optional[str] = None) -> None:
@@ -233,6 +241,9 @@ def fire(point: str, **info) -> Optional[str]:
         for spec in specs:
             if spec.host is not None and spec.host != me:
                 continue  # addressed to another host: count, never fire
+            if spec.where is not None and any(
+                    info.get(k) != v for k, v in spec.where.items()):
+                continue  # addressed to another device/entity: skip
             if spec.times > 0 and hit >= spec.at \
                     and (spec.end is None or hit < spec.end):
                 spec.times -= 1

@@ -536,9 +536,21 @@ def plan_model_load(booster, config) -> Optional[MemoryPlan]:
     pf = drv._packed_forest()       # host pack only; upload is lazy
     host = pf._host or {}
     count = pf._count
+    # quantized serving tables (ISSUE 19): price what will actually
+    # land on each device — the preflight and the registry's post-load
+    # accounting must agree, or a bf16/int16 load would be refused
+    # against its f32 size
+    precision = str(config.get("serving_table_precision", "f32"))
+    if precision != "f32" and host:
+        from ..ops.predict import quantize_tables
+
+        host = quantize_tables(
+            {k: (v if k == "cat_words" else v[:count])
+             for k, v in host.items()}, precision)
+        count = -1  # already sliced above
     table_bytes = 0
     for key, arr in host.items():
-        view = arr if key == "cat_words" else arr[:count]
+        view = arr if (key == "cat_words" or count < 0) else arr[:count]
         table_bytes += int(view.nbytes)
     comps = {"packed_tables": table_bytes}
     chunk = drv.predict_chunk_rows()

@@ -1,0 +1,373 @@
+"""Fleet-scale serving (ISSUE 19): mesh-replicated dispatch, AOT cold
+starts, quantized serving tables.
+
+Contracts under test:
+* a `serving_devices=N` load places one replica per device (distinct
+  jax devices, placement table row, per-device HBM gauges that sum to
+  `hbm_total_bytes`) and replicated predicts stay value-correct;
+* concurrent traffic spreads across dispatch workers (least-loaded
+  routing, per-device rows counters);
+* pressure-evicting a replicated model frees bytes on EVERY device —
+  the per-device gauges drop together, not just the summary gauge;
+* a single device's injected `device_alloc` OOM fails over to the
+  surviving replicas with ZERO caller-visible errors
+  (`replica_failovers` counts it; the native walker is never needed);
+* `serving_table_precision=bf16` cuts per-model serving bytes >= 40%
+  with a bounded raw-score delta; `int16` keeps the decision path
+  EXACTLY (thresholds/ids/codes quantize losslessly) so the score
+  delta is leaf-rounding only;
+* an AOT cache dir makes the SECOND load reach a full request-size
+  sweep with zero new jitted programs and zero warmup compiles
+  (`aot_cache_hits` ledger-asserted); a corrupt `.aotx` degrades to a
+  logged warm compile, never a failed load.
+
+Everything runs under JAX_PLATFORMS=cpu with 8 virtual devices
+(tests/conftest.py pins `--xla_force_host_platform_device_count`).
+"""
+
+import glob
+import threading
+
+import numpy as np
+import pytest
+
+from .conftest import *  # noqa: F401,F403  (cpu backend pin)
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.serving import ServingSession
+from lightgbm_tpu.utils import faultline, membudget
+
+PARAMS = {"objective": "binary", "num_leaves": 15,
+          "tpu_predict_device": "true", "verbose": -1}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultline.reset()
+    yield
+    faultline.reset()
+
+
+def _make_data(n=3000, f=6, seed=0):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    X[rng.random((n, f)) < 0.08] = np.nan
+    y = (np.nansum(X[:, :3], axis=1) > 0).astype(float)
+    return X, y
+
+
+def _train(X, y, rounds=8):
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 31})
+    return lgb.train(dict(PARAMS), ds, num_boost_round=rounds,
+                     verbose_eval=False)
+
+
+@pytest.fixture(scope="module")
+def booster():
+    X, y = _make_data()
+    return _train(X, y), X
+
+
+def _session(devices=0, **params):
+    p = {"serving_max_batch_rows": 1024, "serving_max_wait_ms": 1.0,
+         "verbosity": -1, **params}
+    if devices:
+        p["serving_devices"] = devices
+    return ServingSession(params=p)
+
+
+def _gauge(sess, name, **labels):
+    return float(sess._stats.registry.value(name, **labels))
+
+
+# ---------------------------------------------------------------------------
+# 1. replicated placement + routing
+# ---------------------------------------------------------------------------
+class TestReplicatedDispatch:
+    def test_replicas_land_on_distinct_devices_with_gauges(self, booster):
+        bst, X = booster
+        sess = _session(devices=4)
+        try:
+            sess.load("m", booster=bst)
+            entry = sess.registry.resolve("m")
+            assert len(entry.replicas) == 4
+            devs = [r.device for r in entry.replicas]
+            assert len(set(devs)) == 4
+            assert tuple(sess.registry.placement.devices_for(entry.key)) \
+                == (0, 1, 2, 3)
+            per_dev = [_gauge(sess, "lgbm_serving_device_hbm_bytes",
+                              device=str(i)) for i in range(4)]
+            assert all(g > 0 for g in per_dev)
+            assert int(sum(per_dev)) == int(entry.hbm_total_bytes)
+            # the per-device budget unit stays ONE replica's bytes
+            assert entry.hbm_bytes == entry.replicas[0].nbytes
+        finally:
+            sess.close()
+
+    def test_replicated_predict_matches_native(self, booster):
+        bst, X = booster
+        sess = _session(devices=4)
+        try:
+            sess.load("m", booster=bst)
+            got = sess.predict("m", X[:700], raw_score=True)
+            ref = bst.predict(X[:700], raw_score=True, device="cpu")
+            np.testing.assert_allclose(got, ref, rtol=0, atol=1e-5)
+        finally:
+            sess.close()
+
+    def test_concurrent_load_spreads_across_devices(self, booster):
+        bst, X = booster
+        sess = _session(devices=4)
+        try:
+            sess.load("m", booster=bst)
+            errs = []
+
+            def worker(i):
+                try:
+                    for j in range(6):
+                        sess.predict("m", X[(i * 37 + j) % 512:][:64],
+                                     raw_score=True)
+                except Exception as exc:  # pragma: no cover - fail loud
+                    errs.append(exc)
+
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(16)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert not errs
+            rows = [_gauge(sess, "lgbm_serving_device_rows_total",
+                           device=str(i)) for i in range(4)]
+            assert sum(1 for r in rows if r > 0) >= 2, \
+                f"least-loaded routing never left device 0: {rows}"
+            snap = sess.batcher.device_snapshot()
+            assert [d["device"] for d in snap] == [0, 1, 2, 3]
+            assert sum(d["rows"] for d in snap) == sum(rows)
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# 2. pressure eviction frees the whole fleet's bytes
+# ---------------------------------------------------------------------------
+class TestFleetEviction:
+    def test_pressure_eviction_frees_bytes_on_every_device(self, booster):
+        bst, X = booster
+        from lightgbm_tpu.config import Config
+
+        base_cfg = {"verbosity": -1, "serving_max_batch_rows": 16,
+                    "serving_devices": 2}
+        plan = membudget.plan_model_load(bst, Config(base_cfg))
+        tables = plan.components["packed_tables"]
+        budget = plan.total * 3
+        frac = (tables * 1.5) / budget
+        assert frac >= 0.05
+        sess = ServingSession(params={
+            **base_cfg, "serving_hbm_budget_bytes": budget,
+            "serving_hbm_pressure_frac": frac})
+        try:
+            sess.load("m", booster=bst)          # v1 on devices {0, 1}
+            v1 = sess.registry.resolve("m")
+            before = [_gauge(sess, "lgbm_serving_device_hbm_bytes",
+                             device=str(i)) for i in range(2)]
+            assert all(b >= v1.replicas[i].nbytes
+                       for i, b in enumerate(before))
+            sess.load("m", booster=bst)          # v2: v1 must yield
+            st = sess.stats()
+            assert st["evictions_pressure"] >= 1
+            v2 = sess.registry.resolve("m")
+            assert v2.key != v1.key
+            after = [_gauge(sess, "lgbm_serving_device_hbm_bytes",
+                            device=str(i)) for i in range(2)]
+            # EVERY device's gauge dropped to exactly v2's replica bytes
+            for i in range(2):
+                assert int(after[i]) == int(v2.replicas[i].nbytes), \
+                    (i, before, after)
+            assert not sess.registry.placement.devices_for(v1.key)
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. single-device OOM chaos -> sibling failover, zero errors
+# ---------------------------------------------------------------------------
+class TestSingleDeviceFailover:
+    def test_device0_oom_fails_over_with_zero_errors(self, booster):
+        bst, X = booster
+        sess = _session(devices=2)
+        try:
+            sess.load("m", booster=bst)
+            ref = bst.predict(X[:64], raw_score=True, device="cpu")
+            # only device 0's dispatch allocations fail; loads/warmups
+            # and device 1 stay healthy (the `where` faultline filter)
+            faultline.arm("device_alloc", action="oom", times=10 ** 6,
+                          where={"site": "serve_dispatch", "device": 0})
+            for _ in range(6):
+                got = sess.predict("m", X[:64], raw_score=True)
+                np.testing.assert_allclose(got, ref, rtol=0, atol=1e-5)
+            st = sess.stats()
+            assert st["replica_failovers"] >= 1
+            assert st["dispatch_oom"] >= 1
+            # the walker escape hatch was never needed: siblings served
+            assert st["device_fallbacks"] == 0
+            entry = sess.registry.resolve("m")
+            assert entry.healthy  # device 1 keeps the model routable
+            faultline.reset()
+            got = sess.predict("m", X[:64], raw_score=True)
+            np.testing.assert_allclose(got, ref, rtol=0, atol=1e-5)
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# 4. quantized serving tables
+# ---------------------------------------------------------------------------
+class TestQuantizedTables:
+    def _pack_host(self, bst):
+        return bst._driver._packed_forest().host()
+
+    def test_bf16_cuts_model_bytes_40pct_with_bounded_scores(self, booster):
+        bst, X = booster
+        f32 = _session(**{"serving_table_precision": "f32"})
+        bf16 = _session(**{"serving_table_precision": "bf16"})
+        try:
+            f32.load("m", booster=bst)
+            bf16.load("m", booster=bst)
+            b_f32 = f32.registry.resolve("m").hbm_bytes
+            b_bf16 = bf16.registry.resolve("m").hbm_bytes
+            assert b_bf16 <= 0.6 * b_f32, (b_f32, b_bf16)
+            a = f32.predict("m", X[:800], raw_score=True)
+            b = bf16.predict("m", X[:800], raw_score=True)
+            # bf16 has 8 head bits of mantissa: each tree's leaf errs
+            # <= 2^-9 relative, so the documented sum-of-trees bound
+            lv = np.asarray(self._pack_host(bst)["leaf_value"],
+                            np.float64)
+            bound = np.abs(lv).max(axis=1).sum() * 2.0 ** -8
+            assert float(np.abs(a - b).max()) <= bound, \
+                (float(np.abs(a - b).max()), bound)
+        finally:
+            f32.close()
+            bf16.close()
+
+    def test_int16_decision_path_parity_exact(self, booster):
+        bst, X = booster
+        from lightgbm_tpu.ops.predict import _NODE_KEYS, quantize_tables
+
+        host = self._pack_host(bst)
+        q = quantize_tables(host, "int16")
+        # structural proof: every node table quantized LOSSLESSLY, so
+        # traversal decisions are the same integer comparisons
+        for key in _NODE_KEYS + ("init_node",):
+            assert q[key].dtype == np.int16, key
+            assert np.array_equal(q[key].astype(np.int64),
+                                  host[key].astype(np.int64)), key
+        i16 = _session(**{"serving_table_precision": "int16"})
+        f32 = _session()
+        try:
+            i16.load("m", booster=bst)
+            f32.load("m", booster=bst)
+            a = f32.predict("m", X[:800], raw_score=True)
+            b = i16.predict("m", X[:800], raw_score=True)
+            # identical decision path => the delta is per-tree leaf
+            # rounding only: half a quantization step per tree
+            bound = float(q["leaf_scale"].astype(np.float64).sum()) \
+                * 0.51 + 1e-7
+            assert float(np.abs(a - b).max()) <= bound, \
+                (float(np.abs(a - b).max()), bound)
+        finally:
+            i16.close()
+            f32.close()
+
+    def test_plan_model_load_prices_quantized_tables(self, booster):
+        bst, _ = booster
+        from lightgbm_tpu.config import Config
+
+        base = {"verbosity": -1, "serving_max_batch_rows": 16}
+        p_f32 = membudget.plan_model_load(bst, Config(base))
+        p_bf16 = membudget.plan_model_load(
+            bst, Config({**base, "serving_table_precision": "bf16"}))
+        assert p_bf16.components["packed_tables"] <= \
+            0.6 * p_f32.components["packed_tables"]
+        # the preflight number matches what the load actually puts on
+        # each device (the budget unit stays truthful under precision)
+        sess = _session(**{"serving_table_precision": "bf16",
+                           "serving_max_batch_rows": 16})
+        try:
+            sess.load("m", booster=bst)
+            assert sess.registry.resolve("m").hbm_bytes == \
+                p_bf16.components["packed_tables"]
+        finally:
+            sess.close()
+
+
+# ---------------------------------------------------------------------------
+# 5. AOT-compiled cold starts
+# ---------------------------------------------------------------------------
+class TestAOTColdStart:
+    def test_second_load_serves_sweep_with_zero_new_programs(
+            self, booster, tmp_path):
+        bst, X = booster
+        cache = str(tmp_path / "aot")
+        params = {"serving_aot_cache_dir": cache,
+                  "serving_max_batch_rows": 1024}
+        warm = _session(**params)
+        try:
+            warm.load("m", booster=bst)
+            st = warm.stats()
+            assert st["aot_cache_misses"] >= 1  # first load compiles
+            assert glob.glob(cache + "/*.aotx")
+        finally:
+            warm.close()
+        from lightgbm_tpu.ops.predict import _class_scores_kernel
+
+        jit_before = (_class_scores_kernel._cache_size()
+                      if hasattr(_class_scores_kernel, "_cache_size")
+                      else None)
+        cold = _session(**params)
+        try:
+            cold.load("m", booster=bst)
+            st0 = cold.stats()
+            assert st0["aot_cache_hits"] >= 1
+            assert st0["aot_cache_misses"] == 0
+            # the compile ledger: a cold replica reaches a full
+            # request-size sweep with ZERO jit-compiled programs
+            assert st0["compiles_warmup"] == 0
+            ref = bst.predict(X[:900], raw_score=True, device="cpu")
+            for sz in (1, 7, 64, 513, 900):
+                got = cold.predict("m", X[:sz], raw_score=True)
+                np.testing.assert_allclose(got, ref[:sz], rtol=0,
+                                           atol=1e-5)
+            st = cold.stats()
+            assert st["compile_cache_misses"] == 0
+            if jit_before is not None:
+                assert _class_scores_kernel._cache_size() == jit_before, \
+                    "cold start compiled a jitted program after all"
+        finally:
+            cold.close()
+
+    def test_corrupt_aot_blob_degrades_to_warm_compile(self, booster,
+                                                       tmp_path):
+        bst, X = booster
+        cache = str(tmp_path / "aot")
+        params = {"serving_aot_cache_dir": cache,
+                  "serving_max_batch_rows": 1024}
+        warm = _session(**params)
+        try:
+            warm.load("m", booster=bst)
+        finally:
+            warm.close()
+        blobs = sorted(glob.glob(cache + "/*.aotx"))
+        assert blobs
+        with open(blobs[0], "wb") as f:
+            f.write(b"not an executable")
+        sess = _session(**params)
+        try:
+            sess.load("m", booster=bst)   # must not raise
+            st = sess.stats()
+            assert st["aot_cache_misses"] >= 1   # the corrupt bucket
+            ref = bst.predict(X[:256], raw_score=True, device="cpu")
+            got = sess.predict("m", X[:256], raw_score=True)
+            np.testing.assert_allclose(got, ref, rtol=0, atol=1e-5)
+        finally:
+            sess.close()

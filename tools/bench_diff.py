@@ -94,6 +94,15 @@ METRICS = {
     "grow_iter_ms": (-1, 0.30),
     "fused_frontier_rows_per_sec": (+1, 0.30),
     "autotune_resolve_ms": (-1, 0.50),
+    # fleet serving (ISSUE 19): replicated-dispatch goodput across the
+    # device set, cold-replica time-to-first-batch (AOT deserialization
+    # path — wide slack, it embeds process/session startup wall), and
+    # the per-model serving-table footprint (quantization exists to
+    # shrink it; a tightened 10% band would fight f32 rounds, so the
+    # band only flags a real format regrowth)
+    "serve_fleet_goodput_rows_per_sec": (+1, 0.25),
+    "serve_cold_start_ms": (-1, 0.50),
+    "serve_table_hbm_bytes": (-1, 0.10),
 }
 
 

@@ -49,6 +49,15 @@ OWNERSHIP: Dict[Tuple[str, Optional[str]], Dict[str, str]] = {
         "_stop": "_cv", "_draining": "_cv"},
     ("serving/batcher.py", "_SerialDispatcher"): {
         "_work": "_lock", "_busy": "_lock"},
+    # fleet dispatch (ISSUE 19): each device worker's lane + goodput
+    # accounting under its own condition variable; the placement table
+    # is the model->device routing truth the registry writes
+    ("serving/batcher.py", "_DeviceWorker"): {
+        "_work": "_cv", "_queued_rows": "_cv", "_inflight_rows": "_cv",
+        "_stop": "_cv", "_dispatches": "_cv", "_rows_done": "_cv",
+        "_wall_s": "_cv", "_lat": "_cv"},
+    ("serving/placement.py", "PlacementTable"): {
+        "_sets": "_lock"},
     ("serving/stats.py", "ServingStats"): {
         "_fill_rows": "_lock", "_fill_bucket": "_lock",
         "_queue_depth": "_lock", "_shapes": "_lock",

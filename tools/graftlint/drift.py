@@ -27,7 +27,11 @@ _DOC_TOKEN = re.compile(r"\b((?:tpu|serving)_[a-z0-9_]+)\b")
 #       model-file format token, not a config knob
 #   tpu_feature_profile — the model-health trailer section name
 #       (ISSUE 14), same model-file format family as tpu_bin_mappers
-_DOC_TOKEN_ALLOWED = {"tpu_bin_mappers", "tpu_feature_profile"}
+#   serving_aot — the `<tpu_compile_cache_dir>/serving_aot` cache
+#       SUBDIRECTORY named in serving_aot_cache_dir's default rule
+#       (ISSUE 19), a filesystem path component, not a config knob
+_DOC_TOKEN_ALLOWED = {"tpu_bin_mappers", "tpu_feature_profile",
+                      "serving_aot"}
 
 
 def _registry_params(project: Project) -> Dict[str, int]:

@@ -1159,6 +1159,11 @@ def run_stream_sweep(n=200_000, f=28, iters=5, leaves=63, bins=255):
 
 
 def main():
+    # probe crashes must never drop a blackbox dump beside the sources
+    # the probe is usually run from; an explicit env/param still wins
+    import tempfile
+    os.environ.setdefault("LIGHTGBM_TPU_BLACKBOX_DIR",
+                          tempfile.gettempdir())
     arg = sys.argv[1] if len(sys.argv) > 1 else ""
     if arg == "drift":
         run_drift_probe(n=int(os.environ.get("N", 20000)),

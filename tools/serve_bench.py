@@ -153,6 +153,24 @@ def run_ramp(new_session, name, X, rows, threads, duration_s,
     print_fn(json.dumps({"mode": "ramp_saturation",
                          "sat_qps": round(sat_qps, 1),
                          "sat_rows_per_sec": round(sat_qps * rows, 0)}))
+    # cold start: a fresh replica's wall time from construction to the
+    # first SERVED batch.  The saturation session above already ran the
+    # load path once, so with serving_aot_cache_dir set this session
+    # deserializes its launch executables instead of compiling them —
+    # the number bench.py tracks as serve_cold_start_ms (ISSUE 19)
+    t0 = time.monotonic()
+    sess = new_session()
+    sess.predict(name, X[:rows], raw_score=True)
+    cold_ms = (time.monotonic() - t0) * 1e3
+    entry = sess.registry.resolve(name)
+    table_bytes = int(getattr(entry, "hbm_total_bytes", 0)
+                      or entry.hbm_bytes)
+    n_dev = len(getattr(entry, "replicas", [])) or 1
+    sess.close()
+    print_fn(json.dumps({"mode": "ramp_cold_start",
+                         "cold_start_ms": round(cold_ms, 1),
+                         "table_hbm_bytes": table_bytes,
+                         "devices": n_dev}))
     best_goodput = 0.0
     top = None
     slo_ms = None
@@ -187,10 +205,24 @@ def run_ramp(new_session, name, X, rows, threads, duration_s,
             "device_fallbacks": st["device_fallbacks"],
         }
         print_fn(json.dumps(top))
+        if sess.batcher.devices > 1:
+            # per-device goodput/p99 breakdown (ISSUE 19): one line per
+            # dispatch worker — uneven rows across devices at high load
+            # means the least-loaded router is compensating for a slow
+            # or breaker-opened device, not spreading by round-robin
+            for d in sess.batcher.device_snapshot():
+                line = dict(d, mode="ramp_device",
+                            offered_x_saturation=round(mult, 2))
+                line["goodput_rows_per_sec"] = round(d["rows"] / dt, 0)
+                print_fn(json.dumps(line))
         sess.close()
     summary = {
         "mode": "ramp_summary",
         "serve_goodput_rows_per_sec": round(best_goodput, 0),
+        "serve_fleet_goodput_rows_per_sec": round(best_goodput, 0),
+        "serve_cold_start_ms": round(cold_ms, 1),
+        "serve_table_hbm_bytes": table_bytes,
+        "serve_devices": n_dev,
         "serve_shed_pct": top["shed_pct"] if top else 0.0,
         "serve_slo_ms": slo_ms,
         "top_step_p99_ms": top["p99_ms"] if top else 0.0,
@@ -298,6 +330,11 @@ def run_replay_drift(new_session, name, X, rows, threads, duration_s,
 
 
 def main():
+    # bench crashes must never drop a blackbox dump beside the sources
+    # the bench is usually run from; an explicit env/param still wins
+    import tempfile
+    os.environ.setdefault("LIGHTGBM_TPU_BLACKBOX_DIR",
+                          tempfile.gettempdir())
     ap = argparse.ArgumentParser(description=__doc__.split("\n", 1)[0])
     ap.add_argument("--model", default="", help="model file (default: "
                     "train a small synthetic model in-process)")
@@ -319,6 +356,17 @@ def main():
     ap.add_argument("--chaos", action="store_true",
                     help="arm a serve_dispatch device fault mid-ramp "
                          "(top step)")
+    ap.add_argument("--devices", type=int, default=0,
+                    help="serving_devices override: replicate the model "
+                         "across N dispatch lanes (0 = config auto)")
+    ap.add_argument("--precision", default="",
+                    choices=["", "f32", "bf16", "int16"],
+                    help="serving_table_precision override for the "
+                         "serving tables (default: config)")
+    ap.add_argument("--aot-cache", default="",
+                    help="serving_aot_cache_dir: persist AOT-compiled "
+                         "launch executables so the cold-start probe "
+                         "measures deserialize-not-compile")
     ap.add_argument("--replay-drift", action="store_true",
                     help="replay a recorded request stream with an "
                          "injected covariate shift halfway through, a "
@@ -345,6 +393,12 @@ def main():
             "verbosity": -1}
         if args.slo_ms > 0:
             params["serving_slo_ms"] = args.slo_ms
+        if args.devices > 0:
+            params["serving_devices"] = args.devices
+        if args.precision:
+            params["serving_table_precision"] = args.precision
+        if args.aot_cache:
+            params["serving_aot_cache_dir"] = args.aot_cache
         s = ServingSession(params=params)
         if args.model:
             s.load("bench", model_file=args.model,
