@@ -101,6 +101,11 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
                                        "init_score", "input_init_score")),
     "valid_data_initscores": ("str_list", [], ("valid_data_init_scores",
                                                "valid_init_score_file", "valid_init_score")),
+    # compatibility alias for the topology's partitioned-rows mode: rows
+    # are already split per process, so ingest skips the global scatter
+    # and sum-type metrics reduce across hosts.  Internally this is the
+    # partitioned_rows flag of the (hosts, data, feature) topology —
+    # consumers key on topology.rows_partitioned(), never on this bool
     "pre_partition": ("bool", False, ("is_pre_partition",)),
     "enable_bundle": ("bool", True, ("is_enable_bundle", "bundle")),
     "max_conflict_rate": ("float", 0.0, ()),
@@ -581,6 +586,14 @@ _P: Dict[str, Tuple[str, Any, Tuple[str, ...]]] = {
     # ('data', 'feature'); 0 = auto (2).  The analog of the reference's
     # device x parallel template nesting (parallel_tree_learner.h:25-187)
     "tpu_feature_shards": ("int", 0, ()),
+    # hosts axis of the (hosts, data, feature) topology
+    # (parallel/topology.py) — the process/DCN tier every row-axis
+    # collective also reduces over.  0 = auto (the live jax process
+    # count; the only valid setting on real multi-host meshes).  A
+    # positive value pins the axis on a SINGLE process, laying the local
+    # devices out exactly as that many hosts would — the simulated
+    # multi-host grid the (hosts x devices) bitwise tests sweep
+    "tpu_topology_hosts": ("int", 0, ()),
     # compile-cache shape policy: quantize the padded (rows, features)
     # axes so at most this many distinct shapes exist per power-of-2
     # octave — new datasets of similar size reuse cached XLA programs

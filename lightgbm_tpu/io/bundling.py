@@ -170,41 +170,28 @@ def find_bundles_multihost(local_bins: np.ndarray, num_bin: np.ndarray,
                             local_zero_frac >= sparse_threshold,
                             max_conflict_rate, max_bundle_bins,
                             sample_rows=sample_rows)
-    from jax.experimental import multihost_utils
+    from ..parallel.topology import host_allgather, ragged_all_gather
 
-    from ..parallel.collective import guarded_collective
-
-    def _exchange():
-        zf = np.asarray(multihost_utils.process_allgather(np.concatenate(
-            [np.asarray(local_zero_frac, np.float64) * local_rows,
-             [local_rows]]).astype(np.float32)))
-        tot = zf.sum(axis=0)
-        mfz = tot[:-1] / max(tot[-1], 1) >= sparse_threshold
-        samp = _stride_sample(local_bins, max(1, sample_rows // nproc))
-        lens = np.asarray(multihost_utils.process_allgather(
-            np.asarray([samp.shape[0]], np.int32)))[:, 0]
-        mx = int(lens.max())
-        # transport dtype must hold every bin id: uint16 truncates
-        # silently past 65535, so wide-bin features ride uint32 instead
-        # (num_bin is plan input on every rank, so all ranks agree on
-        # the widening)
-        transport = (np.uint32
-                     if int(np.asarray(num_bin).max(initial=0))
-                     > int(np.iinfo(np.uint16).max)
-                     else np.uint16)
-        buf = np.zeros((mx, local_bins.shape[1]), transport)
-        buf[:samp.shape[0]] = samp
-        g = np.asarray(multihost_utils.process_allgather(buf))  # [P,mx,F]
-        sample_global = np.concatenate(
-            [g[p, :int(lens[p])] for p in range(nproc)])
-        return mfz, sample_global
-
-    # one watchdog spans the fraction + ragged-sample exchange: its
-    # inner allgathers are one logical collective (ranks enter/leave
-    # together), part of distributed bin finding — hence the
-    # binning_allgather fault point
-    mfz, sample_global = guarded_collective(
-        _exchange, name="efb_bundle_exchange", point="binning_allgather")
+    # globally weighted zero fractions decide the candidate set; both
+    # exchanges ride distributed bin finding's own fault point so chaos
+    # runs can target ingest separately from train-loop sync
+    zf = host_allgather(
+        np.concatenate([np.asarray(local_zero_frac, np.float64)
+                        * local_rows, [local_rows]]).astype(np.float32),
+        name="efb_zero_frac", point="binning_allgather")
+    tot = zf.sum(axis=0)
+    mfz = tot[:-1] / max(tot[-1], 1) >= sparse_threshold
+    samp = _stride_sample(local_bins, max(1, sample_rows // nproc))
+    # transport dtype must hold every bin id: uint16 truncates silently
+    # past 65535, so wide-bin features ride uint32 instead (num_bin is
+    # plan input on every rank, so all ranks agree on the widening)
+    transport = (np.uint32
+                 if int(np.asarray(num_bin).max(initial=0))
+                 > int(np.iinfo(np.uint16).max)
+                 else np.uint16)
+    sample_global = ragged_all_gather(np.ascontiguousarray(
+        samp, dtype=transport), name="efb_bundle_exchange",
+        point="binning_allgather")
     return find_bundles(sample_global, num_bin, mfz,
                         max_conflict_rate, max_bundle_bins,
                         sample_rows=sample_global.shape[0])

@@ -9,7 +9,7 @@ from partial (per-machine) data by design — machines see different rows,
 and the global mapper for feature f is whichever machine owned f.
 
 TPU-native equivalent: hosts in a `jax.distributed` run exchange mapper
-dicts via `multihost_utils.process_allgather` on a JSON payload.  The
+dicts via the topology layer's ragged allgather on a JSON payload.  The
 assignment and merge are pure functions so single-process tests can
 exercise them without a multi-host runtime.
 """
@@ -126,16 +126,15 @@ def gather_row_samples(X_local: np.ndarray, quota: int,
     """Deterministic per-host row sample, allgathered into ONE global
     bin-finding sample every host holds identically.
 
-    Reuses the `find_bundles_multihost` ragged fixed-width transport:
-    per-host lengths allgather first, then a zero-padded f64 block, and
-    each host's contribution is sliced back out in process order — so
-    the result is deterministic given (data, seed, process layout).
-    Each host contributes at most `quota` of its local rows (sorted
-    deterministic choice, the same sampler `_find_mappers` uses)."""
-    import jax
-    from jax.experimental import multihost_utils
-
-    from ..parallel.collective import guarded_collective
+    The ragged transport (per-host lengths allgather, zero-padded
+    payload block, per-host slices back out in process order) is
+    `topology.ragged_all_gather` — ONE logical collective under ONE
+    watchdog, on binning's own fault point so chaos runs can target
+    ingest separately from train-loop sync.  The result is
+    deterministic given (data, seed, process layout).  Each host
+    contributes at most `quota` of its local rows (sorted deterministic
+    choice, the same sampler `_find_mappers` uses)."""
+    from ..parallel.topology import ragged_all_gather
 
     n = X_local.shape[0]
     if n > quota:
@@ -145,23 +144,8 @@ def gather_row_samples(X_local: np.ndarray, quota: int,
             np.asarray(X_local, np.float64)[idx])
     else:
         samp = np.asarray(X_local, np.float64)
-
-    def _gather() -> np.ndarray:
-        lens = np.asarray(multihost_utils.process_allgather(
-            np.asarray([samp.shape[0]], np.int64)))[:, 0]
-        mx = max(int(lens.max()), 1)
-        buf = np.zeros((mx, X_local.shape[1]), np.float64)
-        buf[:samp.shape[0]] = samp
-        g = np.asarray(multihost_utils.process_allgather(buf))  # [P, mx, F]
-        return np.concatenate(
-            [g[p, :int(lens[p])] for p in range(jax.process_count())])
-
-    # the lens+payload pair is ONE logical collective under the watchdog
-    # (a diverged host deadlocks the group's allgather — this module's
-    # historical failure mode); binning has its own fault point so chaos
-    # runs can target ingest separately from train-loop sync
-    return guarded_collective(_gather, name="gather_row_samples",
-                              point="binning_allgather")
+    return ragged_all_gather(samp, name="gather_row_samples",
+                             point="binning_allgather")
 
 
 def find_mappers_multihost(X_local: np.ndarray, config: Config,
@@ -196,16 +180,13 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
                                 total_rows=local_total_rows,
                                 feature_names=feature_names)
         return merge_mapper_payloads([payload], nf)
-    from jax.experimental import multihost_utils
-
-    from ..parallel.collective import guarded_collective
+    from ..parallel.topology import host_allgather, ragged_all_gather
 
     local_n = int(local_total_rows if local_total_rows is not None
                   else X_local.shape[0])
-    global_rows = int(guarded_collective(
-        lambda: multihost_utils.process_allgather(
-            np.asarray([local_n], np.int64)).sum(),
-        name="global_row_count", point="binning_allgather"))
+    global_rows = int(host_allgather(
+        np.asarray([local_n], np.int64),
+        name="global_row_count", point="binning_allgather").sum())
     assignment = assign_features(nf, nproc)
     mine = assignment[jax.process_index()]
     from .dataset import _is_scipy_sparse
@@ -219,18 +200,10 @@ def find_mappers_multihost(X_local: np.ndarray, config: Config,
                             total_rows=global_rows,
                             feature_names=feature_names)
 
-    # fixed-width byte tensor: allgather needs identical shapes per host
-    raw = payload.encode()
-
-    def _exchange() -> List[str]:
-        width = int(multihost_utils.process_allgather(
-            np.asarray([len(raw)], np.int64)).max())
-        buf = np.zeros(width, np.uint8)
-        buf[:len(raw)] = np.frombuffer(raw, np.uint8)
-        gathered = multihost_utils.process_allgather(buf)  # [nproc, width]
-        return [bytes(row).rstrip(b"\x00").decode()
-                for row in np.asarray(gathered).reshape(nproc, width)]
-
-    payloads = guarded_collective(_exchange, name="mapper_exchange",
-                                  point="binning_allgather")
+    # ragged byte transport, split back per host so each serialized
+    # payload decodes at its own boundary
+    raw = np.frombuffer(payload.encode(), np.uint8)
+    parts = ragged_all_gather(raw, name="mapper_exchange",
+                              point="binning_allgather", split=True)
+    payloads = [bytes(p).decode() for p in parts]
     return merge_mapper_payloads(payloads, nf)

@@ -1280,6 +1280,7 @@ class GBDT:
                                         False)),
             "data_shards": int(getattr(self.learner, "d_shards", 1)),
             "feature_shards": int(getattr(self.learner, "f_shards", 1)),
+            "hosts": int(getattr(self.learner, "hosts", 1)),
             "tree_learner": str(self.config.tree_learner),
         }
 

@@ -23,7 +23,7 @@ from ..io.dataset import TrainingData
 from ..ops.grower import (GrowerParams, canonical_params, mode_flags_np,
                           pad_rows, pool_dtype, resolve_split_batch)
 from ..ops.histogram import hashed_uniform, key_words
-from ..parallel.mesh import make_mesh, put_global, put_local
+from ..parallel.mesh import put_global, put_local
 from ..parallel.strategies import (bins_sharding, make_strategy_grower,
                                    pool_partition_spec,
                                    resolve_tree_learner, rows_sharding)
@@ -114,10 +114,25 @@ class TPUTreeLearner:
                 raise ValueError(
                     f"num_machines={n_shards} exceeds the {ndev} available "
                     f"devices ({jax.devices()[0].platform})")
-        self.strategy = strategy
         self.n_shards = n_shards if strategy != "serial" else 1
-        # 2-D factorization: rows on 'data' x features on 'feature'
-        # (reference parallel_tree_learner.h:25-187 template nesting)
+        # hosts axis of the (hosts, data, feature) topology — the
+        # process/DCN tier.  tpu_topology_hosts>0 pins it (simulated
+        # multi-host grids on one process); 0 follows the live process
+        # count.  Live multi-process runs must agree with reality: the
+        # put_local/put_global placement contracts key on it.
+        from ..parallel.topology import resolve_hosts
+
+        self.hosts = (resolve_hosts(int(config.tpu_topology_hosts))
+                      if strategy != "serial" else 1)
+        if (strategy != "serial" and jax.process_count() > 1
+                and self.hosts != jax.process_count()):
+            raise ValueError(
+                f"tpu_topology_hosts={self.hosts} disagrees with the live "
+                f"process count {jax.process_count()}; leave it 0 (auto) "
+                "on real multi-host meshes")
+        # 2-D factorization: rows on (hosts, data) x features on
+        # 'feature' (reference parallel_tree_learner.h:25-187 template
+        # nesting)
         if strategy == "data_feature":
             fs = int(config.tpu_feature_shards)
             if fs <= 0:
@@ -135,32 +150,48 @@ class TPUTreeLearner:
             self.f_shards = fs
             self.d_shards = self.n_shards // fs
         elif strategy == "feature":
-            self.f_shards, self.d_shards = self.n_shards, 1
+            if self.hosts > 1:
+                # feature sharding across hosts: no host holds every row
+                # once the hosts axis is real, so rows ride the hosts
+                # axis (one row shard per host) and each host's devices
+                # shard the features — the data_feature composition with
+                # d_shards == hosts.  Split decisions are gain-identical
+                # to 1-host feature sharding: histograms psum exactly
+                # over the row axes and the best-split sync shares the
+                # deterministic tie-break.
+                if self.n_shards % self.hosts != 0:
+                    raise ValueError(
+                        f"num_machines={self.n_shards} must split evenly "
+                        f"across {self.hosts} hosts for tree_learner="
+                        "feature")
+                strategy = "data_feature"
+                self.f_shards = self.n_shards // self.hosts
+                self.d_shards = self.hosts
+            else:
+                self.f_shards, self.d_shards = self.n_shards, 1
         else:
             self.f_shards, self.d_shards = 1, self.n_shards
+        self.strategy = strategy
 
         # ---- pre-partitioned training rows (reference loader
         # pre_partition, dataset_loader.cpp row distribution): each
         # PROCESS holds only its local row shard, so the row geometry
         # and device placement below become process-local and metrics
-        # reduce globally (parallel/metric_sync).
-        self._partitioned = False
-        if (bool(config.pre_partition) and strategy != "serial"
-                and jax.process_count() > 1):
-            if strategy not in ("data", "voting"):
-                raise NotImplementedError(
-                    "pre_partition training rows require tree_learner="
-                    "data or voting (feature sharding needs the full row "
-                    "set on every shard)")
+        # reduce globally (parallel/metric_sync).  DERIVED, not gated on
+        # strategy: every parallel learner rides the same (hosts, data,
+        # feature) mesh, so the old feature/EFB refusals are gone.
+        self._partitioned = (bool(config.pre_partition)
+                             and strategy != "serial"
+                             and jax.process_count() > 1)
+        if self._partitioned:
             if self.n_shards != len(jax.devices()):
                 raise ValueError(
                     "pre_partition requires num_machines == the total "
                     f"device count ({len(jax.devices())}); got "
                     f"{self.n_shards}")
-            if self.n_shards % jax.process_count() != 0:
-                raise ValueError("devices must split evenly across "
+            if self.d_shards % jax.process_count() != 0:
+                raise ValueError("row shards must split evenly across "
                                  "processes for pre_partition")
-            self._partitioned = True
 
         for key, allowed in (("tpu_partition_impl", ("select", "vselect",
                                                      "gather", "kernel")),
@@ -304,14 +335,11 @@ class TPUTreeLearner:
                 # every rank must agree on WHICH features are sparse, or
                 # Gs/perm diverge and the global tables are inconsistent
                 # — decide from the GLOBAL nonzero fractions
-                from jax.experimental import multihost_utils
+                from ..parallel.topology import host_allgather
 
-                from ..parallel.collective import guarded_collective
-
-                g = np.asarray(guarded_collective(
-                    lambda: multihost_utils.process_allgather(
-                        np.concatenate([nz_counts, [n]]).astype(np.int32)),
-                    name="sparse_global_fractions"))
+                g = host_allgather(
+                    np.concatenate([nz_counts, [n]]).astype(np.int32),
+                    name="sparse_global_fractions")
                 tot = g.sum(axis=0)
                 nz_counts, denom = tot[:-1], int(tot[-1])
             nz_frac = nz_counts / max(denom, 1)
@@ -395,15 +423,11 @@ class TPUTreeLearner:
             # rows per shard must be UNIFORM across the whole mesh: size
             # from the largest process's share (short ranks pad with
             # masked rows); n here is only THIS process's row count
-            from jax.experimental import multihost_utils
-
-            from ..parallel.collective import guarded_collective
+            from ..parallel.topology import host_allgather
 
             shards_local = self.d_shards // jax.process_count()
-            ns = np.asarray(guarded_collective(
-                lambda: multihost_utils.process_allgather(
-                    np.asarray([n], np.int32)),
-                name="shard_rows_sync"))
+            ns = host_allgather(np.asarray([n], np.int32),
+                                name="shard_rows_sync")
             max_shard_rows = -(-int(ns.max()) // shards_local)
             self.n_pad = bucket_rows(max_shard_rows) * self.d_shards
             self._local_width = (self.n_pad // self.d_shards) * shards_local
@@ -517,14 +541,11 @@ class TPUTreeLearner:
                 counts = np.bincount(key, minlength=sl * Gs)
                 max_nnz = int(counts.max()) if counts.size else 0
                 if self._partitioned:
-                    from jax.experimental import multihost_utils
+                    from ..parallel.topology import host_allgather
 
-                    from ..parallel.collective import guarded_collective
-
-                    max_nnz = int(np.asarray(guarded_collective(
-                        lambda: multihost_utils.process_allgather(
-                            np.asarray([max_nnz], np.int32)),
-                        name="sparse_table_width")).max())
+                    max_nnz = int(host_allgather(
+                        np.asarray([max_nnz], np.int32),
+                        name="sparse_table_width").max())
                 M = max(128, -(-max_nnz // 128) * 128)
                 sp_rows = np.full((sl, Gs, M), rps, np.int32)
                 sp_bins = np.full((sl, Gs, M), B, np.int32)
@@ -627,12 +648,21 @@ class TPUTreeLearner:
                                 dtype=v.dtype)])
             meta_host[k] = v
 
+        from ..parallel import topology as _topo
+
         if strategy == "serial":
+            self.topology = None
             self.mesh = None
+            _topo.activate(None)
             self._place_serial_bins(bins_t, n)
         else:
-            self.mesh = make_mesh(num_data_shards=self.d_shards,
-                                  num_feature_shards=self.f_shards)
+            self.topology = _topo.make_topology(
+                num_data_shards=self.d_shards,
+                num_feature_shards=self.f_shards,
+                num_hosts=self.hosts,
+                partitioned_rows=self._partitioned)
+            _topo.activate(self.topology)
+            self.mesh = self.topology.mesh
             if self._partitioned:
                 # each process contributes only ITS rows to the global
                 # arrays (reference pre_partition: rows never leave
@@ -691,7 +721,9 @@ class TPUTreeLearner:
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P_
 
-                shard3 = NamedSharding(self.mesh, P_("data"))
+                from ..parallel.topology import ROW_AXES
+
+                shard3 = NamedSharding(self.mesh, P_(ROW_AXES))
                 if self._partitioned:
                     # this process built only ITS shards' tables
                     gshape = (self.d_shards,) + sp_rows.shape[1:]
@@ -1324,17 +1356,13 @@ class TPUTreeLearner:
             # driver's score updates and renew paths operate on LOCAL
             # arrays (identical on all ranks), and a non-addressable
             # global array cannot be device_get there
-            from jax.experimental import multihost_utils
-
-            from ..parallel.collective import guarded_collective
+            from ..parallel.topology import host_device_allgather
 
             # the per-iteration hot collective: a dead peer here is the
             # canonical distributed-GBDT hang, so the watchdog matters
             # most at this site
-            lids = guarded_collective(
-                lambda: multihost_utils.process_allgather(
-                    out["leaf_ids"], tiled=True),
-                name="leaf_id_allgather")[:self.n]
+            lids = host_device_allgather(
+                out["leaf_ids"], name="leaf_id_allgather")[:self.n]
             return tree, jnp.asarray(lids), out
         return tree, out["leaf_ids"][:self.n], out
 

@@ -128,9 +128,13 @@ class GammaDevianceMetric(Metric):
         # a global SUM (no denominator): unlike averaged losses, a sum is
         # NOT replication-safe — adding the local sums of P replicated
         # ranks reports P x the true value.  Reduce across ranks only
-        # when each rank holds a distinct row shard (pre_partition);
-        # replicated ranks already hold the full sum locally.
-        if bool(self.config.pre_partition):
+        # when each rank actually holds a distinct row shard — DERIVED
+        # from the live topology's row placement, not the pre_partition
+        # config flag (a flag echo desynchronizes from reality the
+        # moment a new axis changes what the flag implies).
+        from ..parallel.topology import rows_partitioned
+
+        if rows_partitioned():
             from ..parallel.metric_sync import sync_sums
 
             total = float(sync_sums([total])[0])

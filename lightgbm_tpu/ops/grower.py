@@ -338,6 +338,14 @@ def make_grower(params: GrowerParams, num_features: int,
 def _build_grower(params, num_features, data_axis, feature_axis,
                   voting_k, num_shards, jit, num_columns, debug_hist,
                   external_pool):
+    # the axis-addressed collective vocabulary (the ONLY sanctioned
+    # spelling of cross-shard ops — graftlint T5xx).  Imported at build
+    # time: parallel/strategies.py imports this module, so a module-level
+    # import back into parallel/ would cycle.
+    from ..parallel.topology import (axis_all_gather, axis_best_split_sync,
+                                     axis_index, axis_pmax, axis_psum,
+                                     axis_psum_scatter)
+
     if voting_k and not data_axis:
         raise ValueError("voting requires a data axis")
     if voting_k and feature_axis:
@@ -435,10 +443,10 @@ def _build_grower(params, num_features, data_axis, feature_axis,
     sparse_tot = pool_scatter and params.has_sparse
 
     def preduce_scalar(x):
-        return jax.lax.psum(x, data_axis) if data_axis else x
+        return axis_psum(x, data_axis) if data_axis else x
 
     def agg_hist(x):
-        """Aggregate LOCAL (per-shard) histograms over the data axis.
+        """Aggregate LOCAL (per-shard) histograms over the row axes.
         x's feature/column axis is axis -3 ([..., G, B, 3]).  psum
         replicates the full aggregate; scatter (reduce-scatter) leaves
         this shard only its contiguous G/P column slice — shard d holds
@@ -447,10 +455,10 @@ def _build_grower(params, num_features, data_axis, feature_axis,
         if not data_axis or voting_k:
             return x
         if pool_scatter:
-            return jax.lax.psum_scatter(x, data_axis,
-                                        scatter_dimension=x.ndim - 3,
-                                        tiled=True)
-        return jax.lax.psum(x, data_axis)
+            return axis_psum_scatter(x, data_axis,
+                                     scatter_dimension=x.ndim - 3,
+                                     tiled=True)
+        return axis_psum(x, data_axis)
 
     split_kw = dict(l1=params.l1, l2=params.l2,
                     max_delta_step=params.max_delta_step,
@@ -583,7 +591,7 @@ def _build_grower(params, num_features, data_axis, feature_axis,
         bcols = block // 2 if params.packed_bins else block
 
         if feature_axis:
-            ax = jax.lax.axis_index(feature_axis)
+            ax = axis_index(feature_axis)
 
             def fslice(a):
                 return jax.lax.dynamic_slice_in_dim(a, ax * F, F)
@@ -598,9 +606,10 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             ax = None
             meta_local = meta
             bins_hist_t = bins_t
-        # this shard's position on the data axis: under scatter it owns
-        # histogram columns [dax*SG, (dax+1)*SG) after the reduce-scatter
-        dax = jax.lax.axis_index(data_axis) if scatter_on else None
+        # this shard's LINEARIZED position on the row axes: under scatter
+        # it owns histogram columns [dax*SG, (dax+1)*SG) after the
+        # reduce-scatter
+        dax = axis_index(data_axis) if scatter_on else None
 
         FG = feature_mask.shape[0]  # global feature width
 
@@ -710,29 +719,29 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             (parallel_tree_learner.h:190-213).  `gfeat` is this shard's
             winning feature id in the frame common to all shards on
             `axis`, and becomes the returned feature."""
-            gains = jax.lax.all_gather(res.gain, axis)             # [P]
-            feats = jax.lax.all_gather(
-                jnp.asarray(gfeat).astype(jnp.int32), axis)
-            thrs = jax.lax.all_gather(res.threshold, axis)
-            winner = argbest(gains, feats, thrs)
-            own = jax.lax.axis_index(axis) == winner
-
-            def pick(x):
-                return jax.lax.psum(
-                    jnp.where(own, x, jnp.zeros_like(x)), axis)
-
+            payload = dict(
+                default_left=res.default_left.astype(jnp.int32),
+                left_sum_g=res.left_sum_g,
+                left_sum_h=res.left_sum_h,
+                left_count=res.left_count,
+                left_output=res.left_output,
+                right_output=res.right_output,
+                is_cat=res.is_cat.astype(jnp.int32),
+                cat_mask=res.cat_mask)
+            gain, feat, thr, w = axis_best_split_sync(
+                axis, res.gain, gfeat, res.threshold, payload)
             return SplitResult(
-                gain=gains[winner],
-                feature=feats[winner],
-                threshold=thrs[winner].astype(jnp.int32),
-                default_left=pick(res.default_left.astype(jnp.int32)) > 0,
-                left_sum_g=pick(res.left_sum_g),
-                left_sum_h=pick(res.left_sum_h),
-                left_count=pick(res.left_count),
-                left_output=pick(res.left_output),
-                right_output=pick(res.right_output),
-                is_cat=pick(res.is_cat.astype(jnp.int32)) > 0,
-                cat_mask=pick(res.cat_mask))
+                gain=gain,
+                feature=feat,
+                threshold=thr.astype(jnp.int32),
+                default_left=w["default_left"] > 0,
+                left_sum_g=w["left_sum_g"],
+                left_sum_h=w["left_sum_h"],
+                left_count=w["left_count"],
+                left_output=w["left_output"],
+                right_output=w["right_output"],
+                is_cat=w["is_cat"] > 0,
+                cat_mask=w["cat_mask"])
 
         def select(hist, sg, sh, cnt, min_c, max_c, fmask,
                    delta, sp_tot=None) -> SplitResult:
@@ -768,7 +777,7 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                 # weighted-gain vote across shards (GlobalVoting :170-200)
                 contrib = jnp.zeros(F, jnp.float32).at[idx].add(
                     jnp.where(vals > K_MIN_SCORE / 2, vals, 0.0))
-                score = jax.lax.psum(contrib, data_axis)
+                score = axis_psum(contrib, data_axis)
                 kk = min(voting_k, F)
                 _, sel = jax.lax.top_k(score, kk)
                 sel = sel.astype(jnp.int32)
@@ -786,7 +795,7 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                         vmask = jnp.zeros(kp, jnp.float32).at[:kk].set(1.0)
                     else:
                         sel_p, vmask = sel, jnp.ones(kk, jnp.float32)
-                    sel_hist = jax.lax.psum_scatter(
+                    sel_hist = axis_psum_scatter(
                         hist[sel_p], data_axis, scatter_dimension=0,
                         tiled=True)                        # [kp/P, B, 3]
                     W = kp // num_shards
@@ -804,12 +813,12 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                 sel_meta = {k: v[sel_loc] for k, v in meta_local.items()
                             if k not in NONFEAT_META}
                 if sel_hist is None:
-                    sel_hist = jax.lax.psum(hist[sel], data_axis)
+                    sel_hist = axis_psum(hist[sel], data_axis)
                 if params.has_sparse:
                     sel_hist = fix_sparse_bins(
                         sel_hist, sel_meta["is_sparse"] > 0,
                         sel_meta["default_bin"],
-                        jax.lax.psum(loc, data_axis))
+                        axis_psum(loc, data_axis))
                 gain_sel, fin = combined_search(dequant(sel_hist), sg, sh,
                                                 cnt, sel_meta,
                                                 fmask_sel,
@@ -937,14 +946,14 @@ def _build_grower(params, num_features, data_axis, feature_axis,
             amax_g = jnp.max(jnp.abs(g))
             amax_h = jnp.max(jnp.abs(h))
             if data_axis:
-                amax_g = jax.lax.pmax(amax_g, data_axis)
-                amax_h = jax.lax.pmax(amax_h, data_axis)
+                amax_g = axis_pmax(amax_g, data_axis)
+                amax_h = axis_pmax(amax_h, data_axis)
             g_scale = jnp.maximum(amax_g, jnp.float32(1e-30)) / qmax
             h_scale = jnp.maximum(amax_h, jnp.float32(1e-30)) / qmax
             # fold_in leaves the caller's split stream untouched, so the
             # bynode draws below stay on their usual sequence
             seed_a, seed_b = key_words(jax.random.fold_in(key, 0x5154))
-            row0 = (jax.lax.axis_index(data_axis) * n_pad if data_axis
+            row0 = (axis_index(data_axis) * n_pad if data_axis
                     else 0)
             # rounding mode as a traced flag: stochastic and nearest are
             # both elementwise-cheap, so ONE program serves either (the
@@ -1646,16 +1655,19 @@ def _build_grower(params, num_features, data_axis, feature_axis,
                 own_d = (f_loc // SG) == dax
                 f_loc = f_loc % SG
                 own = own_d if own is None else (own & own_d)
-                axes += (data_axis,)
+                # data_axis may itself be an axis TUPLE (hosts, data) —
+                # splice its members so the psum sees flat names
+                axes += (data_axis if isinstance(data_axis, tuple)
+                         else (data_axis,))
             col_hist = state["pool"][p, f_loc]               # [B, 3]
             sums = jnp.sum(col_hist * mask_b[:, None], axis=0)
             if axes:
-                sums = jax.lax.psum(
+                sums = axis_psum(
                     jnp.where(own, sums, jnp.zeros_like(sums)), axes)
             if data_axis and voting_k:
                 # voting keeps the pool local: forced stats need the
                 # global sums
-                sums = jax.lax.psum(sums, data_axis)
+                sums = axis_psum(sums, data_axis)
             lg0, lh0, lc0 = sums[0], sums[1], sums[2]
             pg0 = state["leaf_sum_g"][p]
             ph0 = state["leaf_sum_h"][p]

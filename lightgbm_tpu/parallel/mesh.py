@@ -5,12 +5,16 @@ Bruck/recursive-halving/ring collectives (reference src/network/
 network.cpp:68-318).  On TPU the transport and algorithm selection belong to
 XLA: we declare a `jax.sharding.Mesh` with axes
 
+  * 'hosts'   — the process/DCN tier (parallel/topology.py)
   * 'data'    — row shards (the reference's data_parallel machines)
   * 'feature' — feature shards (the reference's feature_parallel machines)
 
-and express the collectives as `lax.psum` / `lax.all_gather` inside
-shard_map'ped growers.  `num_machines`/`machines` config maps to the mesh
-shape; ICI vs DCN placement is XLA's concern.
+and express the collectives through the axis-addressed vocabulary in
+`parallel/topology.py`, inside shard_map'ped growers.  `num_machines`/
+`machines` config maps to the mesh shape; ICI vs DCN placement follows
+the hosts axis.  This module keeps the process-group plumbing
+(rendezvous, global/local array placement) and the ring cost models the
+psum-vs-scatter decision is priced with.
 """
 
 from __future__ import annotations
@@ -139,15 +143,16 @@ def put_local(local_arr, sharding: NamedSharding, global_shape) -> "jax.Array":
 
 
 def make_mesh(num_data_shards: int = 1, num_feature_shards: int = 1,
-              devices: Optional[Sequence] = None) -> Mesh:
-    devices = list(devices if devices is not None else jax.devices())
-    need = num_data_shards * num_feature_shards
-    if need > len(devices):
-        raise ValueError(
-            f"mesh {num_data_shards}x{num_feature_shards} needs {need} "
-            f"devices, have {len(devices)}")
-    dev = np.array(devices[:need]).reshape(num_data_shards, num_feature_shards)
-    return Mesh(dev, ("data", "feature"))
+              devices: Optional[Sequence] = None,
+              num_hosts: int = 0) -> Mesh:
+    """The (hosts, data, feature) mesh — compatibility shim over
+    `topology.make_topology`; new call sites should build the Topology
+    directly and keep it (the mesh alone loses the shard counts)."""
+    from .topology import make_topology
+
+    return make_topology(num_data_shards=num_data_shards,
+                         num_feature_shards=num_feature_shards,
+                         num_hosts=num_hosts, devices=devices).mesh
 
 
 def shard_rows(n: int, num_shards: int) -> int:
@@ -181,14 +186,10 @@ def local_row_offset(local_n: int) -> Tuple[int, int]:
 
     if jax.process_count() == 1:
         return 0, int(local_n)
-    from jax.experimental import multihost_utils
+    from .topology import host_allgather
 
-    from .collective import guarded_collective
-
-    lens = np.asarray(guarded_collective(
-        lambda: multihost_utils.process_allgather(
-            np.asarray([int(local_n)], np.int64)),
-        name="row_offsets"))[:, 0]
+    lens = host_allgather(np.asarray([int(local_n)], np.int64),
+                          name="row_offsets")[:, 0]
     offsets, total = row_offsets(lens)
     return int(offsets[jax.process_index()]), total
 
@@ -223,3 +224,39 @@ def reduce_scatter_recv_bytes(nbytes: int, shards: int) -> int:
     if shards <= 1:
         return 0
     return (shards - 1) * nbytes // shards
+
+
+# --------------------------------------------------------------------------
+# Tiered (ICI vs DCN) cost model: a reduction over ROW_AXES on an
+# (hosts, data, feature) mesh lowers hierarchically — reduce-scatter
+# inside each host's ICI ring, the cross-host leg over DCN on the 1/D
+# partials, then an ICI all-gather to rebuild the full array where the
+# op is an all-reduce.  Splitting the predicted receive bytes by tier
+# prices the psum-vs-scatter decision per topology: DCN bandwidth is
+# ~an order of magnitude below ICI, so the DCN leg dominates wall time
+# even though it moves the fewest bytes.  perf_probe comm prints both
+# legs next to measured walls.
+# --------------------------------------------------------------------------
+
+def tiered_allreduce_recv_bytes(nbytes: int, hosts: int,
+                                devices_per_host: int) -> Tuple[int, int]:
+    """(ICI, DCN) per-shard receive bytes of a hierarchical all-reduce:
+    ICI reduce-scatter + DCN all-reduce of the 1/D partials + ICI
+    all-gather.  Degenerates to the flat ring models at either tier=1."""
+    d, h = max(devices_per_host, 1), max(hosts, 1)
+    # ICI reduce-scatter (d-1)/d + ICI all-gather (d-1)/d = the flat
+    # all-reduce ring's bytes; the DCN tier all-reduces the 1/d partials
+    ici = allreduce_recv_bytes(nbytes, d)
+    dcn = allreduce_recv_bytes(nbytes // d, h)
+    return ici, dcn
+
+
+def tiered_reduce_scatter_recv_bytes(nbytes: int, hosts: int,
+                                     devices_per_host: int) -> Tuple[int, int]:
+    """(ICI, DCN) per-shard receive bytes of a hierarchical
+    reduce-scatter: the ICI phase, then the DCN reduce-scatter of each
+    host's 1/D partials down to the final 1/(H*D) slices."""
+    d, h = max(devices_per_host, 1), max(hosts, 1)
+    ici = reduce_scatter_recv_bytes(nbytes, d)
+    dcn = reduce_scatter_recv_bytes(nbytes // d, h)
+    return ici, dcn

@@ -27,9 +27,11 @@ denominator both scale by P) nor a pairwise/positional rank statistic,
 so ranks agree either way.  SUM-type metrics (no denominator — e.g.
 ``gamma_deviance``'s 2x summed deviance) are the exception: summing the
 local sums of P replicated ranks reports P x the true value, so they
-must reduce only under ``pre_partition`` (distinct row shards) and skip
-the cross-rank reduction in replicated mode, where each rank's local
-sum already IS the global sum.
+must reduce only when each rank actually holds a DISTINCT row shard.
+That predicate is ``topology.rows_partitioned()`` — derived from where
+the live learner placed its rows (put_local vs put_global), not from
+echoing the ``pre_partition`` config flag, so a topology change cannot
+silently desynchronize the gate from reality.
 
 Collective discipline: these are process-level collectives — every rank
 must call them in the same order.  The engine's eval cadence is
@@ -66,26 +68,14 @@ def _allgather(arr: np.ndarray) -> np.ndarray:
     """Stack a same-shaped host array from every process: [P, *shape].
 
     Module-level indirection so tests can monkeypatch a fake world.
-    Transport detail: process_allgather rides jnp arrays, which demote
-    f64/i64 payloads to 32-bit whenever jax_enable_x64 is off (the
-    default outside deterministic mode) — that would silently break the
-    exact-merge contract.  64-bit payloads therefore travel as uint32
-    views/pairs (uint32 is never demoted) and are reassembled here.
+    The transport is the topology layer's bitsafe gather: 64-bit
+    payloads ride uint32 views (process_allgather's jnp transport would
+    demote f64/i64 to 32 bits whenever jax_enable_x64 is off), so the
+    exact-merge contract holds regardless of x64 mode.
     """
-    from jax.experimental import multihost_utils
+    from .topology import _bitsafe_gather
 
-    arr = np.ascontiguousarray(arr)
-    if arr.dtype == np.float64:
-        out = np.asarray(multihost_utils.process_allgather(
-            arr.view(np.uint32)))
-        return np.ascontiguousarray(out).view(np.float64)
-    if arr.dtype == np.int64:
-        if (arr < 0).any() or (arr >= 2 ** 32).any():
-            raise ValueError("int64 allgather payload out of uint32 range")
-        out = np.asarray(multihost_utils.process_allgather(
-            arr.astype(np.uint32)))
-        return out.astype(np.int64)
-    return np.asarray(multihost_utils.process_allgather(arr))
+    return _bitsafe_gather(np.ascontiguousarray(arr))
 
 
 def sync_sums(vals: Sequence[float]) -> np.ndarray:

@@ -62,6 +62,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.grower import GrowerParams, make_grower
 from ..utils.compile_ledger import ledger_jit
+from .topology import FEATURE, ROW_AXES
 
 META_KEYS = ("num_bin", "missing_type", "default_bin", "monotone", "penalty",
              "is_categorical", "cegb_coupled", "cegb_lazy", "bundle_idx",
@@ -95,14 +96,16 @@ def pool_partition_spec(strategy: str, scatter: bool) -> P:
     shards exactly like the slices the grower keeps per shard: the full
     width under psum (replicated), the contiguous G/P slice under
     scatter, the feature slice under feature sharding (feature-major /
-    data-minor in the 2-D mesh)."""
+    data-minor in the 2-D mesh).  Row shards address the (hosts, data)
+    axis PRODUCT — the linearized index equals the old flat data-axis
+    index, so placement is unchanged on a 1-host mesh."""
     if strategy in ("data", "voting"):
-        return P(None, "data") if scatter else P()
+        return P(None, ROW_AXES) if scatter else P()
     if strategy == "feature":
-        return P(None, "feature")
+        return P(None, FEATURE)
     if strategy == "data_feature":
-        return (P(None, ("feature", "data")) if scatter
-                else P(None, "feature"))
+        return (P(None, (FEATURE,) + ROW_AXES) if scatter
+                else P(None, FEATURE))
     return P()
 
 
@@ -167,8 +170,8 @@ def _build_strategy_grower(params, num_features, strategy, mesh,
         for k in ("is_sparse", "sparse_slot", "dense_col", "dense_ref",
                   "hist_perm"):
             meta_spec[k] = P()
-        meta_spec["sparse_idx"] = P("data")
-        meta_spec["sparse_bin"] = P("data")
+        meta_spec["sparse_idx"] = P(ROW_AXES)
+        meta_spec["sparse_bin"] = P(ROW_AXES)
     scatter = params.hist_agg == "scatter"
     if scatter and params.has_bundles:
         # static shard -> feature-ids table for the scattered EFB search
@@ -176,13 +179,13 @@ def _build_strategy_grower(params, num_features, strategy, mesh,
         meta_spec["scatter_feat"] = P()
     pool_spec = pool_partition_spec(strategy, scatter)
     if strategy in ("data", "voting"):
-        nshards = mesh.shape["data"]
+        nshards = mesh.shape["hosts"] * mesh.shape["data"]
         grow = make_grower(
-            params, num_features, data_axis="data",
+            params, num_features, data_axis=ROW_AXES,
             voting_k=(voting_k if strategy == "voting" else 0),
             num_shards=nshards, jit=False, num_columns=num_columns,
             debug_hist=debug_hist, external_pool=external_pool)
-        out_specs = {**base_out, "leaf_ids": P("data")}
+        out_specs = {**base_out, "leaf_ids": P(ROW_AXES)}
         if external_pool:
             out_specs["pool"] = pool_spec
         if debug_hist:
@@ -192,11 +195,11 @@ def _build_strategy_grower(params, num_features, strategy, mesh,
             # feature slice (stacking over 'data' reassembles the global
             # histogram — and the per-shard slice width IS the
             # no-global-histogram assertion hook for tests)
-            out_specs["root_hist"] = (P("data")
+            out_specs["root_hist"] = (P(ROW_AXES)
                                       if strategy == "voting" or scatter
                                       else P())
-        in_specs = (P(None, "data"), P("data"), P("data"), P("data"),
-                    P(), meta_spec, P())
+        in_specs = (P(None, ROW_AXES), P(ROW_AXES), P(ROW_AXES),
+                    P(ROW_AXES), P(), meta_spec, P())
         if external_pool:
             in_specs = in_specs + (pool_spec,)
         fn = shard_map(
@@ -212,7 +215,7 @@ def _build_strategy_grower(params, num_features, strategy, mesh,
                 f"feature count {num_features} must be padded to a multiple "
                 f"of the feature-shard count {nshards}")
         f_local = num_features // nshards
-        grow = make_grower(params, f_local, feature_axis="feature",
+        grow = make_grower(params, f_local, feature_axis=FEATURE,
                            jit=False, debug_hist=debug_hist,
                            external_pool=external_pool)
         # bins REPLICATED (P()), like the reference feature-parallel mode
@@ -225,7 +228,7 @@ def _build_strategy_grower(params, num_features, strategy, mesh,
         if external_pool:
             out_specs["pool"] = pool_spec
         if debug_hist:
-            out_specs["root_hist"] = P("feature")
+            out_specs["root_hist"] = P(FEATURE)
         in_specs = (P(), P(), P(), P(), P(), meta_spec, P())
         if external_pool:
             in_specs = in_specs + (pool_spec,)
@@ -237,31 +240,33 @@ def _build_strategy_grower(params, num_features, strategy, mesh,
         return _strategy_jit(fn, strategy, external_pool)
     if strategy == "data_feature":
         f_shards = mesh.shape["feature"]
-        d_shards = mesh.shape["data"]
+        d_shards = mesh.shape["hosts"] * mesh.shape["data"]
         if num_features % f_shards != 0:
             raise ValueError(
                 f"feature count {num_features} must be padded to a multiple "
                 f"of the feature-shard count {f_shards}")
         f_local = num_features // f_shards
-        grow = make_grower(params, f_local, data_axis="data",
-                           feature_axis="feature", num_shards=d_shards,
+        grow = make_grower(params, f_local, data_axis=ROW_AXES,
+                           feature_axis=FEATURE, num_shards=d_shards,
                            jit=False, debug_hist=debug_hist,
                            external_pool=external_pool)
-        # rows shard over 'data'; the bin matrix is [F_global, n_local]
-        # per device (features replicated within a data shard so the
-        # partition reads the full matrix, like the 1-D feature mode);
-        # histograms psum over 'data', bests all_gather over 'feature'
-        out_specs = {**base_out, "leaf_ids": P("data")}
+        # rows shard over (hosts, data); the bin matrix is [F_global,
+        # n_local] per device (features replicated within a row shard so
+        # the partition reads the full matrix, like the 1-D feature
+        # mode); histograms psum over the row axes, bests all_gather
+        # over 'feature'
+        out_specs = {**base_out, "leaf_ids": P(ROW_AXES)}
         if external_pool:
             out_specs["pool"] = pool_spec
         if debug_hist:
             # stack feature slices to global; under scatter each feature
-            # shard's slice is further scattered over 'data' (feature-
-            # major, data-minor — exactly the global feature order)
-            out_specs["root_hist"] = (P(("feature", "data")) if scatter
-                                      else P("feature"))
-        in_specs = (P(None, "data"), P("data"), P("data"), P("data"),
-                    P(), meta_spec, P())
+            # shard's slice is further scattered over the row axes
+            # (feature-major, row-minor — exactly the global feature
+            # order)
+            out_specs["root_hist"] = (P((FEATURE,) + ROW_AXES) if scatter
+                                      else P(FEATURE))
+        in_specs = (P(None, ROW_AXES), P(ROW_AXES), P(ROW_AXES),
+                    P(ROW_AXES), P(), meta_spec, P())
         if external_pool:
             in_specs = in_specs + (pool_spec,)
         fn = shard_map(
@@ -276,7 +281,7 @@ def _build_strategy_grower(params, num_features, strategy, mesh,
 def bins_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
     """Sharding for the transposed [F, n_pad] bin matrix under `strategy`."""
     if strategy in ("data", "voting", "data_feature"):
-        return NamedSharding(mesh, P(None, "data"))
+        return NamedSharding(mesh, P(None, ROW_AXES))
     if strategy == "feature":
         # replicated: every shard partitions rows from the full matrix
         # (the reference's all-data-on-all-machines feature mode)
@@ -287,5 +292,5 @@ def bins_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
 def rows_sharding(mesh: Mesh, strategy: str) -> NamedSharding:
     """Sharding for [n_pad] per-row vectors under `strategy`."""
     if strategy in ("data", "voting", "data_feature"):
-        return NamedSharding(mesh, P("data"))
+        return NamedSharding(mesh, P(ROW_AXES))
     return NamedSharding(mesh, P())

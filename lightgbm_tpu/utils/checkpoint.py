@@ -80,7 +80,8 @@ FORMAT_VERSION = 1
 ELASTIC_PARAMS = frozenset({
     "tree_learner", "num_machines", "machines", "machine_list_filename",
     "local_listen_port", "time_out", "pre_partition", "num_threads",
-    "tpu_feature_shards", "tpu_hist_agg", "tpu_donate_buffers",
+    "tpu_feature_shards", "tpu_topology_hosts", "tpu_hist_agg",
+    "tpu_donate_buffers",
     "tpu_compile_cache_dir", "tpu_collective_timeout_s",
     "tpu_collective_retries", "tpu_resume_elastic", "tpu_resume_strict",
     "tpu_checkpoint_dir", "tpu_checkpoint_interval",
@@ -310,13 +311,9 @@ class CheckpointManager:
         triple, under the collective watchdog."""
         if self.host_count == 1:
             return [vec]
-        from jax.experimental import multihost_utils
+        from ..parallel.topology import host_allgather
 
-        from ..parallel.collective import guarded_collective
-
-        out = guarded_collective(
-            lambda: multihost_utils.process_allgather(vec),
-            name="checkpoint_barrier")
+        out = host_allgather(np.asarray(vec), name="checkpoint_barrier")
         return [np.asarray(row) for row in np.asarray(out)]
 
     def commit_global(self, iteration: int, topology: Optional[Dict] = None,

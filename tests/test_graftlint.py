@@ -562,6 +562,65 @@ class TestMetricDrift:
 
 
 # ---------------------------------------------------------------------------
+# topology family: every collective is written once (ISSUE 20)
+# ---------------------------------------------------------------------------
+class TestTopologyRules:
+    def test_raw_lax_collectives_fire(self, tmp_path):
+        """Qualified calls, from-imports, and aliased-module spellings of
+        the psum family outside parallel/topology.py are all findings."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/hist.py": """
+            import jax
+            from jax import lax
+            from jax.lax import psum_scatter
+            def agg(h):
+                s = jax.lax.psum(h, "data")
+                i = lax.axis_index(("hosts", "data"))
+                return s, i
+        """})
+        fs = run(["lightgbm_tpu"], root, rules=["T501"])
+        # from-import (psum_scatter) + two qualified calls
+        assert len(fs) == 3
+        assert all(f.rule == "T501" for f in fs)
+        assert "parallel/topology.py" in fs[0].message
+
+    def test_raw_process_allgather_fires(self, tmp_path):
+        root = _tree(tmp_path, {"lightgbm_tpu/parallel/sync.py": """
+            from jax.experimental import multihost_utils
+            from jax.experimental.multihost_utils import process_allgather
+            def pull(x):
+                return multihost_utils.process_allgather(x, tiled=True)
+        """})
+        fs = run(["lightgbm_tpu"], root, rules=["T502"])
+        assert len(fs) == 2  # the from-import and the qualified call
+        assert all(f.rule == "T502" for f in fs)
+
+    def test_topology_module_itself_exempt(self, tmp_path):
+        """parallel/topology.py is the ONE module allowed to spell the
+        raw primitives — the vocabulary has to be written somewhere."""
+        root = _tree(tmp_path, {"lightgbm_tpu/parallel/topology.py": """
+            import jax
+            from jax.experimental.multihost_utils import process_allgather
+            def axis_psum(x, axes):
+                return jax.lax.psum(x, axes)
+            def host_allgather(x):
+                return process_allgather(x, tiled=True)
+        """})
+        assert run(["lightgbm_tpu"], root, rules=["T501", "T502"]) == []
+
+    def test_axis_vocabulary_consumers_clean(self, tmp_path):
+        """The ported idiom — axis_* helpers addressed by named axes —
+        must NOT be flagged (bare names carry no lax qualifier)."""
+        root = _tree(tmp_path, {"lightgbm_tpu/ops/grower.py": """
+            from ..parallel.topology import (axis_index, axis_psum,
+                                             axis_psum_scatter)
+            def agg(h, data_axis):
+                s = axis_psum_scatter(h, data_axis, scatter_dimension=0)
+                return s + axis_psum(h, data_axis), axis_index(data_axis)
+        """})
+        assert run(["lightgbm_tpu"], root, rules=["T501", "T502"]) == []
+
+
+# ---------------------------------------------------------------------------
 # 3. machinery: suppressions, baseline, reporters, explain, CLI
 # ---------------------------------------------------------------------------
 class TestMachinery:

@@ -185,8 +185,13 @@ class TestMergedMetricsEqualFull:
                             self.weight)
 
     def test_gamma_deviance_global_sum(self, monkeypatch):
-        # sum-type metric: reduces across ranks ONLY under pre_partition
-        # (distinct row shards); the harness models exactly that world
+        # sum-type metric: reduces across ranks ONLY when each rank holds
+        # a distinct row shard.  The gate is the topology layer's derived
+        # row-ownership predicate (not the pre_partition config flag) —
+        # arm it the way a live partitioned learner would
+        from lightgbm_tpu.parallel import topology
+
+        monkeypatch.setattr(topology, "rows_partitioned", lambda: True)
         label = np.abs(self.label_reg) + 0.5
         score = np.abs(self.score) + 0.5
         _merged_vs_full(monkeypatch, "gamma_deviance",

@@ -1,0 +1,289 @@
+"""The unified (hosts, data, feature) topology (ISSUE 20).
+
+Load-bearing guarantees under test:
+
+* `make_topology` always builds the 3-axis mesh (hosts may be size 1)
+  over process-major `jax.devices()`, so relabeling the flat data axis
+  as (hosts, data) preserves device placement — and therefore bitwise
+  model output — exactly.  `axis_index(ROW_AXES)` linearizes row-major
+  back to the old flat shard index.
+* the (hosts × devices) bitwise grid: int8/int16 model files are
+  byte-identical across {1,2}-host × {1,2,4}-device points (hosts
+  simulated on one process via `tpu_topology_hosts`), and an elastic
+  resume may cross a host-count change.
+* `tree_learner=feature` under hosts>1 remaps onto the data_feature
+  grower (rows ride the hosts axis) instead of refusing — the carve-out
+  ISSUE 20 deleted.
+* `rows_partitioned()` is the single sum-type predicate (replaces
+  config.pre_partition echoes); host transport helpers degrade to
+  identities in a 1-process world but still honor fault points.
+
+Runs on the 8-virtual-device CPU mesh (tests/conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel import topology
+from lightgbm_tpu.parallel.topology import (DATA, FEATURE, HOSTS, ROW_AXES,
+                                            axis_index, axis_psum,
+                                            make_topology, ragged_all_gather,
+                                            resolve_hosts, rows_partitioned)
+
+
+def _problem(n=4096, f=10, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] * X[:, 2] > 0).astype(np.float64)
+    return X, y
+
+
+def _train_model_text(X, y, rounds=3, **cfg):
+    params = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+              "min_data_in_leaf": 5, "tpu_block_rows": 512,
+              "verbosity": -1, "tpu_shape_buckets": 0}
+    params.update(cfg)
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=rounds,
+                    keep_training_booster=True)
+    text = bst.model_to_string().split("\nparameters:")[0]
+    return text, bst
+
+
+@pytest.fixture(autouse=True)
+def _restore_active_topology():
+    """Training activates the learner's topology in the module registry;
+    leave no cross-test residue."""
+    yield
+    topology.activate(None)
+
+
+# ---------------------------------------------------------------------------
+class TestMakeTopology:
+    def test_three_axes_always(self):
+        t = make_topology(num_data_shards=4)
+        assert t.mesh.axis_names == (HOSTS, DATA, FEATURE)
+        assert dict(t.mesh.shape) == {HOSTS: 1, DATA: 4, FEATURE: 1}
+        assert (t.hosts, t.data_shards, t.feature_shards) == (1, 4, 1)
+        assert t.local_data_shards == 4
+
+    def test_hosts_axis_factorizes_the_row_shards(self):
+        t = make_topology(num_data_shards=4, num_hosts=2)
+        assert dict(t.mesh.shape) == {HOSTS: 2, DATA: 2, FEATURE: 1}
+        assert t.data_shards == 4 and t.local_data_shards == 2
+
+    def test_device_order_is_flat_reshape(self):
+        """(hosts, data) relabeling must NOT permute devices — that is
+        the whole bitwise-invariance argument."""
+        flat = make_topology(num_data_shards=4).mesh.devices.ravel()
+        split = make_topology(num_data_shards=4,
+                              num_hosts=2).mesh.devices.ravel()
+        assert list(flat) == list(split)
+
+    def test_indivisible_hosts_rejected(self):
+        with pytest.raises(ValueError, match="hosts"):
+            make_topology(num_data_shards=3, num_hosts=2)
+
+    def test_too_few_devices_rejected(self):
+        with pytest.raises(ValueError, match="devices"):
+            make_topology(num_data_shards=8, num_feature_shards=2)
+
+    def test_resolve_hosts(self):
+        assert resolve_hosts(0) == jax.process_count()
+        assert resolve_hosts(3) == 3
+
+
+class TestAxisVocabulary:
+    def test_row_axes_index_linearizes_row_major(self):
+        """axis_index(ROW_AXES) on the (2, 2) factorization equals the
+        old flat data-axis index 0..3 in device order."""
+        from jax.sharding import PartitionSpec as P
+
+        from lightgbm_tpu.parallel.strategies import shard_map
+
+        t = make_topology(num_data_shards=4, num_hosts=2)
+
+        def body():
+            return axis_index(ROW_AXES)[None]
+
+        out = jax.jit(shard_map(body, mesh=t.mesh, in_specs=(),
+                                out_specs=P(ROW_AXES),
+                                check_vma=False))()
+        np.testing.assert_array_equal(np.asarray(out), [0, 1, 2, 3])
+
+    def test_axis_psum_over_row_axes_is_global(self):
+        from jax.sharding import PartitionSpec as P
+
+        from lightgbm_tpu.parallel.strategies import shard_map
+
+        t = make_topology(num_data_shards=4, num_hosts=2)
+
+        def body(x):
+            return axis_psum(x, ROW_AXES)
+
+        x = jnp.arange(4, dtype=jnp.int32)
+        out = jax.jit(shard_map(body, mesh=t.mesh,
+                                in_specs=P(ROW_AXES), out_specs=P(),
+                                check_vma=False))(x)
+        assert int(out[0]) == 6
+
+
+class TestRowsPartitioned:
+    def test_default_false(self):
+        topology.activate(None)
+        assert rows_partitioned() is False
+
+    def test_single_process_world_is_never_partitioned(self):
+        t = make_topology(num_data_shards=2, partitioned_rows=True)
+        topology.activate(t)
+        assert rows_partitioned() is False  # process_count() == 1
+
+    def test_true_under_multiprocess_partitioned(self, monkeypatch):
+        t = make_topology(num_data_shards=2, partitioned_rows=True)
+        topology.activate(t)
+        monkeypatch.setattr(topology.jax, "process_count", lambda: 2)
+        assert rows_partitioned() is True
+        topology.activate(t._replace(partitioned_rows=False))
+        assert rows_partitioned() is False
+
+
+class TestHostTransportLocal:
+    """1-process world: every host collective is an identity that still
+    rides the watchdog (fault points must fire even locally)."""
+
+    def test_host_allgather_identity(self):
+        a = np.arange(6, dtype=np.int64).reshape(2, 3)
+        out = topology.host_allgather(a, name="t")
+        assert out.shape == (1, 2, 3)
+        np.testing.assert_array_equal(out[0], a)
+
+    def test_ragged_all_gather_identity_and_split(self):
+        a = np.arange(5, dtype=np.float64)
+        np.testing.assert_array_equal(ragged_all_gather(a, name="t"), a)
+        parts = ragged_all_gather(a, name="t", split=True)
+        assert len(parts) == 1
+        np.testing.assert_array_equal(parts[0], a)
+
+    def test_local_collectives_fire_fault_points(self):
+        from lightgbm_tpu.parallel.collective import CollectiveTimeout
+        from lightgbm_tpu.utils import faultline
+
+        faultline.reset()
+        try:
+            faultline.arm("collective_sync", action="hang")
+            from lightgbm_tpu.parallel import collective
+            collective.configure(timeout_s=0.2, retries=1, backoff_s=0.0)
+            with pytest.raises(CollectiveTimeout):
+                topology.host_allgather(np.zeros(2), name="t")
+        finally:
+            faultline.reset()
+            from lightgbm_tpu.parallel import collective
+            collective.configure(timeout_s=0.0, retries=1, backoff_s=0.25)
+
+
+# ---------------------------------------------------------------------------
+class TestHostsGridQuick:
+    """Tier-1 hosts-axis coverage: one cheap bitwise point per claim;
+    the full {1,2}-host × {1,2,4}-device × {int8,int16} grid is the slow
+    sweep below + the multichip dryrun's topology section."""
+
+    def test_data_hosts2_bitwise_vs_serial_int8(self):
+        X, y = _problem(n=2048)
+        # refit off, as in the shard-count sweep: the refit leaf psum is
+        # the one f32 reduction whose shard-order ulps reach the model
+        q = {"tpu_hist_precision": "int8",
+             "tpu_quant_refit_leaves": False}
+        ref, _ = _train_model_text(X, y, **q)
+        got, bst = _train_model_text(X, y, tree_learner="data",
+                                     num_machines=4, tpu_topology_hosts=2,
+                                     **q)
+        assert got == ref
+        assert bst._driver.learner.hosts == 2
+
+    def test_feature_under_hosts_remaps_to_data_feature(self):
+        """The deleted carve-out: feature sharding under a multihost
+        topology now rides the data_feature grower (rows on the hosts
+        axis) and must match the explicit data_feature factorization
+        bitwise."""
+        X, y = _problem(n=2048)
+        q = {"tpu_hist_precision": "int8"}
+        got, bst = _train_model_text(X, y, tree_learner="feature",
+                                     num_machines=4, tpu_topology_hosts=2,
+                                     **q)
+        lrn = bst._driver.learner
+        assert lrn.strategy == "data_feature"
+        assert (lrn.d_shards, lrn.f_shards) == (2, 2)
+        ref, _ = _train_model_text(X, y, tree_learner="data_feature",
+                                   num_machines=4, **q)
+        assert got == ref
+
+    def test_hosts_must_divide_shards(self):
+        X, y = _problem(n=512)
+        with pytest.raises(ValueError, match="hosts"):
+            _train_model_text(X, y, tree_learner="data", num_machines=3,
+                              tpu_topology_hosts=2)
+
+    def test_snapshot_reports_hosts(self):
+        X, y = _problem(n=1024)
+        _, bst = _train_model_text(X, y, tree_learner="data",
+                                   num_machines=2, tpu_topology_hosts=2)
+        snap = bst._driver.topology_snapshot()
+        assert snap["hosts"] == 2
+
+
+@pytest.mark.slow
+class TestHostsGridBitwise:
+    """The acceptance grid: int8/int16 model files byte-identical across
+    every (hosts, devices) point — {1,2} hosts × {1,2,4} device shards,
+    hosts simulated on one process via tpu_topology_hosts."""
+
+    @pytest.mark.parametrize("prec", ["int8", "int16"])
+    def test_grid(self, prec):
+        X, y = _problem()
+        q = {"tpu_hist_precision": prec, "tpu_quant_refit_leaves": False}
+        ref, _ = _train_model_text(X, y, **q)  # serial baseline
+        for hosts in (1, 2):
+            for shards in (1, 2, 4):
+                if shards % hosts != 0 or shards < hosts:
+                    continue
+                if shards == 1:
+                    continue  # serial IS the baseline
+                got, bst = _train_model_text(
+                    X, y, tree_learner="data", num_machines=shards,
+                    tpu_topology_hosts=hosts, **q)
+                assert got == ref, (hosts, shards, prec)
+                assert bst._driver.learner.hosts == hosts
+
+
+# ---------------------------------------------------------------------------
+class TestElasticResumeHostCrossing:
+    """A checkpoint taken on one host layout resumes on another: the
+    hosts axis is an ELASTIC param (scores are global f32 buffers;
+    quantized rounding keys on the GLOBAL row index)."""
+
+    def test_int8_bitwise_across_host_counts(self, tmp_path):
+        X, y = _problem(n=1500, f=6, seed=11)
+        q = {"objective": "binary", "num_leaves": 13, "max_bin": 47,
+             "min_data_in_leaf": 5, "verbosity": -1,
+             "tpu_hist_precision": "int8", "tree_learner": "data",
+             "tpu_quant_refit_leaves": False, "tpu_shape_buckets": 0}
+
+        def train(params, rounds, resume=False):
+            ds = lgb.Dataset(X, label=y, params=params)
+            return lgb.train(params, ds, num_boost_round=rounds,
+                             keep_training_booster=True, resume=resume)
+
+        def model(bst):
+            return bst.model_to_string(
+                num_iteration=-1).split("\nparameters:")[0]
+
+        base = model(train(dict(q, num_machines=1), 6))
+        pc = dict(q, tpu_checkpoint_dir=str(tmp_path))
+        train(dict(pc, num_machines=4, tpu_topology_hosts=1), 3)
+        resumed = train(dict(pc, num_machines=4, tpu_topology_hosts=2), 6,
+                        resume=True)
+        assert model(resumed) == base

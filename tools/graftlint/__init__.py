@@ -1,7 +1,7 @@
-"""graftlint: determinism / jit-discipline / concurrency / drift
-static analysis for the lightgbm_tpu codebase.
+"""graftlint: determinism / jit-discipline / concurrency / drift /
+topology static analysis for the lightgbm_tpu codebase.
 
-Four rule families, each born from a postmortem this repo already
+Five rule families, each born from a postmortem this repo already
 paid for (see `--explain <rule-id>` and ROADMAP item 7):
 
 * **D1xx determinism** — the PR-11 bitwise root causes as lint:
@@ -16,6 +16,9 @@ paid for (see `--explain <rule-id>` and ROADMAP item 7):
 * **P4xx config/docs drift** — every tpu_*/serving_* param read
   somewhere (P401), documented (P402), and nothing documented that
   does not exist (P403).
+* **T5xx topology** — every collective is written once, in
+  parallel/topology.py: raw jax.lax psum-family calls (T501) and raw
+  multihost_utils.process_allgather (T502) anywhere else are findings.
 
 Run: ``python -m tools.graftlint lightgbm_tpu/`` (text) or
 ``--format json`` (machine-readable, the multichip-dryrun gate).

@@ -55,7 +55,7 @@ def main(argv=None) -> int:
 
     if args.explain:
         # load the registry
-        from . import concurrency, determinism, drift, jitrules  # noqa: F401
+        from . import collectives, concurrency, determinism, drift, jitrules  # noqa: F401
 
         text = explain(args.explain)
         if text is None:
@@ -66,7 +66,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.list_rules:
-        from . import concurrency, determinism, drift, jitrules  # noqa: F401
+        from . import collectives, concurrency, determinism, drift, jitrules  # noqa: F401
 
         for rid in sorted(RULES):
             r = RULES[rid]
@@ -78,7 +78,7 @@ def main(argv=None) -> int:
     rules = ([s.strip() for s in args.rules.split(",") if s.strip()]
              if args.rules else None)
     if rules:
-        from . import concurrency, determinism, drift, jitrules  # noqa: F401
+        from . import collectives, concurrency, determinism, drift, jitrules  # noqa: F401
 
         unknown = [r for r in rules if r not in RULES]
         if unknown:

@@ -1,0 +1,126 @@
+"""Topology rules (T5xx): every collective is written once.
+
+ISSUE 20 folded the two parallel stacks (single-host shard_map
+strategies, multihost ``pre_partition`` hand-rolled allgathers) into one
+declarative (hosts, data, feature) topology whose collective vocabulary
+lives in ``parallel/topology.py`` — `axis_psum`/`axis_psum_scatter`/
+`axis_all_gather`/`axis_index`/`axis_best_split_sync` on the device
+side, `host_allgather`/`host_sum`/`ragged_all_gather` (each under ONE
+guarded_collective watchdog) on the host side.  The PR-13 pattern:
+yesterday's root cause — a collective expressed per-site drifts from
+its siblings (wrong axis name, missing watchdog, 64-bit payloads
+silently demoted in transport) — becomes today's lint.  A raw
+`lax.psum`-family call or `multihost_utils.process_allgather` anywhere
+else is a finding; the committed baseline stays empty.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileContext, Rule, dotted_name, register
+
+# the one module allowed to spell the raw primitives
+_TOPOLOGY = "lightgbm_tpu/parallel/topology.py"
+
+# device-collective leaves (jax.lax.*) the topology vocabulary wraps
+_LAX_COLLECTIVES = frozenset({
+    "psum", "psum_scatter", "all_gather", "pmax", "pmin", "pmean",
+    "axis_index", "all_to_all", "ppermute",
+})
+
+
+def outside_topology(rel: str) -> bool:
+    return not rel.replace("\\", "/").endswith(_TOPOLOGY)
+
+
+def _check_raw_lax(fc: FileContext):
+    for node in ast.walk(fc.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod == "jax.lax" or mod.endswith(".lax"):
+                hit = [a.name for a in node.names
+                       if a.name in _LAX_COLLECTIVES]
+                if hit:
+                    yield fc.finding(
+                        "T501", node,
+                        f"raw jax.lax collective import ({', '.join(hit)}) "
+                        "outside parallel/topology.py — use the axis-"
+                        "addressed vocabulary (axis_psum, "
+                        "axis_psum_scatter, axis_all_gather, axis_index, "
+                        "axis_best_split_sync) so every collective is "
+                        "written once against the named (hosts, data, "
+                        "feature) axes.")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        parts = name.split(".")
+        if parts[-1] in _LAX_COLLECTIVES and "lax" in parts[:-1]:
+            yield fc.finding(
+                "T501", node,
+                f"raw device collective {name}(...) outside "
+                "parallel/topology.py — use the axis-addressed "
+                "vocabulary (axis_psum, axis_psum_scatter, "
+                "axis_all_gather, axis_index, axis_best_split_sync) so "
+                "every collective is written once against the named "
+                "(hosts, data, feature) axes.")
+
+
+def _check_raw_process_allgather(fc: FileContext):
+    for node in ast.walk(fc.tree):
+        if isinstance(node, ast.ImportFrom):
+            if any(a.name == "process_allgather" for a in node.names):
+                yield fc.finding(
+                    "T502", node,
+                    "raw process_allgather import outside "
+                    "parallel/topology.py — host exchanges ride "
+                    "topology.host_allgather / host_sum / "
+                    "ragged_all_gather (one watchdog per logical "
+                    "collective, bitsafe 64-bit transport).")
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name.rsplit(".", 1)[-1] == "process_allgather":
+            yield fc.finding(
+                "T502", node,
+                f"raw {name}(...) outside parallel/topology.py — host "
+                "exchanges ride topology.host_allgather / host_sum / "
+                "ragged_all_gather (one watchdog per logical collective, "
+                "bitsafe 64-bit transport).")
+
+
+register(Rule(
+    id="T501", name="raw-device-collective", family="topology",
+    summary=("jax.lax psum/psum_scatter/all_gather/pmax/axis_index "
+             "(and friends) may be spelled only in parallel/topology.py; "
+             "everything else uses the axis_* vocabulary."),
+    rationale=(
+        "ISSUE 20: the grower, strategies, and metric layers each "
+        "hand-spelled their collectives against a bare 'data' axis "
+        "while the multihost path rode outside the mesh entirely — so "
+        "the same logical reduction existed in several spellings and "
+        "the multihost learner had to refuse whatever the single-host "
+        "path expressed differently (EFB, feature sharding).  With one "
+        "vocabulary in parallel/topology.py, a collective names its "
+        "axes ONCE and lowers identically from a single host to a pod; "
+        "a raw lax call is a new spelling waiting to drift."),
+    scope=outside_topology,
+    check=lambda fc: _check_raw_lax(fc)))
+
+register(Rule(
+    id="T502", name="raw-process-allgather", family="topology",
+    summary=("multihost_utils.process_allgather may be spelled only in "
+             "parallel/topology.py; host exchanges use host_allgather/"
+             "host_sum/ragged_all_gather."),
+    rationale=(
+        "ISSUE 20: hand-rolled process_allgather sites each re-solved "
+        "the same three problems — watchdog wrapping (or forgetting "
+        "it), ragged lens+pad+slice transport, and 64-bit payloads "
+        "that jnp transport silently demotes to 32 bits when x64 is "
+        "off.  parallel/topology.py solves each once (guarded "
+        "collectives, ragged_all_gather, uint32-view bitsafe "
+        "transport); a raw call site re-opens all three."),
+    scope=outside_topology,
+    check=lambda fc: _check_raw_process_allgather(fc)))

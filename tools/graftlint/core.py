@@ -298,7 +298,7 @@ class Project:
 def run(paths: Sequence[str], root: str,
         rules: Optional[Sequence[str]] = None) -> List[Finding]:
     # rule modules self-register on import
-    from . import concurrency, determinism, drift, jitrules  # noqa: F401
+    from . import collectives, concurrency, determinism, drift, jitrules  # noqa: F401
 
     project = Project.load(paths, root)
     if not project.files and not project.errors:
@@ -413,7 +413,7 @@ def to_json(findings: List[Finding], all_findings: List[Finding]) -> str:
 
 
 def explain(rule_id: str) -> Optional[str]:
-    from . import concurrency, determinism, drift, jitrules  # noqa: F401
+    from . import collectives, concurrency, determinism, drift, jitrules  # noqa: F401
 
     rule = RULES.get(rule_id)
     if rule is None:

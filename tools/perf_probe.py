@@ -411,80 +411,110 @@ def run_ingest_sweep(X, y, bins=255):
               f"(sketch {sk:5.2f}s bin {bn:5.2f}s)", flush=True)
 
 
-def run_comm_sweep(shard_counts, reps=10):
+def run_comm_sweep(shard_counts, reps=10, host_counts=(1,)):
     """Histogram-aggregation sweep: psum (all-reduce) vs psum_scatter
-    (reduce-scatter) wall time over (shards, F, B, K, precision), with
-    the predicted per-shard ICI receive bytes printed next to the
-    measured wall so the scatter win stays legible even on the CPU
-    container (where the "collective" is a memcpy and the wall mostly
-    tracks bytes touched).  The array is the grower's aggregation
-    payload: the [K, F, B, 3] smaller-child histograms in the
-    accumulation dtype (int32 for int8/int16, f32 for hilo/f32).
+    (reduce-scatter) wall time over (hosts, shards, F, B, K, precision),
+    with the predicted per-shard receive bytes split into ICI and DCN
+    legs printed next to the measured wall so the scatter win stays
+    legible even on the CPU container (where the "collective" is a
+    memcpy and the wall mostly tracks bytes touched).  The collectives
+    ride the unified (hosts, data, feature) topology — `axis_psum` /
+    `axis_psum_scatter` over the ROW_AXES pair, exactly the grower's
+    aggregation path — so the sweep measures what training runs.  The
+    hierarchical ring model (parallel/mesh.py tiered_* helpers) splits
+    the receive bytes: the intra-host ring moves full-payload legs over
+    ICI while the cross-host ring moves 1/d-sized legs over DCN; total
+    scatter bytes equal the flat ring at every (h, d) factorization, so
+    growing the hosts axis re-labels legs without adding traffic.  The
+    array is the grower's aggregation payload: the [K, F, B, 3]
+    smaller-child histograms in the accumulation dtype (int32 for
+    int8/int16, f32 for hilo/f32).
 
-        SHARDS=2,4,8 python tools/perf_probe.py comm
+        SHARDS=2,4,8 HOSTS=1,2 python tools/perf_probe.py comm
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
-    from lightgbm_tpu.parallel.mesh import (allreduce_recv_bytes,
-                                            reduce_scatter_recv_bytes)
+    from lightgbm_tpu.parallel.mesh import (tiered_allreduce_recv_bytes,
+                                            tiered_reduce_scatter_recv_bytes)
     from lightgbm_tpu.parallel.strategies import shard_map
+    from lightgbm_tpu.parallel.topology import (ROW_AXES, axis_psum,
+                                                axis_psum_scatter,
+                                                make_topology)
 
     devices = jax.devices()
     rng = np.random.default_rng(0)
     print(f"{len(devices)} {devices[0].platform} devices; per-shard "
-          "receive bytes predicted by the ring cost model "
-          "(parallel/mesh.py)", flush=True)
-    header = (f"{'shards':>6s} {'F':>5s} {'B':>4s} {'K':>3s} {'prec':>5s} "
-              f"{'payload':>9s} {'pred psum':>10s} {'pred scat':>10s} "
+          "receive bytes predicted by the tiered ring cost model "
+          "(parallel/mesh.py): ICI = intra-host ring over full payload, "
+          "DCN = cross-host ring over the 1/devices-per-host slice",
+          flush=True)
+    header = (f"{'hosts':>5s} {'shards':>6s} {'F':>5s} {'B':>4s} {'K':>3s} "
+              f"{'prec':>5s} {'payload':>9s} "
+              f"{'psum ICI':>9s} {'psum DCN':>9s} "
+              f"{'scat ICI':>9s} {'scat DCN':>9s} "
               f"{'psum ms':>8s} {'scatter ms':>10s} {'ratio':>6s}")
     print(header, flush=True)
-    for p in shard_counts:
-        if p > len(devices):
-            print(f"{p:6d}  SKIP (only {len(devices)} devices)", flush=True)
-            continue
-        mesh = Mesh(np.array(devices[:p]), ("data",))
-        for F, B, K in ((32, 64, 16), (32, 256, 25), (256, 256, 25)):
-            # pad F to the shard count like the learner does
-            Fp = -(-F // p) * p
-            for prec in ("int8", "hilo"):
-                dt = jnp.int32 if prec in ("int8", "int16") else jnp.float32
-                h = jnp.asarray(
-                    rng.integers(0, 1000, size=(K, Fp, B, 3)), dtype=dt)
-                nbytes = h.size * h.dtype.itemsize
+    for hosts in host_counts:
+        for p in shard_counts:
+            if p > len(devices):
+                print(f"{hosts:5d} {p:6d}  SKIP (only {len(devices)} "
+                      "devices)", flush=True)
+                continue
+            if p % hosts != 0:
+                print(f"{hosts:5d} {p:6d}  SKIP ({p} shards not divisible "
+                      f"by {hosts} hosts)", flush=True)
+                continue
+            d_local = p // hosts
+            topo = make_topology(num_data_shards=p, num_feature_shards=1,
+                                 num_hosts=hosts, devices=devices)
+            mesh = topo.mesh
+            for F, B, K in ((32, 64, 16), (32, 256, 25), (256, 256, 25)):
+                # pad F to the shard count like the learner does
+                Fp = -(-F // p) * p
+                for prec in ("int8", "hilo"):
+                    dt = (jnp.int32 if prec in ("int8", "int16")
+                          else jnp.float32)
+                    h = jnp.asarray(
+                        rng.integers(0, 1000, size=(K, Fp, B, 3)), dtype=dt)
+                    nbytes = h.size * h.dtype.itemsize
 
-                def f_psum(x):
-                    return jax.lax.psum(x, "data")
+                    def f_psum(x):
+                        return axis_psum(x, ROW_AXES)
 
-                def f_scat(x):
-                    return jax.lax.psum_scatter(x, "data",
-                                                scatter_dimension=1,
-                                                tiled=True)
+                    def f_scat(x):
+                        return axis_psum_scatter(x, ROW_AXES,
+                                                 scatter_dimension=1,
+                                                 tiled=True)
 
-                fns = {}
-                fns["psum"] = jax.jit(shard_map(
-                    f_psum, mesh=mesh, in_specs=P(), out_specs=P(),
-                    check_vma=False))
-                fns["scatter"] = jax.jit(shard_map(
-                    f_scat, mesh=mesh, in_specs=P(),
-                    out_specs=P(None, "data"), check_vma=False))
-                walls = {}
-                for name, fn in fns.items():
-                    jax.block_until_ready(fn(h))  # compile
-                    t0 = time.time()
-                    for _ in range(reps):
-                        out = fn(h)
-                    jax.block_until_ready(out)
-                    walls[name] = (time.time() - t0) / reps * 1e3
-                mb = 1.0 / (1024 * 1024)
-                print(f"{p:6d} {Fp:5d} {B:4d} {K:3d} {prec:>5s} "
-                      f"{nbytes * mb:8.1f}M "
-                      f"{allreduce_recv_bytes(nbytes, p) * mb:9.1f}M "
-                      f"{reduce_scatter_recv_bytes(nbytes, p) * mb:9.1f}M "
-                      f"{walls['psum']:8.2f} {walls['scatter']:10.2f} "
-                      f"{walls['psum'] / max(walls['scatter'], 1e-9):6.2f}",
-                      flush=True)
+                    fns = {}
+                    fns["psum"] = jax.jit(shard_map(
+                        f_psum, mesh=mesh, in_specs=P(), out_specs=P(),
+                        check_vma=False))
+                    fns["scatter"] = jax.jit(shard_map(
+                        f_scat, mesh=mesh, in_specs=P(),
+                        out_specs=P(None, ROW_AXES), check_vma=False))
+                    walls = {}
+                    for name, fn in fns.items():
+                        jax.block_until_ready(fn(h))  # compile
+                        t0 = time.time()
+                        for _ in range(reps):
+                            out = fn(h)
+                        jax.block_until_ready(out)
+                        walls[name] = (time.time() - t0) / reps * 1e3
+                    ar_ici, ar_dcn = tiered_allreduce_recv_bytes(
+                        nbytes, hosts, d_local)
+                    rs_ici, rs_dcn = tiered_reduce_scatter_recv_bytes(
+                        nbytes, hosts, d_local)
+                    mb = 1.0 / (1024 * 1024)
+                    print(f"{hosts:5d} {p:6d} {Fp:5d} {B:4d} {K:3d} "
+                          f"{prec:>5s} {nbytes * mb:8.1f}M "
+                          f"{ar_ici * mb:8.1f}M {ar_dcn * mb:8.1f}M "
+                          f"{rs_ici * mb:8.1f}M {rs_dcn * mb:8.1f}M "
+                          f"{walls['psum']:8.2f} {walls['scatter']:10.2f} "
+                          f"{walls['psum'] / max(walls['scatter'], 1e-9):6.2f}",
+                          flush=True)
 
 
 def run_retrace(n=20000, f=10, leaves=31, bins=63, iters=3):
@@ -1208,6 +1238,8 @@ def main():
         # keeps the attached accelerator mesh for real ICI numbers
         shard_counts = [int(s) for s in
                         os.environ.get("SHARDS", "2,4,8").split(",")]
+        host_counts = [int(s) for s in
+                       os.environ.get("HOSTS", "1").split(",")]
         if os.environ.get("COMM_BACKEND", "cpu") != "tpu":
             import importlib.util as _ilu
 
@@ -1219,7 +1251,7 @@ def main():
             mod = _ilu.module_from_spec(spec)
             spec.loader.exec_module(mod)
             mod.pin_cpu_backend(force_device_count=max(shard_counts))
-        run_comm_sweep(shard_counts)
+        run_comm_sweep(shard_counts, host_counts=host_counts)
         return
     if arg == "tune":
         run_tune(bins=int(os.environ.get("BINS", 255)))
